@@ -1,0 +1,22 @@
+"""jax_mapping — TPU-native distributed exploration & mapping framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the reference
+ROS 2 stack (rafaelgmv/Distributed-Autonomous-Exploration-and-Mapping):
+occupancy-grid SLAM, correlative scan matching, loop closure, frontier
+exploration, multi-robot fleet scaling, live map serving, and robot control —
+re-designed TPU-first.
+
+Layout (mirrors SURVEY.md §7 build plan):
+  ops/       pure-JAX device kernels (grid fusion, scan match, frontier, pose graph)
+  models/    composed pipelines (SlamModel, FleetModel, explorer policies)
+  parallel/  mesh construction, shard_map fleet step, collectives
+  bridge/    ROS-shaped node graph: messages, pub/sub bus, TF tree, Flask API
+  sim/       simulated Thymio fleet + synthetic LD06 LiDAR
+  io/        checkpoint/resume, trace record/replay
+  utils/     profiling, config/units, testing helpers
+  native/    C++ host-side components (LD06 packet parser/filter)
+"""
+
+__version__ = "0.1.0"
+
+from jax_mapping.config import GridConfig, RobotConfig, SlamConfig  # noqa: F401

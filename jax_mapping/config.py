@@ -1,0 +1,256 @@
+"""Configuration system with explicit units.
+
+The reference carries its tuning as bare module-level constants
+(`/root/reference/server/thymio_project/thymio_project/main.py:17-26`) plus a
+slam_toolbox YAML (`server/thymio_project/config/slam_config.yaml`) and env
+vars. It also carries a famous unit trap: `SPEED_COEFF` differs 100x between
+the server variant (0.0003027, metres) and the pi variant (0.03027,
+centimetres) — see SURVEY.md Appendix B. Here every physical quantity carries
+its unit in the field name, and configs are frozen dataclasses usable as jit
+static arguments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Any, Dict, Optional, Tuple
+
+
+def _frozen(cls):
+    return dataclasses.dataclass(frozen=True)(cls)
+
+
+@_frozen
+class GridConfig:
+    """Occupancy-grid geometry + log-odds sensor model.
+
+    Mirrors the capability surface of slam_toolbox's grid parameters
+    (`/root/reference/server/thymio_project/config/slam_config.yaml:26-27`:
+    resolution 0.05 m, max laser range 12 m), re-expressed for a fixed-shape
+    device-resident log-odds grid.
+    """
+
+    size_cells: int = 4096            # grid is size x size cells, static shape
+    resolution_m: float = 0.05        # metres per cell (slam_config.yaml:26)
+    # Local update patch edge; must satisfy
+    # patch/2 - align_cols/2 >= max_range_m/resolution_m for full coverage.
+    patch_cells: int = 640
+    max_range_m: float = 12.0         # slam_config.yaml:27
+    align_rows: int = 8               # patch-origin alignment (TPU sublane)
+    align_cols: int = 128             # patch-origin alignment (TPU lane)
+    # Log-odds inverse sensor model.
+    logodds_free: float = -0.40       # increment for cells a beam passes through
+    logodds_occ: float = 0.85         # increment for cells a beam terminates in
+    logodds_min: float = -4.0
+    logodds_max: float = 4.0
+    occ_threshold: float = 0.5        # log-odds above which a cell reports occupied
+    free_threshold: float = -0.5      # log-odds below which a cell reports free
+    hit_tolerance_cells: float = 1.0  # half-width of the "occupied" band, in cells
+
+    @property
+    def extent_m(self) -> float:
+        return self.size_cells * self.resolution_m
+
+    @property
+    def origin_m(self) -> Tuple[float, float]:
+        """World coordinate of cell (0, 0)'s corner; grid is centred on (0,0)."""
+        half = self.extent_m / 2.0
+        return (-half, -half)
+
+    @property
+    def max_range_cells(self) -> float:
+        return self.max_range_m / self.resolution_m
+
+
+@_frozen
+class ScanConfig:
+    """LaserScan geometry: the LD06 contract.
+
+    The LD06 spins counterclockwise producing ~360 beams/rotation at ~10 Hz
+    (`/root/reference/pi/src/thymio_project/launch/pi_hardware.launch.py:13-21`,
+    counterclockwise; `BASELINE.md`). Beams pad to a static length. A range
+    reading of exactly 0 is an outlier and is treated as `invalid_range_m`
+    (semantics of `server/.../main.py:152`: `ranges[ranges == 0] = 10.0`).
+    """
+
+    n_beams: int = 360
+    padded_beams: int = 512           # static shape; tail is masked invalid
+    angle_min_rad: float = 0.0
+    angle_increment_rad: float = 2.0 * math.pi / 360.0
+    counterclockwise: bool = True     # pi_hardware.launch.py:20 laser_scan_dir
+    invalid_range_m: float = 10.0     # server/.../main.py:152 outlier clamp
+    range_min_m: float = 0.02
+    range_max_m: float = 12.0
+
+
+@_frozen
+class RobotConfig:
+    """Differential-drive Thymio II model with explicit units.
+
+    Calibration from the reference server brain
+    (`/root/reference/server/thymio_project/thymio_project/main.py:18-26`) and
+    report.pdf §III.D: K_d ~= 0.03027 cm/unit/s == 0.0003027 m/unit/s. The pi
+    variant stores the cm figure (`pi/src/.../main.py:23`) — the 100x trap
+    this config kills by putting the unit in the name.
+    """
+
+    speed_coeff_m_per_unit_s: float = 0.0003027   # server main.py:18 (metres!)
+    wheel_base_m: float = 0.0935                  # ROBOT_WIDTH, main.py:19
+    cruise_speed_units: int = 100                 # ROBOT_SPEED, main.py:21
+    rotation_speed_units: int = 50                # ROTATION_SPEED, main.py:22
+    ir_threshold: int = 1800                      # IR_THRESHOLD, main.py:25
+    lidar_warn_dist_m: float = 0.25               # LIDAR_WARN_DIST, main.py:26
+    lidar_stop_dist_m: float = 0.40               # pi variant stop distance (pi main.py)
+    swerve_inner_units: int = -10                 # inner-wheel target during swerve (main.py:168-175)
+    control_rate_hz: float = 10.0                 # server loop (main.py:60)
+    # Pi variant odometry reads motor *targets* not measured speeds
+    # (pi/src/.../main.py:188-191); the sim models this as first-order lag.
+    motor_lag_tau_s: float = 0.15
+
+    @property
+    def speed_coeff_cm_per_unit_s(self) -> float:
+        return self.speed_coeff_m_per_unit_s * 100.0
+
+
+@_frozen
+class MatcherConfig:
+    """Correlative scan-matcher search windows.
+
+    Capability target of slam_toolbox's matcher as configured by
+    `/root/reference/server/thymio_project/config/slam_config.yaml:51-66`:
+    +-0.5 m translation window, coarse angle +-0.349 rad @ 0.0349,
+    fine angle resolution 0.00349, smear deviation 0.1.
+    """
+
+    search_half_extent_m: float = 0.5         # slam_config.yaml:51
+    coarse_step_m: float = 0.05               # coarse pass at grid resolution
+    fine_step_m: float = 0.01                 # slam_config.yaml:52 fine resolution
+    coarse_angle_half_rad: float = 0.349      # slam_config.yaml:66
+    coarse_angle_step_rad: float = 0.0349     # slam_config.yaml:65
+    fine_angle_step_rad: float = 0.00349      # slam_config.yaml:64
+    smear_cells: int = 2                      # likelihood-field smear radius (yaml:53)
+    min_response: float = 0.1                 # acceptance gate
+    # Gating: only match when moved enough (slam_config.yaml:37-38).
+    min_travel_m: float = 0.1
+    min_heading_rad: float = 0.1
+
+
+@_frozen
+class LoopClosureConfig:
+    """Loop-closure gating, per slam_config.yaml:43-58."""
+
+    enabled: bool = True
+    search_radius_m: float = 3.0              # yaml:44 loop_search_maximum_distance
+    min_chain_size: int = 10                  # yaml:45
+    response_coarse: float = 0.35             # yaml:47
+    response_fine: float = 0.45               # yaml:48
+    loop_window_m: float = 8.0                # yaml:56 loop search space dimension
+    max_poses: int = 1024                     # pose ring-buffer capacity (static)
+    max_edges: int = 4096                     # edge buffer capacity (static)
+    gn_iters: int = 8                         # Gauss-Newton iterations per solve
+    damping: float = 1e-3
+
+
+@_frozen
+class FrontierConfig:
+    """Wavefront frontier exploration (the map-based explorer the reference
+    lists as future work, report.pdf §VI.2; replaces the reactive subsumption
+    navigator at `server/.../main.py:119-196`)."""
+
+    downsample: int = 4               # frontier work at size/downsample resolution
+    max_clusters: int = 64            # static cluster slot count
+    min_cluster_cells: int = 4        # ignore tiny frontiers
+    label_prop_iters: int = 96        # connected-component propagation bound
+    bfs_iters: int = 512              # multi-source cost-to-go bound (coarse cells)
+
+
+@_frozen
+class FleetConfig:
+    """Multi-robot scaling (BASELINE.json configs 4-5: 8-64 simulated Thymios)."""
+
+    n_robots: int = 8
+    batch_scans: int = 16             # scans fused per robot per device step
+    mesh_fleet: int = 1               # devices along the robot/fleet axis
+    mesh_space: int = 1               # devices along the grid-row axis
+
+
+@_frozen
+class SlamConfig:
+    """Top-level bundle; the analog of the reference's whole config surface."""
+
+    grid: GridConfig = GridConfig()
+    scan: ScanConfig = ScanConfig()
+    robot: RobotConfig = RobotConfig()
+    matcher: MatcherConfig = MatcherConfig()
+    loop: LoopClosureConfig = LoopClosureConfig()
+    frontier: FrontierConfig = FrontierConfig()
+    fleet: FleetConfig = FleetConfig()
+    map_publish_period_s: float = 5.0         # slam_config.yaml:25
+    tf_publish_period_s: float = 0.1          # slam_config.yaml:24
+    # README.md:86 / pi/Dockerfile:3: ROS_DOMAIN_ID=42. Read lazily and
+    # defensively so a weird env value can't break package import.
+    domain_id: int = dataclasses.field(default_factory=lambda: _env_domain_id())
+
+    def replace(self, **kw: Any) -> "SlamConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_json(text: str) -> "SlamConfig":
+        raw: Dict[str, Any] = json.loads(text)
+        return SlamConfig(
+            grid=GridConfig(**raw.get("grid", {})),
+            scan=ScanConfig(**raw.get("scan", {})),
+            robot=RobotConfig(**raw.get("robot", {})),
+            matcher=MatcherConfig(**raw.get("matcher", {})),
+            loop=LoopClosureConfig(**raw.get("loop", {})),
+            frontier=FrontierConfig(**raw.get("frontier", {})),
+            fleet=FleetConfig(**raw.get("fleet", {})),
+            **{k: v for k, v in raw.items()
+               if k in ("map_publish_period_s", "tf_publish_period_s", "domain_id")},
+        )
+
+
+def tiny_config(n_robots: int = 2) -> SlamConfig:
+    """Small static shapes for CPU tests and multi-chip dry runs."""
+    return SlamConfig(
+        grid=GridConfig(size_cells=256, patch_cells=128, max_range_m=3.0,
+                        align_rows=8, align_cols=8),
+        scan=ScanConfig(n_beams=90, padded_beams=128, range_max_m=3.0,
+                        angle_increment_rad=2.0 * math.pi / 90.0),
+        matcher=MatcherConfig(search_half_extent_m=0.25),
+        loop=LoopClosureConfig(max_poses=64, max_edges=256, gn_iters=4),
+        frontier=FrontierConfig(downsample=2, max_clusters=16,
+                                label_prop_iters=24, bfs_iters=64),
+        fleet=FleetConfig(n_robots=n_robots, batch_scans=4),
+    )
+
+
+def _env_domain_id() -> int:
+    try:
+        return int(os.environ.get("ROS_DOMAIN_ID", "42"))
+    except ValueError:
+        return 42
+
+
+def sign_extend_16bit(raw):
+    """Thymio motor speeds arrive as unsigned 16-bit; negative speeds wrap.
+
+    Semantics of `server/.../main.py:101-102` (`if rl > 32767: rl -= 65536`),
+    vectorised. Works on python ints and numpy or jax arrays of any integer
+    dtype (including uint16, where the subtraction must not wrap).
+    """
+    import numpy as _np
+    if isinstance(raw, (int, float)):
+        return raw - 65536 if raw > 32767 else raw
+    if isinstance(raw, _np.ndarray):
+        arr = raw.astype(_np.int32)
+        return _np.where(arr > 32767, arr - 65536, arr)
+    import jax.numpy as jnp
+    arr = jnp.asarray(raw).astype(jnp.int32)
+    return jnp.where(arr > 32767, arr - 65536, arr)

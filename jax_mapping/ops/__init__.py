@@ -1,0 +1,1 @@
+"""Pure-JAX device kernels: grid fusion, scan matching, frontiers, pose graph."""

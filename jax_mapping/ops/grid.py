@@ -1,0 +1,292 @@
+"""Log-odds occupancy grid: the TPU-native replacement for slam_toolbox's
+occupancy-grid rasterization.
+
+The reference delegates grid building to slam_toolbox (C++ Karto), configured
+at `/root/reference/server/thymio_project/config/slam_config.yaml:26-27`
+(0.05 m resolution, 12 m max range), and exports ROS `nav_msgs/OccupancyGrid`
+semantics {-1 unknown, 0 free, 100 occupied} which the reference's Flask
+endpoint re-colors for PNG (`server/thymio_project/thymio_project/main.py:259-263`).
+
+TPU-first design — no per-ray Bresenham marching (that is a scalar,
+data-dependent CUDA/CPU idiom). Instead each scan updates a fixed-shape local
+*patch* with a dense inverse sensor model evaluated per cell:
+
+    for every cell in a P x P patch around the robot:
+        r, theta = polar coords of the cell relative to the sensor
+        z        = scan range at the beam covering theta   (gather)
+        cell is FREE     if r < min(z, r_max) - tol
+        cell is OCCUPIED if |r - z| <= tol and the beam actually hit
+        else unchanged
+
+This is embarrassingly cell-parallel (VPU-friendly, no scatter contention —
+SURVEY.md §7 "hard parts": deterministic accumulation comes for free because
+each cell is written exactly once per scan), maps to static shapes, and
+batches over scans with `vmap`. Patches fold into the global grid with
+aligned `dynamic_update_slice` read-modify-writes.
+
+Zero ranges are outliers and treated as `invalid_range_m`
+(`server/.../main.py:152`: `ranges[ranges == 0] = 10.0`). Beam angle
+convention (counterclockwise LD06, `pi_hardware.launch.py:20`) is an explicit,
+tested transform — see SURVEY.md Appendix B on the reference's inverted cone
+indexing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import GridConfig, ScanConfig
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# World <-> grid transforms
+# ---------------------------------------------------------------------------
+
+def world_to_cell(grid: GridConfig, xy: Array) -> Array:
+    """Continuous world metres -> continuous cell coordinates (col, row).
+
+    Grid is centred on world (0, 0); cell (0, 0) corner sits at origin_m.
+    """
+    ox, oy = grid.origin_m
+    origin = jnp.array([ox, oy], dtype=jnp.float32)
+    return (xy - origin) / grid.resolution_m
+
+
+def cell_to_world(grid: GridConfig, cr: Array) -> Array:
+    """Continuous cell coords (col, row) -> world metres of the cell centre
+    when given integer coords + 0.5."""
+    ox, oy = grid.origin_m
+    origin = jnp.array([ox, oy], dtype=jnp.float32)
+    return cr * grid.resolution_m + origin
+
+
+def empty_grid(grid: GridConfig, dtype=jnp.float32) -> Array:
+    """Fresh all-unknown (log-odds 0) grid."""
+    return jnp.zeros((grid.size_cells, grid.size_cells), dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Scan sanitation
+# ---------------------------------------------------------------------------
+
+def sanitize_ranges(scan_cfg: ScanConfig, ranges: Array) -> Tuple[Array, Array]:
+    """Pad-aware range cleanup.
+
+    Returns (ranges_m, hit_mask):
+      * zero readings become `invalid_range_m` (reference outlier rule,
+        `server/.../main.py:152`) and are not hits;
+      * readings beyond range_max or below range_min are not hits (the beam
+        still clears free space up to min(r, max));
+      * padded tail beams (index >= n_beams) are fully ignored.
+    """
+    if ranges.shape[-1] != scan_cfg.padded_beams:
+        raise ValueError(
+            f"scan has {ranges.shape[-1]} beams, config expects padded_beams="
+            f"{scan_cfg.padded_beams}; XLA gather would clamp out-of-bounds "
+            f"beam indices silently and mis-fuse")
+    idx = jnp.arange(ranges.shape[-1])
+    in_beam = idx < scan_cfg.n_beams
+    r = jnp.asarray(ranges, jnp.float32)
+    is_zero = r <= 0.0
+    r = jnp.where(is_zero, scan_cfg.invalid_range_m, r)
+    hit = (~is_zero) & (r >= scan_cfg.range_min_m) & (r <= scan_cfg.range_max_m) & in_beam
+    # Non-hit beams still carve free space out to invalid_range (capped later
+    # by the grid's max_range); padded beams carve nothing.
+    r = jnp.where(in_beam, r, 0.0)
+    return r, hit
+
+
+# ---------------------------------------------------------------------------
+# Patch origin (aligned for TPU lane-friendly dynamic slices)
+# ---------------------------------------------------------------------------
+
+def patch_origin(grid: GridConfig, pose_xy: Array) -> Array:
+    """Integer (row0, col0) of the update patch for a robot at pose_xy.
+
+    Snapped to (sublane, lane)-aligned offsets so the dynamic_update_slice
+    read-modify-write stays tiled; `patch_cells` must satisfy
+    P/2 - align/2 >= max_range_cells for full coverage (the default 640-cell
+    patch covers 12 m at 0.05 m with 128-lane alignment).
+    """
+    ar, ac = grid.align_rows, grid.align_cols
+    cr = world_to_cell(grid, pose_xy)          # (col, row) float
+    col0 = jnp.round((cr[0] - grid.patch_cells / 2) / ac).astype(jnp.int32) * ac
+    row0 = jnp.round((cr[1] - grid.patch_cells / 2) / ar).astype(jnp.int32) * ar
+    hi = grid.size_cells - grid.patch_cells
+    return jnp.stack([jnp.clip(row0, 0, hi), jnp.clip(col0, 0, hi)])
+
+
+# ---------------------------------------------------------------------------
+# Dense inverse sensor model over one patch
+# ---------------------------------------------------------------------------
+
+def classify_patch(grid: GridConfig, scan_cfg: ScanConfig,
+                   ranges: Array, pose: Array, origin_rc: Array) -> Array:
+    """Evaluate the inverse sensor model on every cell of the patch.
+
+    Args:
+      ranges: (padded_beams,) raw ranges in metres (0 == outlier).
+      pose: (3,) [x_m, y_m, yaw_rad] sensor pose in world frame.
+      origin_rc: (2,) int32 [row0, col0] patch origin in the global grid.
+
+    Returns:
+      (P, P) float32 log-odds delta for the patch.
+    """
+    P = grid.patch_cells
+    res = grid.resolution_m
+    r_m, hit = sanitize_ranges(scan_cfg, ranges)
+
+    # Cell centres in world metres.
+    rows = origin_rc[0] + jnp.arange(P, dtype=jnp.int32)
+    cols = origin_rc[1] + jnp.arange(P, dtype=jnp.int32)
+    ox, oy = grid.origin_m
+    ys = (rows.astype(jnp.float32) + 0.5) * res + oy       # (P,)
+    xs = (cols.astype(jnp.float32) + 0.5) * res + ox       # (P,)
+    dx = xs[None, :] - pose[0]                              # (1,P) -> bcast (P,P)
+    dy = ys[:, None] - pose[1]                              # (P,1)
+    r_cell = jnp.sqrt(dx * dx + dy * dy)                    # (P,P) metres
+
+    # Bearing of the cell in the sensor frame, wrapped to [0, 2*pi).
+    theta = jnp.arctan2(dy, dx) - pose[2]
+    if not scan_cfg.counterclockwise:
+        theta = -theta
+    theta = jnp.mod(theta - scan_cfg.angle_min_rad, 2.0 * jnp.pi)
+
+    beam_raw = jnp.round(theta / scan_cfg.angle_increment_rad).astype(jnp.int32)
+    beam = jnp.mod(beam_raw, scan_cfg.n_beams)
+    # For a full-circle scanner the wrap beam_raw == n_beams is beam 0; for a
+    # partial FOV, bearings past the last beam must NOT alias onto real beams
+    # (a cell behind a 180-degree scanner is unobserved, not free).
+    full_circle = abs(scan_cfg.n_beams * scan_cfg.angle_increment_rad
+                      - 2.0 * jnp.pi) < scan_cfg.angle_increment_rad / 2
+    in_fov = True if full_circle else (beam_raw <= scan_cfg.n_beams - 1)
+    z = r_m[beam]                                           # (P,P) gather
+    beam_hit = hit[beam] & in_fov
+
+    tol = grid.hit_tolerance_cells * res
+    max_r = jnp.float32(grid.max_range_m)
+    carve = jnp.minimum(jnp.where(z > 0.0, z, 0.0), max_r)
+    free = (r_cell < carve - tol) & (r_cell > scan_cfg.range_min_m) & in_fov
+    occ = beam_hit & (jnp.abs(r_cell - z) <= tol) & (r_cell <= max_r)
+
+    delta = jnp.where(occ, grid.logodds_occ,
+                      jnp.where(free, grid.logodds_free, 0.0))
+    return delta.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Folding patches into the global grid
+# ---------------------------------------------------------------------------
+
+def apply_patch(grid_cfg: GridConfig, grid_arr: Array, delta: Array,
+                origin_rc: Array, clamp: bool = True) -> Array:
+    """grid[origin:origin+P, ...] += delta, clamped to log-odds bounds."""
+    cur = jax.lax.dynamic_slice(grid_arr, (origin_rc[0], origin_rc[1]),
+                                (grid_cfg.patch_cells, grid_cfg.patch_cells))
+    new = cur + delta
+    if clamp:
+        new = jnp.clip(new, grid_cfg.logodds_min, grid_cfg.logodds_max)
+    return jax.lax.dynamic_update_slice(grid_arr, new, (origin_rc[0], origin_rc[1]))
+
+
+def _classify_batch(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                    ranges_b: Array, poses_b: Array) -> Tuple[Array, Array]:
+    """vmap the inverse sensor model over a batch: (deltas, origins)."""
+    origins = jax.vmap(lambda p: patch_origin(grid_cfg, p[:2]))(poses_b)
+    deltas = jax.vmap(
+        lambda r, p, o: classify_patch(grid_cfg, scan_cfg, r, p, o)
+    )(ranges_b, poses_b, origins)
+    return deltas, origins
+
+
+def _fold(grid_cfg: GridConfig, grid_arr: Array, deltas: Array,
+          origins: Array, clamp: bool) -> Array:
+    """Sequentially apply patches (exact under overlap; no scatter)."""
+    def body(g, do):
+        delta, origin = do
+        return apply_patch(grid_cfg, g, delta, origin, clamp=clamp), None
+
+    out, _ = jax.lax.scan(body, grid_arr, (deltas, origins))
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def fuse_scan(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+              grid_arr: Array, ranges: Array, pose: Array) -> Array:
+    """Fuse a single scan (the minimum end-to-end kernel)."""
+    origin = patch_origin(grid_cfg, pose[:2])
+    delta = classify_patch(grid_cfg, scan_cfg, ranges, pose, origin)
+    return apply_patch(grid_cfg, grid_arr, delta, origin)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def fuse_scans(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+               grid_arr: Array, ranges_b: Array, poses_b: Array) -> Array:
+    """Fuse a batch of B scans into the grid.
+
+    Classification is batched (vmap — fully parallel); the fold is a
+    sequential `scan` of aligned read-modify-writes, which keeps overlapping
+    patches exact (SURVEY.md §7 "scatter contention" without the scatter).
+
+    Args:
+      ranges_b: (B, padded_beams) metres.
+      poses_b:  (B, 3) [x, y, yaw].
+    """
+    deltas, origins = _classify_batch(grid_cfg, scan_cfg, ranges_b, poses_b)
+    return _fold(grid_cfg, grid_arr, deltas, origins, clamp=True)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def scan_deltas_full(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                     ranges_b: Array, poses_b: Array) -> Array:
+    """Batch of scans -> one full-size log-odds delta grid (no clamp).
+
+    Used by the multi-robot merge path: per-robot deltas are `psum`-merged
+    across the fleet mesh axis before a single clamped apply (parallel/fleet).
+    """
+    deltas, origins = _classify_batch(grid_cfg, scan_cfg, ranges_b, poses_b)
+    zero = jnp.zeros((grid_cfg.size_cells, grid_cfg.size_cells), jnp.float32)
+    return _fold(grid_cfg, zero, deltas, origins, clamp=False)
+
+
+def merge_delta(grid_cfg: GridConfig, grid_arr: Array, delta_full: Array) -> Array:
+    """Apply a full-size delta (e.g. the psum of a fleet's deltas)."""
+    return jnp.clip(grid_arr + delta_full, grid_cfg.logodds_min,
+                    grid_cfg.logodds_max)
+
+
+# ---------------------------------------------------------------------------
+# Export: ROS OccupancyGrid semantics
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def to_occupancy(grid_cfg: GridConfig, grid_arr: Array) -> Array:
+    """Log-odds -> int8 {-1 unknown, 0 free, 100 occupied}.
+
+    The nav_msgs/OccupancyGrid contract the reference's map consumer reads
+    (`server/.../main.py:259-263` maps 0->255 free, 100->0 occupied,
+    else 127 unknown for PNG).
+    """
+    occ = grid_arr > grid_cfg.occ_threshold
+    free = grid_arr < grid_cfg.free_threshold
+    return jnp.where(occ, jnp.int8(100),
+                     jnp.where(free, jnp.int8(0), jnp.int8(-1)))
+
+
+def occupancy_to_png_array(occ_int8) -> "np.ndarray":  # noqa: F821
+    """int8 occupancy -> uint8 grayscale image array, reference PNG semantics:
+    127 unknown, 255 free, 0 occupied, flipud for image coords
+    (`server/.../main.py:256-266`). Host-side numpy; the device hands off the
+    int8 grid once, then this is pure PIL-ready bytes."""
+    import numpy as np
+    data = np.asarray(occ_int8, dtype=np.int8)
+    img = np.full(data.shape, 127, dtype=np.uint8)
+    img[data == 0] = 255
+    img[data == 100] = 0
+    return np.flipud(img)

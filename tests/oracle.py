@@ -1,0 +1,91 @@
+"""Pure-NumPy reference implementations (oracles) for the JAX kernels.
+
+Deliberately written in the naive per-ray / per-cell style so they are easy
+to audit against the textbook inverse sensor model and the reference's
+behavioral contracts, and slow enough that nobody mistakes them for the
+product."""
+
+import math
+
+import numpy as np
+
+
+def sanitize_ranges_np(scan_cfg, ranges):
+    r = np.asarray(ranges, np.float64).copy()
+    n = scan_cfg.padded_beams
+    idx = np.arange(n)
+    in_beam = idx < scan_cfg.n_beams
+    is_zero = r <= 0.0
+    r[is_zero] = scan_cfg.invalid_range_m
+    hit = (~is_zero) & (r >= scan_cfg.range_min_m) & \
+        (r <= scan_cfg.range_max_m) & in_beam
+    r[~in_beam] = 0.0
+    return r, hit
+
+
+def classify_patch_np(grid, scan_cfg, ranges, pose, origin_rc):
+    """Cell-by-cell inverse sensor model, mirroring ops.grid.classify_patch."""
+    P = grid.patch_cells
+    res = grid.resolution_m
+    r_m, hit = sanitize_ranges_np(scan_cfg, ranges)
+    ox, oy = grid.origin_m
+    out = np.zeros((P, P), np.float32)
+    tol = grid.hit_tolerance_cells * res
+    for i in range(P):
+        for j in range(P):
+            y = (origin_rc[0] + i + 0.5) * res + oy
+            x = (origin_rc[1] + j + 0.5) * res + ox
+            dx, dy = x - pose[0], y - pose[1]
+            r_cell = math.hypot(dx, dy)
+            theta = math.atan2(dy, dx) - pose[2]
+            if not scan_cfg.counterclockwise:
+                theta = -theta
+            theta = (theta - scan_cfg.angle_min_rad) % (2 * math.pi)
+            beam = int(round(theta / scan_cfg.angle_increment_rad)) % scan_cfg.n_beams
+            z = r_m[beam]
+            carve = min(z if z > 0 else 0.0, grid.max_range_m)
+            if hit[beam] and abs(r_cell - z) <= tol and r_cell <= grid.max_range_m:
+                out[i, j] = grid.logodds_occ
+            elif scan_cfg.range_min_m < r_cell < carve - tol:
+                out[i, j] = grid.logodds_free
+    return out
+
+
+def raycast_scan_np(world_occ, pose, n_beams, angle_increment, max_range, res):
+    """Ground-truth LiDAR: march each beam through a boolean occupancy image
+    (row-major, row=y/res, col=x/res, origin centred) until it hits."""
+    H, W = world_occ.shape
+    out = np.zeros(n_beams, np.float64)
+    step = res * 0.25
+    for b in range(n_beams):
+        a = pose[2] + b * angle_increment
+        ca, sa = math.cos(a), math.sin(a)
+        r = 0.0
+        hit = 0.0
+        while r < max_range:
+            x = pose[0] + r * ca
+            y = pose[1] + r * sa
+            col = int(x / res + W / 2)
+            row = int(y / res + H / 2)
+            if not (0 <= row < H and 0 <= col < W):
+                break
+            if world_occ[row, col]:
+                hit = r
+                break
+            r += step
+        out[b] = hit
+    return out
+
+
+def rk2_odometry_np(robot_cfg, x, y, yaw, left_units, right_units, dt):
+    """Reference odometry math (`server/.../main.py:104-115`): differential
+    drive with 2nd-order Runge-Kutta midpoint integration."""
+    vl = left_units * robot_cfg.speed_coeff_m_per_unit_s
+    vr = right_units * robot_cfg.speed_coeff_m_per_unit_s
+    v_lin = (vr + vl) / 2.0
+    v_ang = (vr - vl) / robot_cfg.wheel_base_m
+    delta_th = v_ang * dt
+    mid = yaw + delta_th / 2.0
+    return (x + v_lin * math.cos(mid) * dt,
+            y + v_lin * math.sin(mid) * dt,
+            yaw + delta_th)

@@ -1,0 +1,154 @@
+"""Grid kernel tests: transforms, inverse sensor model vs NumPy oracle,
+fusion semantics, occupancy export, PNG contract."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from jax_mapping.ops import grid as G
+from tests.oracle import classify_patch_np
+
+
+def test_world_cell_roundtrip(tiny_cfg):
+    g = tiny_cfg.grid
+    pts = np.array([[0.0, 0.0], [1.0, -2.0], [-3.0, 0.5]], np.float32)
+    cells = np.asarray(G.world_to_cell(g, jnp.asarray(pts)))
+    back = np.asarray(G.cell_to_world(g, jnp.asarray(cells)))
+    np.testing.assert_allclose(back, pts, atol=1e-5)
+    # World (0,0) lands at the grid centre.
+    c = np.asarray(G.world_to_cell(g, jnp.zeros(2)))
+    assert np.allclose(c, g.size_cells / 2)
+
+
+def test_sanitize_ranges_reference_semantics(tiny_cfg):
+    s = tiny_cfg.scan
+    ranges = np.zeros(s.padded_beams, np.float32)
+    ranges[:s.n_beams] = 1.5
+    ranges[3] = 0.0          # outlier -> invalid_range, not a hit
+    ranges[7] = 100.0        # beyond max -> carves but no hit
+    r, hit = G.sanitize_ranges(s, jnp.asarray(ranges))
+    r, hit = np.asarray(r), np.asarray(hit)
+    assert r[3] == pytest.approx(s.invalid_range_m)  # main.py:152 rule
+    assert not hit[3]
+    assert not hit[7]
+    assert hit[0] and r[0] == pytest.approx(1.5)
+    assert not hit[s.n_beams:].any()
+    assert (r[s.n_beams:] == 0).all()
+
+
+def test_patch_origin_alignment_and_coverage(tiny_cfg):
+    g = tiny_cfg.grid
+    o = np.asarray(G.patch_origin(g, jnp.array([0.3, -0.7])))
+    assert o[0] % g.align_rows == 0 and o[1] % g.align_cols == 0
+    # Robot must sit well inside the patch.
+    cr = np.asarray(G.world_to_cell(g, jnp.array([0.3, -0.7])))
+    max_c = g.max_range_m / g.resolution_m
+    assert o[1] <= cr[0] - max_c + g.align_cols and \
+        cr[0] + max_c - g.align_cols <= o[1] + g.patch_cells
+    # Clipped at grid edges.
+    o_edge = np.asarray(G.patch_origin(g, jnp.array([-100.0, 100.0])))
+    assert 0 <= o_edge[0] <= g.size_cells - g.patch_cells
+    assert 0 <= o_edge[1] <= g.size_cells - g.patch_cells
+
+
+def test_classify_patch_matches_oracle(tiny_cfg, rng):
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    ranges = rng.uniform(0.3, 2.5, s.padded_beams).astype(np.float32)
+    ranges[5] = 0.0
+    ranges[40] = 50.0
+    pose = np.array([0.42, -0.31, 0.7], np.float32)
+    origin = np.asarray(G.patch_origin(g, jnp.asarray(pose[:2])))
+    got = np.asarray(G.classify_patch(g, s, jnp.asarray(ranges),
+                                      jnp.asarray(pose), jnp.asarray(origin)))
+    want = classify_patch_np(g, s, ranges, pose, origin)
+    # Beam-index rounding at cell-bearing boundaries can differ by one ulp;
+    # demand exact agreement on >99.8% of cells and zero large deviations.
+    agree = np.mean(got == want)
+    assert agree > 0.998, f"only {agree:.4f} of cells agree with oracle"
+    assert np.abs(got - want).max() <= g.logodds_occ - g.logodds_free + 1e-6
+
+
+def test_classify_patch_geometry(tiny_cfg):
+    """Property test: the cell at each beam endpoint is occupied, cells along
+    the beam are free, cells beyond are untouched."""
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    ranges = np.zeros(s.padded_beams, np.float32)
+    ranges[:s.n_beams] = 2.0
+    pose = np.array([0.0, 0.0, 0.0], np.float32)
+    origin = np.asarray(G.patch_origin(g, jnp.zeros(2)))
+    delta = np.asarray(G.classify_patch(g, s, jnp.asarray(ranges),
+                                        jnp.asarray(pose), jnp.asarray(origin)))
+    res = g.resolution_m
+
+    def cell_of(x, y):
+        col = int((x - g.origin_m[0]) / res) - origin[1]
+        row = int((y - g.origin_m[1]) / res) - origin[0]
+        return row, col
+
+    for ang_deg in (0, 45, 90, 200, 315):
+        a = math.radians(ang_deg)
+        # Endpoint occupied.
+        r, c = cell_of(2.0 * math.cos(a), 2.0 * math.sin(a))
+        assert delta[r, c] == pytest.approx(g.logodds_occ), ang_deg
+        # Midpoint free.
+        r, c = cell_of(1.0 * math.cos(a), 1.0 * math.sin(a))
+        assert delta[r, c] == pytest.approx(g.logodds_free), ang_deg
+        # Beyond endpoint untouched.
+        r, c = cell_of(2.6 * math.cos(a), 2.6 * math.sin(a))
+        assert delta[r, c] == pytest.approx(0.0), ang_deg
+
+
+def test_fuse_batch_equals_sequential(tiny_cfg, rng):
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    B = 5
+    ranges = rng.uniform(0.3, 2.8, (B, s.padded_beams)).astype(np.float32)
+    poses = np.stack([rng.uniform(-0.5, 0.5, B), rng.uniform(-0.5, 0.5, B),
+                      rng.uniform(-3, 3, B)], axis=1).astype(np.float32)
+    grid0 = G.empty_grid(g)
+    seq = grid0
+    for i in range(B):
+        seq = G.fuse_scan(g, s, seq, jnp.asarray(ranges[i]), jnp.asarray(poses[i]))
+    bat = G.fuse_scans(g, s, grid0, jnp.asarray(ranges), jnp.asarray(poses))
+    np.testing.assert_allclose(np.asarray(seq), np.asarray(bat), atol=1e-6)
+
+
+def test_fuse_clamps_logodds(tiny_cfg):
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    ranges = np.full((40, s.padded_beams), 1.0, np.float32)
+    poses = np.zeros((40, 3), np.float32)
+    out = np.asarray(G.fuse_scans(g, s, G.empty_grid(g),
+                                  jnp.asarray(ranges), jnp.asarray(poses)))
+    assert out.max() <= g.logodds_max + 1e-6
+    assert out.min() >= g.logodds_min - 1e-6
+    assert out.max() == pytest.approx(g.logodds_max)   # saturated hits
+    assert out.min() == pytest.approx(g.logodds_min)   # saturated free space
+
+
+def test_scan_deltas_full_matches_fuse(tiny_cfg, rng):
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    B = 3
+    ranges = rng.uniform(0.5, 2.5, (B, s.padded_beams)).astype(np.float32)
+    poses = np.zeros((B, 3), np.float32)
+    delta = G.scan_deltas_full(g, s, jnp.asarray(ranges), jnp.asarray(poses))
+    merged = G.merge_delta(g, G.empty_grid(g), delta)
+    direct = G.fuse_scans(g, s, G.empty_grid(g), jnp.asarray(ranges),
+                          jnp.asarray(poses))
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(direct), atol=1e-5)
+
+
+def test_occupancy_export_and_png(tiny_cfg):
+    g = tiny_cfg.grid
+    arr = np.zeros((g.size_cells, g.size_cells), np.float32)
+    arr[10, 10] = 2.0    # occupied
+    arr[20, 20] = -2.0   # free
+    occ = np.asarray(G.to_occupancy(g, jnp.asarray(arr)))
+    assert occ[10, 10] == 100 and occ[20, 20] == 0 and occ[0, 0] == -1
+    img = G.occupancy_to_png_array(occ)
+    H = g.size_cells
+    # Reference PNG contract (main.py:259-266): 0->255, 100->0, else 127, flipud.
+    assert img[H - 1 - 10, 10] == 0
+    assert img[H - 1 - 20, 20] == 255
+    assert img[H - 1, 0] == 127
