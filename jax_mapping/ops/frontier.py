@@ -1,0 +1,287 @@
+"""Wavefront frontier detection, clustering, and fleet assignment on device.
+
+The reference explores *reactively* — a 3-layer subsumption navigator
+(`/root/reference/server/thymio_project/thymio_project/main.py:119-196`) —
+and its report lists map-based frontier exploration as future work
+(report.pdf §VI.2). This module supplies that capability as fixed-shape
+array programs (the BASELINE.json north star: p50 frontier recompute < 5 ms
+at 64 robots):
+
+  * frontier mask: free cells 4-adjacent to unknown — pure shifts;
+  * clustering: connected components by iterated 8-neighbour label
+    propagation (bounded iterations, no data-dependent recursion);
+  * cluster summarisation into a static number of slots via one-hot
+    matmuls (MXU) instead of host-side dictionaries;
+  * assignment: per-robot cost = distance to cluster centroid through a
+    multi-source BFS cost-to-go field (obstacle-aware), greedily auctioned
+    on device with `lax.scan` over robots.
+
+All work runs at a downsampled resolution (cfg.downsample) — the same
+work-bounding idea slam_toolbox applies with its correlative windows
+(SURVEY.md §5 "long-context" analog).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import FrontierConfig, GridConfig
+
+Array = jax.Array
+
+_BIG = jnp.float32(1e9)
+
+
+class FrontierResult(NamedTuple):
+    mask: Array            # (n, n) bool frontier cells (coarse resolution)
+    labels: Array          # (n, n) int32 cluster label per cell (-1 none)
+    centroids: Array       # (K, 2) float32 world-metre centroids
+    sizes: Array           # (K,) int32 cells per cluster (0 = empty slot)
+    assignment: Array      # (R,) int32 cluster index per robot (-1 = none)
+    costs: Array           # (R, K) float32 robot->cluster travel cost (cells)
+
+
+# ---------------------------------------------------------------------------
+# Downsample + frontier mask
+# ---------------------------------------------------------------------------
+
+def coarsen(cfg: FrontierConfig, grid_cfg: GridConfig, logodds: Array):
+    """Full-res log-odds -> coarse (free, occupied, unknown) masks.
+
+    A coarse cell is occupied if ANY child is occupied (conservative for
+    planning), free if any child is free and none occupied, else unknown.
+    """
+    d = cfg.downsample
+    n = grid_cfg.size_cells // d
+    x = logodds.reshape(n, d, n, d)
+    any_occ = (x > grid_cfg.occ_threshold).any(axis=(1, 3))
+    any_free = (x < grid_cfg.free_threshold).any(axis=(1, 3))
+    free = any_free & ~any_occ
+    unknown = ~any_occ & ~any_free
+    return free, any_occ, unknown
+
+
+def _shift(x: Array, dr: int, dc: int, fill=False) -> Array:
+    """Shift a 2D bool/float array, filling vacated cells."""
+    out = jnp.full_like(x, fill)
+    H, W = x.shape
+    src = x[max(0, -dr):H - max(0, dr), max(0, -dc):W - max(0, dc)]
+    return jax.lax.dynamic_update_slice(out, src, (max(0, dr), max(0, dc)))
+
+
+def frontier_mask(free: Array, unknown: Array) -> Array:
+    """Free cells with a 4-neighbour unknown cell: the classic frontier."""
+    near_unknown = (_shift(unknown, 1, 0) | _shift(unknown, -1, 0)
+                    | _shift(unknown, 0, 1) | _shift(unknown, 0, -1))
+    return free & near_unknown
+
+
+# ---------------------------------------------------------------------------
+# Connected-component clustering by label propagation
+# ---------------------------------------------------------------------------
+
+def label_components(cfg: FrontierConfig, mask: Array) -> Array:
+    """8-connected components: every frontier cell takes the max linear index
+    reachable within its component. Bounded iteration count, early exit via
+    `lax.while_loop` on convergence (SURVEY.md §7: frontier BFS is
+    data-dependent -> fixed-bound loop)."""
+    n = mask.shape[0]
+    seed = jnp.where(mask,
+                     jnp.arange(n * n, dtype=jnp.int32).reshape(n, n),
+                     jnp.int32(-1))
+
+    def neighbor_max(lab):
+        best = lab
+        for dr in (-1, 0, 1):
+            for dc in (-1, 0, 1):
+                if dr == 0 and dc == 0:
+                    continue
+                best = jnp.maximum(best, _shift(lab, dr, dc, fill=-1))
+        return jnp.where(mask, best, -1)
+
+    def cond(state):
+        lab, prev, it = state
+        return (it < cfg.label_prop_iters) & jnp.any(lab != prev)
+
+    def body(state):
+        lab, _, it = state
+        # Two sweeps per iteration: label propagation is O(diameter), the
+        # doubled sweep halves the bound.
+        nxt = neighbor_max(neighbor_max(lab))
+        return nxt, lab, it + 1
+
+    lab, _, _ = jax.lax.while_loop(
+        cond, body, (neighbor_max(seed), seed, jnp.int32(0)))
+    return lab
+
+
+def summarize_clusters(cfg: FrontierConfig, grid_cfg: GridConfig,
+                       labels: Array) -> tuple[Array, Array, Array]:
+    """Compress arbitrary labels into K static slots (top-K by size).
+
+    Returns (centroids_world (K,2), sizes (K,), slot_of_cell (n,n) int32).
+    One-hot reductions keep this on the MXU; slots beyond the true cluster
+    count have size 0 and centroid at _BIG.
+    """
+    n = labels.shape[0]
+    K = cfg.max_clusters
+    flat = labels.reshape(-1)
+    present = flat >= 0
+
+    # Unique labels -> the K largest clusters, via a bincount-free trick:
+    # a cluster's label is the max linear index in it, so cells whose own
+    # linear index equals their label are cluster representatives.
+    lin = jnp.arange(n * n, dtype=jnp.int32)
+    is_rep = present & (flat == lin)
+    # Cluster size per representative: count cells sharing its label.
+    # segment_sum over labels (clamped for the -1s).
+    sizes_by_cell = jax.ops.segment_sum(
+        present.astype(jnp.int32), jnp.clip(flat, 0), num_segments=n * n)
+    rep_sizes = jnp.where(is_rep, sizes_by_cell[lin], 0)
+    rep_sizes = jnp.where(rep_sizes >= cfg.min_cluster_cells, rep_sizes, 0)
+
+    # Top-K representative linear indices by size.
+    top_sizes, top_idx = jax.lax.top_k(rep_sizes, K)       # (K,)
+    slot_valid = top_sizes > 0
+
+    # Map every cell to its slot (or -1).
+    slot_of_label = jnp.full((n * n,), -1, jnp.int32)
+    slot_of_label = slot_of_label.at[top_idx].set(
+        jnp.where(slot_valid, jnp.arange(K, dtype=jnp.int32), -1))
+    slot_of_cell = jnp.where(present, slot_of_label[jnp.clip(flat, 0)], -1)
+
+    # Centroids via segment sums over slots.
+    rows = (lin // n).astype(jnp.float32)
+    cols = (lin % n).astype(jnp.float32)
+    sel = slot_of_cell >= 0
+    seg = jnp.clip(slot_of_cell, 0)
+    cnt = jax.ops.segment_sum(sel.astype(jnp.float32), seg, num_segments=K)
+    sr = jax.ops.segment_sum(jnp.where(sel, rows, 0.0), seg, num_segments=K)
+    sc = jax.ops.segment_sum(jnp.where(sel, cols, 0.0), seg, num_segments=K)
+    cnt_safe = jnp.maximum(cnt, 1.0)
+    c_row = sr / cnt_safe
+    c_col = sc / cnt_safe
+
+    d = cfg.downsample
+    res = grid_cfg.resolution_m * d
+    ox, oy = grid_cfg.origin_m
+    cx = (c_col + 0.5) * res + ox
+    cy = (c_row + 0.5) * res + oy
+    centroids = jnp.where(slot_valid[:, None],
+                          jnp.stack([cx, cy], -1), _BIG)
+    return centroids, top_sizes.astype(jnp.int32), \
+        slot_of_cell.reshape(n, n)
+
+
+# ---------------------------------------------------------------------------
+# Obstacle-aware cost-to-go (multi-source BFS as min-plus dilation)
+# ---------------------------------------------------------------------------
+
+def cost_to_go(cfg: FrontierConfig, passable: Array, seeds_rc: Array,
+               seed_valid: Array) -> Array:
+    """Distance field (in coarse cells) from a robot's cell through passable
+    space, by bounded min-plus dilation with early exit. seeds_rc: (S, 2).
+    """
+    n = passable.shape[0]
+    dist = jnp.full((n, n), _BIG)
+    rr = jnp.clip(seeds_rc[:, 0], 0, n - 1)
+    cc = jnp.clip(seeds_rc[:, 1], 0, n - 1)
+    dist = dist.at[rr, cc].min(jnp.where(seed_valid, 0.0, _BIG))
+    blocked = ~passable
+    # A robot hugging a wall can land in a conservatively-occupied coarse
+    # cell; its seed must stay traversable or the whole field becomes _BIG
+    # and the robot silently loses all frontier assignments.
+    blocked = blocked.at[rr, cc].set(jnp.where(seed_valid, False, blocked[rr, cc]))
+
+    sq2 = jnp.float32(1.41421356)
+
+    def relax(dm):
+        best = dm
+        for dr, dc, w in ((1, 0, 1.0), (-1, 0, 1.0), (0, 1, 1.0), (0, -1, 1.0),
+                          (1, 1, sq2), (1, -1, sq2), (-1, 1, sq2), (-1, -1, sq2)):
+            best = jnp.minimum(best, _shift(dm, dr, dc, fill=_BIG) + w)
+        return jnp.where(blocked, _BIG, best)
+
+    def cond(state):
+        dm, prev, it = state
+        return (it < cfg.bfs_iters) & jnp.any(dm != prev)
+
+    def body(state):
+        dm, _, it = state
+        # Doubled sweep, same rationale as label propagation.
+        nxt = relax(relax(dm))
+        return nxt, dm, it + 1
+
+    out, _, _ = jax.lax.while_loop(
+        cond, body, (relax(jnp.where(blocked, _BIG, dist)), dist, jnp.int32(0)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fleet assignment
+# ---------------------------------------------------------------------------
+
+def assign_frontiers(costs: Array) -> Array:
+    """Greedy auction: robots claim their cheapest cluster; a cluster serves
+    one robot until every (valid) cluster is taken, then re-opens (more
+    robots than frontiers -> sharing). costs: (R, K) with _BIG invalid.
+    Returns (R,) int32 cluster per robot, -1 if no reachable cluster."""
+    R, K = costs.shape
+
+    def claim(taken, r):
+        c = jnp.where(taken, costs[r] + 1e6, costs[r])   # prefer untaken
+        best = jnp.argmin(c)
+        ok = c[best] < _BIG
+        taken = taken.at[best].set(taken[best] | ok)
+        return taken, jnp.where(ok, best.astype(jnp.int32), -1)
+
+    _, out = jax.lax.scan(claim, jnp.zeros(K, bool), jnp.arange(R))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Full pipeline
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def compute_frontiers(cfg: FrontierConfig, grid_cfg: GridConfig,
+                      logodds: Array, robot_poses: Array) -> FrontierResult:
+    """logodds (N,N) + robot poses (R,3) -> frontiers, clusters, assignment."""
+    free, occ, unknown = coarsen(cfg, grid_cfg, logodds)
+    mask = frontier_mask(free, unknown)
+    labels = label_components(cfg, mask)
+    centroids, sizes, slots = summarize_clusters(cfg, grid_cfg, labels)
+
+    # Per-robot obstacle-aware cost to each cluster centroid.
+    d = cfg.downsample
+    res = grid_cfg.resolution_m * d
+    ox, oy = grid_cfg.origin_m
+    passable = free | mask | unknown   # robots may push into unknown space
+
+    cent_r = jnp.clip(((centroids[:, 1] - oy) / res).astype(jnp.int32),
+                      0, free.shape[0] - 1)
+    cent_c = jnp.clip(((centroids[:, 0] - ox) / res).astype(jnp.int32),
+                      0, free.shape[0] - 1)
+
+    if cfg.obstacle_aware:
+        def robot_costs(pose):
+            rc = jnp.stack([((pose[1] - oy) / res).astype(jnp.int32),
+                            ((pose[0] - ox) / res).astype(jnp.int32)])[None, :]
+            dist = cost_to_go(cfg, passable, rc, jnp.array([True]))
+            return dist[cent_r, cent_c]
+
+        costs = jax.vmap(robot_costs)(robot_poses)        # (R, K)
+    else:
+        # Euclidean centroid distance in coarse cells (latency mode).
+        diff = centroids[None, :, :] - robot_poses[:, None, :2]
+        costs = jnp.linalg.norm(diff, axis=-1) / res
+        costs = jnp.where(jnp.isfinite(costs), costs, _BIG)
+        costs = jnp.minimum(costs, _BIG)
+    costs = jnp.where((sizes > 0)[None, :], costs, _BIG)
+    assignment = assign_frontiers(costs)
+    return FrontierResult(mask=mask, labels=labels, centroids=centroids,
+                          sizes=sizes, assignment=assignment, costs=costs)

@@ -1,0 +1,106 @@
+"""Differential-drive odometry as JAX kernels.
+
+Re-implements the reference's dead-reckoning math — differential drive with
+2nd-order Runge-Kutta midpoint integration
+(`/root/reference/server/thymio_project/thymio_project/main.py:104-115`,
+report.pdf §III.D eqs. (3)-(6)) — as pure functions: a single step, a
+`lax.scan` trajectory integrator, and a batched fleet version. Wheel speeds
+arrive in raw Thymio units; the 16-bit sign fix
+(`server/.../main.py:101-102`) lives in `config.sign_extend_16bit` and is
+applied at the ingest edge, not here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import RobotConfig
+
+Array = jax.Array
+
+
+def wheel_velocities(robot: RobotConfig, left_units: Array,
+                     right_units: Array) -> tuple[Array, Array]:
+    """Raw speed units -> (v_lin m/s, v_ang rad/s)."""
+    vl = left_units * robot.speed_coeff_m_per_unit_s
+    vr = right_units * robot.speed_coeff_m_per_unit_s
+    v_lin = (vr + vl) / 2.0
+    v_ang = (vr - vl) / robot.wheel_base_m
+    return v_lin, v_ang
+
+
+def rk2_step(robot: RobotConfig, pose: Array, left_units: Array,
+             right_units: Array, dt: Array) -> Array:
+    """One RK2-midpoint odometry update. pose = [x, y, yaw]."""
+    v_lin, v_ang = wheel_velocities(robot, left_units, right_units)
+    delta_th = v_ang * dt
+    mid = pose[2] + delta_th / 2.0
+    return jnp.stack([
+        pose[0] + v_lin * jnp.cos(mid) * dt,
+        pose[1] + v_lin * jnp.sin(mid) * dt,
+        pose[2] + delta_th,
+    ])
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def integrate(robot: RobotConfig, pose0: Array, left_units: Array,
+              right_units: Array, dts: Array) -> Array:
+    """Integrate a whole wheel-speed log -> (T, 3) trajectory of poses
+    *after* each step. `lax.scan` keeps the sequential dependence on-device
+    with static shapes."""
+    def body(pose, lrdt):
+        l, r, dt = lrdt
+        nxt = rk2_step(robot, pose, l, r, dt)
+        return nxt, nxt
+
+    _, traj = jax.lax.scan(body, pose0, (left_units, right_units, dts))
+    return traj
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def integrate_fleet(robot: RobotConfig, poses0: Array, left_units: Array,
+                    right_units: Array, dts: Array) -> Array:
+    """vmap over a robot axis: (R,3), (R,T), (R,T), (R,T) -> (R,T,3)."""
+    return jax.vmap(lambda p, l, r, d: integrate(robot, p, l, r, d))(
+        poses0, left_units, right_units, dts)
+
+
+def twist_to_wheel_units(robot: RobotConfig, v_lin_mps: Array,
+                         v_ang_radps: Array) -> tuple[Array, Array]:
+    """Inverse kinematics for the teleop path (`geometry_msgs/Twist` ->
+    motor targets; capability of the reference's joystick teleop config,
+    `server/install/.../config/joystick.yaml`)."""
+    vr = v_lin_mps + v_ang_radps * robot.wheel_base_m / 2.0
+    vl = v_lin_mps - v_ang_radps * robot.wheel_base_m / 2.0
+    k = robot.speed_coeff_m_per_unit_s
+    return vl / k, vr / k
+
+
+def pose_compose(a: Array, b: Array) -> Array:
+    """SE(2) composition a ⊕ b (b expressed in a's frame)."""
+    ca, sa = jnp.cos(a[..., 2]), jnp.sin(a[..., 2])
+    return jnp.stack([
+        a[..., 0] + ca * b[..., 0] - sa * b[..., 1],
+        a[..., 1] + sa * b[..., 0] + ca * b[..., 1],
+        a[..., 2] + b[..., 2],
+    ], axis=-1)
+
+
+def pose_between(a: Array, b: Array) -> Array:
+    """SE(2) relative pose a ⊖ b: the transform taking a to b, in a's frame."""
+    ca, sa = jnp.cos(a[..., 2]), jnp.sin(a[..., 2])
+    dx = b[..., 0] - a[..., 0]
+    dy = b[..., 1] - a[..., 1]
+    return jnp.stack([
+        ca * dx + sa * dy,
+        -sa * dx + ca * dy,
+        wrap_angle(b[..., 2] - a[..., 2]),
+    ], axis=-1)
+
+
+def wrap_angle(theta: Array) -> Array:
+    """Wrap to (-pi, pi]."""
+    return jnp.arctan2(jnp.sin(theta), jnp.cos(theta))

@@ -1,0 +1,190 @@
+"""Pose-graph SLAM back-end on device: fixed-shape graph, Gauss-Newton solve.
+
+The reference gets loop closure from slam_toolbox's Karto pose graph + SPA
+solver, gated by `/root/reference/server/thymio_project/config/slam_config.yaml:43-48`
+(loop search 3 m, chain >= 10, response gates 0.35/0.45). That C++ graph is
+unbounded and pointer-based; the TPU-native design is a *fixed-capacity* ring
+of poses and edges (static shapes, SURVEY.md §7 "loop-closure corrections
+mutate history"), with the linear algebra done densely on the MXU:
+
+  * the Jacobian is materialised as one dense (3E x 3N) matrix via a single
+    scatter of per-edge 3x6 blocks,
+  * the normal equations H = J^T W J are one matmul,
+  * the damped solve is a Cholesky factorisation,
+  * invalid pose/edge slots carry zero weight, so capacity padding is free.
+
+Map repair after a closure is not an incremental patch dance like Karto's:
+the whole occupancy grid is simply re-fused from the optimised trajectory
+and the stored scan ring (`ops.grid.fuse_scans`) — cheap on TPU, exact by
+construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import LoopClosureConfig
+from jax_mapping.ops.odometry import pose_between, wrap_angle
+
+Array = jax.Array
+
+
+class PoseGraph(NamedTuple):
+    """Fixed-capacity pose graph; all shapes static."""
+    poses: Array        # (N, 3) world poses
+    pose_valid: Array   # (N,) bool
+    n_poses: Array      # () int32 next free slot
+    edge_ij: Array      # (E, 2) int32 endpoints
+    edge_meas: Array    # (E, 3) relative pose of j in i's frame
+    edge_weight: Array  # (E, 3) information diag [wx, wy, wth]
+    edge_valid: Array   # (E,) bool
+    n_edges: Array      # () int32
+
+
+def empty_graph(cfg: LoopClosureConfig) -> PoseGraph:
+    N, E = cfg.max_poses, cfg.max_edges
+    return PoseGraph(
+        poses=jnp.zeros((N, 3), jnp.float32),
+        pose_valid=jnp.zeros((N,), bool),
+        n_poses=jnp.int32(0),
+        edge_ij=jnp.zeros((E, 2), jnp.int32),
+        edge_meas=jnp.zeros((E, 3), jnp.float32),
+        edge_weight=jnp.zeros((E, 3), jnp.float32),
+        edge_valid=jnp.zeros((E,), bool),
+        n_edges=jnp.int32(0),
+    )
+
+
+def add_pose(g: PoseGraph, pose: Array) -> PoseGraph:
+    """Append a pose at the next slot (no-op when full)."""
+    i = g.n_poses
+    ok = i < g.poses.shape[0]
+    poses = jnp.where(ok, g.poses.at[i].set(pose), g.poses)
+    valid = g.pose_valid.at[i].set(ok | g.pose_valid[i])
+    return g._replace(poses=poses, pose_valid=valid,
+                      n_poses=i + ok.astype(jnp.int32))
+
+
+def add_edge(g: PoseGraph, i: Array, j: Array, meas: Array,
+             weight: Array) -> PoseGraph:
+    """Append a relative-pose constraint (no-op when full)."""
+    e = g.n_edges
+    ok = e < g.edge_ij.shape[0]
+    ij = jnp.stack([jnp.asarray(i, jnp.int32), jnp.asarray(j, jnp.int32)])
+    return g._replace(
+        edge_ij=jnp.where(ok, g.edge_ij.at[e].set(ij), g.edge_ij),
+        edge_meas=jnp.where(ok, g.edge_meas.at[e].set(meas), g.edge_meas),
+        edge_weight=jnp.where(ok, g.edge_weight.at[e].set(weight),
+                              g.edge_weight),
+        edge_valid=g.edge_valid.at[e].set(ok | g.edge_valid[e]),
+        n_edges=e + ok.astype(jnp.int32),
+    )
+
+
+def odometry_edge(g: PoseGraph, i: Array, j: Array,
+                  weight_t: float = 50.0, weight_th: float = 100.0) -> PoseGraph:
+    """Constrain j to its current relative pose from i (dead-reckoning link)."""
+    meas = pose_between(g.poses[i], g.poses[j])
+    w = jnp.array([weight_t, weight_t, weight_th], jnp.float32)
+    return add_edge(g, i, j, meas, w)
+
+
+# ---------------------------------------------------------------------------
+# Loop-closure candidate gating (slam_config.yaml:44-45 semantics)
+# ---------------------------------------------------------------------------
+
+def loop_candidate(cfg: LoopClosureConfig, g: PoseGraph,
+                   query: Array) -> tuple[Array, Array]:
+    """For pose index `query`, the nearest old pose within search_radius_m
+    whose index is at least min_chain_size behind. Returns (index, found)."""
+    idx = jnp.arange(g.poses.shape[0])
+    d = jnp.linalg.norm(g.poses[:, :2] - g.poses[query, :2], axis=-1)
+    old_enough = idx <= query - cfg.min_chain_size
+    ok = g.pose_valid & old_enough & (d <= cfg.search_radius_m)
+    d_masked = jnp.where(ok, d, jnp.inf)
+    best = jnp.argmin(d_masked)
+    return best.astype(jnp.int32), ok.any()
+
+
+# ---------------------------------------------------------------------------
+# Gauss-Newton optimisation (dense, MXU-shaped)
+# ---------------------------------------------------------------------------
+
+def _edge_residual_jac(poses: Array, ij: Array, meas: Array):
+    """Residual (3,) and two 3x3 Jacobian blocks for one edge."""
+    pi, pj = poses[ij[0]], poses[ij[1]]
+    ci, si = jnp.cos(pi[2]), jnp.sin(pi[2])
+    Rt = jnp.array([[ci, si], [-si, ci]])             # R(th_i)^T
+    dt = pj[:2] - pi[:2]
+    r_t = Rt @ dt - meas[:2]
+    r_th = wrap_angle(pj[2] - pi[2] - meas[2])
+    r = jnp.concatenate([r_t, jnp.array([r_th])])
+    dRt = jnp.array([[-si, ci], [-ci, -si]])          # d(R^T)/d th_i
+    Ji = jnp.zeros((3, 3)).at[:2, :2].set(-Rt) \
+        .at[:2, 2].set(dRt @ dt).at[2, 2].set(-1.0)
+    Jj = jnp.zeros((3, 3)).at[:2, :2].set(Rt).at[2, 2].set(1.0)
+    return r, Ji, Jj
+
+
+def _assemble(g: PoseGraph):
+    """All residuals/Jacobians -> dense J (3E, 3N), r (3E,), w (3E,)."""
+    E = g.edge_ij.shape[0]
+    N = g.poses.shape[0]
+    r, Ji, Jj = jax.vmap(
+        lambda ij, m: _edge_residual_jac(g.poses, ij, m)
+    )(g.edge_ij, g.edge_meas)                          # (E,3), (E,3,3) x2
+
+    w = (g.edge_weight * g.edge_valid[:, None]).reshape(-1)  # (3E,)
+    r = (r * g.edge_valid[:, None]).reshape(-1)              # (3E,)
+
+    rows = (3 * jnp.arange(E)[:, None, None]
+            + jnp.arange(3)[None, :, None])                  # (E,3,1)
+    rows = jnp.broadcast_to(rows, (E, 3, 3))
+    cols_i = (3 * g.edge_ij[:, 0, None, None]
+              + jnp.arange(3)[None, None, :])
+    cols_i = jnp.broadcast_to(cols_i, (E, 3, 3))
+    cols_j = (3 * g.edge_ij[:, 1, None, None]
+              + jnp.arange(3)[None, None, :])
+    cols_j = jnp.broadcast_to(cols_j, (E, 3, 3))
+
+    J = jnp.zeros((3 * E, 3 * N), jnp.float32)
+    J = J.at[rows.reshape(-1), cols_i.reshape(-1)].add(Ji.reshape(-1))
+    J = J.at[rows.reshape(-1), cols_j.reshape(-1)].add(Jj.reshape(-1))
+    return J, r, w
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def optimize(cfg: LoopClosureConfig, g: PoseGraph) -> PoseGraph:
+    """Damped Gauss-Newton over the whole graph; pose 0 is gauge-fixed by a
+    strong prior. Fixed iteration count keeps everything jit-compatible."""
+    N = g.poses.shape[0]
+
+    def gn_iter(graph: PoseGraph, _):
+        J, r, w = _assemble(graph)
+        H = J.T @ (w[:, None] * J)                    # (3N, 3N) — MXU
+        b = J.T @ (w * r)
+        # Gauge prior on pose 0 + Levenberg damping.
+        gauge = jnp.concatenate([jnp.full(3, 1e6), jnp.zeros(3 * N - 3)])
+        H = H + jnp.diag(gauge) + cfg.damping * jnp.eye(3 * N, dtype=H.dtype)
+        delta = jax.scipy.linalg.cho_solve(
+            jax.scipy.linalg.cho_factor(H), -b).reshape(N, 3)
+        delta = delta * graph.pose_valid[:, None]
+        poses = graph.poses + delta
+        poses = poses.at[:, 2].set(wrap_angle(poses[:, 2]))
+        return graph._replace(poses=poses), None
+
+    out, _ = jax.lax.scan(gn_iter, g, None, length=cfg.gn_iters)
+    return out
+
+
+def graph_error(g: PoseGraph) -> Array:
+    """Total weighted squared residual (for tests/telemetry)."""
+    r, _, _ = jax.vmap(
+        lambda ij, m: _edge_residual_jac(g.poses, ij, m)
+    )(g.edge_ij, g.edge_meas)
+    w = g.edge_weight * g.edge_valid[:, None]
+    return jnp.sum(w * r * r)

@@ -1,0 +1,219 @@
+"""Correlative scan matching on device: the TPU-native replacement for
+slam_toolbox's Karto scan matcher.
+
+Capability contract from the reference's matcher configuration
+(`/root/reference/server/thymio_project/config/slam_config.yaml:51-66`):
+translation window +-0.5 m (fine step 0.01 m), coarse angular window
++-0.349 rad @ 0.0349, fine angular resolution 0.00349, smear deviation 0.1,
+and a [0,1] "response" score used for acceptance/loop gating
+(`slam_config.yaml:46-48`).
+
+TPU-first design: instead of Karto's pointer-chasing lookup tables, the
+matcher is two dense passes over static shapes —
+
+  1. build a smooth *likelihood field* from the local grid patch with a
+     separable Gaussian blur of the occupied mask (conv -> MXU/VPU, smooth
+     enough for sub-cell refinement);
+  2. score every (dtheta, dy, dx) candidate jointly: rotate the scan's
+     point cloud per candidate angle (one einsum), then gather the field at
+     every translated point — a (n_angles, n_shifts, n_points) gather batch,
+     reduced to a response tensor and argmax'd.
+
+Coarse pass at grid resolution over the full window, fine pass with
+bilinear sub-cell sampling around the coarse winner. Everything jits; no
+data-dependent shapes (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import GridConfig, MatcherConfig, ScanConfig
+from jax_mapping.ops import grid as G
+
+Array = jax.Array
+
+
+class MatchResult(NamedTuple):
+    pose: Array          # (3,) refined [x, y, yaw]
+    response: Array      # () fine-stage response in [0, 1]
+    coarse_response: Array  # () coarse-stage response in [0, 1]
+    accepted: Array      # () bool: response >= matcher.min_response
+
+
+# ---------------------------------------------------------------------------
+# Scan -> point cloud
+# ---------------------------------------------------------------------------
+
+def scan_points(scan_cfg: ScanConfig, ranges: Array) -> tuple[Array, Array]:
+    """Ranges -> (padded_beams, 2) points in the sensor frame + valid mask.
+
+    Only genuine hits become points (zero/outlier/padded beams are masked),
+    mirroring what a matcher may legitimately align against.
+    """
+    r_m, hit = G.sanitize_ranges(scan_cfg, ranges)
+    idx = jnp.arange(scan_cfg.padded_beams, dtype=jnp.float32)
+    ang = scan_cfg.angle_min_rad + idx * scan_cfg.angle_increment_rad
+    if not scan_cfg.counterclockwise:
+        ang = -ang
+    pts = jnp.stack([r_m * jnp.cos(ang), r_m * jnp.sin(ang)], axis=-1)
+    return pts, hit
+
+
+# ---------------------------------------------------------------------------
+# Likelihood field
+# ---------------------------------------------------------------------------
+
+def likelihood_field(grid_cfg: GridConfig, m_cfg: MatcherConfig,
+                     patch: Array) -> Array:
+    """Occupied-cell mask -> smooth [0,1] field via separable Gaussian blur.
+
+    Unknown cells contribute nothing (slam_toolbox semantics: only mapped
+    obstacles attract the matcher), the blur supplies the smear
+    (slam_config.yaml:53) and a gradient for sub-cell refinement.
+    """
+    occ = (patch > grid_cfg.occ_threshold).astype(jnp.float32)
+    sigma = float(max(m_cfg.smear_cells, 1))
+    radius = int(3 * sigma)
+    # max_{cells} exp(-(di^2+dj^2)/2s^2) separates exactly into two weighted
+    # max passes because the per-axis decays are non-negative:
+    #   max_{di,dj} kv(di) kh(dj) occ(i-di, j-dj)
+    #     = max_dj kh(dj) [ max_di kv(di) occ(i-di, j) ].
+    # (A summed Gaussian blur saturates on walls and flattens the response
+    # surface — max-smear keeps a unique peak per obstacle.)
+    def max_blur(x: Array, axis: int) -> Array:
+        pad = [(0, 0), (0, 0)]
+        pad[axis] = (radius, radius)
+        xp = jnp.pad(x, pad)
+        n = x.shape[axis]
+        out = jnp.zeros_like(x)
+        for off in range(-radius, radius + 1):
+            w = jnp.float32(jnp.exp(-0.5 * (off / sigma) ** 2))
+            sl = jax.lax.slice_in_dim(xp, off + radius, off + radius + n,
+                                      axis=axis)
+            out = jnp.maximum(out, w * sl)
+        return out
+
+    return max_blur(max_blur(occ, 0), 1)
+
+
+def bilinear_sample(field: Array, rc: Array) -> Array:
+    """Sample field at float (row, col) coords (..., 2), edge-clamped."""
+    H, W = field.shape
+    r = jnp.clip(rc[..., 0], 0.0, H - 1.001)
+    c = jnp.clip(rc[..., 1], 0.0, W - 1.001)
+    r0 = jnp.floor(r).astype(jnp.int32)
+    c0 = jnp.floor(c).astype(jnp.int32)
+    fr = r - r0
+    fc = c - c0
+    v00 = field[r0, c0]
+    v01 = field[r0, c0 + 1]
+    v10 = field[r0 + 1, c0]
+    v11 = field[r0 + 1, c0 + 1]
+    return ((1 - fr) * (1 - fc) * v00 + (1 - fr) * fc * v01
+            + fr * (1 - fc) * v10 + fr * fc * v11)
+
+
+# ---------------------------------------------------------------------------
+# Correlative search
+# ---------------------------------------------------------------------------
+
+def _angle_grid(half: float, step: float) -> jnp.ndarray:
+    n = int(round(half / step))
+    return jnp.arange(-n, n + 1, dtype=jnp.float32) * step
+
+
+def _shift_grid(half_m: float, step_m: float) -> jnp.ndarray:
+    n = int(round(half_m / step_m))
+    s = jnp.arange(-n, n + 1, dtype=jnp.float32) * step_m
+    dy, dx = jnp.meshgrid(s, s, indexing="ij")
+    return jnp.stack([dy.ravel(), dx.ravel()], axis=-1)   # (S, 2) metres
+
+
+def _score_candidates(field: Array, origin_rc: Array, grid_cfg: GridConfig,
+                      pts_world: Array, valid: Array, dthetas: Array,
+                      shifts_m: Array, centre_xy: Array) -> Array:
+    """Response[(a, s)] = mean_valid field(R(dtheta)·(p - c) + c + shift).
+
+    pts_world: (N,2) scan points already placed at the guess pose.
+    Rotation is about the sensor centre, matching a yaw perturbation.
+    """
+    res = grid_cfg.resolution_m
+    rel = pts_world - centre_xy                               # (N,2)
+    ca, sa = jnp.cos(dthetas), jnp.sin(dthetas)               # (A,)
+    rot = jnp.stack([jnp.stack([ca, -sa], -1),
+                     jnp.stack([sa, ca], -1)], -2)            # (A,2,2)
+    pts_a = jnp.einsum("aij,nj->ani", rot, rel) + centre_xy   # (A,N,2)
+    # world -> patch-local continuous cell coords (row, col)
+    ox, oy = grid_cfg.origin_m
+    col = (pts_a[..., 0] - ox) / res - origin_rc[1].astype(jnp.float32) - 0.5
+    row = (pts_a[..., 1] - oy) / res - origin_rc[0].astype(jnp.float32) - 0.5
+    rc = jnp.stack([row, col], axis=-1)                       # (A,N,2)
+    shift_rc = shifts_m / res        # (S, 2) [dy, dx] metres -> cells
+    samples = bilinear_sample(
+        field, rc[:, None, :, :] + shift_rc[None, :, None, :])  # (A,S,N)
+    w = valid.astype(jnp.float32)
+    return jnp.einsum("asn,n->as", samples, w) / jnp.maximum(w.sum(), 1.0)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def match(grid_cfg: GridConfig, scan_cfg: ScanConfig, m_cfg: MatcherConfig,
+          grid_arr: Array, ranges: Array, guess_pose: Array) -> MatchResult:
+    """Coarse-to-fine correlative match of one scan against the map.
+
+    Returns the refined pose; `accepted` mirrors the reference's response
+    gating (callers fall back to the odometry guess when not accepted).
+    """
+    origin = G.patch_origin(grid_cfg, guess_pose[:2])
+    patch = jax.lax.dynamic_slice(
+        grid_arr, (origin[0], origin[1]),
+        (grid_cfg.patch_cells, grid_cfg.patch_cells))
+    field = likelihood_field(grid_cfg, m_cfg, patch)
+
+    pts_s, valid = scan_points(scan_cfg, ranges)
+    ca, sa = jnp.cos(guess_pose[2]), jnp.sin(guess_pose[2])
+    rotg = jnp.array([[ca, -sa], [sa, ca]])
+    pts_world = pts_s @ rotg.T + guess_pose[:2]
+    centre = guess_pose[:2]
+
+    # --- coarse pass: full windows at grid resolution -------------------
+    dth_c = _angle_grid(m_cfg.coarse_angle_half_rad, m_cfg.coarse_angle_step_rad)
+    shifts_c = _shift_grid(m_cfg.search_half_extent_m, m_cfg.coarse_step_m)
+    resp_c = _score_candidates(field, origin, grid_cfg, pts_world, valid,
+                               dth_c, shifts_c, centre)
+    best_c = jnp.argmax(resp_c)
+    ai_c, si_c = jnp.unravel_index(best_c, resp_c.shape)
+    coarse_resp = resp_c[ai_c, si_c]
+    dth0 = dth_c[ai_c]
+    shift0 = shifts_c[si_c]
+
+    # --- fine pass: sub-cell window around the coarse winner ------------
+    dth_f = dth0 + _angle_grid(m_cfg.coarse_angle_step_rad, m_cfg.fine_angle_step_rad)
+    shifts_f = shift0 + _shift_grid(m_cfg.coarse_step_m, m_cfg.fine_step_m)
+    resp_f = _score_candidates(field, origin, grid_cfg, pts_world, valid,
+                               dth_f, shifts_f, centre)
+    best_f = jnp.argmax(resp_f)
+    ai_f, si_f = jnp.unravel_index(best_f, resp_f.shape)
+    fine_resp = resp_f[ai_f, si_f]
+
+    pose = jnp.stack([
+        guess_pose[0] + shifts_f[si_f, 1],
+        guess_pose[1] + shifts_f[si_f, 0],
+        guess_pose[2] + dth_f[ai_f],
+    ])
+    return MatchResult(pose=pose, response=fine_resp,
+                       coarse_response=coarse_resp,
+                       accepted=fine_resp >= m_cfg.min_response)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def match_batch(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                m_cfg: MatcherConfig, grid_arr: Array, ranges_b: Array,
+                guesses_b: Array) -> MatchResult:
+    """vmap the matcher over a batch of scans against one shared map."""
+    return jax.vmap(lambda r, p: match(grid_cfg, scan_cfg, m_cfg,
+                                       grid_arr, r, p))(ranges_b, guesses_b)

@@ -9,7 +9,26 @@ tests on virtual CPU devices so they run anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests are CPU-only, but the axon TPU plugin (registered at interpreter
+# startup via sitecustomize when PALLAS_AXON_POOL_IPS is set) can hang every
+# jax backend init when its tunnel is wedged — even under JAX_PLATFORMS=cpu.
+# Registration already happened by the time conftest runs, so re-exec the
+# whole pytest process once with the axon env removed.
+if os.environ.get("PALLAS_AXON_POOL_IPS") and \
+        not os.environ.get("_JAX_MAPPING_REEXEC") and \
+        "pytest" in (sys.argv[0] or ""):
+    # Only when launched as a pytest CLI (python -m pytest / pytest binary);
+    # programmatic pytest.main() callers have a foreign sys.argv we must not
+    # replay. They get the env cleanup below instead.
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["_JAX_MAPPING_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    os.execvpe(sys.executable, [sys.executable, "-m", "pytest"]
+               + sys.argv[1:], env)
+
+# Force CPU: the ambient environment may pin JAX_PLATFORMS=axon (TPU).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

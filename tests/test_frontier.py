@@ -1,0 +1,148 @@
+"""Frontier detection / clustering / assignment tests on toy maps."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from jax_mapping.config import FrontierConfig, GridConfig
+from jax_mapping.ops import frontier as F
+
+
+@pytest.fixture()
+def gcfg():
+    return GridConfig(size_cells=128, patch_cells=64, max_range_m=2.0,
+                      align_rows=8, align_cols=8)
+
+
+@pytest.fixture()
+def fcfg():
+    return FrontierConfig(downsample=2, max_clusters=8, min_cluster_cells=2,
+                          label_prop_iters=64, bfs_iters=256)
+
+
+def toy_logodds(gcfg):
+    """64x64-coarse world: free square room in the middle, unknown outside,
+    an occupied wall on the room's right edge with a gap (the frontier should
+    appear on the open edges, not through the wall)."""
+    n = gcfg.size_cells
+    lo = np.zeros((n, n), np.float32)            # unknown everywhere
+    lo[40:90, 40:90] = -2.0                      # free room
+    lo[40:90, 88:90] = 2.0                       # right wall (occupied)
+    lo[60:66, 88:90] = -2.0                      # gap in the wall
+    return lo
+
+
+def test_coarsen_masks(gcfg, fcfg):
+    lo = toy_logodds(gcfg)
+    free, occ, unknown = F.coarsen(fcfg, gcfg, jnp.asarray(lo))
+    free, occ, unknown = map(np.asarray, (free, occ, unknown))
+    n = gcfg.size_cells // fcfg.downsample
+    assert free.shape == (n, n)
+    assert free[30, 30] and not occ[30, 30]      # room interior
+    assert occ[25, 44]                           # wall
+    assert unknown[5, 5]                         # outside
+    # Exclusive.
+    assert not (free & occ).any() and not (free & unknown).any()
+
+
+def test_frontier_mask_on_boundary(gcfg, fcfg):
+    lo = toy_logodds(gcfg)
+    free, occ, unknown = F.coarsen(fcfg, gcfg, jnp.asarray(lo))
+    mask = np.asarray(F.frontier_mask(free, unknown))
+    # Frontier on the room's left edge (free touching unknown).
+    assert mask[25, 20]
+    # No frontier inside the room.
+    assert not mask[25, 30]
+    # The wall itself is not frontier.
+    assert not mask[25, 44]
+
+
+def test_label_components_two_regions(fcfg):
+    mask = np.zeros((32, 32), bool)
+    mask[2:5, 2:5] = True         # blob A
+    mask[20:24, 20:22] = True     # blob B
+    labels = np.asarray(F.label_components(fcfg, jnp.asarray(mask)))
+    la = labels[3, 3]
+    lb = labels[21, 21]
+    assert la >= 0 and lb >= 0 and la != lb
+    assert (labels[2:5, 2:5] == la).all()
+    assert (labels[20:24, 20:22] == lb).all()
+    assert (labels[~mask] == -1).all()
+
+
+def test_summarize_clusters_centroids(gcfg, fcfg):
+    n = gcfg.size_cells // fcfg.downsample
+    mask = np.zeros((n, n), bool)
+    mask[10:12, 10:12] = True     # 4 cells
+    mask[40:46, 40:41] = True     # 6 cells
+    labels = F.label_components(fcfg, jnp.asarray(mask))
+    centroids, sizes, slots = F.summarize_clusters(fcfg, gcfg, labels)
+    sizes = np.asarray(sizes)
+    assert sorted(sizes[sizes > 0].tolist()) == [4, 6]
+    # Biggest first via top_k.
+    assert sizes[0] == 6
+    # Centroid of the 6-cell blob: rows 40..45, col 40.
+    c = np.asarray(centroids[0])
+    res = gcfg.resolution_m * fcfg.downsample
+    ox, oy = gcfg.origin_m
+    assert c[0] == pytest.approx((40 + 0.5) * res + ox, abs=res)
+    assert c[1] == pytest.approx((42.5 + 0.5) * res + oy, abs=res)
+
+
+def test_cost_to_go_walls_block(fcfg):
+    n = 32
+    passable = np.ones((n, n), bool)
+    passable[:, 16] = False       # vertical wall
+    passable[0, 16] = True        # gap at top
+    seeds = jnp.array([[16, 2]])
+    dist = np.asarray(F.cost_to_go(fcfg, jnp.asarray(passable), seeds,
+                                   jnp.array([True])))
+    assert dist[16, 2] == 0
+    # Right of the wall is reachable only through the top gap -> much longer
+    # than the straight-line distance.
+    straight = 28 - 2
+    assert dist[16, 28] > straight * 1.3
+    assert dist[16, 28] < 1e8     # but reachable
+    # Wall cells unreachable.
+    assert dist[5, 16] >= 1e8
+
+
+def test_compute_frontiers_end_to_end(gcfg, fcfg):
+    lo = toy_logodds(gcfg)
+    # Robots inside the room (world coords: cell ~ (x/res + n/2)).
+    res = gcfg.resolution_m
+    n = gcfg.size_cells
+    def world(row, col):
+        return ((col - n / 2) * res, (row - n / 2) * res)
+    x0, y0 = world(65, 65)
+    x1, y1 = world(45, 45)
+    robots = jnp.asarray(np.array([[x0, y0, 0.0], [x1, y1, 0.0]], np.float32))
+    out = F.compute_frontiers(fcfg, gcfg, jnp.asarray(lo), robots)
+    sizes = np.asarray(out.sizes)
+    assert (sizes > 0).sum() >= 1          # found frontier(s)
+    assign = np.asarray(out.assignment)
+    assert (assign >= 0).all()             # both robots got a target
+    costs = np.asarray(out.costs)
+    for r in range(2):
+        assert costs[r, assign[r]] < 1e8
+
+
+def test_compute_frontiers_none_on_closed_map(gcfg, fcfg):
+    n = gcfg.size_cells
+    lo = np.full((n, n), -2.0, np.float32)   # everything known-free
+    lo[0:2, :] = 2.0; lo[-2:, :] = 2.0; lo[:, 0:2] = 2.0; lo[:, -2:] = 2.0
+    robots = jnp.zeros((1, 3))
+    out = F.compute_frontiers(fcfg, gcfg, jnp.asarray(lo), robots)
+    assert (np.asarray(out.sizes) == 0).all()
+    assert int(out.assignment[0]) == -1
+
+
+def test_euclidean_cost_mode(gcfg, fcfg):
+    import dataclasses
+    cheap = dataclasses.replace(fcfg, obstacle_aware=False)
+    lo = toy_logodds(gcfg)
+    robots = jnp.zeros((2, 3))
+    out = F.compute_frontiers(cheap, gcfg, jnp.asarray(lo), robots)
+    assert (np.asarray(out.sizes) > 0).sum() >= 1
+    assert (np.asarray(out.assignment) >= 0).all()

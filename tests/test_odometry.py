@@ -1,0 +1,103 @@
+"""Odometry and SE(2) helper tests vs the reference math oracle."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from jax_mapping.config import RobotConfig, sign_extend_16bit
+from jax_mapping.ops import odometry as O
+from tests.oracle import rk2_odometry_np
+
+
+def test_rk2_step_matches_reference_math(rng):
+    robot = RobotConfig()
+    pose = np.array([0.1, -0.2, 0.4])
+    x, y, yaw = pose
+    jpose = jnp.asarray(pose, jnp.float32)
+    for _ in range(20):
+        l = float(rng.integers(-200, 200))
+        r = float(rng.integers(-200, 200))
+        dt = float(rng.uniform(0.05, 0.15))
+        x, y, yaw = rk2_odometry_np(robot, x, y, yaw, l, r, dt)
+        jpose = O.rk2_step(robot, jpose, jnp.float32(l), jnp.float32(r),
+                           jnp.float32(dt))
+    np.testing.assert_allclose(np.asarray(jpose), [x, y, yaw], atol=1e-4)
+
+
+def test_integrate_equals_stepping(rng):
+    robot = RobotConfig()
+    T = 50
+    l = rng.integers(-150, 150, T).astype(np.float32)
+    r = rng.integers(-150, 150, T).astype(np.float32)
+    dts = np.full(T, 0.1, np.float32)
+    traj = np.asarray(O.integrate(robot, jnp.zeros(3), jnp.asarray(l),
+                                  jnp.asarray(r), jnp.asarray(dts)))
+    pose = jnp.zeros(3)
+    for t in range(T):
+        pose = O.rk2_step(robot, pose, l[t], r[t], dts[t])
+    np.testing.assert_allclose(traj[-1], np.asarray(pose), atol=1e-5)
+    assert traj.shape == (T, 3)
+
+
+def test_straight_line_and_pivot():
+    robot = RobotConfig()
+    # Equal speeds -> straight along +x from origin.
+    T = 10
+    sp = jnp.full(T, 100.0)
+    dts = jnp.full(T, 0.1)
+    traj = np.asarray(O.integrate(robot, jnp.zeros(3), sp, sp, dts))
+    expect_x = 100 * robot.speed_coeff_m_per_unit_s * 1.0
+    np.testing.assert_allclose(traj[-1], [expect_x, 0, 0], atol=1e-6)
+    # Opposite speeds -> pure pivot, no translation.
+    traj = np.asarray(O.integrate(robot, jnp.zeros(3), -sp, sp, dts))
+    np.testing.assert_allclose(traj[-1][:2], [0, 0], atol=1e-6)
+    assert traj[-1][2] > 0.5  # turned left (right wheel forward)
+
+
+def test_integrate_fleet_matches_single(rng):
+    robot = RobotConfig()
+    R, T = 3, 20
+    l = rng.integers(-100, 100, (R, T)).astype(np.float32)
+    r = rng.integers(-100, 100, (R, T)).astype(np.float32)
+    dts = np.full((R, T), 0.1, np.float32)
+    p0 = rng.uniform(-1, 1, (R, 3)).astype(np.float32)
+    fleet = np.asarray(O.integrate_fleet(robot, jnp.asarray(p0),
+                                         jnp.asarray(l), jnp.asarray(r),
+                                         jnp.asarray(dts)))
+    for i in range(R):
+        single = np.asarray(O.integrate(robot, jnp.asarray(p0[i]),
+                                        jnp.asarray(l[i]), jnp.asarray(r[i]),
+                                        jnp.asarray(dts[i])))
+        np.testing.assert_allclose(fleet[i], single, atol=1e-6)
+
+
+def test_twist_roundtrip():
+    robot = RobotConfig()
+    l, r = O.twist_to_wheel_units(robot, jnp.float32(0.1), jnp.float32(0.5))
+    v, w = O.wheel_velocities(robot, l, r)
+    assert float(v) == pytest.approx(0.1, abs=1e-5)
+    assert float(w) == pytest.approx(0.5, abs=1e-4)
+
+
+def test_pose_compose_between_roundtrip(rng):
+    a = jnp.asarray(rng.uniform(-2, 2, 3).astype(np.float32))
+    b = jnp.asarray(rng.uniform(-2, 2, 3).astype(np.float32))
+    rel = O.pose_between(a, b)
+    back = O.pose_compose(a, rel)
+    got = np.asarray(back)
+    want = np.asarray(b)
+    np.testing.assert_allclose(got[:2], want[:2], atol=1e-5)
+    assert abs(math.remainder(float(got[2] - want[2]), 2 * math.pi)) < 1e-5
+
+
+def test_sign_extend_16bit_variants():
+    # Reference semantics (server main.py:101-102).
+    assert sign_extend_16bit(100) == 100
+    assert sign_extend_16bit(65436) == -100
+    out = sign_extend_16bit(np.array([100, 65436], dtype=np.uint16))
+    np.testing.assert_array_equal(out, [100, -100])
+    out = sign_extend_16bit(jnp.array([100, 65436], dtype=jnp.uint16))
+    np.testing.assert_array_equal(np.asarray(out), [100, -100])

@@ -1,0 +1,124 @@
+"""Pose-graph tests: construction, loop gating, Gauss-Newton convergence."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from jax_mapping.config import LoopClosureConfig
+from jax_mapping.ops import posegraph as PG
+from jax_mapping.ops.odometry import pose_between
+
+
+@pytest.fixture()
+def cfg():
+    return LoopClosureConfig(max_poses=64, max_edges=256, gn_iters=6)
+
+
+def test_add_pose_and_edge(cfg):
+    g = PG.empty_graph(cfg)
+    g = PG.add_pose(g, jnp.array([1.0, 2.0, 0.3]))
+    g = PG.add_pose(g, jnp.array([2.0, 2.0, 0.3]))
+    assert int(g.n_poses) == 2
+    assert bool(g.pose_valid[0]) and bool(g.pose_valid[1])
+    assert not bool(g.pose_valid[2])
+    g = PG.odometry_edge(g, jnp.int32(0), jnp.int32(1))
+    assert int(g.n_edges) == 1
+    np.testing.assert_allclose(
+        np.asarray(g.edge_meas[0]),
+        np.asarray(pose_between(g.poses[0], g.poses[1])), atol=1e-6)
+
+
+def test_capacity_overflow_is_noop():
+    cfg = LoopClosureConfig(max_poses=2, max_edges=1, gn_iters=2)
+    g = PG.empty_graph(cfg)
+    for i in range(4):
+        g = PG.add_pose(g, jnp.array([float(i), 0.0, 0.0]))
+    assert int(g.n_poses) == 2
+    np.testing.assert_allclose(np.asarray(g.poses[1]), [1, 0, 0])
+    g = PG.add_edge(g, 0, 1, jnp.zeros(3), jnp.ones(3))
+    g = PG.add_edge(g, 0, 1, jnp.ones(3), jnp.ones(3))
+    assert int(g.n_edges) == 1
+    np.testing.assert_allclose(np.asarray(g.edge_meas[0]), np.zeros(3))
+
+
+def test_loop_candidate_gating(cfg):
+    g = PG.empty_graph(cfg)
+    # A loop trajectory: 20 poses around a circle of radius 1 -> pose 19
+    # is close to pose 0 but far in index.
+    for i in range(20):
+        a = 2 * np.pi * i / 20
+        g = PG.add_pose(g, jnp.array([np.cos(a), np.sin(a), a], jnp.float32))
+    idx, found = PG.loop_candidate(cfg, g, jnp.int32(19))
+    assert bool(found)
+    assert int(idx) == 0           # nearest old-enough pose
+    # Pose 5 has no old-enough pose within 3 m ... pose 0 is within 3 m but
+    # the chain gate (>=10 behind) excludes everything.
+    idx, found = PG.loop_candidate(cfg, g, jnp.int32(5))
+    assert not bool(found)
+
+
+def test_gn_recovers_noisy_loop(cfg, rng):
+    """Classic pose-graph test: odometry edges with drift + one loop edge;
+    optimisation must pull the chain back together."""
+    T = 30
+    # Ground truth: square loop.
+    truth = []
+    pose = np.zeros(3)
+    for t in range(T):
+        truth.append(pose.copy())
+        pose = pose + np.array([0.2 * np.cos(pose[2]), 0.2 * np.sin(pose[2]), 0.0])
+        if (t + 1) % 8 == 0:
+            pose[2] += np.pi / 2
+    truth = np.array(truth, np.float32)
+
+    # Noisy odometry estimate: accumulate perturbed relative poses.
+    est = [truth[0]]
+    rels = []
+    for t in range(1, T):
+        rel = np.asarray(pose_between(jnp.asarray(truth[t - 1]),
+                                      jnp.asarray(truth[t])))
+        rels.append(rel)
+        noisy = rel + rng.normal(0, [0.01, 0.01, 0.02])
+        prev = est[-1]
+        c, s = np.cos(prev[2]), np.sin(prev[2])
+        est.append(np.array([prev[0] + c * noisy[0] - s * noisy[1],
+                             prev[1] + s * noisy[0] + c * noisy[1],
+                             prev[2] + noisy[2]], np.float32))
+    est = np.array(est, np.float32)
+
+    g = PG.empty_graph(cfg)
+    for t in range(T):
+        g = PG.add_pose(g, jnp.asarray(est[t]))
+    for t in range(1, T):
+        # Edge measurement = the noisy relative pose actually observed.
+        rel = np.asarray(pose_between(jnp.asarray(est[t - 1]), jnp.asarray(est[t])))
+        g = PG.add_edge(g, t - 1, t, jnp.asarray(rel),
+                        jnp.array([50.0, 50.0, 100.0]))
+    # Loop edge: perfect observation pose 0 -> pose T-1.
+    loop_rel = pose_between(jnp.asarray(truth[0]), jnp.asarray(truth[-1]))
+    g = PG.add_edge(g, 0, T - 1, loop_rel, jnp.array([500.0, 500.0, 500.0]))
+
+    err_before = np.linalg.norm(est[-1][:2] - truth[-1][:2])
+    g_opt = PG.optimize(cfg, g)
+    opt = np.asarray(g_opt.poses[:T])
+    err_after = np.linalg.norm(opt[-1][:2] - truth[-1][:2])
+    # End pose snaps to the loop constraint.
+    assert err_after < err_before * 0.5
+    assert err_after < 0.05
+    # Gauge: pose 0 stays pinned.
+    np.testing.assert_allclose(opt[0], truth[0], atol=1e-3)
+    # Graph error decreases.
+    assert float(PG.graph_error(g_opt)) < float(PG.graph_error(g))
+
+
+def test_optimize_noop_on_consistent_graph(cfg):
+    g = PG.empty_graph(cfg)
+    poses = [np.array([0.1 * t, 0.05 * t, 0.01 * t], np.float32) for t in range(5)]
+    for p in poses:
+        g = PG.add_pose(g, jnp.asarray(p))
+    for t in range(1, 5):
+        g = PG.odometry_edge(g, jnp.int32(t - 1), jnp.int32(t))
+    g_opt = PG.optimize(cfg, g)
+    np.testing.assert_allclose(np.asarray(g_opt.poses[:5]),
+                               np.stack(poses), atol=1e-3)

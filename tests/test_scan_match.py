@@ -1,0 +1,114 @@
+"""Correlative scan-matcher tests: pose recovery, response gating."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import scan_match as M
+
+
+def room_scan(scan_cfg, pose, half=2.0):
+    """Analytic scan of a square room centred at the origin."""
+    out = np.zeros(scan_cfg.padded_beams, np.float32)
+    for b in range(scan_cfg.n_beams):
+        a = pose[2] + b * scan_cfg.angle_increment_rad
+        ca, sa = math.cos(a), math.sin(a)
+        rx = ((half if ca > 0 else -half) - pose[0]) / ca if abs(ca) > 1e-9 else 1e9
+        ry = ((half if sa > 0 else -half) - pose[1]) / sa if abs(sa) > 1e-9 else 1e9
+        out[b] = min(rx, ry)
+    return out
+
+
+@pytest.fixture(scope="module")
+def room_map(tiny_cfg):
+    """Map built from several scans around the room (so walls are crisp)."""
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    poses, scans = [], []
+    for i in range(8):
+        p = np.array([0.3 * math.cos(i), 0.3 * math.sin(i), 0.7 * i], np.float32)
+        poses.append(p)
+        scans.append(room_scan(s, p))
+    grid = G.fuse_scans(g, s, G.empty_grid(g),
+                        jnp.asarray(np.stack(scans)), jnp.asarray(np.stack(poses)))
+    return grid
+
+
+def test_scan_points_geometry(tiny_cfg):
+    s = tiny_cfg.scan
+    ranges = np.zeros(s.padded_beams, np.float32)
+    ranges[:s.n_beams] = 1.0
+    pts, valid = M.scan_points(s, jnp.asarray(ranges))
+    pts, valid = np.asarray(pts), np.asarray(valid)
+    assert valid[:s.n_beams].all() and not valid[s.n_beams:].any()
+    np.testing.assert_allclose(pts[0], [1.0, 0.0], atol=1e-6)
+    half = s.n_beams // 2   # exactly 180 degrees for an even beam count
+    np.testing.assert_allclose(pts[half], [-1.0, 0.0], atol=1e-5)
+
+
+def test_likelihood_field_peaks_on_walls(tiny_cfg, room_map):
+    g, m = tiny_cfg.grid, tiny_cfg.matcher
+    origin = np.asarray(G.patch_origin(g, jnp.zeros(2)))
+    patch = np.asarray(room_map)[origin[0]:origin[0] + g.patch_cells,
+                                 origin[1]:origin[1] + g.patch_cells]
+    field = np.asarray(M.likelihood_field(g, m, jnp.asarray(patch)))
+    occ = patch > g.occ_threshold
+    assert field[occ].min() > 0.9          # walls are high
+    centre = g.patch_cells // 2
+    assert field[centre, centre] < 0.05    # open interior is low
+    assert field.max() <= 1.0 + 1e-6
+
+
+def test_bilinear_sample_exact_and_interp():
+    f = jnp.asarray(np.arange(16, dtype=np.float32).reshape(4, 4))
+    v = M.bilinear_sample(f, jnp.array([[1.0, 2.0], [1.5, 2.5]]))
+    assert float(v[0]) == pytest.approx(6.0)
+    assert float(v[1]) == pytest.approx((6 + 7 + 10 + 11) / 4)
+
+
+def test_match_recovers_known_offset(tiny_cfg, room_map):
+    g, s, m = tiny_cfg.grid, tiny_cfg.scan, tiny_cfg.matcher
+    true_pose = np.array([0.12, -0.08, 0.25], np.float32)
+    scan = room_scan(s, true_pose)
+    # Guess is off by a realistic odometry drift.
+    guess = true_pose + np.array([0.08, -0.06, 0.12], np.float32)
+    res = M.match(g, s, m, room_map, jnp.asarray(scan), jnp.asarray(guess))
+    got = np.asarray(res.pose)
+    assert bool(res.accepted)
+    np.testing.assert_allclose(got[:2], true_pose[:2], atol=0.03)
+    assert abs(got[2] - true_pose[2]) < 0.02
+    assert float(res.response) > float(res.coarse_response) - 0.05
+
+
+def test_match_identity_when_guess_correct(tiny_cfg, room_map):
+    g, s, m = tiny_cfg.grid, tiny_cfg.scan, tiny_cfg.matcher
+    true_pose = np.array([0.0, 0.0, 0.0], np.float32)
+    scan = room_scan(s, true_pose)
+    res = M.match(g, s, m, room_map, jnp.asarray(scan), jnp.asarray(true_pose))
+    got = np.asarray(res.pose)
+    np.testing.assert_allclose(got, true_pose, atol=0.02)
+    assert float(res.response) > 0.5
+
+
+def test_match_rejects_empty_map(tiny_cfg):
+    g, s, m = tiny_cfg.grid, tiny_cfg.scan, tiny_cfg.matcher
+    scan = room_scan(s, np.zeros(3, np.float32))
+    res = M.match(g, s, m, G.empty_grid(g), jnp.asarray(scan), jnp.zeros(3))
+    assert not bool(res.accepted)          # nothing to match against
+    assert float(res.response) < m.min_response
+
+
+def test_match_batch_matches_single(tiny_cfg, room_map):
+    g, s, m = tiny_cfg.grid, tiny_cfg.scan, tiny_cfg.matcher
+    poses = np.array([[0.0, 0.0, 0.0], [0.1, 0.05, 0.3]], np.float32)
+    scans = np.stack([room_scan(s, p) for p in poses])
+    batch = M.match_batch(g, s, m, room_map, jnp.asarray(scans),
+                          jnp.asarray(poses))
+    for i in range(2):
+        single = M.match(g, s, m, room_map, jnp.asarray(scans[i]),
+                         jnp.asarray(poses[i]))
+        np.testing.assert_allclose(np.asarray(batch.pose[i]),
+                                   np.asarray(single.pose), atol=1e-6)
