@@ -1,0 +1,92 @@
+"""Benchmark: LiDAR scan fusion throughput into the full-size 4096^2 grid.
+
+Headline metric per BASELINE.md: >= 50,000 scans/sec fused into a 4096^2
+0.05 m log-odds grid on a v5e-8. This runs on whatever devices are visible
+(the driver provides one real chip) and pro-rates the baseline target by
+device count: vs_baseline = scans_per_sec / (50_000 * n_devices / 8).
+
+Also measures p50 frontier recompute latency at 64 robots (target < 5 ms)
+and reports it inside the JSON line as an extra field.
+
+Prints exactly ONE JSON line.
+"""
+
+import json
+import math
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from jax_mapping.config import SlamConfig
+    from jax_mapping.ops import frontier as F
+    from jax_mapping.ops import grid as G
+
+    cfg = SlamConfig()
+    g, s = cfg.grid, cfg.scan
+    dev = jax.devices()[0]
+    n_dev = len(jax.devices())
+
+    # ---- workload: B scans along a loop through a synthetic interior ----
+    B = 256
+    rng = np.random.default_rng(0)
+    t = np.linspace(0, 2 * math.pi, B, endpoint=False)
+    poses = np.stack([
+        30.0 * np.cos(t), 30.0 * np.sin(t), t + math.pi / 2
+    ], axis=1).astype(np.float32)
+    # Plausible LD06 returns: walls 1-10 m away, 5% dropouts (zeros).
+    ranges = rng.uniform(1.0, 10.0, (B, s.padded_beams)).astype(np.float32)
+    ranges[:, s.n_beams:] = 0.0
+    drop = rng.random((B, s.padded_beams)) < 0.05
+    ranges[drop] = 0.0
+
+    grid = jax.device_put(G.empty_grid(g), dev)
+    ranges_d = jax.device_put(jnp.asarray(ranges), dev)
+    poses_d = jax.device_put(jnp.asarray(poses), dev)
+
+    fuse = lambda gr: G.fuse_scans(g, s, gr, ranges_d, poses_d)
+    grid = fuse(grid)                      # compile + warm
+    jax.block_until_ready(grid)
+
+    iters = 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        grid = fuse(grid)
+    jax.block_until_ready(grid)
+    dt = (time.perf_counter() - t0) / iters
+    scans_per_sec = B / dt
+
+    # ---- frontier recompute p50 at 64 robots ---------------------------
+    import dataclasses
+    fcfg = dataclasses.replace(cfg.frontier, obstacle_aware=False)
+    robot_poses = jax.device_put(jnp.asarray(
+        np.stack([rng.uniform(-50, 50, 64), rng.uniform(-50, 50, 64),
+                  rng.uniform(-3, 3, 64)], 1).astype(np.float32)), dev)
+    fr = F.compute_frontiers(fcfg, g, grid, robot_poses)   # compile
+    jax.block_until_ready(fr)
+    lat = []
+    for _ in range(11):
+        t0 = time.perf_counter()
+        fr = F.compute_frontiers(fcfg, g, grid, robot_poses)
+        jax.block_until_ready(fr)
+        lat.append(time.perf_counter() - t0)
+    frontier_p50_ms = float(np.median(lat) * 1e3)
+
+    target = 50_000.0 * n_dev / 8.0
+    print(json.dumps({
+        "metric": "lidar_scan_fusion_throughput",
+        "value": round(scans_per_sec, 1),
+        "unit": "scans/sec into 4096^2 0.05m grid",
+        "vs_baseline": round(scans_per_sec / target, 3),
+        "devices": f"{n_dev}x {dev.platform}",
+        "frontier_p50_ms_64robots": round(frontier_p50_ms, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

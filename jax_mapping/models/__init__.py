@@ -1,0 +1,1 @@
+"""Composed pipelines: single-robot SLAM, multi-robot fleet, explorers."""

@@ -1,0 +1,130 @@
+"""Exploration policies as pure, vmappable functions.
+
+Two policies, matching the reference's present and future:
+
+* `subsumption_policy` — the reference's 3-layer reactive navigator
+  (`/root/reference/server/thymio_project/thymio_project/main.py:119-196`):
+  (1) IR emergency pivot when any front prox > 1800, turn away from the
+  heavier side (prox[0]*2+prox[1] vs prox[4]*2+prox[3]); (2) LiDAR
+  anticipation over the two 30-beam front cones with the asymmetric swerve
+  (inner wheel -10); (3) cruise. Zero-range outliers read as 10 m
+  (main.py:152). LED state machine included (green idle / red IR / orange
+  LiDAR warn / blue cruise — main.py:131,161,181,192).
+
+* `frontier_policy` — map-based goal seeking toward an assigned frontier
+  centroid (the report's §VI.2 future work): proportional heading control
+  with the same reactive layers as a safety shield.
+
+Both return integer wheel targets in Thymio units, batched over robots.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import RobotConfig, ScanConfig
+from jax_mapping.ops.odometry import wrap_angle
+
+Array = jax.Array
+
+# LED colors, reference state machine (main.py:69,131,161,181).
+LED_IDLE = jnp.array([0, 32, 0])
+LED_IR = jnp.array([32, 0, 0])
+LED_WARN = jnp.array([32, 16, 0])
+LED_CRUISE = jnp.array([0, 0, 32])
+
+
+class PolicyOut(NamedTuple):
+    targets: Array     # (R, 2) wheel targets [left, right], thymio units
+    led: Array         # (R, 3) LED color (physical status display)
+    state: Array       # (R,) int32: 0 idle, 1 cruise, 2 ir, 3 warn
+
+
+def _front_cones(scan_cfg: ScanConfig, ranges: Array) -> tuple[Array, Array]:
+    """Min range over the two front 30-beam cones.
+
+    The reference indexes ranges[0:30] and ranges[-30:] and notes the
+    left/right decision is "inverted because of the LIDAR angle convention"
+    (main.py:154-177). Here the convention is explicit: beam 0 points along
+    +x (robot forward), beams increase counterclockwise, so beams [0:30)
+    sweep the robot's LEFT-front and the last 30 live beams sweep the
+    RIGHT-front.
+    """
+    r = jnp.where(ranges <= 0.0, 10.0, ranges)        # outlier rule
+    left = jnp.min(r[..., 0:30], axis=-1)
+    n = scan_cfg.n_beams
+    right = jnp.min(r[..., n - 30:n], axis=-1)
+    return left, right
+
+
+def subsumption_policy(robot: RobotConfig, scan_cfg: ScanConfig,
+                       ranges: Array, prox: Array,
+                       exploring: Array) -> PolicyOut:
+    """Batched reactive navigator. ranges (R, B), prox (R, 5),
+    exploring (R,) bool."""
+    R = ranges.shape[0]
+    cruise = jnp.float32(robot.cruise_speed_units)
+    rot = jnp.float32(robot.rotation_speed_units)
+    inner = jnp.float32(robot.swerve_inner_units)
+
+    max_ir = jnp.max(prox[:, 0:5], axis=-1)
+    ir_stop = max_ir > robot.ir_threshold
+    weight_left = prox[:, 0] * 2 + prox[:, 1]
+    weight_right = prox[:, 4] * 2 + prox[:, 3]
+    # Obstacle on the left -> pivot right (left wheel fwd, right wheel back).
+    pivot = jnp.where((weight_left > weight_right)[:, None],
+                      jnp.stack([jnp.full(R, rot), jnp.full(R, -rot)], -1),
+                      jnp.stack([jnp.full(R, -rot), jnp.full(R, rot)], -1))
+
+    left_cone, right_cone = _front_cones(scan_cfg, ranges)
+    min_dist = jnp.minimum(left_cone, right_cone)
+    lidar_warn = min_dist < robot.lidar_warn_dist_m
+    # Obstacle in the left cone -> swerve right, else swerve left.
+    swerve = jnp.where((left_cone < right_cone)[:, None],
+                       jnp.stack([jnp.full(R, cruise), jnp.full(R, inner)], -1),
+                       jnp.stack([jnp.full(R, inner), jnp.full(R, cruise)], -1))
+
+    go = jnp.stack([jnp.full(R, cruise), jnp.full(R, cruise)], -1)
+
+    targets = jnp.where(ir_stop[:, None], pivot,
+                        jnp.where(lidar_warn[:, None], swerve, go))
+    targets = jnp.where(exploring[:, None], targets, 0.0)
+
+    state = jnp.where(~exploring, 0,
+                      jnp.where(ir_stop, 2, jnp.where(lidar_warn, 3, 1)))
+    led = jnp.stack([LED_IDLE, LED_CRUISE, LED_IR, LED_WARN])[state]
+    return PolicyOut(targets=targets.astype(jnp.int32), led=led,
+                     state=state.astype(jnp.int32))
+
+
+def frontier_policy(robot: RobotConfig, scan_cfg: ScanConfig,
+                    poses: Array, goals_xy: Array, goal_valid: Array,
+                    ranges: Array, prox: Array,
+                    exploring: Array) -> PolicyOut:
+    """Goal-seeking with the reactive shield.
+
+    Steers toward the assigned frontier centroid; the subsumption layers
+    override whenever IR/LiDAR demand it; robots without a valid goal cruise
+    (the reference's LiDAR-less fallback, main.py:185-188).
+    """
+    reactive = subsumption_policy(robot, scan_cfg, ranges, prox, exploring)
+
+    bearing = jnp.arctan2(goals_xy[:, 1] - poses[:, 1],
+                          goals_xy[:, 0] - poses[:, 0])
+    err = wrap_angle(bearing - poses[:, 2])                  # (R,)
+    cruise = jnp.float32(robot.cruise_speed_units)
+    # Proportional differential steer, saturating at a pivot.
+    steer = jnp.clip(err * 2.0, -1.5, 1.5)
+    base = cruise * jnp.clip(1.0 - jnp.abs(err) / jnp.pi * 1.5, 0.2, 1.0)
+    left = base - steer * cruise * 0.5
+    right = base + steer * cruise * 0.5
+    seek = jnp.stack([left, right], axis=-1)
+
+    use_seek = goal_valid & (reactive.state == 1)            # only in cruise
+    targets = jnp.where(use_seek[:, None], seek, reactive.targets)
+    targets = jnp.where(exploring[:, None], targets, 0.0)
+    return PolicyOut(targets=targets.astype(jnp.int32), led=reactive.led,
+                     state=reactive.state)

@@ -1,0 +1,106 @@
+"""Fleet model: N simulated Thymios exploring and mapping one shared world —
+the framework's flagship pipeline (BASELINE.json configs 4-5).
+
+One jitted step closes the whole loop the reference spreads across two
+machines and three processes (SURVEY.md §3.2-3.4):
+
+  simulate LD06 scans (device raycast)           [was: LD06 driver on the Pi]
+  -> odometry from measured wheel speeds         [was: ThymioBrain update_loop]
+  -> batched correlative matching                [was: slam_toolbox matcher]
+  -> batched log-odds fusion into a shared grid  [was: slam_toolbox rasterizer]
+  -> frontier detect/cluster/assign              [was: future work, §VI.2]
+  -> explorer policy -> wheel targets            [was: subsumption navigator]
+  -> fleet kinematics step                       [was: physical robots]
+
+Everything is (R, ...)-batched with vmap; `parallel.fleet_sharded` runs the
+same step under shard_map over a ('fleet', 'space') mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import SlamConfig
+from jax_mapping.models.explorer import PolicyOut, frontier_policy
+from jax_mapping.ops import frontier as F
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import scan_match as M
+from jax_mapping.ops.odometry import rk2_step
+from jax_mapping.sim import lidar, thymio
+
+Array = jax.Array
+
+
+class FleetState(NamedTuple):
+    sim: thymio.FleetSimState   # ground truth
+    est_poses: Array            # (R, 3) SLAM estimates
+    grid: Array                 # (N, N) shared log-odds map
+    exploring: Array            # (R,) bool (the /start /stop flag)
+    t: Array                    # () int32 step counter
+
+
+class FleetDiag(NamedTuple):
+    policy: PolicyOut
+    frontiers: F.FrontierResult
+    match_response: Array       # (R,)
+    pose_err: Array             # (R,) |est - truth| (sim-only luxury)
+
+
+def init_fleet_state(cfg: SlamConfig, key: Array) -> FleetState:
+    R = cfg.fleet.n_robots
+    sim = thymio.init_fleet(cfg.robot, key, R)
+    return FleetState(
+        sim=sim,
+        est_poses=sim.poses,               # start calibrated
+        grid=G.empty_grid(cfg.grid),
+        exploring=jnp.ones((R,), bool),
+        t=jnp.int32(0),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def fleet_step(cfg: SlamConfig, state: FleetState, world_res_m: float,
+               world: Array) -> tuple[FleetState, FleetDiag]:
+    """One synchronous fleet tick (the reference's 10 Hz loop, batched)."""
+    dt = 1.0 / cfg.robot.control_rate_hz
+    n_samples = int(cfg.scan.range_max_m / (world_res_m * 0.5))
+
+    # 1. Sense: scans + IR from ground truth.
+    scans = lidar.simulate_scans(cfg.scan, world, world_res_m, n_samples,
+                                 state.sim.poses)
+    prox = lidar.ir_proximity(world, world_res_m, state.sim.poses)
+
+    # 2. Act: frontier assignment on the current map drives the policy.
+    fr = F.compute_frontiers(cfg.frontier, cfg.grid, state.grid,
+                             state.est_poses)
+    goals = fr.targets[jnp.clip(fr.assignment, 0)]
+    goal_valid = fr.assignment >= 0
+    pol = frontier_policy(cfg.robot, cfg.scan, state.est_poses, goals,
+                          goal_valid, scans, prox, state.exploring)
+
+    # 3. Move the simulated fleet; read measured wheel speeds.
+    sim2, measured = thymio.step_fleet(cfg.robot, state.sim,
+                                       pol.targets.astype(jnp.float32), dt)
+
+    # 4. Odometry propagate estimates from measured speeds.
+    est = jax.vmap(lambda p, w: rk2_step(cfg.robot, p, w[0], w[1], dt))(
+        state.est_poses, measured)
+
+    # 5. Correlative correction against the shared map.
+    res = M.match_batch(cfg.grid, cfg.scan, cfg.matcher, state.grid,
+                        scans, est)
+    est = jnp.where(res.accepted[:, None], res.pose, est)
+
+    # 6. Fuse this tick's scans (batched fold, exact under overlap).
+    grid = G.fuse_scans(cfg.grid, cfg.scan, state.grid, scans, est)
+
+    state2 = FleetState(sim=sim2, est_poses=est, grid=grid,
+                        exploring=state.exploring, t=state.t + 1)
+    diag = FleetDiag(policy=pol, frontiers=fr, match_response=res.response,
+                     pose_err=jnp.linalg.norm(
+                         est[:, :2] - sim2.poses[:, :2], axis=-1))
+    return state2, diag

@@ -1,0 +1,166 @@
+"""Single-robot SLAM model: the full slam_toolbox capability as one jitted
+step function.
+
+Replaces the reference's external SLAM process (slam_toolbox online_async,
+`/root/reference/server/thymio_project/launch/pc_server.launch.py:14-19`,
+behavior fixed by `config/slam_config.yaml` — see SURVEY.md §3.4):
+
+  gate (min travel 0.1 m / 0.1 rad) -> correlative scan match -> pose-graph
+  insert -> loop-closure search/verify -> optimise -> occupancy update.
+
+TPU-first: state is a pytree of fixed-shape device arrays (grid, pose ring,
+scan ring, pose graph); every branch is a `lax.cond` with identical shapes;
+loop-closure *map repair* is a full re-fusion of the stored scan ring from
+the optimised trajectory (cheap on TPU, exact) instead of Karto's
+incremental patching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import SlamConfig
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import posegraph as PG
+from jax_mapping.ops import scan_match as M
+from jax_mapping.ops.odometry import pose_between, rk2_step, wrap_angle
+
+Array = jax.Array
+
+
+class SlamState(NamedTuple):
+    grid: Array          # (N, N) log-odds
+    pose: Array          # (3,) current estimate (map frame)
+    last_key_pose: Array  # (3,) pose at the last accepted key-scan
+    graph: PG.PoseGraph
+    scan_ring: Array     # (max_poses, padded_beams) key-scans
+    n_loops: Array       # () int32 closed loops (telemetry)
+    n_keyscans: Array    # () int32
+
+
+class SlamDiag(NamedTuple):
+    matched: Array       # () bool: scan-matcher accepted
+    response: Array      # () float
+    key_added: Array     # () bool
+    loop_closed: Array   # () bool
+
+
+def init_state(cfg: SlamConfig, pose0=None) -> SlamState:
+    g = cfg.grid
+    pose = jnp.zeros(3) if pose0 is None else jnp.asarray(pose0)
+    return SlamState(
+        grid=G.empty_grid(g),
+        pose=pose.astype(jnp.float32),
+        last_key_pose=jnp.full(3, 1e9, jnp.float32),   # force first key-scan
+        graph=PG.empty_graph(cfg.loop),
+        scan_ring=jnp.zeros((cfg.loop.max_poses, cfg.scan.padded_beams),
+                            jnp.float32),
+        n_loops=jnp.int32(0),
+        n_keyscans=jnp.int32(0),
+    )
+
+
+def _loop_matcher_cfg(cfg: SlamConfig):
+    """Wider search window for loop verification (slam_config.yaml:56:
+    loop search space 8 m; here bounded by the patch margin)."""
+    m = cfg.matcher
+    half = min(cfg.loop.search_radius_m,
+               (cfg.grid.patch_cells / 2 - cfg.grid.align_cols / 2)
+               * cfg.grid.resolution_m - cfg.grid.max_range_m)
+    half = max(half, m.search_half_extent_m)
+    return dataclasses.replace(m, search_half_extent_m=half,
+                               coarse_step_m=m.coarse_step_m * 2)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
+              wheel_left: Array, wheel_right: Array,
+              dt: Array) -> tuple[SlamState, SlamDiag]:
+    """One control-period update: odometry, gated match+fuse, loop closure."""
+    m = cfg.matcher
+    pose_odo = rk2_step(cfg.robot, state.pose, wheel_left, wheel_right, dt)
+
+    # Key-scan gate (slam_config.yaml:37-38).
+    d = jnp.linalg.norm(pose_odo[:2] - state.last_key_pose[:2])
+    dth = jnp.abs(wrap_angle(pose_odo[2] - state.last_key_pose[2]))
+    is_key = (d > m.min_travel_m) | (dth > m.min_heading_rad)
+
+    def key_branch(st: SlamState):
+        # Bootstrap: with an empty map the matcher has nothing to align to;
+        # response gating rejects and we fall back to odometry (reference
+        # degraded-mode semantics, SURVEY.md §5 failure detection).
+        res = M.match(cfg.grid, cfg.scan, m, st.grid, ranges, pose_odo)
+        pose = jnp.where(res.accepted, res.pose, pose_odo)
+
+        grid = G.fuse_scan(cfg.grid, cfg.scan, st.grid, ranges, pose)
+        k = st.graph.n_poses
+        graph = PG.add_pose(st.graph, pose)
+        graph = jax.lax.cond(
+            k > 0,
+            lambda gr: PG.odometry_edge(gr, jnp.maximum(k - 1, 0), k),
+            lambda gr: gr, graph)
+        ring = jnp.where(k < cfg.loop.max_poses,
+                         st.scan_ring.at[jnp.minimum(
+                             k, cfg.loop.max_poses - 1)].set(ranges),
+                         st.scan_ring)
+
+        # ---- loop closure ------------------------------------------------
+        cand, found = PG.loop_candidate(cfg.loop, graph, k)
+
+        def close_loop(args):
+            graph, grid, ring = args
+            lres = M.match(cfg.grid, cfg.scan, _loop_matcher_cfg(cfg),
+                           grid, ranges, pose)
+            good = lres.accepted & (lres.response >= cfg.loop.response_fine)
+
+            def apply(args):
+                graph, grid, ring = args
+                # Loop edge: candidate -> current, measured by the verified
+                # match; strong information.
+                rel = pose_between(graph.poses[cand], lres.pose)
+                g2 = PG.add_edge(graph, cand, k, rel,
+                                 jnp.array([200.0, 200.0, 400.0]))
+                g2 = PG.optimize(cfg.loop, g2)
+                # Map repair: re-fuse every key-scan from optimised poses.
+                grid2 = G.fuse_scans(
+                    cfg.grid, cfg.scan,
+                    G.empty_grid(cfg.grid),
+                    ring,
+                    g2.poses[:cfg.loop.max_poses]
+                    * g2.pose_valid[:cfg.loop.max_poses, None])
+                return g2, grid2, jnp.bool_(True)
+
+            return jax.lax.cond(good, apply,
+                                lambda a: (a[0], a[1], jnp.bool_(False)),
+                                (graph, grid, ring))
+
+        graph, grid, closed = jax.lax.cond(
+            found & (cfg.loop.enabled),
+            close_loop,
+            lambda a: (a[0], a[1], jnp.bool_(False)),
+            (graph, grid, ring))
+
+        # After optimisation the current pose may have moved.
+        pose = jnp.where(closed, graph.poses[k], pose)
+
+        st2 = SlamState(grid=grid, pose=pose, last_key_pose=pose,
+                        graph=graph, scan_ring=ring,
+                        n_loops=st.n_loops + closed.astype(jnp.int32),
+                        n_keyscans=st.n_keyscans + 1)
+        diag = SlamDiag(matched=res.accepted, response=res.response,
+                        key_added=jnp.bool_(True), loop_closed=closed)
+        return st2, diag
+
+    def skip_branch(st: SlamState):
+        st2 = st._replace(pose=pose_odo)
+        diag = SlamDiag(matched=jnp.bool_(False), response=jnp.float32(0),
+                        key_added=jnp.bool_(False),
+                        loop_closed=jnp.bool_(False))
+        return st2, diag
+
+    return jax.lax.cond(is_key, key_branch, skip_branch, state)

@@ -39,7 +39,11 @@ _BIG = jnp.float32(1e9)
 class FrontierResult(NamedTuple):
     mask: Array            # (n, n) bool frontier cells (coarse resolution)
     labels: Array          # (n, n) int32 cluster label per cell (-1 none)
+    slots: Array           # (n, n) int32 top-K slot per cell (-1 none)
     centroids: Array       # (K, 2) float32 world-metre centroids
+    targets: Array         # (K, 2) float32 world-metre goal points: a real
+    #                        frontier cell of the cluster (centroids of
+    #                        concave clusters can land on walls)
     sizes: Array           # (K,) int32 cells per cluster (0 = empty slot)
     assignment: Array      # (R,) int32 cluster index per robot (-1 = none)
     costs: Array           # (R, K) float32 robot->cluster travel cost (cells)
@@ -54,10 +58,10 @@ def coarsen(cfg: FrontierConfig, grid_cfg: GridConfig, logodds: Array):
 
     A coarse cell is occupied if ANY child is occupied (conservative for
     planning), free if any child is free and none occupied, else unknown.
+    Works on the full grid or a row slab (spatially sharded caller).
     """
     d = cfg.downsample
-    n = grid_cfg.size_cells // d
-    x = logodds.reshape(n, d, n, d)
+    x = logodds.reshape(logodds.shape[0] // d, d, logodds.shape[1] // d, d)
     any_occ = (x > grid_cfg.occ_threshold).any(axis=(1, 3))
     any_free = (x < grid_cfg.free_threshold).any(axis=(1, 3))
     free = any_free & ~any_occ
@@ -120,12 +124,15 @@ def label_components(cfg: FrontierConfig, mask: Array) -> Array:
 
 
 def summarize_clusters(cfg: FrontierConfig, grid_cfg: GridConfig,
-                       labels: Array) -> tuple[Array, Array, Array]:
+                       labels: Array) -> tuple[Array, Array, Array, Array]:
     """Compress arbitrary labels into K static slots (top-K by size).
 
-    Returns (centroids_world (K,2), sizes (K,), slot_of_cell (n,n) int32).
-    One-hot reductions keep this on the MXU; slots beyond the true cluster
-    count have size 0 and centroid at _BIG.
+    Returns (centroids_world (K,2), targets_world (K,2), sizes (K,),
+    slot_of_cell (n,n) int32). `targets` is a representative cell that IS
+    part of the cluster — a concave cluster's centroid can fall on a wall,
+    which would make it unreachable for the BFS cost and a bad goal point.
+    Segment reductions keep this dense; slots beyond the true cluster count
+    have size 0 and centroid/target at _BIG.
     """
     n = labels.shape[0]
     K = cfg.max_clusters
@@ -173,7 +180,26 @@ def summarize_clusters(cfg: FrontierConfig, grid_cfg: GridConfig,
     cy = (c_row + 0.5) * res + oy
     centroids = jnp.where(slot_valid[:, None],
                           jnp.stack([cx, cy], -1), _BIG)
-    return centroids, top_sizes.astype(jnp.int32), \
+
+    # Representative cell per slot: the member closest to the centroid
+    # (min squared distance via segment_min) — always a real frontier cell.
+    d2 = (rows - c_row[jnp.clip(slot_of_cell, 0)]) ** 2 \
+        + (cols - c_col[jnp.clip(slot_of_cell, 0)]) ** 2
+    # d2 holds small integers-ish (< 2*n^2 < 2^24), exact in float32.
+    min_d2 = jax.ops.segment_min(jnp.where(sel, d2, jnp.inf), seg,
+                                 num_segments=K)
+    is_best = sel & (d2 <= min_d2[seg] + 0.5)
+    rep_lin = jax.ops.segment_min(jnp.where(is_best, lin, n * n), seg,
+                                  num_segments=K)
+    has_rep = rep_lin < n * n
+    rep_lin = jnp.clip(rep_lin, 0, n * n - 1)
+    rep_row = (rep_lin // n).astype(jnp.float32)
+    rep_col = (rep_lin % n).astype(jnp.float32)
+    tx = (rep_col + 0.5) * res + ox
+    ty = (rep_row + 0.5) * res + oy
+    targets = jnp.where(slot_valid[:, None] & has_rep[:, None],
+                        jnp.stack([tx, ty], -1), _BIG)
+    return centroids, targets, top_sizes.astype(jnp.int32), \
         slot_of_cell.reshape(n, n)
 
 
@@ -251,37 +277,49 @@ def assign_frontiers(costs: Array) -> Array:
 def compute_frontiers(cfg: FrontierConfig, grid_cfg: GridConfig,
                       logodds: Array, robot_poses: Array) -> FrontierResult:
     """logodds (N,N) + robot poses (R,3) -> frontiers, clusters, assignment."""
-    free, occ, unknown = coarsen(cfg, grid_cfg, logodds)
+    free, _occ, unknown = coarsen(cfg, grid_cfg, logodds)
+    return compute_frontiers_from_masks(cfg, grid_cfg, free, unknown,
+                                        robot_poses)
+
+
+def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
+                                 free: Array, unknown: Array,
+                                 robot_poses: Array) -> FrontierResult:
+    """Mask-level entry point: lets a spatially-sharded caller coarsen its
+    own grid slab locally and all_gather only the coarse masks."""
     mask = frontier_mask(free, unknown)
     labels = label_components(cfg, mask)
-    centroids, sizes, slots = summarize_clusters(cfg, grid_cfg, labels)
+    centroids, targets, sizes, slots = summarize_clusters(cfg, grid_cfg,
+                                                          labels)
 
-    # Per-robot obstacle-aware cost to each cluster centroid.
+    # Per-robot cost to each cluster's representative frontier cell (a real
+    # member cell — always passable, unlike a concave cluster's centroid).
     d = cfg.downsample
     res = grid_cfg.resolution_m * d
     ox, oy = grid_cfg.origin_m
     passable = free | mask | unknown   # robots may push into unknown space
 
-    cent_r = jnp.clip(((centroids[:, 1] - oy) / res).astype(jnp.int32),
-                      0, free.shape[0] - 1)
-    cent_c = jnp.clip(((centroids[:, 0] - ox) / res).astype(jnp.int32),
-                      0, free.shape[0] - 1)
+    tgt_r = jnp.clip(((targets[:, 1] - oy) / res).astype(jnp.int32),
+                     0, free.shape[0] - 1)
+    tgt_c = jnp.clip(((targets[:, 0] - ox) / res).astype(jnp.int32),
+                     0, free.shape[0] - 1)
 
     if cfg.obstacle_aware:
         def robot_costs(pose):
             rc = jnp.stack([((pose[1] - oy) / res).astype(jnp.int32),
                             ((pose[0] - ox) / res).astype(jnp.int32)])[None, :]
             dist = cost_to_go(cfg, passable, rc, jnp.array([True]))
-            return dist[cent_r, cent_c]
+            return dist[tgt_r, tgt_c]
 
         costs = jax.vmap(robot_costs)(robot_poses)        # (R, K)
     else:
-        # Euclidean centroid distance in coarse cells (latency mode).
-        diff = centroids[None, :, :] - robot_poses[:, None, :2]
+        # Euclidean distance in coarse cells (latency mode).
+        diff = targets[None, :, :] - robot_poses[:, None, :2]
         costs = jnp.linalg.norm(diff, axis=-1) / res
         costs = jnp.where(jnp.isfinite(costs), costs, _BIG)
         costs = jnp.minimum(costs, _BIG)
     costs = jnp.where((sizes > 0)[None, :], costs, _BIG)
     assignment = assign_frontiers(costs)
-    return FrontierResult(mask=mask, labels=labels, centroids=centroids,
-                          sizes=sizes, assignment=assignment, costs=costs)
+    return FrontierResult(mask=mask, labels=labels, slots=slots,
+                          centroids=centroids, targets=targets, sizes=sizes,
+                          assignment=assignment, costs=costs)

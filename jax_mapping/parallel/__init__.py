@@ -1,0 +1,8 @@
+"""Mesh construction, shard_map fleet step, collectives.
+
+The reference's distribution is DDS pub/sub between two hosts (SURVEY.md
+§2.4); here distribution is XLA collectives over a jax.sharding.Mesh:
+robots data-parallel along a 'fleet' axis (psum map merge), the grid
+spatially sharded along a 'space' axis (the spatial analog of sequence
+parallelism, SURVEY.md §5).
+"""

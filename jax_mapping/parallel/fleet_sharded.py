@@ -1,0 +1,202 @@
+"""Multi-chip fleet step: shard_map over a ('fleet', 'space') mesh.
+
+The distribution design (SURVEY.md §2.4 mapping, scaling-book recipe —
+pick a mesh, annotate shardings, let XLA insert collectives):
+
+  axis 'fleet' — robots are data-parallel. Sensing, matching, patch
+      classification and the explorer policy never communicate; the ONLY
+      fleet-wide exchange is (a) one psum merging per-robot log-odds slab
+      contributions (the on-device analog of the reference's DDS fan-in of
+      every robot's /scan into one SLAM node) and (b) one all_gather of the
+      small robot->cluster cost matrix so the greedy auction sees the whole
+      fleet.
+
+  axis 'space' — the grid lives sharded by row slabs. The dense inverse
+      sensor model is cell-local, so each slab evaluates every local robot's
+      patch restricted to its own rows with NO halo exchange (SURVEY.md §7
+      "sharded grid halos" solved by construction). The matcher needs map
+      context around each robot, obtained with one tiled all_gather along
+      'space'; frontier work coarsens slabs locally and all_gathers only the
+      (size/downsample)^2 coarse masks.
+
+Collectives per step: all_gather(grid, 'space'), psum(slab deltas, 'fleet'),
+all_gather(coarse masks, 'space'), all_gather(costs, 'fleet') — all riding
+ICI on a real pod.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jax_mapping.config import SlamConfig
+from jax_mapping.models.explorer import frontier_policy
+from jax_mapping.ops import frontier as F
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import scan_match as M
+from jax_mapping.ops.odometry import rk2_step
+from jax_mapping.sim import lidar, thymio
+
+Array = jax.Array
+
+
+class ShardedFleetState(NamedTuple):
+    """Global-view pytree; sharding applied via NamedSharding on creation."""
+    true_poses: Array     # (R, 3)   P('fleet', None)
+    wheel_speeds: Array   # (R, 2)   P('fleet', None)
+    keys: Array           # (R,)     P('fleet',)  per-robot PRNG keys
+    est_poses: Array      # (R, 3)   P('fleet', None)
+    grid: Array           # (N, N)   P('space', None)
+    exploring: Array      # (R,)     P('fleet',)
+    t: Array              # ()       replicated
+
+
+def state_specs() -> ShardedFleetState:
+    return ShardedFleetState(
+        true_poses=P("fleet", None),
+        wheel_speeds=P("fleet", None),
+        keys=P("fleet"),
+        est_poses=P("fleet", None),
+        grid=P("space", None),
+        exploring=P("fleet"),
+        t=P(),
+    )
+
+
+def init_sharded_state(cfg: SlamConfig, mesh: Mesh, seed: int = 0
+                       ) -> ShardedFleetState:
+    R = cfg.fleet.n_robots
+    ang = jnp.linspace(0, 2 * jnp.pi, R, endpoint=False)
+    r = 0.4 + 0.2 * (jnp.arange(R) % 3) / 3.0
+    poses = jnp.stack([r * jnp.cos(ang), r * jnp.sin(ang), ang], -1)
+    state = ShardedFleetState(
+        true_poses=poses.astype(jnp.float32),
+        wheel_speeds=jnp.zeros((R, 2), jnp.float32),
+        keys=jax.vmap(lambda i: jax.random.fold_in(
+            jax.random.PRNGKey(seed), i))(jnp.arange(R)),
+        est_poses=poses.astype(jnp.float32),
+        grid=G.empty_grid(cfg.grid),
+        exploring=jnp.ones((R,), bool),
+        t=jnp.int32(0),
+    )
+    specs = state_specs()
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs,
+        is_leaf=lambda x: isinstance(x, (jnp.ndarray, jax.Array)))
+
+
+def _slab_delta(cfg: SlamConfig, scans: Array, poses: Array,
+                slab_row0: Array, slab_rows: int) -> Array:
+    """Per-robot patches -> one (slab_rows, N) delta restricted to this slab.
+
+    A patch at global row origin o lands at canvas row o - slab_row0 + Pp
+    in a (slab_rows + 2*Pp, N) canvas; non-overlapping patches clip into the
+    discarded margins, overlap slices out exactly. Sequential fold keeps
+    overlapping patches deterministic (no scatter)."""
+    g, s = cfg.grid, cfg.scan
+    Pp = g.patch_cells
+    N = g.size_cells
+    origins = jax.vmap(lambda p: G.patch_origin(g, p[:2]))(poses)
+    deltas = jax.vmap(
+        lambda r, p, o: G.classify_patch(g, s, r, p, o))(scans, poses, origins)
+
+    canvas = jnp.zeros((slab_rows + 2 * Pp, N), jnp.float32)
+
+    def body(cv, do):
+        delta, origin = do
+        ro = jnp.clip(origin[0] - slab_row0 + Pp, 0, slab_rows + Pp)
+        cur = jax.lax.dynamic_slice(cv, (ro, origin[1]), (Pp, Pp))
+        return jax.lax.dynamic_update_slice(cv, cur + delta,
+                                            (ro, origin[1])), None
+
+    canvas, _ = jax.lax.scan(body, canvas, (deltas, origins))
+    return canvas[Pp:Pp + slab_rows]
+
+
+def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
+    """Build the jitted sharded step: (state, world) -> (state, metrics)."""
+    n_space = mesh.shape["space"]
+    n_fleet = mesh.shape["fleet"]
+    N = cfg.grid.size_cells
+    slab_rows = N // n_space
+    R = cfg.fleet.n_robots
+    if R % n_fleet:
+        raise ValueError(f"n_robots={R} not divisible by fleet axis {n_fleet}")
+    n_samples = max(8, int(cfg.scan.range_max_m / (world_res_m * 0.5)))
+    dt = 1.0 / cfg.robot.control_rate_hz
+    d = cfg.frontier.downsample
+    if slab_rows % d:
+        raise ValueError("slab rows must be divisible by frontier downsample")
+
+    def step(state: ShardedFleetState, world: Array):
+        # Per-device views: robots R/n_fleet, grid slab (slab_rows, N).
+        slab_idx = jax.lax.axis_index("space")
+        slab_row0 = slab_idx * slab_rows
+
+        # 1. Sense (local robots, replicated world).
+        scans = lidar.simulate_scans(cfg.scan, world, world_res_m,
+                                     n_samples, state.true_poses)
+        prox = lidar.ir_proximity(world, world_res_m, state.true_poses)
+
+        # 2. Frontier: coarsen own slab, gather coarse masks along 'space'.
+        free_s, _occ_s, unk_s = F.coarsen(cfg.frontier, cfg.grid, state.grid)
+        free = jax.lax.all_gather(free_s, "space", axis=0, tiled=True)
+        unk = jax.lax.all_gather(unk_s, "space", axis=0, tiled=True)
+        fr = F.compute_frontiers_from_masks(cfg.frontier, cfg.grid,
+                                            free, unk, state.est_poses)
+        # Fleet-wide auction: gather every robot's costs, auction, slice.
+        costs_all = jax.lax.all_gather(fr.costs, "fleet", axis=0, tiled=True)
+        assign_all = F.assign_frontiers(costs_all)
+        my = jax.lax.axis_index("fleet") * (R // n_fleet)
+        assignment = jax.lax.dynamic_slice_in_dim(assign_all, my,
+                                                  R // n_fleet)
+        goals = fr.targets[jnp.clip(assignment, 0)]
+        goal_valid = assignment >= 0
+
+        # 3. Policy (local).
+        pol = frontier_policy(cfg.robot, cfg.scan, state.est_poses, goals,
+                              goal_valid, scans, prox, state.exploring)
+
+        # 4. Kinematics (local, per-robot keys).
+        tp, ws, keys, measured = thymio.step_robots_keyed(
+            cfg.robot, state.true_poses, state.wheel_speeds, state.keys,
+            pol.targets.astype(jnp.float32), dt)
+
+        # 5. Odometry + matching against the gathered full grid.
+        est = jax.vmap(lambda p, w: rk2_step(cfg.robot, p, w[0], w[1], dt))(
+            state.est_poses, measured)
+        full_grid = jax.lax.all_gather(state.grid, "space", axis=0,
+                                       tiled=True)
+        res = M.match_batch(cfg.grid, cfg.scan, cfg.matcher, full_grid,
+                            scans, est)
+        est = jnp.where(res.accepted[:, None], res.pose, est)
+
+        # 6. Fuse: local robots' slab contributions, psum across the fleet.
+        delta = _slab_delta(cfg, scans, est, slab_row0, slab_rows)
+        delta = jax.lax.psum(delta, "fleet")
+        grid = jnp.clip(state.grid + delta, cfg.grid.logodds_min,
+                        cfg.grid.logodds_max)
+
+        state2 = ShardedFleetState(
+            true_poses=tp, wheel_speeds=ws, keys=keys, est_poses=est,
+            grid=grid, exploring=state.exploring, t=state.t + 1)
+        # Scalar fleet metrics (psum'd so they are true fleet aggregates).
+        err = jnp.sum(jnp.linalg.norm(est[:, :2] - tp[:, :2], axis=-1))
+        err = jax.lax.psum(err, "fleet") / R
+        resp = jax.lax.psum(jnp.sum(res.response), "fleet") / R
+        metrics = {"mean_pose_err_m": err, "mean_match_response": resp,
+                   "n_clusters": jnp.sum(fr.sizes > 0)}
+        return state2, metrics
+
+    specs = state_specs()
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, P(None, None)),
+        out_specs=(specs, {"mean_pose_err_m": P(), "mean_match_response": P(),
+                           "n_clusters": P()}),
+        check_vma=False)
+    return jax.jit(sharded)

@@ -1,0 +1,50 @@
+"""Device-mesh construction helpers.
+
+Axes:
+  'fleet' — data parallelism over robots: per-robot sensing/matching/patch
+            classification are independent; map contributions merge with a
+            single psum (the on-device replacement for the reference's DDS
+            fan-in of /scan to one SLAM process, SURVEY.md §2.4).
+  'space' — the occupancy grid sharded by row blocks: each device owns a
+            horizontal slab of the world (halo-free by construction: the
+            inverse sensor model is cell-local, so a slab can evaluate any
+            robot's patch restricted to its own rows).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+
+def factor_devices(n: int) -> tuple[int, int]:
+    """Split n devices into (fleet, space) as square-ish as possible,
+    preferring more fleet parallelism (robot count usually exceeds the
+    useful number of grid slabs)."""
+    best = (n, 1)
+    for space in range(1, int(math.isqrt(n)) + 1):
+        if n % space == 0:
+            best = (n // space, space)
+    return best
+
+
+def make_mesh(n_fleet: Optional[int] = None, n_space: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ('fleet', 'space') mesh over the available devices."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if n_fleet is None and n_space is None:
+        n_fleet, n_space = factor_devices(n)
+    elif n_fleet is None:
+        n_fleet = n // n_space
+    elif n_space is None:
+        n_space = n // n_fleet
+    if n_fleet * n_space != n:
+        raise ValueError(
+            f"mesh {n_fleet}x{n_space} != {n} devices available")
+    import numpy as np
+    arr = np.array(devs).reshape(n_fleet, n_space)
+    return Mesh(arr, axis_names=("fleet", "space"))

@@ -1,0 +1,1 @@
+"""Simulated Thymio fleet + synthetic LD06 LiDAR, all on device."""

@@ -1,0 +1,97 @@
+"""Synthetic LD06 LiDAR: device-native raycasting against a world bitmap.
+
+The real pipeline's sensor is the LD06 driver publishing ~360-beam
+counterclockwise scans (`/root/reference/pi/src/thymio_project/launch/
+pi_hardware.launch.py:13-21`). The simulator reproduces that contract on
+device — but TPU-first: no per-ray marching loops. Every beam samples the
+world at S fixed radial steps (one big gather), and the first hit distance
+falls out of an argmax over the boolean hit profile. vmap over beams and
+robots; everything static-shape.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import ScanConfig
+
+Array = jax.Array
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def simulate_scan(scan_cfg: ScanConfig, world: Array, world_res_m: float,
+                  n_samples: int, pose: Array, noise_key=None,
+                  noise_std_m: float = 0.0) -> Array:
+    """One scan from `pose` against boolean `world` (centred indexing).
+
+    Returns (padded_beams,) ranges in metres; beams that exit the world or
+    exceed range_max report 0.0 — the LD06's "no return" code, which the
+    ingest path treats as an outlier (`server/.../main.py:152`).
+    """
+    H, W = world.shape
+    B = scan_cfg.padded_beams
+    idx = jnp.arange(B, dtype=jnp.float32)
+    ang = pose[2] + scan_cfg.angle_min_rad + idx * scan_cfg.angle_increment_rad
+    if not scan_cfg.counterclockwise:
+        ang = pose[2] - (scan_cfg.angle_min_rad
+                         + idx * scan_cfg.angle_increment_rad)
+
+    # Radial sample distances: (S,) from just past the robot to range_max.
+    rs = jnp.linspace(scan_cfg.range_min_m, scan_cfg.range_max_m, n_samples)
+    xs = pose[0] + jnp.cos(ang)[:, None] * rs[None, :]       # (B, S)
+    ys = pose[1] + jnp.sin(ang)[:, None] * rs[None, :]
+    col = jnp.round(xs / world_res_m + W / 2 - 0.5).astype(jnp.int32)
+    row = jnp.round(ys / world_res_m + H / 2 - 0.5).astype(jnp.int32)
+    inb = (row >= 0) & (row < H) & (col >= 0) & (col < W)
+    hit = world[jnp.clip(row, 0, H - 1), jnp.clip(col, 0, W - 1)] & inb
+
+    any_hit = hit.any(axis=1)
+    first = jnp.argmax(hit, axis=1)                          # (B,)
+    r = jnp.where(any_hit, rs[first], 0.0)
+    if noise_key is not None and noise_std_m > 0.0:
+        r = jnp.where(any_hit,
+                      r + noise_std_m * jax.random.normal(noise_key, r.shape),
+                      r)
+    # Padded tail beams report nothing.
+    live = jnp.arange(B) < scan_cfg.n_beams
+    return jnp.where(live, r, 0.0).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def simulate_scans(scan_cfg: ScanConfig, world: Array, world_res_m: float,
+                   n_samples: int, poses: Array) -> Array:
+    """vmap over a (R, 3) pose batch -> (R, padded_beams) scans."""
+    return jax.vmap(
+        lambda p: simulate_scan(scan_cfg, world, world_res_m, n_samples, p)
+    )(poses)
+
+
+def ir_proximity(world: Array, world_res_m: float, poses: Array,
+                 max_dist_m: float = 0.12, n_samples: int = 16) -> Array:
+    """Simulated Thymio front IR sensors: 5 horizontal proximity readings.
+
+    The real robot reports prox.horizontal[0:5] across ~+-40 degrees with
+    values up to ~4500 near contact (`server/.../main.py:98,125-137`). The
+    sim maps obstacle distance linearly to that scale.
+    """
+    angles = jnp.linspace(-0.7, 0.7, 5)                       # sensor bearings
+    H, W = world.shape
+
+    def one(pose):
+        a = pose[2] + angles                                  # (5,)
+        rs = jnp.linspace(0.02, max_dist_m, n_samples)
+        xs = pose[0] + jnp.cos(a)[:, None] * rs[None, :]
+        ys = pose[1] + jnp.sin(a)[:, None] * rs[None, :]
+        col = jnp.round(xs / world_res_m + W / 2 - 0.5).astype(jnp.int32)
+        row = jnp.round(ys / world_res_m + H / 2 - 0.5).astype(jnp.int32)
+        inb = (row >= 0) & (row < H) & (col >= 0) & (col < W)
+        hit = world[jnp.clip(row, 0, H - 1), jnp.clip(col, 0, W - 1)] & inb
+        any_hit = hit.any(axis=1)
+        d = jnp.where(any_hit, rs[jnp.argmax(hit, axis=1)], max_dist_m)
+        return jnp.where(any_hit,
+                         4500.0 * (1.0 - d / max_dist_m), 0.0)
+
+    return jax.vmap(one)(poses)

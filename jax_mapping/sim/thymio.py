@@ -1,0 +1,80 @@
+"""Simulated Thymio fleet: actuation lag, wheel noise, kinematics.
+
+Models both reference odometry regimes (SURVEY.md Appendix B): the server
+reads *measured* wheel speeds (`server/.../main.py:96-97`) while the pi
+variant integrated motor *targets* (`pi/src/.../main.py:188-191`) —
+here motors follow targets through a first-order lag, and the "measured"
+speeds are the lagged values plus calibration noise (report.pdf §V.B: 13%
+coefficient of variation on K_d).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import RobotConfig
+from jax_mapping.ops.odometry import rk2_step
+
+Array = jax.Array
+
+
+class FleetSimState(NamedTuple):
+    poses: Array          # (R, 3) ground-truth poses
+    wheel_speeds: Array   # (R, 2) actual [left, right] in thymio units
+    key: Array            # PRNG
+
+
+def init_fleet(robot: RobotConfig, key: Array, n_robots: int,
+               spawn_radius_m: float = 0.5) -> FleetSimState:
+    """Spawn robots on a ring near the origin, facing outward."""
+    k1, k2 = jax.random.split(key)
+    ang = jnp.linspace(0, 2 * jnp.pi, n_robots, endpoint=False)
+    r = spawn_radius_m * (0.5 + 0.5 * jax.random.uniform(k1, (n_robots,)))
+    poses = jnp.stack([r * jnp.cos(ang), r * jnp.sin(ang), ang], axis=-1)
+    return FleetSimState(poses=poses,
+                         wheel_speeds=jnp.zeros((n_robots, 2)),
+                         key=k2)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def step_fleet(robot: RobotConfig, state: FleetSimState, targets: Array,
+               dt: float, speed_noise_frac: float = 0.05
+               ) -> tuple[FleetSimState, Array]:
+    """Advance every robot dt seconds toward its (R, 2) wheel targets.
+
+    Returns (new_state, measured_speeds): measured speeds are what the
+    odometry path sees — actual wheel speeds with multiplicative noise.
+    """
+    key, k1 = jax.random.split(state.key)
+    alpha = 1.0 - jnp.exp(-dt / robot.motor_lag_tau_s)
+    actual = state.wheel_speeds + alpha * (targets - state.wheel_speeds)
+
+    poses = jax.vmap(
+        lambda p, w: rk2_step(robot, p, w[0], w[1], dt)
+    )(state.poses, actual)
+
+    noise = 1.0 + speed_noise_frac * jax.random.normal(k1, actual.shape)
+    measured = actual * noise
+    return FleetSimState(poses=poses, wheel_speeds=actual, key=key), measured
+
+
+def step_robots_keyed(robot: RobotConfig, poses: Array, wheel_speeds: Array,
+                      keys: Array, targets: Array, dt: float,
+                      speed_noise_frac: float = 0.05):
+    """Per-robot-keyed variant for shard_map (no cross-robot PRNG state):
+    poses (R,3), wheel_speeds (R,2), keys (R,) PRNG keys, targets (R,2).
+    Returns (poses, wheel_speeds, keys, measured)."""
+    def one(pose, w, key, tgt):
+        k_next, k1 = jax.random.split(key)
+        alpha = 1.0 - jnp.exp(-dt / robot.motor_lag_tau_s)
+        actual = w + alpha * (tgt - w)
+        p2 = rk2_step(robot, pose, actual[0], actual[1], dt)
+        measured = actual * (1.0 + speed_noise_frac
+                             * jax.random.normal(k1, (2,)))
+        return p2, actual, k_next, measured
+
+    return jax.vmap(one)(poses, wheel_speeds, keys, targets)

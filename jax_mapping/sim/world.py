@@ -1,0 +1,71 @@
+"""Ground-truth worlds for the simulated fleet.
+
+The reference was validated physically in workshop courses built from wooden
+planks (report.pdf §IV, SURVEY.md §4); the framework equivalent is a
+procedural world generator producing boolean occupancy bitmaps: a bounded
+arena with random axis-aligned walls/boxes — the same courses, simulated.
+World grids use the same centred indexing as the map grid (row = y, col = x).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def empty_arena(size_cells: int, resolution_m: float,
+                wall_cells: int = 2) -> np.ndarray:
+    """Closed rectangular arena: walls around the border."""
+    w = np.zeros((size_cells, size_cells), bool)
+    t = wall_cells
+    w[:t, :] = True
+    w[-t:, :] = True
+    w[:, :t] = True
+    w[:, -t:] = True
+    return w
+
+
+def plank_course(size_cells: int, resolution_m: float, n_planks: int = 12,
+                 seed: int = 0, margin_m: float = 0.6) -> np.ndarray:
+    """Arena + random 'wooden planks': thin axis-aligned wall segments,
+    keeping a clear margin around the centre so robots can start there."""
+    rng = np.random.default_rng(seed)
+    w = empty_arena(size_cells, resolution_m)
+    res = resolution_m
+    margin_c = int(margin_m / res)
+    c = size_cells // 2
+    for _ in range(n_planks):
+        length = rng.integers(int(0.5 / res), int(2.0 / res))
+        thick = max(1, int(0.04 / res))
+        r0 = rng.integers(2, size_cells - 2 - length)
+        c0 = rng.integers(2, size_cells - 2 - length)
+        horiz = rng.random() < 0.5
+        if horiz:
+            rr = slice(r0, r0 + thick)
+            cc = slice(c0, c0 + length)
+        else:
+            rr = slice(r0, r0 + length)
+            cc = slice(c0, c0 + thick)
+        # Keep the spawn zone clear.
+        if abs((rr.start + rr.stop) / 2 - c) < margin_c and \
+                abs((cc.start + cc.stop) / 2 - c) < margin_c:
+            continue
+        w[rr, cc] = True
+    return w
+
+
+def rooms_world(size_cells: int, resolution_m: float,
+                seed: int = 1) -> np.ndarray:
+    """Arena split into rooms with door gaps — loop-closure friendly."""
+    rng = np.random.default_rng(seed)
+    w = empty_arena(size_cells, resolution_m)
+    res = resolution_m
+    door = max(3, int(0.5 / res))
+    for frac in (0.33, 0.66):
+        pos = int(size_cells * frac)
+        gap = rng.integers(door, size_cells - 2 * door)
+        w[pos:pos + 2, :] = True
+        w[pos:pos + 2, gap:gap + door] = False
+        gap = rng.integers(door, size_cells - 2 * door)
+        w[:, pos:pos + 2] = True
+        w[gap:gap + door, pos:pos + 2] = False
+    return w

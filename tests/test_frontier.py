@@ -77,7 +77,7 @@ def test_summarize_clusters_centroids(gcfg, fcfg):
     mask[10:12, 10:12] = True     # 4 cells
     mask[40:46, 40:41] = True     # 6 cells
     labels = F.label_components(fcfg, jnp.asarray(mask))
-    centroids, sizes, slots = F.summarize_clusters(fcfg, gcfg, labels)
+    centroids, targets, sizes, slots = F.summarize_clusters(fcfg, gcfg, labels)
     sizes = np.asarray(sizes)
     assert sorted(sizes[sizes > 0].tolist()) == [4, 6]
     # Biggest first via top_k.

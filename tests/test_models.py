@@ -1,0 +1,139 @@
+"""Model-level tests: sim lidar, explorer policies, slam_step, fleet_step."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import tiny_config
+from jax_mapping.models import explorer as E
+from jax_mapping.models import fleet as FM
+from jax_mapping.models import slam as SM
+from jax_mapping.ops import grid as G
+from jax_mapping.sim import lidar, thymio, world as W
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    import dataclasses
+    c = tiny_config()
+    # Frontier at the same resolution the fleet model uses.
+    return c
+
+
+@pytest.fixture(scope="module")
+def arena(cfg):
+    # 6.4 m arena at map resolution (walls at +-3.2 m).
+    return jnp.asarray(W.empty_arena(128, cfg.grid.resolution_m))
+
+
+@pytest.fixture(scope="module")
+def small_arena(cfg):
+    # 4.8 m arena: walls at +-2.4 m, inside the tiny config's 3 m range.
+    return jnp.asarray(W.empty_arena(96, cfg.grid.resolution_m))
+
+
+def test_simulated_scan_matches_oracle(cfg, arena):
+    from tests.oracle import raycast_scan_np
+    s = cfg.scan
+    pose = np.array([0.3, -0.2, 0.5], np.float32)
+    got = np.asarray(lidar.simulate_scan(s, arena, cfg.grid.resolution_m,
+                                         256, jnp.asarray(pose)))
+    want = raycast_scan_np(np.asarray(arena), pose, s.n_beams,
+                           s.angle_increment_rad, s.range_max_m,
+                           cfg.grid.resolution_m)
+    live = want[:s.n_beams] > 0
+    err = np.abs(got[:s.n_beams][live] - want[:s.n_beams][live])
+    assert np.median(err) < 0.06          # within a cell-ish
+    assert (got[s.n_beams:] == 0).all()   # padded tail silent
+
+
+def test_ir_proximity_scales(cfg, arena):
+    res = cfg.grid.resolution_m
+    # Robot facing the east wall from ~5 cm away: strong IR response.
+    wall_x = (64 - 2) * res
+    near = jnp.asarray(np.array([[wall_x - 0.05, 0.0, 0.0]], np.float32))
+    far = jnp.asarray(np.array([[0.0, 0.0, 0.0]], np.float32))
+    p_near = np.asarray(lidar.ir_proximity(arena, res, near))
+    p_far = np.asarray(lidar.ir_proximity(arena, res, far))
+    assert p_near.max() > 2000            # above IR_THRESHOLD territory
+    assert p_far.max() == 0.0
+
+
+def test_subsumption_policy_layers(cfg):
+    s, r = cfg.scan, cfg.robot
+    R = 4
+    ranges = np.full((R, s.padded_beams), 5.0, np.float32)
+    prox = np.zeros((R, 5), np.float32)
+    exploring = np.array([True, True, True, False])
+    # Robot 1: IR emergency on the left side -> pivot right.
+    prox[1, 0] = 3000
+    # Robot 2: obstacle in the left LiDAR cone -> swerve right.
+    ranges[2, 5] = 0.1
+    out = E.subsumption_policy(r, s, jnp.asarray(ranges), jnp.asarray(prox),
+                               jnp.asarray(exploring))
+    t = np.asarray(out.targets)
+    st = np.asarray(out.state)
+    assert st.tolist() == [1, 2, 3, 0]
+    np.testing.assert_array_equal(t[0], [r.cruise_speed_units] * 2)  # cruise
+    assert t[1, 0] == r.rotation_speed_units and t[1, 1] == -r.rotation_speed_units
+    assert t[2, 0] == r.cruise_speed_units and t[2, 1] == r.swerve_inner_units
+    np.testing.assert_array_equal(t[3], [0, 0])                      # stopped
+    # LED protocol (reference colors).
+    np.testing.assert_array_equal(np.asarray(out.led[3]), [0, 32, 0])
+    np.testing.assert_array_equal(np.asarray(out.led[1]), [32, 0, 0])
+
+
+def test_frontier_policy_steers_toward_goal(cfg):
+    s, r = cfg.scan, cfg.robot
+    ranges = np.full((2, s.padded_beams), 5.0, np.float32)
+    prox = np.zeros((2, 5), np.float32)
+    poses = jnp.asarray(np.array([[0, 0, 0], [0, 0, 0]], np.float32))
+    goals = jnp.asarray(np.array([[1.0, 1.0], [1.0, -1.0]], np.float32))
+    out = E.frontier_policy(r, s, poses, goals, jnp.array([True, True]),
+                            jnp.asarray(ranges), jnp.asarray(prox),
+                            jnp.ones(2, bool))
+    t = np.asarray(out.targets)
+    assert t[0, 1] > t[0, 0]   # goal up-left -> right wheel faster (turn left)
+    assert t[1, 0] > t[1, 1]   # goal down-right -> turn right
+
+
+def test_slam_step_runs_and_maps(cfg, small_arena):
+    arena = small_arena
+    state = SM.init_state(cfg)
+    res_m = cfg.grid.resolution_m
+    key_count = 0
+    for t in range(12):
+        pose_t = state.pose
+        scan = lidar.simulate_scan(cfg.scan, arena, res_m, 256, pose_t)
+        state, diag = SM.slam_step(cfg, state, scan,
+                                   jnp.float32(120.0), jnp.float32(150.0),
+                                   jnp.float32(0.3))
+        key_count += int(diag.key_added)
+    assert key_count >= 2
+    assert int(state.n_keyscans) == key_count
+    occ = np.asarray(G.to_occupancy(cfg.grid, state.grid))
+    assert (occ == 100).sum() > 50        # walls appeared
+    assert (occ == 0).sum() > 200         # free space carved
+    assert np.isfinite(np.asarray(state.pose)).all()
+
+
+def test_fleet_step_explores(cfg, small_arena):
+    arena = small_arena
+    import dataclasses
+    c = dataclasses.replace(cfg, fleet=dataclasses.replace(
+        cfg.fleet, n_robots=4))
+    state = FM.init_fleet_state(c, jax.random.PRNGKey(0))
+    res_m = c.grid.resolution_m
+    for t in range(8):
+        state, diag = FM.fleet_step(c, state, res_m, arena)
+    assert int(state.t) == 8
+    # Map has content; robots stayed in the arena; estimates track truth.
+    occ = np.asarray(G.to_occupancy(c.grid, state.grid))
+    assert (occ == 100).sum() > 30
+    tp = np.asarray(state.sim.poses)
+    assert (np.abs(tp[:, :2]) < 3.2).all()
+    assert np.asarray(diag.pose_err).max() < 0.3
