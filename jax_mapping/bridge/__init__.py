@@ -1,0 +1,20 @@
+"""ROS-shaped plugin boundary.
+
+The reference keeps slam_toolbox, RViz, and Nav2 working by speaking standard
+ROS 2 messages over DDS (SURVEY.md §1 LX, §2.2). This package provides that
+boundary for the TPU framework: message dataclasses mirroring the ROS 2 wire
+types, an in-process pub/sub bus with DDS-like QoS semantics (Best-Effort
+drops included), a TF tree, and a Node/executor model — so the node graph
+shape of the reference (`/scan` + `/odom` in, `/map` + `/frontiers` out) is
+preserved exactly, and a thin rclpy adapter can swap the bus for real DDS
+when ROS 2 is present.
+"""
+
+from jax_mapping.bridge.messages import (  # noqa: F401
+    Header, LaserScan, MapMetaData, OccupancyGrid, Odometry, Pose2D,
+    TransformStamped, Twist,
+)
+from jax_mapping.bridge.qos import QoSProfile, Reliability  # noqa: F401
+from jax_mapping.bridge.bus import Bus  # noqa: F401
+from jax_mapping.bridge.node import Node, Executor  # noqa: F401
+from jax_mapping.bridge.tf import TfTree  # noqa: F401

@@ -1,0 +1,202 @@
+"""In-process pub/sub bus with DDS-like QoS semantics.
+
+Plays the role CycloneDDS plays in the reference (SURVEY.md §1 LX): topics
+scoped by domain id (`ROS_DOMAIN_ID=42`, `/root/reference/README.md:86`,
+`pi/Dockerfile:3`), per-subscription bounded queues, Best-Effort vs Reliable
+delivery, transient-local latching for late joiners (the `/map` pattern),
+and optional fault injection (drop probability, reordering) so the scan
+batcher's tolerance to lossy Wi-Fi delivery (report.pdf §V.A) is testable —
+the race-condition coverage the reference never had (SURVEY.md §4, §5).
+
+Unlike the reference's GIL-reliant unsynchronized sharing
+(`server/.../main.py:285-287`), every queue here is explicitly locked.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from jax_mapping.bridge.qos import Durability, QoSProfile, Reliability, \
+    qos_default
+
+
+class Subscription:
+    """A bounded mailbox attached to one topic."""
+
+    def __init__(self, bus: "Bus", topic: str, qos: QoSProfile,
+                 callback: Optional[Callable[[Any], None]] = None):
+        self.bus = bus
+        self.topic = topic
+        self.qos = qos
+        self.callback = callback
+        self._queue: collections.deque = collections.deque(maxlen=None)
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self.n_received = 0
+        self.n_dropped = 0
+        self._closed = False
+
+    def _offer(self, msg: Any) -> None:
+        """Called by the bus on publish. Best-Effort drops oldest on
+        overflow; Reliable blocks the publisher until there is room."""
+        with self._lock:
+            if self._closed:
+                return
+            if len(self._queue) >= self.qos.depth:
+                if self.qos.reliability is Reliability.BEST_EFFORT:
+                    self._queue.popleft()
+                    self.n_dropped += 1
+                else:
+                    while len(self._queue) >= self.qos.depth \
+                            and not self._closed:
+                        if not self._not_full.wait(timeout=5.0):
+                            # Deadlock breaker: a reliable reader that has
+                            # stalled for 5 s forfeits its oldest sample.
+                            self._queue.popleft()
+                            self.n_dropped += 1
+                            break
+            self._queue.append(msg)
+            self.n_received += 1
+            self._not_empty.notify()
+
+    def take(self, timeout: Optional[float] = None) -> Optional[Any]:
+        """Pop the oldest pending sample, or None on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while not self._queue:
+                if deadline is None or self._closed:
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._not_empty.wait(timeout=remaining)
+            msg = self._queue.popleft()
+            self._not_full.notify()
+            return msg
+
+    def take_all(self) -> List[Any]:
+        """Drain everything pending — the batcher's bulk read."""
+        with self._lock:
+            msgs = list(self._queue)
+            self._queue.clear()
+            self._not_full.notify_all()
+            return msgs
+
+    def latest(self) -> Optional[Any]:
+        """Drop all but the newest sample and return it (the reference's
+        `latest_scan`/`latest_map` caching pattern, `server/.../main.py:
+        77-81`, made explicit)."""
+        msgs = self.take_all()
+        return msgs[-1] if msgs else None
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+        self.bus._remove_subscription(self)
+
+
+class Publisher:
+    def __init__(self, bus: "Bus", topic: str, qos: QoSProfile):
+        self.bus = bus
+        self.topic = topic
+        self.qos = qos
+        self.n_published = 0
+
+    def publish(self, msg: Any) -> None:
+        self.n_published += 1
+        self.bus._dispatch(self.topic, msg, self.qos)
+
+
+class Bus:
+    """One DDS domain: topic registry + delivery + fault injection.
+
+    `drop_prob`/`reorder_prob` act on Best-Effort subscriptions only
+    (Reliable delivery must never lose data) — modelling lossy Wi-Fi between
+    the Pi and the PC (report.pdf §V.A).
+    """
+
+    def __init__(self, domain_id: int = 42, drop_prob: float = 0.0,
+                 reorder_prob: float = 0.0, seed: int = 0):
+        self.domain_id = domain_id
+        self.drop_prob = drop_prob
+        self.reorder_prob = reorder_prob
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._subs: Dict[str, List[Subscription]] = {}
+        self._latched: Dict[str, Any] = {}
+        self._reorder_hold: Dict[Tuple[int, str], Any] = {}
+
+    # -- graph construction -------------------------------------------------
+
+    def publisher(self, topic: str, qos: QoSProfile = qos_default
+                  ) -> Publisher:
+        return Publisher(self, topic, qos)
+
+    def subscribe(self, topic: str, qos: QoSProfile = qos_default,
+                  callback: Optional[Callable[[Any], None]] = None
+                  ) -> Subscription:
+        sub = Subscription(self, topic, qos, callback)
+        with self._lock:
+            self._subs.setdefault(topic, []).append(sub)
+            latched = self._latched.get(topic)
+        if latched is not None \
+                and qos.durability is Durability.TRANSIENT_LOCAL:
+            sub._offer(latched)
+            if sub.callback is not None:
+                m = sub.take()
+                if m is not None:
+                    sub.callback(m)
+        return sub
+
+    def topics(self) -> List[str]:
+        with self._lock:
+            return sorted(self._subs.keys() | self._latched.keys())
+
+    # -- delivery -----------------------------------------------------------
+
+    def _dispatch(self, topic: str, msg: Any, pub_qos: QoSProfile) -> None:
+        # One lock acquisition covers the latch write and the subscriber
+        # snapshot, so a subscriber joining mid-publish cannot receive the
+        # sample twice (once from the latch, once from the snapshot).
+        with self._lock:
+            if pub_qos.durability is Durability.TRANSIENT_LOCAL:
+                self._latched[topic] = msg
+            subs = list(self._subs.get(topic, ()))
+        for sub in subs:
+            delivery = [msg]
+            if sub.qos.reliability is Reliability.BEST_EFFORT:
+                with self._lock:
+                    if self._rng.random() < self.drop_prob:
+                        sub.n_dropped += 1
+                        continue
+                    key = (id(sub), topic)
+                    if self._rng.random() < self.reorder_prob:
+                        # Hold this sample; release it after the next one.
+                        held = self._reorder_hold.pop(key, None)
+                        self._reorder_hold[key] = msg
+                        if held is None:
+                            continue
+                        delivery = [held]
+                    else:
+                        held = self._reorder_hold.pop(key, None)
+                        if held is not None:
+                            delivery = [msg, held]   # swapped order
+            for m in delivery:
+                sub._offer(m)
+                if sub.callback is not None:
+                    taken = sub.take()
+                    if taken is not None:
+                        sub.callback(taken)
+
+    def _remove_subscription(self, sub: Subscription) -> None:
+        with self._lock:
+            lst = self._subs.get(sub.topic)
+            if lst and sub in lst:
+                lst.remove(sub)

@@ -1,0 +1,242 @@
+"""Message types mirroring the ROS 2 wire contracts the reference speaks.
+
+Field names and semantics follow the ROS 2 interface definitions for
+`sensor_msgs/LaserScan`, `nav_msgs/OccupancyGrid`, `nav_msgs/Odometry`,
+`geometry_msgs/TransformStamped` and `geometry_msgs/Twist` so that the rclpy
+adapter (bridge/rclpy_adapter.py) is a field-for-field copy and everything
+downstream of the reference's topics — RViz map display
+(`/root/reference/server/rviz_config.rviz:152-165`), Nav2, the Flask image
+endpoint (`server/thymio_project/thymio_project/main.py:241-279`) — keeps
+working unchanged.
+
+Payload arrays are numpy (host-side); device arrays live inside the models.
+Occupancy values use the nav_msgs convention: -1 unknown, 0 free, 100
+occupied (thresholding semantics of `server/.../main.py:259-263`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Header:
+    """std_msgs/Header: stamp in float seconds + frame id.
+
+    The reference future-dates its odom TF stamp by +0.1 s to dodge
+    transform_timeout (`server/.../main.py:205`, SURVEY.md Appendix B); this
+    framework stamps honestly — the TF buffer interpolates/extrapolates
+    instead.
+    """
+
+    stamp: float = 0.0
+    frame_id: str = ""
+
+    @staticmethod
+    def now(frame_id: str = "") -> "Header":
+        return Header(stamp=time.monotonic(), frame_id=frame_id)
+
+
+@dataclasses.dataclass
+class Pose2D:
+    """Planar pose (x, y, theta) — the framework's native pose currency.
+
+    Full 3D quaternions appear only at the message edge (`to_quaternion`,
+    math of `euler_to_quaternion` at `server/.../main.py:31-36` restricted to
+    yaw).
+    """
+
+    x: float = 0.0
+    y: float = 0.0
+    theta: float = 0.0
+
+    def to_quaternion(self) -> Tuple[float, float, float, float]:
+        """(qx, qy, qz, qw) for pure yaw."""
+        half = self.theta * 0.5
+        return (0.0, 0.0, math.sin(half), math.cos(half))
+
+    @staticmethod
+    def from_quaternion(qx: float, qy: float, qz: float, qw: float,
+                        x: float = 0.0, y: float = 0.0) -> "Pose2D":
+        yaw = math.atan2(2.0 * (qw * qz + qx * qy),
+                         1.0 - 2.0 * (qy * qy + qz * qz))
+        return Pose2D(x=x, y=y, theta=yaw)
+
+    def as_array(self) -> np.ndarray:
+        return np.array([self.x, self.y, self.theta], np.float32)
+
+    @staticmethod
+    def from_array(a) -> "Pose2D":
+        return Pose2D(float(a[0]), float(a[1]), float(a[2]))
+
+
+@dataclasses.dataclass
+class LaserScan:
+    """sensor_msgs/LaserScan — the `/scan` payload.
+
+    Geometry defaults to the LD06 contract (counterclockwise, ~360 beams,
+    `pi/src/thymio_project/launch/pi_hardware.launch.py:13-21`). `ranges`
+    may be any length; the device path pads to static shape.
+    """
+
+    header: Header = dataclasses.field(default_factory=Header)
+    angle_min: float = 0.0
+    angle_max: float = 2.0 * math.pi
+    angle_increment: float = 2.0 * math.pi / 360.0
+    time_increment: float = 0.0
+    scan_time: float = 0.1
+    range_min: float = 0.02
+    range_max: float = 12.0
+    ranges: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.float32))
+    intensities: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.float32))
+
+
+@dataclasses.dataclass
+class MapMetaData:
+    """nav_msgs/MapMetaData: resolution + dimensions + origin pose."""
+
+    map_load_time: float = 0.0
+    resolution: float = 0.05           # slam_config.yaml:26
+    width: int = 0
+    height: int = 0
+    origin: Pose2D = dataclasses.field(default_factory=Pose2D)
+
+
+@dataclasses.dataclass
+class OccupancyGrid:
+    """nav_msgs/OccupancyGrid — the `/map` payload.
+
+    `data` is int8 row-major from the origin (bottom-left), values in
+    {-1, 0..100}; exactly what RViz's Map display and the reference's
+    `/map-image` endpoint consume (`server/.../main.py:256-266` reshapes and
+    flips it for image coordinates).
+    """
+
+    header: Header = dataclasses.field(default_factory=Header)
+    info: MapMetaData = dataclasses.field(default_factory=MapMetaData)
+    data: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int8))
+
+    def as_image_array(self) -> np.ndarray:
+        """Grayscale uint8 view in image coordinates.
+
+        Exact semantics of the reference endpoint (`server/.../main.py:
+        256-266`): 127 unknown, 255 free (value 0), 0 occupied (value 100),
+        then flipud from ROS bottom-left origin to image top-left.
+        """
+        grid = np.asarray(self.data, np.int16).reshape(
+            self.info.height, self.info.width)
+        img = np.full(grid.shape, 127, np.uint8)
+        img[grid == 0] = 255
+        img[grid == 100] = 0
+        return np.flipud(img)
+
+
+@dataclasses.dataclass
+class Twist:
+    """geometry_msgs/Twist restricted to the planar components the
+    differential drive can realise (`/cmd_vel`, report.pdf §III.A)."""
+
+    linear_x: float = 0.0
+    angular_z: float = 0.0
+
+
+@dataclasses.dataclass
+class Odometry:
+    """nav_msgs/Odometry — the `/odom` payload (`server/.../main.py:217-224`:
+    pose + twist in the odom frame, child base_link)."""
+
+    header: Header = dataclasses.field(default_factory=Header)
+    child_frame_id: str = "base_link"
+    pose: Pose2D = dataclasses.field(default_factory=Pose2D)
+    twist: Twist = dataclasses.field(default_factory=Twist)
+
+
+@dataclasses.dataclass
+class TransformStamped:
+    """geometry_msgs/TransformStamped restricted to SE(2) + z offset.
+
+    Carries the frames the reference's TF tree needs (SURVEY.md §1 L1):
+    map->odom (SLAM correction), odom->base_link (odometry), static
+    base_link->base_laser with z=0.12 m
+    (`pi/src/thymio_project/launch/pi_hardware.launch.py:26-30`).
+    """
+
+    header: Header = dataclasses.field(default_factory=Header)
+    child_frame_id: str = ""
+    x: float = 0.0
+    y: float = 0.0
+    z: float = 0.0
+    theta: float = 0.0
+
+    def compose(self, other: "TransformStamped") -> "TransformStamped":
+        """self ∘ other: transform of other's child expressed in self's
+        parent frame (standard SE(2) composition, z additive)."""
+        c, s = math.cos(self.theta), math.sin(self.theta)
+        return TransformStamped(
+            header=Header(stamp=max(self.header.stamp, other.header.stamp),
+                          frame_id=self.header.frame_id),
+            child_frame_id=other.child_frame_id,
+            x=self.x + c * other.x - s * other.y,
+            y=self.y + s * other.x + c * other.y,
+            z=self.z + other.z,
+            theta=self.theta + other.theta,
+        )
+
+    def inverse(self) -> "TransformStamped":
+        c, s = math.cos(self.theta), math.sin(self.theta)
+        return TransformStamped(
+            header=Header(stamp=self.header.stamp,
+                          frame_id=self.child_frame_id),
+            child_frame_id=self.header.frame_id,
+            x=-(c * self.x + s * self.y),
+            y=-(-s * self.x + c * self.y),
+            z=-self.z,
+            theta=-self.theta,
+        )
+
+
+@dataclasses.dataclass
+class FrontierArray:
+    """Framework-native `/frontiers` payload: clustered frontier targets and
+    the per-robot assignment computed on device (the capability the
+    reference's report defers to future work, report.pdf §VI.2)."""
+
+    header: Header = dataclasses.field(default_factory=Header)
+    targets_xy: np.ndarray = dataclasses.field(          # (K, 2) metres
+        default_factory=lambda: np.zeros((0, 2), np.float32))
+    sizes: np.ndarray = dataclasses.field(               # (K,) cells
+        default_factory=lambda: np.zeros(0, np.int32))
+    assignment: np.ndarray = dataclasses.field(          # (R,) index into K or -1
+        default_factory=lambda: np.zeros(0, np.int32))
+
+
+def occupancy_from_logodds(logodds: np.ndarray, occ_threshold: float,
+                           free_threshold: float, resolution: float,
+                           origin_xy: Tuple[float, float],
+                           stamp: Optional[float] = None,
+                           frame_id: str = "map") -> OccupancyGrid:
+    """Threshold a host log-odds array (row 0 = min-y) into nav_msgs values.
+
+    The int8 {-1, 0, 100} trichotomy only exists at this export edge
+    (SURVEY.md §7 step 1); on device the grid stays float log-odds.
+    """
+    lo = np.asarray(logodds)
+    data = np.full(lo.shape, -1, np.int8)
+    data[lo <= free_threshold] = 0
+    data[lo >= occ_threshold] = 100
+    h, w = lo.shape
+    return OccupancyGrid(
+        header=Header(stamp=time.monotonic() if stamp is None else stamp,
+                      frame_id=frame_id),
+        info=MapMetaData(resolution=resolution, width=w, height=h,
+                         origin=Pose2D(origin_xy[0], origin_xy[1], 0.0)),
+        data=data.reshape(-1),
+    )

@@ -1,0 +1,143 @@
+"""Node + executor model mirroring the rclpy surface the reference uses.
+
+The reference's nodes are rclpy Nodes with `create_publisher`,
+`create_subscription`, `create_timer`, spun on a daemon thread while Flask
+owns the main thread (`/root/reference/server/thymio_project/thymio_project/
+main.py:39-60,281-289`). This module provides the same construction surface
+against the in-process Bus, with an explicit executor whose callbacks are
+serialized per-node (rclpy's default single-threaded executor semantics) —
+removing the reference's reliance on the GIL for safety (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable, List, Optional
+
+from jax_mapping.bridge.bus import Bus, Publisher, Subscription
+from jax_mapping.bridge.qos import QoSProfile, qos_default
+from jax_mapping.bridge.tf import TfTree
+
+
+class Timer:
+    def __init__(self, period_s: float, callback: Callable[[], None]):
+        self.period_s = period_s
+        self.callback = callback
+        self.next_due = time.monotonic() + period_s
+        self.cancelled = False
+        self.n_calls = 0
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class Node:
+    """Base class for framework nodes; subclasses add callbacks."""
+
+    def __init__(self, name: str, bus: Bus, tf: Optional[TfTree] = None):
+        self.name = name
+        self.bus = bus
+        self.tf = tf if tf is not None else TfTree()
+        self._timers: List[Timer] = []
+        self._subs: List[Subscription] = []
+        # Reentrant: inline bus delivery means a guarded callback that
+        # publishes can re-enter this node's guard on the same thread.
+        self._cb_lock = threading.RLock()
+        self.n_errors = 0
+
+    # rclpy-shaped construction surface ------------------------------------
+
+    def create_publisher(self, topic: str,
+                         qos: QoSProfile = qos_default) -> Publisher:
+        return self.bus.publisher(topic, qos)
+
+    def create_subscription(self, topic: str,
+                            callback: Callable[[Any], None],
+                            qos: QoSProfile = qos_default) -> Subscription:
+        sub = self.bus.subscribe(topic, qos,
+                                 callback=self._guarded(callback))
+        self._subs.append(sub)
+        return sub
+
+    def create_timer(self, period_s: float,
+                     callback: Callable[[], None]) -> Timer:
+        timer = Timer(period_s, self._guarded(callback))
+        self._timers.append(timer)
+        return timer
+
+    def destroy(self) -> None:
+        for t in self._timers:
+            t.cancel()
+        for s in self._subs:
+            s.close()
+
+    # ----------------------------------------------------------------------
+
+    def _guarded(self, fn: Callable) -> Callable:
+        """Serialize callbacks and contain exceptions (the reference's
+        catch-all that drops the Thymio connection rather than crashing the
+        loop, `server/.../main.py:198-200`)."""
+        def wrapper(*a, **kw):
+            with self._cb_lock:
+                try:
+                    return fn(*a, **kw)
+                except Exception:
+                    self.n_errors += 1
+                    traceback.print_exc()
+        return wrapper
+
+
+class Executor:
+    """Timer scheduler for a set of nodes.
+
+    Subscriptions with callbacks fire on the publisher's thread (the bus
+    delivers inline, like rmw listener threads); timers fire here. `spin()`
+    blocks; `spin_thread()` is the reference's daemon-thread pattern
+    (`server/.../main.py:285-286`).
+    """
+
+    def __init__(self, nodes: Optional[List[Node]] = None):
+        self.nodes: List[Node] = list(nodes or [])
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add_node(self, node: Node) -> None:
+        self.nodes.append(node)
+
+    def spin(self) -> None:
+        while not self._stop.is_set():
+            now = time.monotonic()
+            due: List[Timer] = []
+            soonest = now + 0.05
+            for node in self.nodes:
+                for t in node._timers:
+                    if t.cancelled:
+                        continue
+                    if t.next_due <= now:
+                        due.append(t)
+                        # Fixed-rate schedule; skip missed periods rather
+                        # than bursting to catch up.
+                        periods = int((now - t.next_due) / t.period_s) + 1
+                        t.next_due += periods * t.period_s
+                    soonest = min(soonest, t.next_due)
+            for t in due:
+                t.n_calls += 1
+                t.callback()
+            wait = max(soonest - time.monotonic(), 0.0)
+            if wait > 0:
+                self._stop.wait(timeout=wait)
+
+    def spin_thread(self) -> threading.Thread:
+        self._thread = threading.Thread(target=self.spin, daemon=True,
+                                        name="executor-spin")
+        self._thread.start()
+        return self._thread
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        for node in self.nodes:
+            node.destroy()

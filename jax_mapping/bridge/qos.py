@@ -1,0 +1,45 @@
+"""QoS profiles with DDS semantics.
+
+The reference's transport fidelity matters: Best-Effort reliability on
+`/scan` was *required* for fluid map updates over Wi-Fi (report.pdf §V.A,
+SURVEY.md §5 "Distributed communication backend"), so the in-process bus
+reproduces the observable difference — Best-Effort subscriptions drop the
+oldest sample when their queue is full and may drop/reorder under injected
+loss, Reliable subscriptions never lose a sample (publisher blocks on a full
+queue instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class Reliability(enum.Enum):
+    BEST_EFFORT = "best_effort"
+    RELIABLE = "reliable"
+
+
+class Durability(enum.Enum):
+    VOLATILE = "volatile"
+    # Late-joining subscribers receive the last published sample — what RViz
+    # relies on for `/map` (map_qos transient local in ROS).
+    TRANSIENT_LOCAL = "transient_local"
+
+
+@dataclasses.dataclass(frozen=True)
+class QoSProfile:
+    depth: int = 10
+    reliability: Reliability = Reliability.RELIABLE
+    durability: Durability = Durability.VOLATILE
+
+
+#: `/scan` over lossy links (report.pdf §V.A).
+qos_sensor_data = QoSProfile(depth=5, reliability=Reliability.BEST_EFFORT)
+
+#: `/map` to late-joining viewers.
+qos_map = QoSProfile(depth=1, reliability=Reliability.RELIABLE,
+                     durability=Durability.TRANSIENT_LOCAL)
+
+#: default pub/sub profile.
+qos_default = QoSProfile()

@@ -1,0 +1,166 @@
+"""TF tree: timestamped transform buffer with interpolation.
+
+Provides the capability of tf2_ros as used by the reference (SURVEY.md §1
+L1): static transforms (base_link->base_laser, z=0.12 m,
+`/root/reference/pi/src/thymio_project/launch/pi_hardware.launch.py:26-30`),
+dynamic transforms (odom->base_link from the brain, map->odom from SLAM),
+and chained lookups across the tree map->odom->base_link->base_laser.
+
+The reference future-dated its odom TF by +0.1 s to beat slam_toolbox's
+transform_timeout (`server/.../main.py:205`, SURVEY.md Appendix B). Here
+stamps are honest and `lookup` interpolates between buffered samples —
+extrapolating (clamped) beyond the newest, which is the principled version
+of the same fix.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from jax_mapping.bridge.messages import Header, TransformStamped
+
+
+def _interp_angle(a: float, b: float, t: float) -> float:
+    d = (b - a + math.pi) % (2 * math.pi) - math.pi
+    return a + d * t
+
+
+class _FrameBuffer:
+    """Time-ordered samples of one parent->child transform."""
+
+    def __init__(self, cache_time_s: float = 10.0):
+        self.cache_time_s = cache_time_s
+        self.stamps: List[float] = []
+        self.tfs: List[TransformStamped] = []
+
+    def insert(self, tf: TransformStamped) -> None:
+        i = bisect.bisect(self.stamps, tf.header.stamp)
+        self.stamps.insert(i, tf.header.stamp)
+        self.tfs.insert(i, tf)
+        cutoff = self.stamps[-1] - self.cache_time_s
+        while len(self.stamps) > 1 and self.stamps[0] < cutoff:
+            self.stamps.pop(0)
+            self.tfs.pop(0)
+
+    def sample(self, stamp: Optional[float]) -> TransformStamped:
+        if stamp is None or len(self.stamps) == 1:
+            return self.tfs[-1]
+        if stamp >= self.stamps[-1]:
+            return self.tfs[-1]          # clamp: no future extrapolation
+        if stamp <= self.stamps[0]:
+            return self.tfs[0]
+        i = bisect.bisect(self.stamps, stamp)
+        a, b = self.tfs[i - 1], self.tfs[i]
+        t = (stamp - self.stamps[i - 1]) / max(
+            self.stamps[i] - self.stamps[i - 1], 1e-9)
+        return TransformStamped(
+            header=Header(stamp=stamp, frame_id=a.header.frame_id),
+            child_frame_id=a.child_frame_id,
+            x=a.x + (b.x - a.x) * t,
+            y=a.y + (b.y - a.y) * t,
+            z=a.z + (b.z - a.z) * t,
+            theta=_interp_angle(a.theta, b.theta, t),
+        )
+
+
+class TfTree:
+    """Thread-safe transform buffer + graph search over frames."""
+
+    def __init__(self, cache_time_s: float = 10.0):
+        self.cache_time_s = cache_time_s
+        self._lock = threading.Lock()
+        # keyed by (parent, child)
+        self._buffers: Dict[Tuple[str, str], _FrameBuffer] = {}
+        self._static: Dict[Tuple[str, str], TransformStamped] = {}
+
+    def set_transform(self, tf: TransformStamped) -> None:
+        key = (tf.header.frame_id, tf.child_frame_id)
+        with self._lock:
+            buf = self._buffers.get(key)
+            if buf is None:
+                buf = self._buffers[key] = _FrameBuffer(self.cache_time_s)
+            buf.insert(tf)
+
+    def set_static_transform(self, tf: TransformStamped) -> None:
+        with self._lock:
+            self._static[(tf.header.frame_id, tf.child_frame_id)] = tf
+
+    # -- lookup -------------------------------------------------------------
+
+    def _edges(self) -> Dict[str, List[Tuple[str, Tuple[str, str], bool]]]:
+        """Adjacency: frame -> [(neighbor, edge_key, forward)]."""
+        adj: Dict[str, List[Tuple[str, Tuple[str, str], bool]]] = {}
+        for (p, c) in list(self._buffers.keys()) + list(self._static.keys()):
+            adj.setdefault(p, []).append((c, (p, c), True))
+            adj.setdefault(c, []).append((p, (p, c), False))
+        return adj
+
+    def _edge_tf(self, key: Tuple[str, str],
+                 stamp: Optional[float]) -> TransformStamped:
+        st = self._static.get(key)
+        if st is not None:
+            return st
+        return self._buffers[key].sample(stamp)
+
+    def lookup(self, target: str, source: str,
+               stamp: Optional[float] = None) -> TransformStamped:
+        """Transform that expresses `source`-frame points in `target` frame,
+        chaining across the tree (e.g. map->base_laser through odom and
+        base_link, the chain slam_toolbox resolves per SURVEY.md §3.3).
+
+        Raises LookupError when the frames are not connected.
+        """
+        if target == source:
+            return TransformStamped(header=Header(stamp=stamp or 0.0,
+                                                  frame_id=target),
+                                    child_frame_id=source)
+        with self._lock:
+            adj = self._edges()
+            if target not in adj or source not in adj:
+                raise LookupError(
+                    f"tf: no path {target} -> {source} (unknown frame)")
+            # BFS from target to source.
+            prev: Dict[str, Tuple[str, Tuple[str, str], bool]] = {}
+            frontier = [target]
+            seen = {target}
+            while frontier and source not in prev:
+                nxt = []
+                for f in frontier:
+                    for (nb, key, fwd) in adj.get(f, ()):
+                        if nb in seen:
+                            continue
+                        seen.add(nb)
+                        prev[nb] = (f, key, fwd)
+                        nxt.append(nb)
+                frontier = nxt
+            if source not in prev:
+                raise LookupError(f"tf: no path {target} -> {source}")
+            # Walk back source -> target collecting edges, then compose
+            # target-side first.
+            chain: List[Tuple[Tuple[str, str], bool]] = []
+            node = source
+            while node != target:
+                parent, key, fwd = prev[node]
+                chain.append((key, fwd))
+                node = parent
+            chain.reverse()
+            out = TransformStamped(header=Header(stamp=stamp or 0.0,
+                                                 frame_id=target),
+                                   child_frame_id=source)
+            for key, fwd in chain:
+                tf = self._edge_tf(key, stamp)
+                out = out.compose(tf if fwd else tf.inverse())
+            out.child_frame_id = source
+            out.header.frame_id = target
+            return out
+
+    def can_transform(self, target: str, source: str,
+                      stamp: Optional[float] = None) -> bool:
+        try:
+            self.lookup(target, source, stamp)
+            return True
+        except LookupError:
+            return False

@@ -1,0 +1,265 @@
+"""Bridge layer tests: messages, QoS bus, TF tree, node/executor.
+
+Covers the transport semantics the reference depends on but never tested
+(SURVEY.md §4): Best-Effort drops, transient-local latching, loss/reorder
+injection (report.pdf §V.A), TF chain lookups, honest-stamp interpolation.
+"""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.messages import (
+    Header, LaserScan, OccupancyGrid, MapMetaData, Pose2D, TransformStamped,
+    occupancy_from_logodds,
+)
+from jax_mapping.bridge.node import Executor, Node
+from jax_mapping.bridge.qos import (
+    QoSProfile, Reliability, qos_map, qos_sensor_data,
+)
+from jax_mapping.bridge.tf import TfTree
+
+
+# ---------------------------------------------------------------- messages
+
+def test_pose2d_quaternion_roundtrip():
+    for th in [-3.0, -1.0, 0.0, 0.5, 2.9]:
+        p = Pose2D(1.0, 2.0, th)
+        q = p.to_quaternion()
+        back = Pose2D.from_quaternion(*q, x=p.x, y=p.y)
+        assert back.theta == pytest.approx(th, abs=1e-6)
+
+
+def test_occupancy_image_semantics():
+    """Exact thresholds of the reference endpoint (server main.py:256-266):
+    127 unknown, 255 free, 0 occupied, flipud to image coords."""
+    data = np.array([[-1, 0], [100, 50]], np.int8)
+    g = OccupancyGrid(info=MapMetaData(width=2, height=2),
+                      data=data.reshape(-1))
+    img = g.as_image_array()
+    # flipud: grid row 1 becomes image row 0.
+    assert img[0, 0] == 0            # occupied
+    assert img[0, 1] == 127          # mid value stays unknown-gray
+    assert img[1, 0] == 127          # unknown
+    assert img[1, 1] == 255          # free
+
+
+def test_occupancy_from_logodds_trichotomy():
+    lo = np.array([[2.0, 0.0], [-2.0, 0.4]], np.float32)
+    g = occupancy_from_logodds(lo, 0.5, -0.5, 0.05, (-1.0, -1.0))
+    d = g.data.reshape(2, 2)
+    assert d[0, 0] == 100 and d[1, 0] == 0
+    assert d[0, 1] == -1 and d[1, 1] == -1
+    assert g.info.resolution == 0.05
+
+
+def test_transform_compose_inverse():
+    a = TransformStamped(header=Header(frame_id="map"),
+                         child_frame_id="odom", x=1.0, y=0.0,
+                         theta=math.pi / 2)
+    b = TransformStamped(header=Header(frame_id="odom"),
+                         child_frame_id="base", x=1.0, y=0.0, theta=0.0)
+    ab = a.compose(b)
+    # Rotating (1,0) by 90 deg lands at (0,1), plus the (1,0) offset.
+    assert ab.x == pytest.approx(1.0, abs=1e-9)
+    assert ab.y == pytest.approx(1.0, abs=1e-9)
+    ident = a.compose(a.inverse())
+    assert ident.x == pytest.approx(0.0, abs=1e-9)
+    assert ident.theta == pytest.approx(0.0, abs=1e-9)
+
+
+# ---------------------------------------------------------------- bus QoS
+
+def test_best_effort_drops_oldest_on_overflow():
+    bus = Bus()
+    sub = bus.subscribe("/scan", qos_sensor_data)     # depth 5
+    pub = bus.publisher("/scan", qos_sensor_data)
+    for i in range(8):
+        pub.publish(i)
+    got = sub.take_all()
+    assert got == [3, 4, 5, 6, 7]
+    assert sub.n_dropped == 3
+
+
+def test_reliable_no_loss_with_consumer():
+    bus = Bus()
+    qos = QoSProfile(depth=4, reliability=Reliability.RELIABLE)
+    sub = bus.subscribe("/odom", qos)
+    pub = bus.publisher("/odom", qos)
+    got = []
+
+    def consumer():
+        while len(got) < 20:
+            m = sub.take(timeout=1.0)
+            if m is not None:
+                got.append(m)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(20):
+        pub.publish(i)
+    t.join(timeout=5.0)
+    assert got == list(range(20))
+    assert sub.n_dropped == 0
+
+
+def test_transient_local_latches_for_late_joiner():
+    """The /map pattern: RViz started after the mapper still sees a map."""
+    bus = Bus()
+    pub = bus.publisher("/map", qos_map)
+    pub.publish("the-map")
+    sub = bus.subscribe("/map", qos_map)
+    assert sub.take(timeout=0.1) == "the-map"
+
+
+def test_latest_keeps_only_newest():
+    """The reference's latest_scan cache pattern (main.py:77-78)."""
+    bus = Bus()
+    sub = bus.subscribe("/scan", qos_sensor_data)
+    pub = bus.publisher("/scan", qos_sensor_data)
+    for i in range(4):
+        pub.publish(i)
+    assert sub.latest() == 3
+    assert sub.latest() is None
+
+
+def test_loss_injection_only_hits_best_effort():
+    bus = Bus(drop_prob=0.5, seed=7)
+    be = bus.subscribe("/scan", QoSProfile(
+        depth=1000, reliability=Reliability.BEST_EFFORT))
+    rel = bus.subscribe("/scan", QoSProfile(depth=1000))
+    pub = bus.publisher("/scan", qos_sensor_data)
+    for i in range(200):
+        pub.publish(i)
+    n_be = len(be.take_all())
+    n_rel = len(rel.take_all())
+    assert n_rel == 200
+    assert 40 < n_be < 160          # ~50% loss
+
+
+def test_reorder_injection_preserves_content():
+    bus = Bus(reorder_prob=0.3, seed=3)
+    sub = bus.subscribe("/scan", QoSProfile(
+        depth=1000, reliability=Reliability.BEST_EFFORT))
+    pub = bus.publisher("/scan", qos_sensor_data)
+    for i in range(100):
+        pub.publish(i)
+    got = sub.take_all()
+    # At most one in-flight held sample is lost; no duplicates; order differs.
+    assert len(set(got)) == len(got)
+    assert len(got) >= 99
+    assert sorted(got) != got or len(got) < 100
+
+
+def test_callback_delivery():
+    bus = Bus()
+    seen = []
+    bus.subscribe("/x", callback=seen.append)
+    pub = bus.publisher("/x")
+    pub.publish("a")
+    pub.publish("b")
+    assert seen == ["a", "b"]
+
+
+# ---------------------------------------------------------------- tf tree
+
+def test_tf_static_chain_lookup():
+    """map->odom->base_link->base_laser, the reference's full chain
+    (SURVEY.md §1 L1) with the z=0.12 laser mount."""
+    tf = TfTree()
+    tf.set_transform(TransformStamped(
+        header=Header(stamp=1.0, frame_id="map"), child_frame_id="odom",
+        x=0.5, y=0.0, theta=0.0))
+    tf.set_transform(TransformStamped(
+        header=Header(stamp=1.0, frame_id="odom"), child_frame_id="base_link",
+        x=1.0, y=2.0, theta=math.pi / 2))
+    tf.set_static_transform(TransformStamped(
+        header=Header(frame_id="base_link"), child_frame_id="base_laser",
+        z=0.12))
+    out = tf.lookup("map", "base_laser", stamp=1.0)
+    assert out.x == pytest.approx(1.5)
+    assert out.y == pytest.approx(2.0)
+    assert out.z == pytest.approx(0.12)
+    assert out.theta == pytest.approx(math.pi / 2)
+    # Reverse direction = inverse.
+    inv = tf.lookup("base_laser", "map", stamp=1.0)
+    assert inv.compose(out).x == pytest.approx(0.0, abs=1e-9)
+
+
+def test_tf_interpolation_and_clamp():
+    tf = TfTree()
+    for stamp, x in [(0.0, 0.0), (1.0, 2.0)]:
+        tf.set_transform(TransformStamped(
+            header=Header(stamp=stamp, frame_id="odom"),
+            child_frame_id="base_link", x=x))
+    mid = tf.lookup("odom", "base_link", stamp=0.25)
+    assert mid.x == pytest.approx(0.5)
+    # Clamp instead of future extrapolation (honest-stamp policy,
+    # SURVEY.md Appendix B).
+    fut = tf.lookup("odom", "base_link", stamp=5.0)
+    assert fut.x == pytest.approx(2.0)
+
+
+def test_tf_unknown_frame_raises():
+    tf = TfTree()
+    with pytest.raises(LookupError):
+        tf.lookup("map", "nowhere")
+    assert not tf.can_transform("map", "nowhere")
+
+
+# ---------------------------------------------------------------- executor
+
+def test_executor_timers_fire_and_shutdown():
+    bus = Bus()
+    node = Node("n", bus)
+    ticks = []
+    node.create_timer(0.02, lambda: ticks.append(time.monotonic()))
+    ex = Executor([node])
+    ex.spin_thread()
+    time.sleep(0.25)
+    ex.shutdown()
+    assert len(ticks) >= 5
+    n_after = len(ticks)
+    time.sleep(0.1)
+    assert len(ticks) == n_after          # really stopped
+
+
+def test_callback_publish_chain_no_deadlock():
+    """A guarded callback that publishes back into the same node must not
+    self-deadlock (inline delivery re-enters the node's callback guard)."""
+    bus = Bus()
+    node = Node("n", bus)
+    seen = []
+    pub_b = bus.publisher("/b")
+    node.create_subscription("/a", lambda m: pub_b.publish(m + 1))
+    node.create_subscription("/b", seen.append)
+    done = []
+
+    def publish():
+        bus.publisher("/a").publish(1)
+        done.append(True)
+
+    t = threading.Thread(target=publish, daemon=True)
+    t.start()
+    t.join(timeout=2.0)
+    assert done, "publish chain deadlocked"
+    assert seen == [2]
+
+
+def test_node_callback_exception_contained():
+    """The reference survives loop exceptions by design (main.py:198-200);
+    the node guard must contain them and count them."""
+    bus = Bus()
+    node = Node("n", bus)
+
+    def bad(_msg):
+        raise RuntimeError("boom")
+
+    node.create_subscription("/x", bad)
+    pub = bus.publisher("/x")
+    pub.publish(1)        # must not raise into the publisher
+    assert node.n_errors == 1
