@@ -11,10 +11,13 @@ when ROS 2 is present.
 """
 
 from jax_mapping.bridge.messages import (  # noqa: F401
-    Header, LaserScan, MapMetaData, OccupancyGrid, Odometry, Pose2D,
-    TransformStamped, Twist,
+    FrontierArray, Header, LaserScan, MapMetaData, OccupancyGrid, Odometry,
+    Pose2D, TransformStamped, Twist,
 )
 from jax_mapping.bridge.qos import QoSProfile, Reliability  # noqa: F401
 from jax_mapping.bridge.bus import Bus  # noqa: F401
 from jax_mapping.bridge.node import Node, Executor  # noqa: F401
 from jax_mapping.bridge.tf import TfTree  # noqa: F401
+
+# Heavier pieces (driver, brain, mapper, sim_node, http_api, launch) are
+# imported from their modules directly; they pull in jax at import time.

@@ -1,0 +1,248 @@
+"""ThymioBrain node: the reference's central control node, fleet-batched.
+
+Re-creates `class ThymioBrain` (`/root/reference/server/thymio_project/
+thymio_project/main.py:38-224`) against the bridge bus and the driver
+abstraction, with the whole per-tick computation — odometry integration,
+subsumption navigation, LED protocol — fused into ONE jitted JAX function
+batched over robots (`brain_tick`), instead of the reference's scalar Python.
+
+Kept behaviors (SURVEY.md §3.2, §5):
+* connect on boot, offline mode on failure (pi variant, `pi/src/.../main.py:66-67`),
+* throttled reconnect probe every ~2 s while disconnected — by wall clock,
+  not the reference's nanosecond-modulo hack (`server/.../main.py:84-88`),
+* any I/O exception ⇒ drop the link, reconnect next tick (`:198-200`),
+* 16-bit sign fix on motor speed reads (`:101-102`),
+* TF odom->base_link + `/odom` publication each tick (`:202-224`) with
+  honest stamps (Appendix B),
+* `is_exploring` start/stop contract (`:227-239`),
+* LED status protocol green/blue/red/orange (`:131,161,181,192`).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.driver import (
+    LEDS_TOP, MOTOR_LEFT_SPEED, MOTOR_LEFT_TARGET, MOTOR_RIGHT_SPEED,
+    MOTOR_RIGHT_TARGET, PROX_HORIZONTAL, connect_with_retries,
+)
+from jax_mapping.bridge.messages import (
+    Header, LaserScan, Odometry, Pose2D, TransformStamped, Twist,
+)
+from jax_mapping.bridge.node import Node
+from jax_mapping.bridge.qos import qos_sensor_data
+from jax_mapping.bridge.tf import TfTree
+from jax_mapping.config import SlamConfig, sign_extend_16bit
+from jax_mapping.models.explorer import subsumption_policy
+from jax_mapping.ops.odometry import rk2_step, wheel_velocities
+
+
+def robot_ns(i: int, n_robots: int) -> str:
+    """Topic/frame namespace: '' for a single robot (reference layout),
+    'robot<i>/' for fleets."""
+    return "" if n_robots == 1 else f"robot{i}/"
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def brain_tick(cfg: SlamConfig, poses, wheel_raw, prox, ranges,
+               exploring, dt):
+    """One fused control tick for R robots.
+
+    poses (R,3) float32; wheel_raw (R,2) int32 raw unsigned16 reads;
+    prox (R,>=5) int32; ranges (R,B) float32 (zeros when no scan yet);
+    exploring (R,) bool; dt () float32.
+    Returns (new_poses, odom_twists (R,2)[v,w], targets (R,2) int32,
+    leds (R,3) int32, nav_state (R,) int32).
+    """
+    wheels = sign_extend_16bit(wheel_raw).astype(jnp.float32)
+    new_poses = jax.vmap(
+        lambda p, w: rk2_step(cfg.robot, p, w[0], w[1], dt))(poses, wheels)
+    v, w = wheel_velocities(cfg.robot, wheels[:, 0], wheels[:, 1])
+    pol = subsumption_policy(cfg.robot, cfg.scan, ranges,
+                             prox[:, :5].astype(jnp.float32), exploring)
+    return (new_poses, jnp.stack([v, w], -1), pol.targets, pol.led,
+            pol.state)
+
+
+class ThymioBrain(Node):
+    """Fleet brain; for n_robots=1 its graph is exactly the reference's."""
+
+    def __init__(self, cfg: SlamConfig, bus: Bus, driver,
+                 tf: Optional[TfTree] = None, n_robots: int = 1,
+                 connect_retries: int = 3, connect_timeout_s: float = 3.0,
+                 reconnect_period_s: float = 2.0):
+        super().__init__("thymio_brain", bus, tf)
+        self.cfg = cfg
+        self.driver = driver
+        self.n_robots = n_robots
+        self.connect_retries = connect_retries
+        self.connect_timeout_s = connect_timeout_s
+        self.reconnect_period_s = reconnect_period_s
+
+        self._state_lock = threading.Lock()
+        self.poses = np.zeros((n_robots, 3), np.float32)
+        self.is_exploring = False                   # /start /stop contract
+        self.link_up = False
+        self._last_reconnect_probe = -1e9
+        self.n_ticks = 0
+        self.n_io_errors = 0
+        self._latest_scans: List[Optional[LaserScan]] = [None] * n_robots
+
+        self.odom_pubs = []
+        for i in range(n_robots):
+            ns = robot_ns(i, n_robots)
+            self.create_subscription(
+                f"{ns}scan",
+                functools.partial(self._scan_cb, i),
+                qos_sensor_data)                    # Best-Effort, §V.A
+            self.odom_pubs.append(self.create_publisher(f"{ns}odom"))
+
+        # Boot connect, offline mode on failure (pi variant semantics).
+        self.link_up = connect_with_retries(
+            driver, max_retries=connect_retries,
+            timeout_s=connect_timeout_s, log=self._log)
+        self.timer = self.create_timer(1.0 / cfg.robot.control_rate_hz,
+                                       self.update_loop)
+
+    def _log(self, msg: str) -> None:
+        print(f"[thymio_brain] {msg}")
+
+    # -- callbacks ----------------------------------------------------------
+
+    def _scan_cb(self, robot_idx: int, msg: LaserScan) -> None:
+        with self._state_lock:
+            self._latest_scans[robot_idx] = msg
+
+    def start_exploring(self) -> None:
+        with self._state_lock:
+            self.is_exploring = True
+
+    def stop_exploring(self) -> None:
+        """Stop AND force motors off immediately — the pi variant's safe
+        stop (`pi/src/.../main.py:320-326`), not just a flag flip."""
+        with self._state_lock:
+            self.is_exploring = False
+        if self.link_up:
+            try:
+                for i in range(self.n_robots):
+                    self.driver[i][MOTOR_LEFT_TARGET] = 0
+                    self.driver[i][MOTOR_RIGHT_TARGET] = 0
+            except Exception:                       # noqa: BLE001
+                self._drop_link()
+
+    def status(self) -> dict:
+        """The pi variant's `/status` payload (`pi/src/.../main.py:332-341`)."""
+        with self._state_lock:
+            return {
+                "connected": self.link_up,
+                "exploring": self.is_exploring,
+                "n_robots": self.n_robots,
+                "poses": [
+                    {"x": float(p[0]), "y": float(p[1]),
+                     "theta": float(p[2])} for p in self.poses],
+                "ticks": self.n_ticks,
+                "io_errors": self.n_io_errors,
+            }
+
+    # -- the 10 Hz loop ------------------------------------------------------
+
+    def _drop_link(self) -> None:
+        self.n_io_errors += 1
+        self.link_up = False
+        try:
+            self.driver.disconnect()
+        except Exception:                           # noqa: BLE001
+            pass
+
+    def _ranges_matrix(self) -> np.ndarray:
+        """Latest scans resampled to (R, n_beams); zeros (= no reading,
+        which the policy's outlier rule reads as far) when absent."""
+        B = self.cfg.scan.n_beams
+        out = np.zeros((self.n_robots, B), np.float32)
+        with self._state_lock:
+            scans = list(self._latest_scans)
+        for i, scan in enumerate(scans):
+            if scan is None or len(scan.ranges) == 0:
+                continue
+            r = np.asarray(scan.ranges, np.float32)
+            if len(r) == B:
+                out[i] = r
+            else:
+                idx = np.linspace(0, len(r) - 1, B).round().astype(int)
+                out[i] = r[idx]
+        return out
+
+    def update_loop(self) -> None:
+        cfg = self.cfg
+        now = time.monotonic()
+        if not self.link_up:
+            # Throttled reconnect probe (`server/.../main.py:84-88`).
+            if now - self._last_reconnect_probe < self.reconnect_period_s:
+                return
+            self._last_reconnect_probe = now
+            self.link_up = connect_with_retries(
+                self.driver, max_retries=1,
+                timeout_s=self.connect_timeout_s, log=self._log)
+            if not self.link_up:
+                return
+
+        try:
+            R = self.n_robots
+            wheel_raw = np.zeros((R, 2), np.int32)
+            prox = np.zeros((R, 7), np.int32)
+            for i in range(R):
+                wheel_raw[i, 0] = self.driver[i][MOTOR_LEFT_SPEED]
+                wheel_raw[i, 1] = self.driver[i][MOTOR_RIGHT_SPEED]
+                prox[i] = self.driver[i][PROX_HORIZONTAL]
+
+            with self._state_lock:
+                poses = self.poses.copy()
+                exploring = np.full(R, self.is_exploring)
+            ranges = self._ranges_matrix()
+
+            new_poses, twists, targets, leds, _ = brain_tick(
+                cfg, poses, wheel_raw, prox, ranges, exploring,
+                np.float32(1.0 / cfg.robot.control_rate_hz))
+            new_poses = np.asarray(new_poses)
+            twists = np.asarray(twists)
+            targets_np = np.asarray(targets)
+            leds_np = np.asarray(leds)
+
+            for i in range(R):
+                self.driver[i][MOTOR_LEFT_TARGET] = int(targets_np[i, 0])
+                self.driver[i][MOTOR_RIGHT_TARGET] = int(targets_np[i, 1])
+                self.driver[i][LEDS_TOP] = leds_np[i].tolist()
+
+            with self._state_lock:
+                self.poses = new_poses
+            self.publish_tf(new_poses, twists)
+            self.n_ticks += 1
+        except Exception:                           # noqa: BLE001
+            # Reference catch-all: drop and re-probe (`main.py:198-200`).
+            self._drop_link()
+
+    def publish_tf(self, poses: np.ndarray, twists: np.ndarray) -> None:
+        """TF odom->base_link + `/odom`, honest stamps
+        (`server/.../main.py:202-224`, Appendix B)."""
+        stamp = time.monotonic()
+        for i in range(self.n_robots):
+            ns = robot_ns(i, self.n_robots)
+            p = poses[i]
+            self.tf.set_transform(TransformStamped(
+                header=Header(stamp=stamp, frame_id=f"{ns}odom"),
+                child_frame_id=f"{ns}base_link",
+                x=float(p[0]), y=float(p[1]), theta=float(p[2])))
+            self.odom_pubs[i].publish(Odometry(
+                header=Header(stamp=stamp, frame_id=f"{ns}odom"),
+                child_frame_id=f"{ns}base_link",
+                pose=Pose2D.from_array(p),
+                twist=Twist(linear_x=float(twists[i, 0]),
+                            angular_z=float(twists[i, 1]))))

@@ -1,0 +1,233 @@
+"""Thymio device driver abstraction + simulated fleet backend.
+
+The reference talks to the robot through `thymiodirect`'s dict-style
+variable access — `th[node_id]["motor.left.target"] = v`
+(`/root/reference/server/thymio_project/thymio_project/main.py:15,66-68,
+96-99,195-196`). This module keeps that exact access surface so the brain
+node reads identically against real hardware or the simulator, and ports the
+pi variant's robustness patterns (SURVEY.md §3.6, §5 failure detection):
+
+* bounded connect retries (3, `pi/src/.../main.py:32,56-64`),
+* a connect timeout imposed from outside because the library call can hang
+  (worker thread + join(3 s), `pi/src/.../main.py:111-148`),
+* post-connect smoke test (read a variable, blink LEDs, `:151-157`),
+* offline/degraded mode instead of crashing (`:66-67`),
+* any runtime I/O error ⇒ disconnect, let the caller's reconnect probe
+  recover (`server/.../main.py:198-200`).
+
+Fault injection hooks (connect failures, hangs, read errors) give the test
+suite the failure-path coverage the reference only ever exercised on a
+workshop floor (SURVEY.md §4).
+
+Raw value conventions match the wire: motor speeds are unsigned 16-bit with
+negative wrap (`sign_extend_16bit` undoes it), prox.horizontal is 7 ints
+(front 0-4, rear 5-6), leds.top is [r, g, b] 0-32.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from jax_mapping.config import RobotConfig
+
+# Thymio variable names used by the reference.
+MOTOR_LEFT_TARGET = "motor.left.target"
+MOTOR_RIGHT_TARGET = "motor.right.target"
+MOTOR_LEFT_SPEED = "motor.left.speed"
+MOTOR_RIGHT_SPEED = "motor.right.speed"
+PROX_HORIZONTAL = "prox.horizontal"
+LEDS_TOP = "leds.top"
+
+
+class DriverError(RuntimeError):
+    """Raised on I/O against a dead link (the exception path the brain's
+    catch-all turns into a reconnect, `server/.../main.py:198-200`)."""
+
+
+class _VarView:
+    """Dict-style view of one robot's variables (thymiodirect's surface)."""
+
+    def __init__(self, driver: "SimulatedThymioDriver", node_id: int):
+        self._driver = driver
+        self._node_id = node_id
+
+    def __getitem__(self, name: str):
+        return self._driver._read_var(self._node_id, name)
+
+    def __setitem__(self, name: str, value) -> None:
+        self._driver._write_var(self._node_id, name, value)
+
+
+class SimulatedThymioDriver:
+    """Simulated fleet behind the thymiodirect access surface.
+
+    Holds host-side mirrors of wheel targets/speeds/prox/LEDs for R robots;
+    the owner (a simulation node) refreshes speeds and prox each physics
+    tick via `ingest_state`. Connection lifecycle and fault injection mimic
+    serial-dongle behavior.
+    """
+
+    def __init__(self, n_robots: int = 1,
+                 fail_connect_times: int = 0,
+                 hang_connect_times: int = 0,
+                 fail_reads_after: Optional[int] = None):
+        self.n_robots = n_robots
+        self.connected = False
+        self.fail_connect_times = fail_connect_times
+        self.hang_connect_times = hang_connect_times
+        self.fail_reads_after = fail_reads_after
+        self.n_connect_calls = 0
+        self._n_reads = 0
+        self._lock = threading.Lock()
+        self._targets = np.zeros((n_robots, 2), np.int32)
+        self._speeds_raw = np.zeros((n_robots, 2), np.uint16)
+        self._prox = np.zeros((n_robots, 7), np.int32)
+        self._leds = np.zeros((n_robots, 3), np.int32)
+
+    # -- thymiodirect-shaped surface ---------------------------------------
+
+    def connect(self) -> None:
+        """May fail or hang per injection settings (the real library can do
+        both, which is why the pi variant wraps it in a thread+join)."""
+        self.n_connect_calls += 1
+        if self.hang_connect_times > 0:
+            self.hang_connect_times -= 1
+            time.sleep(3600.0)       # caller's join(timeout) abandons us
+        if self.fail_connect_times > 0:
+            self.fail_connect_times -= 1
+            raise DriverError("dongle did not answer")
+        self.connected = True
+
+    def disconnect(self) -> None:
+        self.connected = False
+
+    def first_node(self) -> int:
+        """The reference grabs the first node id (`th.first_node()` pattern,
+        `server/.../main.py:66-67`). Sim node ids are 0..R-1."""
+        if not self.connected:
+            raise DriverError("not connected")
+        return 0
+
+    def nodes(self) -> List[int]:
+        if not self.connected:
+            raise DriverError("not connected")
+        return list(range(self.n_robots))
+
+    def __getitem__(self, node_id: int) -> _VarView:
+        return _VarView(self, node_id)
+
+    # -- simulation-side state exchange ------------------------------------
+
+    def ingest_state(self, wheel_speeds: np.ndarray,
+                     prox: np.ndarray) -> None:
+        """Physics tick: set measured wheel speeds (float thymio units,
+        (R, 2)) and prox readings ((R, >=5) ints). Speeds are stored the way
+        the wire stores them — wrapped unsigned 16-bit — so the brain's
+        sign-extension path is exercised for real."""
+        with self._lock:
+            s = np.round(np.asarray(wheel_speeds)).astype(np.int32)
+            self._speeds_raw = (s & 0xFFFF).astype(np.uint16)
+            p = np.asarray(prox, np.int32)
+            self._prox[:, :p.shape[1]] = p
+
+    def targets(self) -> np.ndarray:
+        with self._lock:
+            return self._targets.copy()
+
+    def leds(self) -> np.ndarray:
+        with self._lock:
+            return self._leds.copy()
+
+    # -- variable access (driver-internal) ---------------------------------
+
+    def _check_io(self) -> None:
+        if not self.connected:
+            raise DriverError("link down")
+        if self.fail_reads_after is not None \
+                and self._n_reads >= self.fail_reads_after:
+            self.connected = False
+            raise DriverError("serial timeout")
+
+    def _read_var(self, node_id: int, name: str):
+        self._check_io()
+        self._n_reads += 1
+        with self._lock:
+            if name == MOTOR_LEFT_SPEED:
+                return int(self._speeds_raw[node_id, 0])
+            if name == MOTOR_RIGHT_SPEED:
+                return int(self._speeds_raw[node_id, 1])
+            if name == PROX_HORIZONTAL:
+                return self._prox[node_id].tolist()
+            if name == MOTOR_LEFT_TARGET:
+                return int(self._targets[node_id, 0])
+            if name == MOTOR_RIGHT_TARGET:
+                return int(self._targets[node_id, 1])
+            if name == LEDS_TOP:
+                return self._leds[node_id].tolist()
+        raise KeyError(name)
+
+    def _write_var(self, node_id: int, name: str, value) -> None:
+        self._check_io()
+        with self._lock:
+            if name == MOTOR_LEFT_TARGET:
+                self._targets[node_id, 0] = int(value)
+            elif name == MOTOR_RIGHT_TARGET:
+                self._targets[node_id, 1] = int(value)
+            elif name == LEDS_TOP:
+                self._leds[node_id] = np.asarray(value, np.int32)
+            else:
+                raise KeyError(name)
+
+
+def connect_with_retries(driver, max_retries: int = 3,
+                         timeout_s: float = 3.0,
+                         smoke_test: bool = True,
+                         log: Callable[[str], None] = lambda s: None) -> bool:
+    """The pi variant's robust connect (`pi/src/.../main.py:56-64,97-157`):
+
+    up to `max_retries` attempts; each runs `driver.connect()` on a worker
+    thread and abandons it after `timeout_s` (the library has no timeout
+    argument); on success, a smoke test reads a variable and writes the
+    idle LED. Returns True on success, False ⇒ caller enters offline mode.
+    """
+    for attempt in range(1, max_retries + 1):
+        log(f"thymio connect attempt {attempt}/{max_retries}")
+        result: Dict[str, Optional[BaseException]] = {"err": None}
+        done = threading.Event()
+
+        def work():
+            try:
+                driver.connect()
+            except BaseException as e:          # noqa: BLE001
+                result["err"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        if not done.wait(timeout=timeout_s):
+            log("connect timed out; abandoning worker")
+            continue
+        if result["err"] is not None:
+            log(f"connect failed: {result['err']}")
+            continue
+        if smoke_test:
+            try:
+                node = driver.first_node()
+                driver[node][MOTOR_LEFT_SPEED]          # readable?
+                driver[node][LEDS_TOP] = [0, 32, 0]     # idle green
+            except Exception as e:                      # noqa: BLE001
+                log(f"smoke test failed: {e}")
+                try:
+                    driver.disconnect()
+                except Exception:                       # noqa: BLE001
+                    pass
+                continue
+        log("thymio connected")
+        return True
+    log("all connect attempts failed; entering offline mode")
+    return False

@@ -1,0 +1,193 @@
+"""HTTP control/observability API (stdlib http.server; no Flask dependency).
+
+Re-creates the reference's management plane (SURVEY.md §1 L4) with both
+variants' routes merged:
+
+* `GET /start`, `GET /stop` — flip `is_exploring`
+  (`/root/reference/server/thymio_project/thymio_project/main.py:227-239`);
+  stop also forces motors off (pi variant, `pi/src/.../main.py:320-326`).
+* `GET /status` — JSON connection/exploring/pose (`pi/src/.../main.py:332-341`).
+* `GET /map-image` — latest `/map` as a grayscale PNG, 127 unknown / 255
+  free / 0 occupied, flipped to image coords (`server/.../main.py:241-279`).
+  The reference declared a 1 s PNG cache but never wrote it (`last_png`
+  dead code, `:56-57` vs `:248-249` — SURVEY.md Appendix B); here the cache
+  actually works.
+* `GET /frontiers` — JSON frontier targets + assignment (new capability).
+* `GET /metrics` — framework counters in Prometheus text format.
+
+Served threaded like the reference (Flask's threaded dev server); shutdown
+uses the pi variant's graceful `make_server`/`shutdown` pattern
+(`pi/src/.../main.py:364-380`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from jax_mapping.bridge import png as png_codec
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.messages import FrontierArray, OccupancyGrid
+from jax_mapping.bridge.qos import qos_map
+
+
+class MapApiServer:
+    """Bind handlers to framework state and serve.
+
+    `brain` needs `start_exploring()`, `stop_exploring()`, `status()` (the
+    ThymioBrain surface); map and frontier payloads arrive over the bus.
+    """
+
+    def __init__(self, bus: Bus, brain=None, host: str = "127.0.0.1",
+                 port: int = 5000, png_cache_s: float = 1.0,
+                 extra_status: Optional[Callable[[], dict]] = None):
+        self.bus = bus
+        self.brain = brain
+        self.png_cache_s = png_cache_s
+        self.extra_status = extra_status
+        self._lock = threading.Lock()
+        self._latest_map: Optional[OccupancyGrid] = None
+        self._latest_frontiers: Optional[FrontierArray] = None
+        # The 1 s PNG cache, implemented for real this time.
+        self._png: Optional[bytes] = None
+        self._png_time = -1e9
+        self._png_map_stamp = -1.0
+        self.n_requests = 0
+        self.n_png_cache_hits = 0
+
+        bus.subscribe("/map", qos_map, callback=self._map_cb)
+        bus.subscribe("/frontiers", callback=self._frontiers_cb)
+
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):    # silence per-request spam
+                pass
+
+            def do_GET(self):
+                api.n_requests += 1
+                try:
+                    status, ctype, body = api.handle(self.path)
+                except Exception as e:            # noqa: BLE001
+                    status, ctype, body = 500, "application/json", json.dumps(
+                        {"error": str(e)}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- bus callbacks ------------------------------------------------------
+
+    def _map_cb(self, msg: OccupancyGrid) -> None:
+        with self._lock:
+            self._latest_map = msg
+
+    def _frontiers_cb(self, msg: FrontierArray) -> None:
+        with self._lock:
+            self._latest_frontiers = msg
+
+    # -- request handling ---------------------------------------------------
+
+    def handle(self, path: str) -> Tuple[int, str, bytes]:
+        route = path.split("?")[0].rstrip("/") or "/"
+        if route == "/start":
+            if self.brain is not None:
+                self.brain.start_exploring()
+            return 200, "application/json", \
+                json.dumps({"status": "exploration started"}).encode()
+        if route == "/stop":
+            if self.brain is not None:
+                self.brain.stop_exploring()
+            return 200, "application/json", \
+                json.dumps({"status": "exploration stopped"}).encode()
+        if route == "/status":
+            body = self.brain.status() if self.brain is not None else {}
+            if self.extra_status is not None:
+                body.update(self.extra_status())
+            return 200, "application/json", json.dumps(body).encode()
+        if route == "/map-image":
+            return self._map_image()
+        if route == "/frontiers":
+            return self._frontiers()
+        if route == "/metrics":
+            return 200, "text/plain", self._metrics().encode()
+        return 404, "application/json", \
+            json.dumps({"error": f"no route {route}"}).encode()
+
+    def _map_image(self) -> Tuple[int, str, bytes]:
+        with self._lock:
+            msg = self._latest_map
+            if msg is None:
+                # Reference guard (`server/.../main.py:244-245`).
+                return 404, "application/json", \
+                    json.dumps({"error": "map not yet available"}).encode()
+            now = time.monotonic()
+            if self._png is not None \
+                    and now - self._png_time < self.png_cache_s \
+                    and self._png_map_stamp == msg.header.stamp:
+                self.n_png_cache_hits += 1
+                return 200, "image/png", self._png
+        img = msg.as_image_array()
+        data = png_codec.encode_gray(img)
+        with self._lock:
+            self._png = data
+            self._png_time = time.monotonic()
+            self._png_map_stamp = msg.header.stamp
+        return 200, "image/png", data
+
+    def _frontiers(self) -> Tuple[int, str, bytes]:
+        with self._lock:
+            fr = self._latest_frontiers
+        if fr is None:
+            return 404, "application/json", \
+                json.dumps({"error": "frontiers not yet available"}).encode()
+        body = {
+            "targets_xy": np.asarray(fr.targets_xy).tolist(),
+            "sizes": np.asarray(fr.sizes).tolist(),
+            "assignment": np.asarray(fr.assignment).tolist(),
+        }
+        return 200, "application/json", json.dumps(body).encode()
+
+    def _metrics(self) -> str:
+        lines = [
+            "# TYPE jax_mapping_http_requests_total counter",
+            f"jax_mapping_http_requests_total {self.n_requests}",
+            "# TYPE jax_mapping_png_cache_hits_total counter",
+            f"jax_mapping_png_cache_hits_total {self.n_png_cache_hits}",
+        ]
+        if self.brain is not None:
+            st = self.brain.status()
+            lines += [
+                "# TYPE jax_mapping_brain_ticks_total counter",
+                f"jax_mapping_brain_ticks_total {st.get('ticks', 0)}",
+                "# TYPE jax_mapping_brain_io_errors_total counter",
+                f"jax_mapping_brain_io_errors_total {st.get('io_errors', 0)}",
+                "# TYPE jax_mapping_brain_connected gauge",
+                f"jax_mapping_brain_connected "
+                f"{int(bool(st.get('connected')))}",
+            ]
+        return "\n".join(lines) + "\n"
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def serve_thread(self) -> threading.Thread:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="http-api")
+        self._thread.start()
+        return self._thread
+
+    def shutdown(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -1,0 +1,105 @@
+"""Launch composition: assemble the full node graph, like the reference's
+launch files.
+
+`launch_sim_stack` is the equivalent of running BOTH
+`pi_hardware.launch.py` (LiDAR driver + static TF,
+`/root/reference/pi/src/thymio_project/launch/pi_hardware.launch.py`) and
+`pc_server.launch.py` (SLAM + brain + API,
+`/root/reference/server/thymio_project/launch/pc_server.launch.py`) against
+the simulated world — one call returns a running stack with an explicit
+shutdown, replacing ros2-launch orchestration (SURVEY.md §1 L5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from jax_mapping.bridge.brain import ThymioBrain, robot_ns
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.driver import SimulatedThymioDriver
+from jax_mapping.bridge.http_api import MapApiServer
+from jax_mapping.bridge.mapper import MapperNode
+from jax_mapping.bridge.messages import Header, TransformStamped
+from jax_mapping.bridge.node import Executor
+from jax_mapping.bridge.sim_node import SimNode
+from jax_mapping.bridge.tf import TfTree
+from jax_mapping.config import SlamConfig
+
+#: Laser mount height from the reference's static TF
+#: (`pi_hardware.launch.py:26-30`).
+LASER_MOUNT_Z_M = 0.12
+
+
+@dataclasses.dataclass
+class Stack:
+    """A running stack; fields are live objects."""
+
+    cfg: SlamConfig
+    bus: Bus
+    tf: TfTree
+    driver: SimulatedThymioDriver
+    sim: SimNode
+    brain: ThymioBrain
+    mapper: MapperNode
+    api: Optional[MapApiServer]
+    executor: Executor
+
+    def run_steps(self, n: int) -> None:
+        """Faster-than-realtime: drive physics+brain+mapper loops directly,
+        n sensor ticks (realtime=False stacks only)."""
+        for _ in range(n):
+            self.sim.step()
+            self.brain.update_loop()
+            self.mapper.tick()
+
+    def shutdown(self) -> None:
+        if self.api is not None:
+            self.api.shutdown()
+        self.executor.shutdown()
+
+
+def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
+                     world_res_m: Optional[float] = None,
+                     n_robots: int = 1, http_port: Optional[int] = None,
+                     realtime: bool = False,
+                     drop_prob: float = 0.0, seed: int = 0) -> Stack:
+    """Boot the whole graph. realtime=False leaves timers idle so tests can
+    step deterministically via `Stack.run_steps`; realtime=True spins the
+    executor thread like the reference's rclpy daemon thread
+    (`server/.../main.py:285-287`). http_port=0 picks a free port."""
+    res = world_res_m if world_res_m is not None else cfg.grid.resolution_m
+    bus = Bus(domain_id=cfg.domain_id, drop_prob=drop_prob, seed=seed)
+    tf = TfTree()
+    for i in range(n_robots):
+        ns = robot_ns(i, n_robots)
+        tf.set_static_transform(TransformStamped(
+            header=Header(frame_id=f"{ns}base_link"),
+            child_frame_id=f"{ns}base_laser", z=LASER_MOUNT_Z_M))
+
+    driver = SimulatedThymioDriver(n_robots=n_robots)
+    sim = SimNode(cfg, bus, driver, world, res, tf=tf,
+                  rate_hz=cfg.robot.control_rate_hz, seed=seed,
+                  realtime=realtime)
+    brain = ThymioBrain(cfg, bus, driver, tf=tf, n_robots=n_robots)
+    # Start calibrated: the odom frame origin is the boot pose; expressing
+    # boot poses in the map frame up front keeps multi-robot maps aligned
+    # (the fleet model's convention, models/fleet.py init_fleet_state).
+    brain.poses = sim.truth_poses().copy()
+    mapper = MapperNode(cfg, bus, tf=tf, n_robots=n_robots)
+    for i, st in enumerate(mapper.states):
+        mapper.states[i] = st._replace(pose=jnp.asarray(brain.poses[i]))
+
+    api = None
+    if http_port is not None:
+        api = MapApiServer(bus, brain=brain, port=http_port)
+        api.serve_thread()
+
+    executor = Executor([sim, brain, mapper])
+    if realtime:
+        executor.spin_thread()
+    return Stack(cfg=cfg, bus=bus, tf=tf, driver=driver, sim=sim,
+                 brain=brain, mapper=mapper, api=api, executor=executor)

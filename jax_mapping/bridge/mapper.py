@@ -1,0 +1,205 @@
+"""Mapper node: the slam_toolbox replacement in the node graph.
+
+Occupies exactly the box SURVEY.md §3.4 describes — subscribe `/scan`
+(Best-Effort, report.pdf §V.A) + `/odom`, run gate → correlative match →
+pose-graph insert → loop closure → grid fusion ON DEVICE (`models.slam`),
+publish `/map` every `map_publish_period_s` (5 s, `slam_config.yaml:25`),
+`/frontiers` each tick, and the `map->odom` correction TF
+(role of slam_toolbox per SURVEY.md §1 L2). Multi-robot: one SLAM state per
+robot fused into a shared global grid, frontier assignment across the fleet.
+
+QoS fidelity: the scan subscription is Best-Effort with a bounded queue, and
+the batcher pairs each scan with the freshest odometry at or before its
+stamp — tolerant of drops and reordering by construction (SURVEY.md §7
+"hard parts").
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.brain import robot_ns
+from jax_mapping.bridge.messages import (
+    FrontierArray, Header, LaserScan, Odometry, TransformStamped,
+    occupancy_from_logodds,
+)
+from jax_mapping.bridge.node import Node
+from jax_mapping.bridge.qos import QoSProfile, qos_map, qos_sensor_data
+from jax_mapping.bridge.tf import TfTree
+from jax_mapping.config import SlamConfig
+from jax_mapping.ops.odometry import twist_to_wheel_units
+
+
+class MapperNode(Node):
+    """Device-resident SLAM behind the reference's topic contract."""
+
+    def __init__(self, cfg: SlamConfig, bus: Bus,
+                 tf: Optional[TfTree] = None, n_robots: int = 1,
+                 tick_period_s: Optional[float] = None):
+        super().__init__("jax_mapper", bus, tf)
+        import jax.numpy as jnp
+
+        from jax_mapping.models import slam as S
+        from jax_mapping.ops import frontier as F
+        from jax_mapping.ops import grid as G
+
+        self.cfg = cfg
+        self.n_robots = n_robots
+        self._S, self._F, self._G, self._jnp = S, F, G, jnp
+
+        self._state_lock = threading.Lock()
+        self.states = [S.init_state(cfg) for _ in range(n_robots)]
+        self._odom_hist: List[List[Odometry]] = [[] for _ in range(n_robots)]
+        self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
+        self._last_odom_pose = [None] * n_robots    # pose used at last fuse
+        self.n_scans_fused = 0
+        self.n_scans_dropped_unpaired = 0
+        self.n_loops_closed = 0
+
+        self.map_pub = self.create_publisher("/map", qos_map)
+        self.map_updates_pub = self.create_publisher("/map_updates")
+        self.frontiers_pub = self.create_publisher("/frontiers")
+        self.pose_pub = self.create_publisher("/pose")
+        for i in range(n_robots):
+            ns = robot_ns(i, n_robots)
+            self.create_subscription(
+                f"{ns}scan", functools.partial(self._scan_cb, i),
+                qos_sensor_data)
+            self.create_subscription(
+                f"{ns}odom", functools.partial(self._odom_cb, i),
+                QoSProfile(depth=50))
+
+        period = tick_period_s if tick_period_s is not None \
+            else 1.0 / cfg.robot.control_rate_hz
+        self.create_timer(period, self.tick)
+        self.create_timer(cfg.map_publish_period_s, self.publish_map)
+        self._last_map_stamp = 0.0
+
+    # -- callbacks ----------------------------------------------------------
+
+    def _scan_cb(self, i: int, msg: LaserScan) -> None:
+        with self._state_lock:
+            self._scan_q[i].append(msg)
+
+    def _odom_cb(self, i: int, msg: Odometry) -> None:
+        with self._state_lock:
+            hist = self._odom_hist[i]
+            hist.append(msg)
+            if len(hist) > 200:
+                del hist[:100]
+
+    # -- pairing + device step ----------------------------------------------
+
+    def _pair_odom(self, i: int, stamp: float) -> Optional[Odometry]:
+        """Freshest odometry at or before `stamp` (drop/reorder tolerant)."""
+        best = None
+        for od in self._odom_hist[i]:
+            if od.header.stamp <= stamp and \
+                    (best is None or od.header.stamp > best.header.stamp):
+                best = od
+        if best is None and self._odom_hist[i]:
+            best = self._odom_hist[i][0]            # scan predates odometry
+        return best
+
+    def _pad_ranges(self, scan: LaserScan) -> np.ndarray:
+        sc = self.cfg.scan
+        out = np.zeros(sc.padded_beams, np.float32)
+        r = np.asarray(scan.ranges, np.float32)
+        n = min(len(r), sc.n_beams)
+        if n == sc.n_beams and len(r) != sc.n_beams:
+            idx = np.linspace(0, len(r) - 1, sc.n_beams).round().astype(int)
+            out[:sc.n_beams] = r[idx]
+        else:
+            out[:n] = r[:n]
+        return out
+
+    def tick(self) -> None:
+        """Drain queues, run the device SLAM step per paired scan."""
+        jnp = self._jnp
+        with self._state_lock:
+            work = []
+            for i in range(self.n_robots):
+                for scan in self._scan_q[i]:
+                    od = self._pair_odom(i, scan.header.stamp)
+                    if od is None:
+                        self.n_scans_dropped_unpaired += 1
+                        continue
+                    work.append((i, scan, od))
+                self._scan_q[i].clear()
+
+        for i, scan, od in sorted(work, key=lambda w: w[1].header.stamp):
+            ranges = self._pad_ranges(scan)
+            state = self.states[i]
+            # Feed the odometric pose delta through the step's RK2 slot:
+            # synthesize equivalent wheel speeds from the measured twist
+            # over the inter-scan interval.
+            dt = 1.0 / self.cfg.robot.control_rate_hz
+            wl, wr = twist_to_wheel_units(
+                self.cfg.robot, od.twist.linear_x, od.twist.angular_z)
+            state, diag = self._S.slam_step(
+                self.cfg, state, jnp.asarray(ranges),
+                jnp.float32(wl), jnp.float32(wr), jnp.float32(dt))
+            self._last_odom_pose[i] = od.pose
+            with self._state_lock:
+                self.states[i] = state
+            self.n_scans_fused += 1
+            if bool(diag.loop_closed):
+                self.n_loops_closed += 1
+
+            # map->odom correction TF: est ⊖ odom (slam_toolbox's role).
+            est = np.asarray(state.pose)
+            o = od.pose
+            ns = robot_ns(i, self.n_robots)
+            c, s = np.cos(est[2] - o.theta), np.sin(est[2] - o.theta)
+            self.tf.set_transform(TransformStamped(
+                header=Header(stamp=scan.header.stamp, frame_id="map"),
+                child_frame_id=f"{ns}odom",
+                x=float(est[0] - (c * o.x - s * o.y)),
+                y=float(est[1] - (s * o.x + c * o.y)),
+                theta=float(est[2] - o.theta)))
+
+        if work:
+            self.publish_frontiers()
+
+    # -- exports ------------------------------------------------------------
+
+    def merged_grid(self):
+        """Shared global map: max-merge of per-robot log-odds grids
+        (the psum/max merge of SURVEY.md §7.5, host-orchestrated here)."""
+        jnp = self._jnp
+        with self._state_lock:
+            grids = [st.grid for st in self.states]
+        g = grids[0]
+        for other in grids[1:]:
+            g = jnp.where(jnp.abs(other) > jnp.abs(g), other, g)
+        return g
+
+    def publish_map(self) -> None:
+        g = self.cfg.grid
+        lo = np.asarray(self.merged_grid())
+        msg = occupancy_from_logodds(lo, g.occ_threshold, g.free_threshold,
+                                     g.resolution_m, g.origin_m)
+        self._last_map_stamp = msg.header.stamp
+        self.map_pub.publish(msg)
+        self.map_updates_pub.publish(msg)
+
+    def publish_frontiers(self) -> None:
+        with self._state_lock:
+            poses = np.stack([np.asarray(st.pose) for st in self.states])
+        fr = self._F.compute_frontiers(self.cfg.frontier, self.cfg.grid,
+                                       self.merged_grid(),
+                                       self._jnp.asarray(poses))
+        self.frontiers_pub.publish(FrontierArray(
+            header=Header.now("map"),
+            targets_xy=np.asarray(fr.targets),
+            sizes=np.asarray(fr.sizes),
+            assignment=np.asarray(fr.assignment)))
+        self.pose_pub.publish([
+            {"x": float(p[0]), "y": float(p[1]), "theta": float(p[2])}
+            for p in poses])

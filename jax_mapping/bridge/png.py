@@ -1,0 +1,61 @@
+"""Minimal grayscale PNG encoder (stdlib zlib only).
+
+Plays PIL's role in the reference's `/map-image` endpoint
+(`/root/reference/server/thymio_project/thymio_project/main.py:270-275`)
+without a PIL dependency: 8-bit grayscale, one IDAT, fixed spec-compliant
+output verified against PIL in tests when PIL is available.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+_SIGNATURE = b"\x89PNG\r\n\x1a\n"
+
+
+def _chunk(tag: bytes, payload: bytes) -> bytes:
+    return (struct.pack(">I", len(payload)) + tag + payload
+            + struct.pack(">I", zlib.crc32(tag + payload) & 0xFFFFFFFF))
+
+
+def encode_gray(img: np.ndarray, compress_level: int = 6) -> bytes:
+    """Encode a (H, W) uint8 array as a grayscale PNG byte string."""
+    arr = np.ascontiguousarray(img, np.uint8)
+    if arr.ndim != 2:
+        raise ValueError(f"expected (H, W) grayscale, got shape {arr.shape}")
+    h, w = arr.shape
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, 0, 0, 0, 0)  # 8-bit gray
+    # Filter byte 0 (None) prepended to every row.
+    raw = np.empty((h, w + 1), np.uint8)
+    raw[:, 0] = 0
+    raw[:, 1:] = arr
+    idat = zlib.compress(raw.tobytes(), compress_level)
+    return (_SIGNATURE + _chunk(b"IHDR", ihdr) + _chunk(b"IDAT", idat)
+            + _chunk(b"IEND", b""))
+
+
+def decode_gray(png: bytes) -> np.ndarray:
+    """Decode a grayscale PNG produced by `encode_gray` (tests/round-trip)."""
+    if png[:8] != _SIGNATURE:
+        raise ValueError("not a PNG")
+    pos = 8
+    w = h = None
+    idat = b""
+    while pos < len(png):
+        (length,) = struct.unpack(">I", png[pos:pos + 4])
+        tag = png[pos + 4:pos + 8]
+        payload = png[pos + 8:pos + 8 + length]
+        if tag == b"IHDR":
+            w, h, depth, color = struct.unpack(">IIBB", payload[:10])
+            if depth != 8 or color != 0:
+                raise ValueError("decode_gray only handles 8-bit grayscale")
+        elif tag == b"IDAT":
+            idat += payload
+        pos += 12 + length
+    raw = np.frombuffer(zlib.decompress(idat), np.uint8).reshape(h, w + 1)
+    if np.any(raw[:, 0] != 0):
+        raise ValueError("decode_gray only handles filter type 0")
+    return raw[:, 1:].copy()

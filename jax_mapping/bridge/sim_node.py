@@ -1,0 +1,99 @@
+"""Simulation node: the LD06 driver + physical world, in one box.
+
+Plays the role of the Pi half of the reference (SURVEY.md §3.3): a fixed-rate
+loop that produces `sensor_msgs/LaserScan` on `{ns}scan` — plus the physics
+the workshop floor provided for free. Each tick it:
+
+  1. reads motor targets from the driver (what the brain wrote),
+  2. advances the simulated fleet (first-order motor lag + RK2 kinematics,
+     `sim.thymio`),
+  3. feeds measured wheel speeds + IR prox back into the driver (uint16
+     wire encoding included),
+  4. raycasts LD06 scans from ground-truth poses (`sim.lidar`) and
+     publishes them Best-Effort (report.pdf §V.A).
+
+The scan publish rate defaults to the LD06's ~10 rotations/sec
+(`BASELINE.md` "Effective scan ingest").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from jax_mapping.bridge.brain import robot_ns
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.driver import SimulatedThymioDriver
+from jax_mapping.bridge.messages import Header, LaserScan
+from jax_mapping.bridge.node import Node
+from jax_mapping.bridge.qos import qos_sensor_data
+from jax_mapping.bridge.tf import TfTree
+from jax_mapping.config import SlamConfig
+
+
+class SimNode(Node):
+    """Ground-truth world + sensor emulation behind the driver surface."""
+
+    def __init__(self, cfg: SlamConfig, bus: Bus,
+                 driver: SimulatedThymioDriver, world: np.ndarray,
+                 world_res_m: float, tf: Optional[TfTree] = None,
+                 rate_hz: float = 10.0, seed: int = 0,
+                 realtime: bool = True):
+        super().__init__("sim_node", bus, tf)
+        import jax
+        import jax.numpy as jnp
+
+        from jax_mapping.sim import lidar, thymio
+
+        self.cfg = cfg
+        self.driver = driver
+        self._lidar, self._thymio, self._jnp = lidar, thymio, jnp
+        self.world = jnp.asarray(np.asarray(world, bool))
+        self.world_res_m = world_res_m
+        self.rate_hz = rate_hz
+        self.n_samples = int(cfg.scan.range_max_m / (world_res_m * 0.5))
+        R = driver.n_robots
+        self.sim_state = thymio.init_fleet(cfg.robot, jax.random.PRNGKey(seed),
+                                           R)
+        self.scan_pubs = [
+            self.create_publisher(f"{robot_ns(i, R)}scan", qos_sensor_data)
+            for i in range(R)]
+        self.n_steps = 0
+        if realtime:
+            self.create_timer(1.0 / rate_hz, self.step)
+
+    def truth_poses(self) -> np.ndarray:
+        return np.asarray(self.sim_state.poses)
+
+    def step(self) -> None:
+        """One physics+sensor tick (call directly for faster-than-realtime
+        runs; the timer drives it in realtime mode)."""
+        cfg = self.cfg
+        targets = self._jnp.asarray(self.driver.targets().astype(np.float32))
+        self.sim_state, measured = self._thymio.step_fleet(
+            cfg.robot, self.sim_state, targets, 1.0 / self.rate_hz)
+        prox = self._lidar.ir_proximity(self.world, self.world_res_m,
+                                        self.sim_state.poses)
+        prox7 = np.zeros((self.driver.n_robots, 7), np.int32)
+        prox7[:, :5] = np.clip(np.asarray(prox), 0, 4500).astype(np.int32)
+        self.driver.ingest_state(np.asarray(measured), prox7)
+
+        scans = self._lidar.simulate_scans(
+            cfg.scan, self.world, self.world_res_m, self.n_samples,
+            self.sim_state.poses)
+        scans_np = np.asarray(scans)
+        stamp = time.monotonic()
+        for i, pub in enumerate(self.scan_pubs):
+            pub.publish(LaserScan(
+                header=Header(stamp=stamp,
+                              frame_id=f"{robot_ns(i, len(self.scan_pubs))}"
+                                       f"base_laser"),
+                angle_min=cfg.scan.angle_min_rad,
+                angle_increment=cfg.scan.angle_increment_rad,
+                scan_time=1.0 / self.rate_hz,
+                range_min=cfg.scan.range_min_m,
+                range_max=cfg.scan.range_max_m,
+                ranges=scans_np[i, :cfg.scan.n_beams].copy()))
+        self.n_steps += 1

@@ -24,6 +24,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from jax_mapping.config import RobotConfig, ScanConfig
 from jax_mapping.ops.odometry import wrap_angle
@@ -31,10 +32,12 @@ from jax_mapping.ops.odometry import wrap_angle
 Array = jax.Array
 
 # LED colors, reference state machine (main.py:69,131,161,181).
-LED_IDLE = jnp.array([0, 32, 0])
-LED_IR = jnp.array([32, 0, 0])
-LED_WARN = jnp.array([32, 16, 0])
-LED_CRUISE = jnp.array([0, 0, 32])
+# numpy on purpose: module import may happen inside a jit trace (a lazy
+# importer), and jnp.array here would bake leaked tracers into the module.
+LED_IDLE = np.array([0, 32, 0])
+LED_IR = np.array([32, 0, 0])
+LED_WARN = np.array([32, 16, 0])
+LED_CRUISE = np.array([0, 0, 32])
 
 
 class PolicyOut(NamedTuple):
