@@ -1,0 +1,13 @@
+"""Persistence: checkpoint/resume + trace record/replay.
+
+The reference has neither (SURVEY.md §5): map state lives inside
+slam_toolbox's process and dies with it, and there is no recorded-data test
+path. Both are first-class here — device state is a pytree of fixed-shape
+arrays, so checkpointing is trivial and exact, and traces are the
+golden-test backbone (SURVEY.md §4 "Implication for the TPU build").
+"""
+
+from jax_mapping.io.checkpoint import (  # noqa: F401
+    load_checkpoint, save_checkpoint,
+)
+from jax_mapping.io.trace import TraceRecorder, TraceReplayer  # noqa: F401

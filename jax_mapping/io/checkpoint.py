@@ -1,0 +1,109 @@
+"""Checkpoint/resume for device state pytrees.
+
+The reference loses its whole map on restart — slam_toolbox's serialization
+API exists but is never invoked (`enable_interactive_mode: true` at
+`/root/reference/server/thymio_project/config/slam_config.yaml:32`,
+SURVEY.md §5 "Checkpoint / resume: none"). Here any pytree of arrays
+(SlamState, FleetState, raw grids) round-trips exactly through one `.npz`
+file, with the config JSON embedded so a resume can detect shape drift.
+
+Plain npz rather than orbax: single-host state of a few hundred MB max,
+no need for async/multi-host sharded checkpointing machinery — and the file
+is inspectable with numpy alone. The layout is flatten-with-paths, so any
+NamedTuple nesting (SlamState.graph.poses, ...) keys stably.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+_META_KEY = "__jax_mapping_meta__"
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(p.name)
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        else:
+            parts.append(str(p))
+    return ".".join(parts) or "value"
+
+
+def save_checkpoint(path: str, state: Any,
+                    config_json: Optional[str] = None) -> None:
+    """Write `state` (any pytree of arrays/scalars) to `path` atomically."""
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state)
+    arrays = {}
+    keys = []
+    for kpath, leaf in leaves_with_paths:
+        key = _path_str(kpath)
+        assert key not in arrays, f"duplicate checkpoint key {key}"
+        arrays[key] = np.asarray(leaf)
+        keys.append(key)
+    meta = {
+        "keys": keys,                       # leaf order for exact rebuild
+        "treedef": str(treedef),            # debugging aid only
+        "config": config_json,
+        "version": 1,
+    }
+    arrays[_META_KEY] = np.frombuffer(
+        json.dumps(meta).encode(), np.uint8)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    os.replace(tmp, path)                   # crash-safe swap
+
+
+def load_checkpoint(path: str, like: Any
+                    ) -> Tuple[Any, Optional[str]]:
+    """Read a checkpoint into the structure of `like` (a template pytree,
+    e.g. `init_state(cfg)`), returning (state, config_json).
+
+    Leaf dtypes follow the template (so restored state is jit-compatible
+    with the running program); a shape mismatch raises with the offending
+    key named.
+    """
+    with np.load(path) as z:
+        meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        data = {k: z[k] for k in meta["keys"]}
+
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+    if len(leaves_with_paths) != len(meta["keys"]):
+        raise ValueError(
+            f"checkpoint has {len(meta['keys'])} leaves, template has "
+            f"{len(leaves_with_paths)} — config/shape drift?")
+    new_leaves = []
+    for kpath, leaf in leaves_with_paths:
+        key = _path_str(kpath)
+        if key not in data:
+            raise ValueError(f"checkpoint missing leaf {key!r}")
+        arr = data[key]
+        tmpl = np.asarray(leaf)
+        if arr.shape != tmpl.shape:
+            raise ValueError(
+                f"checkpoint leaf {key!r} shape {arr.shape} != template "
+                f"{tmpl.shape} — was the config changed?")
+        new_leaves.append(jax.numpy.asarray(arr.astype(tmpl.dtype)))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves), meta["config"]
+
+
+def checkpoint_bytes(state: Any, config_json: Optional[str] = None) -> bytes:
+    """In-memory variant (for shipping state over a wire/HTTP)."""
+    buf = io.BytesIO()
+    leaves_with_paths, _ = jax.tree_util.tree_flatten_with_path(state)
+    arrays = {_path_str(k): np.asarray(v) for k, v in leaves_with_paths}
+    meta = {"keys": list(arrays.keys()), "config": config_json, "version": 1}
+    arrays[_META_KEY] = np.frombuffer(json.dumps(meta).encode(), np.uint8)
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
