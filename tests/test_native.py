@@ -1,0 +1,108 @@
+"""Native LD06 ingest tests: wire round trip, resync, CRC rejection,
+filtering — the C++ pipeline the reference vendored pre-built
+(SURVEY.md §2.3), here exercised byte-for-byte."""
+
+import numpy as np
+import pytest
+
+from jax_mapping.native import Ld06Parser, encode_packets, native_available
+from jax_mapping.native.ld06 import PACKET_BYTES, crc8
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="g++/libld06 unavailable")
+
+
+def _rotation(n=360, base=2.0):
+    """A plausible rotation: smooth wall at ~2 m with a bump."""
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    return (base + 0.5 * np.cos(ang)).astype(np.float64)
+
+
+def test_wire_roundtrip_full_rotation():
+    ranges = _rotation()
+    # Two rotations: the assembler needs to cross 360 deg to emit the first.
+    data = encode_packets(ranges) + encode_packets(ranges)
+    p = Ld06Parser(n_beams=360)
+    n_pts = p.feed(data)
+    assert n_pts == 720
+    out = p.take_scan()
+    assert out is not None
+    got, intens = out
+    valid = got > 0
+    assert valid.sum() > 340
+    np.testing.assert_allclose(got[valid], ranges[valid], atol=0.01)
+    assert intens[valid].min() > 0
+    assert p.take_scan() is None            # only one complete rotation
+    assert p.stats()["scans"] == 1
+    assert abs(p.speed_deg_s - 3600) < 1e-6
+
+
+def test_parser_resyncs_over_garbage():
+    ranges = _rotation()
+    clean = encode_packets(ranges)
+    noise = bytes(range(1, 200))            # no 0x54,0x2C pairs that pass CRC
+    p = Ld06Parser()
+    p.feed(noise + clean + noise + clean)
+    assert p.stats()["packets"] == 60       # 2 rotations x 30 packets
+    assert p.stats()["resyncs"] > 0
+    assert p.take_scan() is not None
+
+
+def test_crc_corruption_rejected():
+    ranges = _rotation()
+    data = bytearray(encode_packets(ranges))
+    data[10] ^= 0xFF                        # corrupt first packet payload
+    p = Ld06Parser()
+    p.feed(bytes(data))
+    st = p.stats()
+    assert st["crc_errors"] >= 1
+    assert st["packets"] == 29              # one packet lost
+
+
+def test_chunked_feed_equals_bulk():
+    """UART delivers arbitrary chunk sizes; framing must not care."""
+    ranges = _rotation()
+    data = encode_packets(ranges) + encode_packets(ranges)
+    bulk = Ld06Parser()
+    bulk.feed(data)
+    chunked = Ld06Parser()
+    for i in range(0, len(data), 13):       # awkward chunk size
+        chunked.feed(data[i:i + 13])
+    sb = bulk.take_scan()
+    sc = chunked.take_scan()
+    assert sb is not None and sc is not None
+    np.testing.assert_array_equal(sb[0], sc[0])
+
+
+def test_tof_filter_kills_low_confidence_and_spikes():
+    ranges = _rotation()
+    conf = np.full(360, 200)
+    conf[50] = 3                            # below min_confidence
+    spiked = ranges.copy()
+    spiked[100] = 9.0                       # isolated spike between ~2 m walls
+    data = encode_packets(spiked, conf) + encode_packets(spiked, conf)
+    p = Ld06Parser(min_confidence=15, band_m=0.15)
+    p.feed(data)
+    got, _ = p.take_scan()
+    assert got[50] == 0.0                   # confidence-rejected
+    assert got[100] == 0.0                  # spike-rejected
+    assert p.stats()["points_filtered"] >= 4
+    # Neighbours survive.
+    assert got[49] > 0 and got[51] > 0 and got[99] > 0
+
+
+def test_crc8_self_consistency():
+    pkt = bytes(range(PACKET_BYTES - 1))
+    c = crc8(pkt)
+    assert 0 <= c <= 255
+    assert crc8(pkt) == c
+    assert crc8(pkt + bytes([1])) != crc8(pkt + bytes([2]))
+
+
+def test_beam_binning_partial_rotation_pending():
+    """Half a rotation parsed -> no scan yet."""
+    ranges = _rotation()
+    data = encode_packets(ranges)
+    p = Ld06Parser()
+    p.feed(data[:len(data) // 2])
+    assert p.take_scan() is None
