@@ -1,0 +1,71 @@
+"""LD06 ingest node: wire bytes -> `sensor_msgs/LaserScan` on the bus.
+
+The role of the reference driver's ROS node TU (`demo.cpp` in SURVEY.md
+§2.3: param handling, LaserScan assembly/publish) on top of the native C++
+parse/filter pipeline (`native.ld06`). A transport callable supplies bytes —
+a serial port read, a TCP socket, a recorded dump, or the simulator's
+`encode_packets` output — so the identical node runs against hardware and
+sim. Publishes Best-Effort like the reference's `/scan` (report.pdf §V.A).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.messages import Header, LaserScan
+from jax_mapping.bridge.node import Node
+from jax_mapping.bridge.qos import qos_sensor_data
+from jax_mapping.bridge.tf import TfTree
+from jax_mapping.config import ScanConfig
+
+
+class Ld06IngestNode(Node):
+    """Poll a byte transport, publish complete rotations."""
+
+    def __init__(self, scan_cfg: ScanConfig, bus: Bus,
+                 transport: Callable[[], bytes],
+                 topic: str = "scan", frame_id: str = "base_laser",
+                 tf: Optional[TfTree] = None,
+                 poll_period_s: float = 0.01, realtime: bool = True,
+                 min_confidence: int = 15, band_m: float = 0.15):
+        super().__init__("ld06_ingest", bus, tf)
+        from jax_mapping.native import Ld06Parser
+
+        self.scan_cfg = scan_cfg
+        self.transport = transport
+        self.frame_id = frame_id
+        self.parser = Ld06Parser(n_beams=scan_cfg.n_beams,
+                                 min_confidence=min_confidence,
+                                 band_m=band_m)
+        self.pub = self.create_publisher(topic, qos_sensor_data)
+        self.n_scans_published = 0
+        if realtime:
+            self.create_timer(poll_period_s, self.poll)
+
+    def poll(self) -> None:
+        """Drain the transport, publish any completed rotations."""
+        data = self.transport()
+        if data:
+            self.parser.feed(data)
+        while True:
+            out = self.parser.take_scan()
+            if out is None:
+                break
+            ranges, intensities = out
+            sc = self.scan_cfg
+            self.pub.publish(LaserScan(
+                header=Header(stamp=time.monotonic(),
+                              frame_id=self.frame_id),
+                angle_min=sc.angle_min_rad,
+                angle_increment=sc.angle_increment_rad,
+                scan_time=(360.0 / self.parser.speed_deg_s
+                           if self.parser.speed_deg_s > 0 else 0.1),
+                range_min=sc.range_min_m,
+                range_max=sc.range_max_m,
+                ranges=np.asarray(ranges, np.float32),
+                intensities=np.asarray(intensities, np.float32)))
+            self.n_scans_published += 1

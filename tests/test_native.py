@@ -106,3 +106,41 @@ def test_beam_binning_partial_rotation_pending():
     p = Ld06Parser()
     p.feed(data[:len(data) // 2])
     assert p.take_scan() is None
+
+
+def test_ingest_node_wire_path(tiny_cfg):
+    """Full wire path: sim raycast -> LD06 byte encoding -> C++ parser ->
+    LaserScan on the bus -> mapper-ready ranges."""
+    import jax.numpy as jnp
+
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.ld06_node import Ld06IngestNode
+    from jax_mapping.bridge.qos import qos_sensor_data
+    from jax_mapping.sim import lidar, world as W
+
+    cfg = tiny_cfg
+    res = cfg.grid.resolution_m
+    world = W.empty_arena(96, res)
+    n_samples = int(cfg.scan.range_max_m / (res * 0.5))
+    scan = np.asarray(lidar.simulate_scans(
+        cfg.scan, jnp.asarray(world), res, n_samples,
+        jnp.zeros((1, 3))))[0, :cfg.scan.n_beams]
+
+    chunks = [encode_packets(scan.astype(np.float64)) for _ in range(2)]
+    pending = [b"".join(chunks)]
+
+    def transport():
+        data, pending[0] = pending[0], b""
+        return data
+
+    bus = Bus()
+    sub = bus.subscribe("scan", qos_sensor_data)
+    node = Ld06IngestNode(cfg.scan, bus, transport, realtime=False)
+    node.poll()
+    assert node.n_scans_published == 1
+    msg = sub.take(timeout=0.5)
+    assert msg is not None
+    valid = msg.ranges > 0
+    # Encoder quantizes to mm; raycast walls must survive the wire.
+    np.testing.assert_allclose(msg.ranges[valid], scan[valid], atol=0.01)
+    assert valid.sum() > 0.9 * (scan > 0).sum()
