@@ -161,10 +161,16 @@ class FrontierConfig:
     navigator at `server/.../main.py:119-196`)."""
 
     downsample: int = 4               # frontier work at size/downsample resolution
+    # Clustering (connected components + summarisation) runs another factor
+    # coarser: labels/centroids/assignment at size/(downsample*cluster_downsample).
+    # 1 = exact single-level clustering; >1 is the latency path (label
+    # propagation and segment reductions shrink by cluster_downsample^2;
+    # frontier cells within cluster_downsample coarse cells merge).
+    cluster_downsample: int = 4
     max_clusters: int = 64            # static cluster slot count
-    min_cluster_cells: int = 4        # ignore tiny frontiers
-    label_prop_iters: int = 96        # connected-component propagation bound
-    bfs_iters: int = 512              # multi-source cost-to-go bound (coarse cells)
+    min_cluster_cells: int = 4        # ignore tiny frontiers (fine frontier cells)
+    label_prop_iters: int = 64        # connected-component propagation bound
+    bfs_iters: int = 192              # multi-source cost-to-go bound (cluster cells)
     # Obstacle-aware BFS costs (accurate, heavier) vs Euclidean centroid
     # distance (cheap; what the <5 ms @ 64 robots latency budget buys).
     obstacle_aware: bool = True
@@ -189,6 +195,8 @@ class SlamConfig:
     robot: RobotConfig = RobotConfig()
     matcher: MatcherConfig = MatcherConfig()
     loop: LoopClosureConfig = LoopClosureConfig()
+    # Default FrontierConfig is the hierarchical latency path
+    # (cluster work at 4096/(4*4) = 256^2).
     frontier: FrontierConfig = FrontierConfig()
     fleet: FleetConfig = FleetConfig()
     map_publish_period_s: float = 5.0         # slam_config.yaml:25

@@ -38,7 +38,11 @@ _BIG = jnp.float32(1e9)
 
 class FrontierResult(NamedTuple):
     mask: Array            # (n, n) bool frontier cells (coarse resolution)
-    labels: Array          # (n, n) int32 cluster label per cell (-1 none)
+    # Cluster label per cell, -1 = none. Labels are linear indices into the
+    # grid the connected-component pass ran on: the (n, n) array itself when
+    # cluster_downsample == 1, the (n/c, n/c) clustering grid otherwise —
+    # use them only as opaque component ids in that case.
+    labels: Array          # (n, n) int32
     slots: Array           # (n, n) int32 top-K slot per cell (-1 none)
     centroids: Array       # (K, 2) float32 world-metre centroids
     targets: Array         # (K, 2) float32 world-metre goal points: a real
@@ -90,9 +94,11 @@ def frontier_mask(free: Array, unknown: Array) -> Array:
 
 def label_components(cfg: FrontierConfig, mask: Array) -> Array:
     """8-connected components: every frontier cell takes the max linear index
-    reachable within its component. Bounded iteration count, early exit via
-    `lax.while_loop` on convergence (SURVEY.md §7: frontier BFS is
-    data-dependent -> fixed-bound loop)."""
+    reachable within its component. Fixed trip count (`lax.fori_loop`, two
+    sweeps per iteration so the bound is half the component diameter):
+    data-independent latency, no per-iteration convergence predicate to
+    serialise on (SURVEY.md §7: frontier BFS is data-dependent -> fixed-bound
+    loop)."""
     n = mask.shape[0]
     seed = jnp.where(mask,
                      jnp.arange(n * n, dtype=jnp.int32).reshape(n, n),
@@ -107,20 +113,10 @@ def label_components(cfg: FrontierConfig, mask: Array) -> Array:
                 best = jnp.maximum(best, _shift(lab, dr, dc, fill=-1))
         return jnp.where(mask, best, -1)
 
-    def cond(state):
-        lab, prev, it = state
-        return (it < cfg.label_prop_iters) & jnp.any(lab != prev)
-
-    def body(state):
-        lab, _, it = state
-        # Two sweeps per iteration: label propagation is O(diameter), the
-        # doubled sweep halves the bound.
-        nxt = neighbor_max(neighbor_max(lab))
-        return nxt, lab, it + 1
-
-    lab, _, _ = jax.lax.while_loop(
-        cond, body, (neighbor_max(seed), seed, jnp.int32(0)))
-    return lab
+    return jax.lax.fori_loop(
+        0, cfg.label_prop_iters,
+        lambda _, lab: neighbor_max(neighbor_max(lab)),
+        neighbor_max(seed))
 
 
 def summarize_clusters(cfg: FrontierConfig, grid_cfg: GridConfig,
@@ -134,20 +130,35 @@ def summarize_clusters(cfg: FrontierConfig, grid_cfg: GridConfig,
     Segment reductions keep this dense; slots beyond the true cluster count
     have size 0 and centroid/target at _BIG.
     """
+    out = _summarize(cfg, grid_cfg, labels, weights=None, scale=1)
+    return out[:4]
+
+
+def _summarize(cfg: FrontierConfig, grid_cfg: GridConfig, labels: Array,
+               weights, scale: int):
+    """Slot summarisation at an arbitrary clustering resolution.
+
+    weights: optional (n, n) per-cell fine-frontier-cell counts (hierarchical
+    path) — sizes and centroids weight by it so they stay in fine-cell units.
+    scale: clustering cells per first-level coarse cell (cluster_downsample).
+    Returns (centroids, targets, sizes, slot_of_cell, rep_rc).
+    """
     n = labels.shape[0]
     K = cfg.max_clusters
     flat = labels.reshape(-1)
     present = flat >= 0
+    w = (present.astype(jnp.int32) if weights is None
+         else jnp.where(present, weights.reshape(-1), 0))
 
     # Unique labels -> the K largest clusters, via a bincount-free trick:
     # a cluster's label is the max linear index in it, so cells whose own
     # linear index equals their label are cluster representatives.
     lin = jnp.arange(n * n, dtype=jnp.int32)
     is_rep = present & (flat == lin)
-    # Cluster size per representative: count cells sharing its label.
-    # segment_sum over labels (clamped for the -1s).
+    # Cluster size per representative: weighted count of cells sharing its
+    # label. segment_sum over labels (clamped for the -1s).
     sizes_by_cell = jax.ops.segment_sum(
-        present.astype(jnp.int32), jnp.clip(flat, 0), num_segments=n * n)
+        w, jnp.clip(flat, 0), num_segments=n * n)
     rep_sizes = jnp.where(is_rep, sizes_by_cell[lin], 0)
     rep_sizes = jnp.where(rep_sizes >= cfg.min_cluster_cells, rep_sizes, 0)
 
@@ -161,20 +172,21 @@ def summarize_clusters(cfg: FrontierConfig, grid_cfg: GridConfig,
         jnp.where(slot_valid, jnp.arange(K, dtype=jnp.int32), -1))
     slot_of_cell = jnp.where(present, slot_of_label[jnp.clip(flat, 0)], -1)
 
-    # Centroids via segment sums over slots.
+    # Centroids via weighted segment sums over slots.
     rows = (lin // n).astype(jnp.float32)
     cols = (lin % n).astype(jnp.float32)
     sel = slot_of_cell >= 0
     seg = jnp.clip(slot_of_cell, 0)
-    cnt = jax.ops.segment_sum(sel.astype(jnp.float32), seg, num_segments=K)
-    sr = jax.ops.segment_sum(jnp.where(sel, rows, 0.0), seg, num_segments=K)
-    sc = jax.ops.segment_sum(jnp.where(sel, cols, 0.0), seg, num_segments=K)
+    wf = jnp.where(sel, w.astype(jnp.float32), 0.0)
+    cnt = jax.ops.segment_sum(wf, seg, num_segments=K)
+    sr = jax.ops.segment_sum(wf * rows, seg, num_segments=K)
+    sc = jax.ops.segment_sum(wf * cols, seg, num_segments=K)
     cnt_safe = jnp.maximum(cnt, 1.0)
     c_row = sr / cnt_safe
     c_col = sc / cnt_safe
 
     d = cfg.downsample
-    res = grid_cfg.resolution_m * d
+    res = grid_cfg.resolution_m * d * scale
     ox, oy = grid_cfg.origin_m
     cx = (c_col + 0.5) * res + ox
     cy = (c_row + 0.5) * res + oy
@@ -193,14 +205,69 @@ def summarize_clusters(cfg: FrontierConfig, grid_cfg: GridConfig,
                                   num_segments=K)
     has_rep = rep_lin < n * n
     rep_lin = jnp.clip(rep_lin, 0, n * n - 1)
-    rep_row = (rep_lin // n).astype(jnp.float32)
-    rep_col = (rep_lin % n).astype(jnp.float32)
-    tx = (rep_col + 0.5) * res + ox
-    ty = (rep_row + 0.5) * res + oy
+    rep_row = (rep_lin // n).astype(jnp.int32)
+    rep_col = (rep_lin % n).astype(jnp.int32)
+    tx = (rep_col.astype(jnp.float32) + 0.5) * res + ox
+    ty = (rep_row.astype(jnp.float32) + 0.5) * res + oy
     targets = jnp.where(slot_valid[:, None] & has_rep[:, None],
                         jnp.stack([tx, ty], -1), _BIG)
+    rep_rc = jnp.stack([rep_row, rep_col], -1)
     return centroids, targets, top_sizes.astype(jnp.int32), \
-        slot_of_cell.reshape(n, n)
+        slot_of_cell.reshape(n, n), rep_rc
+
+
+def _pool_any(x: Array, c: int) -> Array:
+    n0, n1 = x.shape
+    return x.reshape(n0 // c, c, n1 // c, c).any(axis=(1, 3))
+
+
+def _pool_sum(x: Array, c: int) -> Array:
+    n0, n1 = x.shape
+    return x.astype(jnp.int32).reshape(n0 // c, c, n1 // c, c).sum(axis=(1, 3))
+
+
+def _upsample(x: Array, c: int) -> Array:
+    return jnp.repeat(jnp.repeat(x, c, axis=0), c, axis=1)
+
+
+def _cluster_hierarchical(cfg: FrontierConfig, grid_cfg: GridConfig,
+                          mask: Array):
+    """Latency-path clustering: connected components and slot summarisation
+    at `cluster_downsample`x coarser resolution, sizes/centroids weighted by
+    the fine frontier-cell counts, targets refined back to a real fine
+    frontier cell. Merges frontier components that pass within
+    cluster_downsample coarse cells of each other — the work-bounding trade
+    the <5 ms @ 64 robots latency budget buys (BASELINE.md)."""
+    c = cfg.cluster_downsample
+    n = mask.shape[0]
+    mask2 = _pool_any(mask, c)
+    w2 = _pool_sum(mask, c)
+    labels2 = label_components(cfg, mask2)
+    centroids, targets2, sizes, slots2, rep_rc = _summarize(
+        cfg, grid_cfg, labels2, weights=w2, scale=c)
+
+    # Refine each slot's target from the rep coarse cell's centre to an
+    # actual fine frontier cell inside it (a coarse cell centre can sit on
+    # a wall even when the c x c block holds frontier cells).
+    res1 = grid_cfg.resolution_m * cfg.downsample
+    ox, oy = grid_cfg.origin_m
+
+    def refine(rc, fallback):
+        win = jax.lax.dynamic_slice(mask, (rc[0] * c, rc[1] * c), (c, c))
+        idx = jnp.argmax(win.reshape(-1))
+        any_fine = win.reshape(-1).any()
+        fr = rc[0] * c + idx // c
+        fc = rc[1] * c + idx % c
+        fine = jnp.stack([(fc.astype(jnp.float32) + 0.5) * res1 + ox,
+                          (fr.astype(jnp.float32) + 0.5) * res1 + oy])
+        return jnp.where(any_fine, fine, fallback)
+
+    targets = jax.vmap(refine)(rep_rc, targets2)
+    targets = jnp.where((sizes > 0)[:, None], targets, _BIG)
+
+    labels = jnp.where(mask, _upsample(labels2, c), -1)
+    slots = jnp.where(mask, _upsample(slots2, c), -1)
+    return labels, slots, centroids, targets, sizes, rep_rc, mask2
 
 
 # ---------------------------------------------------------------------------
@@ -232,19 +299,11 @@ def cost_to_go(cfg: FrontierConfig, passable: Array, seeds_rc: Array,
             best = jnp.minimum(best, _shift(dm, dr, dc, fill=_BIG) + w)
         return jnp.where(blocked, _BIG, best)
 
-    def cond(state):
-        dm, prev, it = state
-        return (it < cfg.bfs_iters) & jnp.any(dm != prev)
-
-    def body(state):
-        dm, _, it = state
-        # Doubled sweep, same rationale as label propagation.
-        nxt = relax(relax(dm))
-        return nxt, dm, it + 1
-
-    out, _, _ = jax.lax.while_loop(
-        cond, body, (relax(jnp.where(blocked, _BIG, dist)), dist, jnp.int32(0)))
-    return out
+    # Fixed trips, doubled sweep — same latency rationale as
+    # label_components.
+    return jax.lax.fori_loop(
+        0, cfg.bfs_iters, lambda _, dm: relax(relax(dm)),
+        relax(jnp.where(blocked, _BIG, dist)))
 
 
 # ---------------------------------------------------------------------------
@@ -288,30 +347,46 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
     """Mask-level entry point: lets a spatially-sharded caller coarsen its
     own grid slab locally and all_gather only the coarse masks."""
     mask = frontier_mask(free, unknown)
-    labels = label_components(cfg, mask)
-    centroids, targets, sizes, slots = summarize_clusters(cfg, grid_cfg,
-                                                          labels)
-
-    # Per-robot cost to each cluster's representative frontier cell (a real
-    # member cell — always passable, unlike a concave cluster's centroid).
+    c = cfg.cluster_downsample
     d = cfg.downsample
     res = grid_cfg.resolution_m * d
     ox, oy = grid_cfg.origin_m
     passable = free | mask | unknown   # robots may push into unknown space
 
-    tgt_r = jnp.clip(((targets[:, 1] - oy) / res).astype(jnp.int32),
-                     0, free.shape[0] - 1)
-    tgt_c = jnp.clip(((targets[:, 0] - ox) / res).astype(jnp.int32),
-                     0, free.shape[0] - 1)
+    if c == 1:
+        labels = label_components(cfg, mask)
+        centroids, targets, sizes, slots = summarize_clusters(cfg, grid_cfg,
+                                                              labels)
+        tgt_r = jnp.clip(((targets[:, 1] - oy) / res).astype(jnp.int32),
+                         0, free.shape[0] - 1)
+        tgt_c = jnp.clip(((targets[:, 0] - ox) / res).astype(jnp.int32),
+                         0, free.shape[0] - 1)
+        bfs_passable, bfs_res, bfs_scale = passable, res, 1.0
+    else:
+        labels, slots, centroids, targets, sizes, rep_rc, _mask2 = \
+            _cluster_hierarchical(cfg, grid_cfg, mask)
+        tgt_r, tgt_c = rep_rc[:, 0], rep_rc[:, 1]
+        # BFS runs at the clustering resolution; costs reported in
+        # first-level coarse cells for unit consistency with c == 1.
+        # Passability pools CONSERVATIVELY (a coarse cell is blocked if ANY
+        # child is blocked — same stance as coarsen()'s occupancy): pooling
+        # with any() instead would erase walls thinner than c cells and let
+        # obstacle-aware costs tunnel straight through them. Frontier cells
+        # stay traversable so targets in wall-adjacent blocks remain
+        # reachable (and robot seeds are unblocked inside cost_to_go).
+        bfs_passable = ~_pool_any(~passable, c) | _pool_any(mask, c)
+        bfs_res, bfs_scale = res * c, float(c)
 
     if cfg.obstacle_aware:
         def robot_costs(pose):
-            rc = jnp.stack([((pose[1] - oy) / res).astype(jnp.int32),
-                            ((pose[0] - ox) / res).astype(jnp.int32)])[None, :]
-            dist = cost_to_go(cfg, passable, rc, jnp.array([True]))
-            return dist[tgt_r, tgt_c]
+            rc = jnp.stack(
+                [((pose[1] - oy) / bfs_res).astype(jnp.int32),
+                 ((pose[0] - ox) / bfs_res).astype(jnp.int32)])[None, :]
+            dist = cost_to_go(cfg, bfs_passable, rc, jnp.array([True]))
+            return dist[tgt_r, tgt_c] * bfs_scale
 
         costs = jax.vmap(robot_costs)(robot_poses)        # (R, K)
+        costs = jnp.minimum(costs, _BIG)
     else:
         # Euclidean distance in coarse cells (latency mode).
         diff = targets[None, :, :] - robot_poses[:, None, :2]

@@ -197,11 +197,24 @@ def apply_patch(grid_cfg: GridConfig, grid_arr: Array, delta: Array,
 
 def _classify_batch(grid_cfg: GridConfig, scan_cfg: ScanConfig,
                     ranges_b: Array, poses_b: Array) -> Tuple[Array, Array]:
-    """vmap the inverse sensor model over a batch: (deltas, origins)."""
+    """Batched inverse sensor model: (deltas, origins).
+
+    On TPU the per-scan Pallas kernel computes the deltas (the XLA
+    formulation's per-cell `ranges[beam]` gather lowers to a scalarised
+    loop ~10x the cost of the rest of the model; the kernel does the
+    lookup as an in-VMEM one-hot contraction on the MXU). Elsewhere the
+    vmapped XLA path runs; the two are parity-tested in
+    tests/test_sensor_kernel.py.
+    """
     origins = jax.vmap(lambda p: patch_origin(grid_cfg, p[:2]))(poses_b)
-    deltas = jax.vmap(
-        lambda r, p, o: classify_patch(grid_cfg, scan_cfg, r, p, o)
-    )(ranges_b, poses_b, origins)
+    if jax.default_backend() == "tpu":
+        from jax_mapping.ops import sensor_kernel as SK
+        deltas = SK.scan_deltas(grid_cfg, scan_cfg, ranges_b, poses_b,
+                                origins)
+    else:
+        deltas = jax.vmap(
+            lambda r, p, o: classify_patch(grid_cfg, scan_cfg, r, p, o)
+        )(ranges_b, poses_b, origins)
     return deltas, origins
 
 
@@ -220,9 +233,9 @@ def _fold(grid_cfg: GridConfig, grid_arr: Array, deltas: Array,
 def fuse_scan(grid_cfg: GridConfig, scan_cfg: ScanConfig,
               grid_arr: Array, ranges: Array, pose: Array) -> Array:
     """Fuse a single scan (the minimum end-to-end kernel)."""
-    origin = patch_origin(grid_cfg, pose[:2])
-    delta = classify_patch(grid_cfg, scan_cfg, ranges, pose, origin)
-    return apply_patch(grid_cfg, grid_arr, delta, origin)
+    deltas, origins = _classify_batch(grid_cfg, scan_cfg, ranges[None],
+                                      pose[None])
+    return apply_patch(grid_cfg, grid_arr, deltas[0], origins[0])
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
@@ -253,6 +266,32 @@ def scan_deltas_full(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     deltas, origins = _classify_batch(grid_cfg, scan_cfg, ranges_b, poses_b)
     zero = jnp.zeros((grid_cfg.size_cells, grid_cfg.size_cells), jnp.float32)
     return _fold(grid_cfg, zero, deltas, origins, clamp=False)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def fuse_scans_window(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                      grid_arr: Array, ranges_b: Array,
+                      poses_b: Array) -> Array:
+    """Fuse a temporal scan window (one robot's consecutive scans) fast.
+
+    All B scans share one patch whose origin is snapped from the mean pose;
+    the Pallas kernel (ops/sensor_kernel.py) sums their deltas in VMEM and
+    the grid sees a single aligned read-modify-write. This is the throughput
+    path: HBM traffic is independent of B. Requires the window to fit the
+    patch (default config: poses within ~4 m of their mean —
+    `sensor_kernel.window_fits`); scans from scattered poses should use
+    `fuse_scans` instead.
+
+    Clamp semantics differ from the sequential fold only *within* a batch:
+    the clamp applies once per window rather than once per scan (the same
+    bounded-relaxation slam_toolbox applies per map update cycle,
+    `slam_config.yaml:25`).
+    """
+    from jax_mapping.ops import sensor_kernel as SK
+    mean_xy = poses_b[:, :2].mean(axis=0)
+    origin = patch_origin(grid_cfg, mean_xy)
+    delta = SK.window_delta(grid_cfg, scan_cfg, ranges_b, poses_b, origin)
+    return apply_patch(grid_cfg, grid_arr, delta, origin, clamp=True)
 
 
 def merge_delta(grid_cfg: GridConfig, grid_arr: Array, delta_full: Array) -> Array:

@@ -1,0 +1,243 @@
+"""Pallas TPU kernel: batched dense inverse sensor model over a shared patch.
+
+This is the hot op of the whole framework — the capability slam_toolbox's
+C++ rasterizer provides (`/root/reference/server/thymio_project/config/
+slam_config.yaml:26-27`), rebuilt as a TPU kernel. The XLA formulation in
+`ops/grid.py` evaluates the same model but pays for a per-cell gather
+``ranges[beam]`` (measured ~10x the cost of all the geometry math combined:
+XLA lowers the small-table gather to a scalarised loop). Here the lookup is
+an in-VMEM one-hot contraction on the MXU, so the (cells x beams) one-hot
+never touches HBM:
+
+    grid = (patch_tiles, B_scans)            # scan axis innermost
+    per step: geometry for a (TILE_R x P) strip of scan b's patch (VPU),
+              z/carve/hit lookup = onehot(beam) @ table[b]  (MXU, VMEM),
+              delta accumulated INTO the output tile across all B scans.
+
+The output tile is revisited across the innermost scan axis, so the
+accumulator stays resident in VMEM and each patch tile is written to HBM
+exactly once per batch — total HBM traffic per batch is one (P, P) float32
+patch plus the (B, BEAMS) tables, independent of B's contribution to
+compute. Scans in a batch share one patch origin (a temporal scan window
+from one robot: the reference's LD06 delivers ~10 scans/sec while the robot
+moves ~1 cm/scan, `server/.../main.py:60`), which also replaces the
+sequential per-scan fold of the general path with a single aligned
+read-modify-write.
+
+Semantics match `ops/grid.classify_patch` (same sanitize rules: zero range
+-> invalid 10 m carve, `server/.../main.py:152`; padded beams inert; CCW
+beam convention `pi_hardware.launch.py:20`) — tests hold the two to a
+NumPy oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from jax_mapping.config import GridConfig, ScanConfig
+
+Array = jax.Array
+
+# Rows of the patch strip each grid step computes. The one-hot intermediate
+# is (TILE_R * P, BEAMS) float32 in VMEM: 4 * 640 * 512 * 4B ~= 5.2 MB for
+# the full-size config — comfortably under the ~16 MB VMEM budget with the
+# output tile and table alongside.
+TILE_R = 4
+_TABLE_COLS = 8          # [carve, z, hit, 0...] padded to a lane-friendly 8
+
+
+def _beam_table(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                ranges_b: Array) -> Array:
+    """(B, BEAMS) raw ranges -> (B, BEAMS, 8) f32 lookup table.
+
+    Columns: 0 = carve distance (free-space limit), 1 = hit range z,
+    2 = hit flag. Sanitize semantics identical to grid.sanitize_ranges.
+    """
+    from jax_mapping.ops.grid import sanitize_ranges
+    r_m, hit = jax.vmap(lambda r: sanitize_ranges(scan_cfg, r))(ranges_b)
+    carve = jnp.minimum(jnp.where(r_m > 0.0, r_m, 0.0),
+                        jnp.float32(grid_cfg.max_range_m))
+    cols = [carve, r_m, hit.astype(jnp.float32)]
+    zeros = jnp.zeros_like(carve)
+    table = jnp.stack(cols + [zeros] * (_TABLE_COLS - len(cols)), axis=-1)
+    return table.astype(jnp.float32)
+
+
+def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                 accumulate: bool = True):
+    P = grid_cfg.patch_cells
+    beams = scan_cfg.padded_beams
+    res = grid_cfg.resolution_m
+    ox, oy = grid_cfg.origin_m
+    inc = scan_cfg.angle_increment_rad
+    n_beams = scan_cfg.n_beams
+    two_pi = 2.0 * math.pi
+    full_circle = abs(n_beams * inc - two_pi) < inc / 2
+    tol = grid_cfg.hit_tolerance_cells * res
+    ccw = scan_cfg.counterclockwise
+
+    def kernel(table_ref, pose_ref, origin_ref, out_ref):
+        b = pl.program_id(1)
+        t = pl.program_id(0)
+
+        px = pose_ref[0, 0]
+        py = pose_ref[0, 1]
+        yaw = pose_ref[0, 2]
+        row0 = origin_ref[0, 0]
+        col0 = origin_ref[0, 1]
+
+        # Cell-centre world coords for this (TILE_R, P) strip.
+        rr = jax.lax.broadcasted_iota(jnp.float32, (TILE_R, P), 0)
+        cc = jax.lax.broadcasted_iota(jnp.float32, (TILE_R, P), 1)
+        gr = (row0 + t * TILE_R).astype(jnp.float32) + rr
+        gc = col0.astype(jnp.float32) + cc
+        y = (gr + 0.5) * res + oy
+        x = (gc + 0.5) * res + ox
+        dx = x - px
+        dy = y - py
+        r_cell = jnp.sqrt(dx * dx + dy * dy)
+
+        theta = jnp.arctan2(dy, dx) - yaw
+        if not ccw:
+            theta = -theta
+        theta = theta - scan_cfg.angle_min_rad
+        theta = theta - two_pi * jnp.floor(theta / two_pi)   # wrap [0, 2pi)
+        beam_raw = jnp.round(theta / inc).astype(jnp.int32)
+        beam = jax.lax.rem(beam_raw, n_beams)
+        in_fov = (jnp.ones_like(r_cell, dtype=jnp.bool_) if full_circle
+                  else beam_raw <= n_beams - 1)
+
+        # z / carve / hit lookup as an MXU contraction; the one-hot only
+        # ever exists in VMEM.
+        bi = jax.lax.broadcasted_iota(jnp.int32, (TILE_R, P, beams), 2)
+        oh = (beam[:, :, None] == bi).astype(jnp.float32)
+        looked = jax.lax.dot_general(
+            oh.reshape(TILE_R * P, beams), table_ref[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).reshape(TILE_R, P, _TABLE_COLS)
+        carve = looked[:, :, 0]
+        z = looked[:, :, 1]
+        beam_hit = (looked[:, :, 2] > 0.5) & in_fov
+
+        free = ((r_cell < carve - tol)
+                & (r_cell > scan_cfg.range_min_m) & in_fov)
+        occ = (beam_hit & (jnp.abs(r_cell - z) <= tol)
+               & (r_cell <= grid_cfg.max_range_m))
+        delta = jnp.where(occ, grid_cfg.logodds_occ,
+                          jnp.where(free, grid_cfg.logodds_free, 0.0))
+        delta = delta.astype(jnp.float32)
+
+        if accumulate:
+            @pl.when(b == 0)
+            def _():
+                out_ref[:] = delta
+
+            @pl.when(b != 0)
+            def _():
+                out_ref[:] = out_ref[:] + delta
+        else:
+            out_ref[0] = delta
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def window_delta(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                 ranges_b: Array, poses_b: Array, origin_rc: Array) -> Array:
+    """Sum of all B scans' log-odds deltas on one shared (P, P) patch.
+
+    Args:
+      ranges_b: (B, padded_beams) raw ranges (0 = outlier).
+      poses_b:  (B, 3) world [x, y, yaw].
+      origin_rc: (2,) int32 patch origin [row0, col0] (aligned; see
+        grid.patch_origin). Every pose must lie within
+        patch/2 - max_range_cells of the patch centre (`window_fits`).
+    """
+    P = grid_cfg.patch_cells
+    if P % TILE_R:
+        raise ValueError(f"patch_cells={P} not divisible by TILE_R={TILE_R}")
+    B = ranges_b.shape[0]
+    table = _beam_table(grid_cfg, scan_cfg, ranges_b)
+    origin = origin_rc.astype(jnp.int32).reshape(1, 2)
+    kernel = _make_kernel(grid_cfg, scan_cfg)
+    interpret = jax.default_backend() != "tpu"
+    return pl.pallas_call(
+        kernel,
+        grid=(P // TILE_R, B),
+        in_specs=[
+            pl.BlockSpec((1, scan_cfg.padded_beams, _TABLE_COLS),
+                         lambda t, b: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3), lambda t, b: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2), lambda t, b: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE_R, P), lambda t, b: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((P, P), jnp.float32),
+        interpret=interpret,
+    )(table, poses_b.astype(jnp.float32), origin)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def scan_deltas(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                ranges_b: Array, poses_b: Array, origins_rc: Array) -> Array:
+    """Per-scan (B, P, P) log-odds deltas, one patch origin per scan.
+
+    The general-pose counterpart of `window_delta` (same kernel body, no
+    cross-scan accumulation): feeds the sequential exact fold in
+    `grid.fuse_scans` when poses are scattered. On TPU this replaces the
+    XLA classify path whose per-cell `ranges[beam]` gather dominates its
+    runtime.
+    """
+    P = grid_cfg.patch_cells
+    if P % TILE_R:
+        raise ValueError(f"patch_cells={P} not divisible by TILE_R={TILE_R}")
+    B = ranges_b.shape[0]
+    table = _beam_table(grid_cfg, scan_cfg, ranges_b)
+    origins = origins_rc.astype(jnp.int32).reshape(B, 2)
+    kernel = _make_kernel(grid_cfg, scan_cfg, accumulate=False)
+    interpret = jax.default_backend() != "tpu"
+    return pl.pallas_call(
+        kernel,
+        grid=(P // TILE_R, B),
+        in_specs=[
+            pl.BlockSpec((1, scan_cfg.padded_beams, _TABLE_COLS),
+                         lambda t, b: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3), lambda t, b: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2), lambda t, b: (b, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, TILE_R, P), lambda t, b: (b, t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, P, P), jnp.float32),
+        interpret=interpret,
+    )(table, poses_b.astype(jnp.float32), origins)
+
+
+def window_fits(grid_cfg: GridConfig, poses_b: Array,
+                origin_rc: Array) -> Array:
+    """Scalar bool: does every pose's max-range disc fit in the patch?
+
+    The window kernel silently drops updates outside the shared patch; a
+    caller batching scans from a fast-moving robot should check (or chunk
+    by) this. Slack for the default config: (640/2 - 240) * 0.05 = 4 m.
+    """
+    P = grid_cfg.patch_cells
+    margin = grid_cfg.max_range_cells
+    cr = (poses_b[:, :2] - jnp.array(grid_cfg.origin_m)) / grid_cfg.resolution_m
+    col = cr[:, 0]
+    row = cr[:, 1]
+    r0 = origin_rc[0].astype(jnp.float32)
+    c0 = origin_rc[1].astype(jnp.float32)
+    ok = ((row - margin >= r0) & (row + margin <= r0 + P)
+          & (col - margin >= c0) & (col + margin <= c0 + P))
+    return ok.all()

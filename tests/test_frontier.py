@@ -146,3 +146,45 @@ def test_euclidean_cost_mode(gcfg, fcfg):
     out = F.compute_frontiers(cheap, gcfg, jnp.asarray(lo), robots)
     assert (np.asarray(out.sizes) > 0).sum() >= 1
     assert (np.asarray(out.assignment) >= 0).all()
+
+
+def test_hierarchical_clustering_matches_exact(gcfg, fcfg):
+    """cluster_downsample=2 finds the same clusters on the toy map (sizes in
+    fine cells, targets on real fine frontier cells, both robots assigned)."""
+    import dataclasses
+    hier = dataclasses.replace(fcfg, cluster_downsample=2)
+    lo = toy_logodds(gcfg)
+    robots = jnp.asarray(np.array([[0.1, 0.1, 0.0], [-0.4, -0.4, 0.0]],
+                                  np.float32))
+    exact = F.compute_frontiers(fcfg, gcfg, jnp.asarray(lo), robots)
+    fast = F.compute_frontiers(hier, gcfg, jnp.asarray(lo), robots)
+    # Same total frontier mass in the kept slots (toy clusters are far
+    # apart, so no merging happens at this scale).
+    assert int(np.asarray(fast.sizes).sum()) == \
+        int(np.asarray(exact.sizes).sum())
+    assert ((np.asarray(fast.sizes) > 0).sum()
+            == (np.asarray(exact.sizes) > 0).sum())
+    # Targets are real fine frontier cells.
+    mask = np.asarray(fast.mask)
+    res = gcfg.resolution_m * fcfg.downsample
+    ox, oy = gcfg.origin_m
+    for k in range(int((np.asarray(fast.sizes) > 0).sum())):
+        tx, ty = np.asarray(fast.targets)[k]
+        r = int((ty - oy) / res)
+        cc = int((tx - ox) / res)
+        assert mask[r, cc], f"slot {k} target not on a fine frontier cell"
+    assert (np.asarray(fast.assignment) >= 0).all()
+    # Label/slot maps only on fine frontier cells.
+    assert (np.asarray(fast.labels)[~mask] == -1).all()
+    assert (np.asarray(fast.slots)[~mask] == -1).all()
+
+
+def test_hierarchical_euclidean_mode(gcfg, fcfg):
+    import dataclasses
+    cfg = dataclasses.replace(fcfg, cluster_downsample=2,
+                              obstacle_aware=False)
+    lo = toy_logodds(gcfg)
+    robots = jnp.zeros((3, 3))
+    out = F.compute_frontiers(cfg, gcfg, jnp.asarray(lo), robots)
+    assert (np.asarray(out.sizes) > 0).sum() >= 1
+    assert (np.asarray(out.assignment) >= 0).all()

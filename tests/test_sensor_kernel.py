@@ -1,0 +1,97 @@
+"""Pallas window-fusion kernel vs the XLA classify path and NumPy oracle.
+
+On CPU the kernel runs in interpret mode (same code path the TPU compiles);
+semantics must match `ops/grid.classify_patch` summed over the batch.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import sensor_kernel as SK
+from tests.oracle import classify_patch_np
+
+
+def _window(rng, tiny_cfg, B=3):
+    s = tiny_cfg.scan
+    t = np.linspace(0, 1.0, B).astype(np.float32)
+    poses = np.stack([0.2 * np.cos(t), 0.2 * np.sin(t), t], 1).astype(np.float32)
+    ranges = rng.uniform(0.3, 2.5, (B, s.padded_beams)).astype(np.float32)
+    ranges[:, s.n_beams:] = 0.0
+    ranges[0, 5] = 0.0       # outlier
+    ranges[1, 7] = 50.0      # beyond max range
+    return ranges, poses
+
+
+def test_window_delta_matches_classify_sum(tiny_cfg, rng):
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    ranges, poses = _window(rng, tiny_cfg)
+    origin = G.patch_origin(g, jnp.asarray(poses[:, :2].mean(0)))
+    assert bool(SK.window_fits(g, jnp.asarray(poses), origin))
+
+    got = np.asarray(SK.window_delta(g, s, jnp.asarray(ranges),
+                                     jnp.asarray(poses), origin))
+    want = sum(
+        np.asarray(G.classify_patch(g, s, jnp.asarray(ranges[i]),
+                                    jnp.asarray(poses[i]), origin))
+        for i in range(len(poses)))
+    # Identical math modulo op ordering: tiny float slack only.
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_window_delta_matches_numpy_oracle(tiny_cfg, rng):
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    ranges, poses = _window(rng, tiny_cfg, B=2)
+    origin_j = G.patch_origin(g, jnp.asarray(poses[:, :2].mean(0)))
+    origin = np.asarray(origin_j)
+    got = np.asarray(SK.window_delta(g, s, jnp.asarray(ranges),
+                                     jnp.asarray(poses), origin_j))
+    want = sum(classify_patch_np(g, s, ranges[i], poses[i], origin)
+               for i in range(len(poses)))
+    agree = np.mean(np.abs(got - want) < 1e-5)
+    assert agree > 0.995, f"only {agree:.4f} of cells agree with oracle"
+
+
+def test_fuse_scans_window_updates_grid(tiny_cfg, rng):
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    ranges, poses = _window(rng, tiny_cfg)
+    grid0 = G.empty_grid(g)
+    grid1 = G.fuse_scans_window(g, s, grid0, jnp.asarray(ranges),
+                                jnp.asarray(poses))
+    arr = np.asarray(grid1)
+    assert (arr > 0).any() and (arr < 0).any()
+    assert arr.min() >= g.logodds_min and arr.max() <= g.logodds_max
+    # Cells outside the patch untouched.
+    origin = np.asarray(G.patch_origin(g, jnp.asarray(poses[:, :2].mean(0))))
+    mask = np.ones_like(arr, bool)
+    mask[origin[0]:origin[0] + g.patch_cells,
+         origin[1]:origin[1] + g.patch_cells] = False
+    assert (arr[mask] == 0).all()
+
+
+def test_window_fits_rejects_far_pose(tiny_cfg):
+    g = tiny_cfg.grid
+    poses = np.array([[0.0, 0.0, 0.0],
+                      [g.patch_cells * g.resolution_m, 0.0, 0.0]], np.float32)
+    origin = G.patch_origin(g, jnp.asarray(poses[:1, :2].mean(0)))
+    assert not bool(SK.window_fits(g, jnp.asarray(poses), origin))
+
+
+def test_scan_deltas_per_scan_origin_matches_classify(tiny_cfg, rng):
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    # Scattered poses: each scan gets its own patch origin.
+    poses = np.array([[0.5, 0.5, 0.3], [-1.5, 1.0, 2.0]], np.float32)
+    ranges = rng.uniform(0.3, 2.5, (2, s.padded_beams)).astype(np.float32)
+    ranges[:, s.n_beams:] = 0.0
+    origins = jax.vmap(lambda p: G.patch_origin(g, p[:2]))(jnp.asarray(poses))
+    got = np.asarray(SK.scan_deltas(g, s, jnp.asarray(ranges),
+                                    jnp.asarray(poses), origins))
+    for i in range(2):
+        want = np.asarray(G.classify_patch(
+            g, s, jnp.asarray(ranges[i]), jnp.asarray(poses[i]), origins[i]))
+        np.testing.assert_allclose(got[i], want, atol=1e-5)
