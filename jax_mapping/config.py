@@ -169,8 +169,12 @@ class FrontierConfig:
     cluster_downsample: int = 4
     max_clusters: int = 64            # static cluster slot count
     min_cluster_cells: int = 4        # ignore tiny frontiers (fine frontier cells)
-    label_prop_iters: int = 64        # connected-component propagation bound
-    bfs_iters: int = 192              # multi-source cost-to-go bound (cluster cells)
+    # Iteration bounds, expressed in FIRST-LEVEL coarse cells (size/downsample)
+    # so their meaning does not change with cluster_downsample: the
+    # hierarchical path divides them by cluster_downsample internally
+    # (its grid is that much smaller).
+    label_prop_iters: int = 96        # connected-component propagation bound
+    bfs_iters: int = 512              # multi-source cost-to-go bound
     # Obstacle-aware BFS costs (accurate, heavier) vs Euclidean centroid
     # distance (cheap; what the <5 ms @ 64 robots latency budget buys).
     obstacle_aware: bool = True

@@ -238,11 +238,16 @@ def _cluster_hierarchical(cfg: FrontierConfig, grid_cfg: GridConfig,
     frontier cell. Merges frontier components that pass within
     cluster_downsample coarse cells of each other — the work-bounding trade
     the <5 ms @ 64 robots latency budget buys (BASELINE.md)."""
+    import dataclasses
     c = cfg.cluster_downsample
     n = mask.shape[0]
     mask2 = _pool_any(mask, c)
     w2 = _pool_sum(mask, c)
-    labels2 = label_components(cfg, mask2)
+    # Iteration bounds are expressed in first-level coarse cells; this grid
+    # is c x smaller, so the same physical diameter needs 1/c the sweeps.
+    cfg_c = dataclasses.replace(cfg, label_prop_iters=max(
+        1, -(-cfg.label_prop_iters // c)))
+    labels2 = label_components(cfg_c, mask2)
     centroids, targets2, sizes, slots2, rep_rc = _summarize(
         cfg, grid_cfg, labels2, weights=w2, scale=c)
 
@@ -378,11 +383,15 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
         bfs_res, bfs_scale = res * c, float(c)
 
     if cfg.obstacle_aware:
+        import dataclasses
+        bfs_cfg = (cfg if c == 1 else dataclasses.replace(
+            cfg, bfs_iters=max(1, -(-cfg.bfs_iters // c))))
+
         def robot_costs(pose):
             rc = jnp.stack(
                 [((pose[1] - oy) / bfs_res).astype(jnp.int32),
                  ((pose[0] - ox) / bfs_res).astype(jnp.int32)])[None, :]
-            dist = cost_to_go(cfg, bfs_passable, rc, jnp.array([True]))
+            dist = cost_to_go(bfs_cfg, bfs_passable, rc, jnp.array([True]))
             return dist[tgt_r, tgt_c] * bfs_scale
 
         costs = jax.vmap(robot_costs)(robot_poses)        # (R, K)

@@ -126,23 +126,18 @@ def patch_origin(grid: GridConfig, pose_xy: Array) -> Array:
 # Dense inverse sensor model over one patch
 # ---------------------------------------------------------------------------
 
-def classify_patch(grid: GridConfig, scan_cfg: ScanConfig,
-                   ranges: Array, pose: Array, origin_rc: Array) -> Array:
-    """Evaluate the inverse sensor model on every cell of the patch.
+def patch_geometry(grid: GridConfig, scan_cfg: ScanConfig, pose: Array,
+                   origin_rc: Array) -> Tuple[Array, Array, Array]:
+    """Per-cell (r_cell_m, beam_index, in_fov) for a patch at origin_rc.
 
-    Args:
-      ranges: (padded_beams,) raw ranges in metres (0 == outlier).
-      pose: (3,) [x_m, y_m, yaw_rad] sensor pose in world frame.
-      origin_rc: (2,) int32 [row0, col0] patch origin in the global grid.
-
-    Returns:
-      (P, P) float32 log-odds delta for the patch.
+    THE canonical encoding of the beam conventions — CCW direction
+    (`pi_hardware.launch.py:20`), [0, 2pi) wrap, partial-FOV masking —
+    shared by classify_patch and raster_patch (the Pallas sensor kernel
+    re-derives the same math in VMEM; parity is pinned by
+    tests/test_sensor_kernel.py).
     """
     P = grid.patch_cells
     res = grid.resolution_m
-    r_m, hit = sanitize_ranges(scan_cfg, ranges)
-
-    # Cell centres in world metres.
     rows = origin_rc[0] + jnp.arange(P, dtype=jnp.int32)
     cols = origin_rc[1] + jnp.arange(P, dtype=jnp.int32)
     ox, oy = grid.origin_m
@@ -165,7 +160,25 @@ def classify_patch(grid: GridConfig, scan_cfg: ScanConfig,
     # (a cell behind a 180-degree scanner is unobserved, not free).
     full_circle = abs(scan_cfg.n_beams * scan_cfg.angle_increment_rad
                       - 2.0 * jnp.pi) < scan_cfg.angle_increment_rad / 2
-    in_fov = True if full_circle else (beam_raw <= scan_cfg.n_beams - 1)
+    in_fov = (jnp.ones_like(beam, dtype=jnp.bool_) if full_circle
+              else beam_raw <= scan_cfg.n_beams - 1)
+    return r_cell, beam, in_fov
+
+def classify_patch(grid: GridConfig, scan_cfg: ScanConfig,
+                   ranges: Array, pose: Array, origin_rc: Array) -> Array:
+    """Evaluate the inverse sensor model on every cell of the patch.
+
+    Args:
+      ranges: (padded_beams,) raw ranges in metres (0 == outlier).
+      pose: (3,) [x_m, y_m, yaw_rad] sensor pose in world frame.
+      origin_rc: (2,) int32 [row0, col0] patch origin in the global grid.
+
+    Returns:
+      (P, P) float32 log-odds delta for the patch.
+    """
+    res = grid.resolution_m
+    r_m, hit = sanitize_ranges(scan_cfg, ranges)
+    r_cell, beam, in_fov = patch_geometry(grid, scan_cfg, pose, origin_rc)
     z = r_m[beam]                                           # (P,P) gather
     beam_hit = hit[beam] & in_fov
 
@@ -178,6 +191,33 @@ def classify_patch(grid: GridConfig, scan_cfg: ScanConfig,
     delta = jnp.where(occ, grid.logodds_occ,
                       jnp.where(free, grid.logodds_free, 0.0))
     return delta.astype(jnp.float32)
+
+
+def raster_patch(grid: GridConfig, scan_cfg: ScanConfig,
+                 ranges: Array, pose: Array, origin_rc: Array) -> Array:
+    """Soft scan raster on a patch: per cell max(0, 1-|r_cell - z|/res) on
+    the hit band (no free carving) — XLA counterpart of the Pallas
+    sensor_kernel 'raster' mode (parity-tested); the correlative matcher's
+    rasterizer."""
+    res = grid.resolution_m
+    r_m, hit = sanitize_ranges(scan_cfg, ranges)
+    r_cell, beam, in_fov = patch_geometry(grid, scan_cfg, pose, origin_rc)
+    z = r_m[beam]
+    keep = hit[beam] & in_fov & (r_cell <= grid.max_range_m)
+    w = jnp.maximum(0.0, 1.0 - jnp.abs(r_cell - z) / res)
+    return jnp.where(keep, w, 0.0).astype(jnp.float32)
+
+
+def scan_rasters(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                 ranges_b: Array, poses_b: Array, origins_rc: Array) -> Array:
+    """Batched soft rasters, backend-dispatched like _classify_batch."""
+    if jax.default_backend() == "tpu":
+        from jax_mapping.ops import sensor_kernel as SK
+        return SK.scan_rasters(grid_cfg, scan_cfg, ranges_b, poses_b,
+                               origins_rc)
+    return jax.vmap(
+        lambda r, p, o: raster_patch(grid_cfg, scan_cfg, r, p, o)
+    )(ranges_b, poses_b, origins_rc)
 
 
 # ---------------------------------------------------------------------------

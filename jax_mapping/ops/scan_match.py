@@ -8,20 +8,23 @@ translation window +-0.5 m (fine step 0.01 m), coarse angular window
 and a [0,1] "response" score used for acceptance/loop gating
 (`slam_config.yaml:46-48`).
 
-TPU-first design: instead of Karto's pointer-chasing lookup tables, the
-matcher is two dense passes over static shapes —
+TPU-first design: instead of Karto's pointer-chasing lookup tables (or a
+gather-based point scorer — ~20M scalarised lookups per match on TPU), the
+matcher is dense passes over static shapes with zero gathers:
 
   1. build a smooth *likelihood field* from the local grid patch with a
-     separable Gaussian blur of the occupied mask (conv -> MXU/VPU, smooth
-     enough for sub-cell refinement);
-  2. score every (dtheta, dy, dx) candidate jointly: rotate the scan's
-     point cloud per candidate angle (one einsum), then gather the field at
-     every translated point — a (n_angles, n_shifts, n_points) gather batch,
-     reduced to a response tensor and argmax'd.
+     separable max-Gaussian smear of the occupied mask;
+  2. rasterize the scan at every candidate angle with the dense sensor
+     kernel (ops/sensor_kernel.py 'raster' mode — candidate poses are just
+     batch rows), and score ALL translation shifts of all angles as one
+     cross-correlation conv on the MXU;
+  3. refine sub-cell by rasterizing at fine_step_m pose offsets — the
+     dense rasterizer evaluates continuous poses exactly, so sub-cell
+     sensitivity needs no bilinear gather.
 
-Coarse pass at grid resolution over the full window, fine pass with
-bilinear sub-cell sampling around the coarse winner. Everything jits; no
-data-dependent shapes (SURVEY.md §7 hard parts).
+Coarse pass over the full window at grid resolution, fine angle pass, then
+sub-cell translation pass. Everything jits; no data-dependent shapes
+(SURVEY.md §7 hard parts).
 """
 
 from __future__ import annotations
@@ -52,8 +55,9 @@ class MatchResult(NamedTuple):
 def scan_points(scan_cfg: ScanConfig, ranges: Array) -> tuple[Array, Array]:
     """Ranges -> (padded_beams, 2) points in the sensor frame + valid mask.
 
-    Only genuine hits become points (zero/outlier/padded beams are masked),
-    mirroring what a matcher may legitimately align against.
+    Only genuine hits become points (zero/outlier/padded beams are masked).
+    Public geometry utility (point-cloud export / visualisation); the
+    matcher itself scores dense rasters, not points.
     """
     r_m, hit = G.sanitize_ranges(scan_cfg, ranges)
     idx = jnp.arange(scan_cfg.padded_beams, dtype=jnp.float32)
@@ -101,21 +105,6 @@ def likelihood_field(grid_cfg: GridConfig, m_cfg: MatcherConfig,
     return max_blur(max_blur(occ, 0), 1)
 
 
-def bilinear_sample(field: Array, rc: Array) -> Array:
-    """Sample field at float (row, col) coords (..., 2), edge-clamped."""
-    H, W = field.shape
-    r = jnp.clip(rc[..., 0], 0.0, H - 1.001)
-    c = jnp.clip(rc[..., 1], 0.0, W - 1.001)
-    r0 = jnp.floor(r).astype(jnp.int32)
-    c0 = jnp.floor(c).astype(jnp.int32)
-    fr = r - r0
-    fc = c - c0
-    v00 = field[r0, c0]
-    v01 = field[r0, c0 + 1]
-    v10 = field[r0 + 1, c0]
-    v11 = field[r0 + 1, c0 + 1]
-    return ((1 - fr) * (1 - fc) * v00 + (1 - fr) * fc * v01
-            + fr * (1 - fc) * v10 + fr * fc * v11)
 
 
 # ---------------------------------------------------------------------------
@@ -127,37 +116,34 @@ def _angle_grid(half: float, step: float) -> jnp.ndarray:
     return jnp.arange(-n, n + 1, dtype=jnp.float32) * step
 
 
-def _shift_grid(half_m: float, step_m: float) -> jnp.ndarray:
-    n = int(round(half_m / step_m))
-    s = jnp.arange(-n, n + 1, dtype=jnp.float32) * step_m
-    dy, dx = jnp.meshgrid(s, s, indexing="ij")
-    return jnp.stack([dy.ravel(), dx.ravel()], axis=-1)   # (S, 2) metres
 
 
-def _score_candidates(field: Array, origin_rc: Array, grid_cfg: GridConfig,
-                      pts_world: Array, valid: Array, dthetas: Array,
-                      shifts_m: Array, centre_xy: Array) -> Array:
-    """Response[(a, s)] = mean_valid field(R(dtheta)·(p - c) + c + shift).
+def _raster_batch(grid_cfg: GridConfig, scan_cfg: ScanConfig, ranges: Array,
+                  poses: Array, origin_rc: Array) -> tuple[Array, Array]:
+    """(A, P, P) soft rasters of one scan at A candidate poses + masses."""
+    A = poses.shape[0]
+    ranges_b = jnp.broadcast_to(ranges, (A,) + ranges.shape)
+    origins = jnp.broadcast_to(origin_rc, (A, 2))
+    rasters = G.scan_rasters(grid_cfg, scan_cfg, ranges_b, poses, origins)
+    mass = jnp.maximum(rasters.sum(axis=(1, 2)), 1e-6)
+    return rasters, mass
 
-    pts_world: (N,2) scan points already placed at the guess pose.
-    Rotation is about the sensor centre, matching a yaw perturbation.
-    """
-    res = grid_cfg.resolution_m
-    rel = pts_world - centre_xy                               # (N,2)
-    ca, sa = jnp.cos(dthetas), jnp.sin(dthetas)               # (A,)
-    rot = jnp.stack([jnp.stack([ca, -sa], -1),
-                     jnp.stack([sa, ca], -1)], -2)            # (A,2,2)
-    pts_a = jnp.einsum("aij,nj->ani", rot, rel) + centre_xy   # (A,N,2)
-    # world -> patch-local continuous cell coords (row, col)
-    ox, oy = grid_cfg.origin_m
-    col = (pts_a[..., 0] - ox) / res - origin_rc[1].astype(jnp.float32) - 0.5
-    row = (pts_a[..., 1] - oy) / res - origin_rc[0].astype(jnp.float32) - 0.5
-    rc = jnp.stack([row, col], axis=-1)                       # (A,N,2)
-    shift_rc = shifts_m / res        # (S, 2) [dy, dx] metres -> cells
-    samples = bilinear_sample(
-        field, rc[:, None, :, :] + shift_rc[None, :, None, :])  # (A,S,N)
-    w = valid.astype(jnp.float32)
-    return jnp.einsum("asn,n->as", samples, w) / jnp.maximum(w.sum(), 1.0)
+
+def _conv_scores(field: Array, rasters: Array, mass: Array,
+                 n_steps: int, stride: int = 1) -> Array:
+    """resp[a, sy, sx] = <raster_a, field shifted by ((sy-n)*stride,
+    (sx-n)*stride) cells> normalised by raster mass — the whole correlative
+    window as ONE cross-correlation on the MXU (XLA conv kernels are not
+    flipped, so the conv IS the correlation). `stride` realises
+    MatcherConfig.coarse_step_m in cells."""
+    pad = n_steps * stride
+    inp = jnp.pad(field, pad)[None, None]          # (1, 1, P+2p, P+2p)
+    ker = rasters[:, None]                          # (A, 1, P, P)
+    out = jax.lax.conv_general_dilated(
+        inp, ker, window_strides=(stride, stride), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32)         # (1, A, 2n+1, 2n+1)
+    return out[0] / mass[:, None, None]
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
@@ -165,45 +151,84 @@ def match(grid_cfg: GridConfig, scan_cfg: ScanConfig, m_cfg: MatcherConfig,
           grid_arr: Array, ranges: Array, guess_pose: Array) -> MatchResult:
     """Coarse-to-fine correlative match of one scan against the map.
 
+    Three dense passes, no gathers (a gather-based scorer pays ~20M
+    scalarised lookups per match on TPU):
+
+      1. coarse: rasters at every coarse angle x every integer cell shift
+         in the window, scored jointly as one conv on the MXU;
+      2. fine angles: rasters at fine angular steps around the winner,
+         conv over +-1 cell;
+      3. sub-cell: rasters at `fine_step_m` translation offsets of the
+         winning angle (the dense rasterizer evaluates continuous poses
+         exactly — sub-cell shifts move the hit band through the cells),
+         scored at zero shift.
+
     Returns the refined pose; `accepted` mirrors the reference's response
     gating (callers fall back to the odometry guess when not accepted).
     """
+    res = grid_cfg.resolution_m
     origin = G.patch_origin(grid_cfg, guess_pose[:2])
     patch = jax.lax.dynamic_slice(
         grid_arr, (origin[0], origin[1]),
         (grid_cfg.patch_cells, grid_cfg.patch_cells))
     field = likelihood_field(grid_cfg, m_cfg, patch)
 
-    pts_s, valid = scan_points(scan_cfg, ranges)
-    ca, sa = jnp.cos(guess_pose[2]), jnp.sin(guess_pose[2])
-    rotg = jnp.array([[ca, -sa], [sa, ca]])
-    pts_world = pts_s @ rotg.T + guess_pose[:2]
-    centre = guess_pose[:2]
-
-    # --- coarse pass: full windows at grid resolution -------------------
-    dth_c = _angle_grid(m_cfg.coarse_angle_half_rad, m_cfg.coarse_angle_step_rad)
-    shifts_c = _shift_grid(m_cfg.search_half_extent_m, m_cfg.coarse_step_m)
-    resp_c = _score_candidates(field, origin, grid_cfg, pts_world, valid,
-                               dth_c, shifts_c, centre)
+    # --- coarse pass: all angles x all strided-cell shifts --------------
+    stride = max(1, int(round(m_cfg.coarse_step_m / res)))
+    n_steps = max(1, int(round(m_cfg.search_half_extent_m / (stride * res))))
+    dth_c = _angle_grid(m_cfg.coarse_angle_half_rad,
+                        m_cfg.coarse_angle_step_rad)
+    A_c = dth_c.shape[0]
+    poses_c = jnp.concatenate([
+        jnp.broadcast_to(guess_pose[:2], (A_c, 2)),
+        (guess_pose[2] + dth_c)[:, None]], axis=1)
+    rasters_c, mass_c = _raster_batch(grid_cfg, scan_cfg, ranges, poses_c,
+                                      origin)
+    resp_c = _conv_scores(field, rasters_c, mass_c, n_steps, stride)
     best_c = jnp.argmax(resp_c)
-    ai_c, si_c = jnp.unravel_index(best_c, resp_c.shape)
-    coarse_resp = resp_c[ai_c, si_c]
+    ai_c, sy_c, sx_c = jnp.unravel_index(best_c, resp_c.shape)
+    coarse_resp = resp_c[ai_c, sy_c, sx_c]
     dth0 = dth_c[ai_c]
-    shift0 = shifts_c[si_c]
+    # Shift in metres ((sy, sx) strided steps; row = y, col = x).
+    step_m = stride * res
+    shift0 = jnp.stack([(sx_c - n_steps).astype(jnp.float32) * step_m,
+                        (sy_c - n_steps).astype(jnp.float32) * step_m])
 
-    # --- fine pass: sub-cell window around the coarse winner ------------
-    dth_f = dth0 + _angle_grid(m_cfg.coarse_angle_step_rad, m_cfg.fine_angle_step_rad)
-    shifts_f = shift0 + _shift_grid(m_cfg.coarse_step_m, m_cfg.fine_step_m)
-    resp_f = _score_candidates(field, origin, grid_cfg, pts_world, valid,
-                               dth_f, shifts_f, centre)
+    # --- fine angles around the winner, +- one coarse step --------------
+    dth_f = dth0 + _angle_grid(m_cfg.coarse_angle_step_rad,
+                               m_cfg.fine_angle_step_rad)
+    A_f = dth_f.shape[0]
+    poses_f = jnp.concatenate([
+        jnp.broadcast_to(guess_pose[:2] + shift0, (A_f, 2)),
+        (guess_pose[2] + dth_f)[:, None]], axis=1)
+    rasters_f, mass_f = _raster_batch(grid_cfg, scan_cfg, ranges, poses_f,
+                                      origin)
+    resp_f = _conv_scores(field, rasters_f, mass_f, stride)
     best_f = jnp.argmax(resp_f)
-    ai_f, si_f = jnp.unravel_index(best_f, resp_f.shape)
-    fine_resp = resp_f[ai_f, si_f]
+    ai_f, sy_f, sx_f = jnp.unravel_index(best_f, resp_f.shape)
+    dth1 = dth_f[ai_f]
+    shift1 = shift0 + jnp.stack([(sx_f - stride).astype(jnp.float32) * res,
+                                 (sy_f - stride).astype(jnp.float32) * res])
+
+    # --- sub-cell translation at the winning angle ----------------------
+    k = max(1, int(round(0.5 * res / m_cfg.fine_step_m)) + 1)
+    d1 = jnp.arange(-k, k + 1, dtype=jnp.float32) * m_cfg.fine_step_m
+    ddx, ddy = jnp.meshgrid(d1, d1, indexing="xy")
+    deltas = jnp.stack([ddx.ravel(), ddy.ravel()], axis=-1)   # (S, 2) m
+    S = deltas.shape[0]
+    poses_s = jnp.concatenate([
+        guess_pose[:2] + shift1 + deltas,
+        jnp.full((S, 1), guess_pose[2] + dth1)], axis=1)
+    rasters_s, mass_s = _raster_batch(grid_cfg, scan_cfg, ranges, poses_s,
+                                      origin)
+    resp_s = jnp.einsum("bhw,hw->b", rasters_s, field) / mass_s
+    si = jnp.argmax(resp_s)
+    fine_resp = resp_s[si]
 
     pose = jnp.stack([
-        guess_pose[0] + shifts_f[si_f, 1],
-        guess_pose[1] + shifts_f[si_f, 0],
-        guess_pose[2] + dth_f[ai_f],
+        guess_pose[0] + shift1[0] + deltas[si, 0],
+        guess_pose[1] + shift1[1] + deltas[si, 1],
+        guess_pose[2] + dth1,
     ])
     return MatchResult(pose=pose, response=fine_resp,
                        coarse_response=coarse_resp,

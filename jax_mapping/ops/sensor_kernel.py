@@ -70,7 +70,11 @@ def _beam_table(grid_cfg: GridConfig, scan_cfg: ScanConfig,
 
 
 def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig,
-                 accumulate: bool = True):
+                 accumulate: bool = True, mode: str = "delta"):
+    """mode='delta': log-odds inverse sensor model. mode='raster': soft
+    scan raster — per cell a triangular weight max(0, 1-|r_cell - z|/res)
+    on the hit band (no free-space carving), the correlative matcher's
+    continuous-pose rasterizer (ops/scan_match.py)."""
     P = grid_cfg.patch_cells
     beams = scan_cfg.padded_beams
     res = grid_cfg.resolution_m
@@ -126,12 +130,17 @@ def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig,
         z = looked[:, :, 1]
         beam_hit = (looked[:, :, 2] > 0.5) & in_fov
 
-        free = ((r_cell < carve - tol)
-                & (r_cell > scan_cfg.range_min_m) & in_fov)
-        occ = (beam_hit & (jnp.abs(r_cell - z) <= tol)
-               & (r_cell <= grid_cfg.max_range_m))
-        delta = jnp.where(occ, grid_cfg.logodds_occ,
-                          jnp.where(free, grid_cfg.logodds_free, 0.0))
+        if mode == "delta":
+            free = ((r_cell < carve - tol)
+                    & (r_cell > scan_cfg.range_min_m) & in_fov)
+            occ = (beam_hit & (jnp.abs(r_cell - z) <= tol)
+                   & (r_cell <= grid_cfg.max_range_m))
+            delta = jnp.where(occ, grid_cfg.logodds_occ,
+                              jnp.where(free, grid_cfg.logodds_free, 0.0))
+        else:
+            w = jnp.maximum(0.0, 1.0 - jnp.abs(r_cell - z) / res)
+            keep = beam_hit & (r_cell <= grid_cfg.max_range_m)
+            delta = jnp.where(keep, w, 0.0)
         delta = delta.astype(jnp.float32)
 
         if accumulate:
@@ -200,10 +209,35 @@ def scan_deltas(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     P = grid_cfg.patch_cells
     if P % TILE_R:
         raise ValueError(f"patch_cells={P} not divisible by TILE_R={TILE_R}")
+    return _per_scan_call(grid_cfg, scan_cfg, ranges_b, poses_b, origins_rc,
+                          mode="delta")
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def scan_rasters(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                 ranges_b: Array, poses_b: Array, origins_rc: Array) -> Array:
+    """Soft (B, P, P) scan rasters at continuous candidate poses.
+
+    The correlative matcher's rasterizer: candidate rotations/sub-cell
+    translations of one scan are just different `poses_b` rows — the dense
+    per-cell evaluation shifts the hit band continuously, which is what
+    gives the matcher sub-cell sensitivity without any gather.
+    """
+    return _per_scan_call(grid_cfg, scan_cfg, ranges_b, poses_b, origins_rc,
+                          mode="raster")
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 5))
+def _per_scan_call(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                   ranges_b: Array, poses_b: Array, origins_rc: Array,
+                   mode: str) -> Array:
+    P = grid_cfg.patch_cells
+    if P % TILE_R:
+        raise ValueError(f"patch_cells={P} not divisible by TILE_R={TILE_R}")
     B = ranges_b.shape[0]
     table = _beam_table(grid_cfg, scan_cfg, ranges_b)
     origins = origins_rc.astype(jnp.int32).reshape(B, 2)
-    kernel = _make_kernel(grid_cfg, scan_cfg, accumulate=False)
+    kernel = _make_kernel(grid_cfg, scan_cfg, accumulate=False, mode=mode)
     interpret = jax.default_backend() != "tpu"
     return pl.pallas_call(
         kernel,

@@ -62,11 +62,6 @@ def test_likelihood_field_peaks_on_walls(tiny_cfg, room_map):
     assert field.max() <= 1.0 + 1e-6
 
 
-def test_bilinear_sample_exact_and_interp():
-    f = jnp.asarray(np.arange(16, dtype=np.float32).reshape(4, 4))
-    v = M.bilinear_sample(f, jnp.array([[1.0, 2.0], [1.5, 2.5]]))
-    assert float(v[0]) == pytest.approx(6.0)
-    assert float(v[1]) == pytest.approx((6 + 7 + 10 + 11) / 4)
 
 
 def test_match_recovers_known_offset(tiny_cfg, room_map):

@@ -95,3 +95,18 @@ def test_scan_deltas_per_scan_origin_matches_classify(tiny_cfg, rng):
         want = np.asarray(G.classify_patch(
             g, s, jnp.asarray(ranges[i]), jnp.asarray(poses[i]), origins[i]))
         np.testing.assert_allclose(got[i], want, atol=1e-5)
+
+
+def test_raster_mode_matches_xla_raster(tiny_cfg, rng):
+    g, s = tiny_cfg.grid, tiny_cfg.scan
+    ranges = rng.uniform(0.3, 2.5, (2, s.padded_beams)).astype(np.float32)
+    ranges[:, s.n_beams:] = 0.0
+    poses = np.array([[0.1, -0.2, 0.4], [0.13, -0.18, 0.42]], np.float32)
+    origins = jax.vmap(lambda p: G.patch_origin(g, p[:2]))(jnp.asarray(poses))
+    got = np.asarray(SK.scan_rasters(g, s, jnp.asarray(ranges),
+                                     jnp.asarray(poses), origins))
+    for i in range(2):
+        want = np.asarray(G.raster_patch(g, s, jnp.asarray(ranges[i]),
+                                         jnp.asarray(poses[i]), origins[i]))
+        np.testing.assert_allclose(got[i], want, atol=5e-5)
+    assert got.max() > 0.5   # hit bands present
