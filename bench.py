@@ -61,6 +61,30 @@ def _chain_time(make_jit, k1: int = 2, k2: int = 10, reps: int = 5) -> float:
 
 
 def main() -> None:
+    try:
+        _run()
+    except Exception:
+        # A Mosaic/toolchain failure of the Pallas engine must not cost the
+        # round its benchmark record: re-exec once with the parity-tested
+        # XLA fallback paths (grid._use_pallas) and report that honestly in
+        # the JSON's "path" field. Fresh process, because jitted branches
+        # bake the engine choice at trace time. Only meaningful where the
+        # Pallas engine was actually in play (TPU backend).
+        import os
+        import traceback
+        from jax_mapping.ops.grid import _use_pallas
+        if not _use_pallas():
+            raise
+        traceback.print_exc(file=sys.stderr)
+        print("bench: pallas path failed, re-running with XLA fallback",
+              file=sys.stderr)
+        env = dict(os.environ, JAX_MAPPING_NO_PALLAS="1")
+        os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _run() -> None:
+    import os
+
     import jax
     import jax.numpy as jnp
 
@@ -140,6 +164,10 @@ def main() -> None:
         "vs_baseline": round(scans_per_sec / target, 3),
         "devices": f"{n_dev}x {dev.platform}",
         "frontier_p50_ms_64robots": round(frontier_p50_ms, 2),
+        "path": ("pallas" if G._use_pallas()
+                 else ("xla-fallback"
+                       if os.environ.get("JAX_MAPPING_NO_PALLAS") == "1"
+                       else "xla")),
     }))
 
 

@@ -95,6 +95,9 @@ class ThymioBrain(Node):
         self.n_ticks = 0
         self.n_io_errors = 0
         self._latest_scans: List[Optional[LaserScan]] = [None] * n_robots
+        self._last_cmd_vel: Optional[Twist] = None
+        self._last_cmd_vel_t = -1e9
+        self.cmd_vel_timeout_s = 0.5
 
         self.odom_pubs = []
         for i in range(n_robots):
@@ -104,6 +107,9 @@ class ThymioBrain(Node):
                 functools.partial(self._scan_cb, i),
                 qos_sensor_data)                    # Best-Effort, §V.A
             self.odom_pubs.append(self.create_publisher(f"{ns}odom"))
+        # Manual teleop override (bridge/teleop.py). Applies to robot 0 —
+        # one pad drives one robot, the rest keep their autonomous policy.
+        self.create_subscription("/cmd_vel", self._cmd_vel_cb)
 
         # Boot connect, offline mode on failure (pi variant semantics).
         self.link_up = connect_with_retries(
@@ -120,6 +126,29 @@ class ThymioBrain(Node):
     def _scan_cb(self, robot_idx: int, msg: LaserScan) -> None:
         with self._state_lock:
             self._latest_scans[robot_idx] = msg
+
+    def _cmd_vel_cb(self, msg: Twist) -> None:
+        with self._state_lock:
+            self._last_cmd_vel = msg
+            self._last_cmd_vel_t = time.monotonic()
+
+    def _manual_targets(self, now: float):
+        """Fresh `/cmd_vel` while not exploring -> (left, right) wheel
+        units for robot 0, else None. Inverse of the odometry kinematics
+        (`server/.../main.py:105-115`): v = K*(l+r)/2, w = K*(r-l)/width."""
+        with self._state_lock:
+            cmd = self._last_cmd_vel
+            fresh = now - self._last_cmd_vel_t <= self.cmd_vel_timeout_s
+            exploring = self.is_exploring
+        if exploring or cmd is None or not fresh:
+            return None
+        r = self.cfg.robot
+        k = r.speed_coeff_m_per_unit_s
+        half_w = r.wheel_base_m / 2.0
+        left = (cmd.linear_x - cmd.angular_z * half_w) / k
+        right = (cmd.linear_x + cmd.angular_z * half_w) / k
+        lim = 600.0                                   # Thymio target range
+        return (int(np.clip(left, -lim, lim)), int(np.clip(right, -lim, lim)))
 
     def start_exploring(self) -> None:
         with self._state_lock:
@@ -213,8 +242,14 @@ class ThymioBrain(Node):
                 np.float32(1.0 / cfg.robot.control_rate_hz))
             new_poses = np.asarray(new_poses)
             twists = np.asarray(twists)
-            targets_np = np.asarray(targets)
-            leds_np = np.asarray(leds)
+            targets_np = np.array(targets)          # writable: teleop override
+            leds_np = np.array(leds)
+
+            manual = self._manual_targets(now)
+            if manual is not None:
+                targets_np[0] = manual
+                leds_np[0] = (32, 32, 32)   # white: manual drive (extension
+                #                             to the reference's LED states)
 
             for i in range(R):
                 self.driver[i][MOTOR_LEFT_TARGET] = int(targets_np[i, 0])

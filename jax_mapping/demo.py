@@ -1,0 +1,137 @@
+"""End-to-end demo CLI: simulated fleet explores and maps a world.
+
+The framework-native equivalent of the reference's operator workflow —
+`ros2 launch thymio_project pc_server.launch.py` + `curl :5000/start` +
+watching RViz (`/root/reference/README.md`, SURVEY.md §3.1) — as one
+command:
+
+    python -m jax_mapping.demo --steps 200 --robots 2 --out map.png
+
+Boots the full node graph (sim world, driver, brain, mapper, HTTP API)
+against a generated arena, starts exploration, steps the stack
+faster-than-realtime, and writes the occupancy map as a grayscale PNG with
+the reference's `/map-image` semantics (127 unknown / 255 free /
+0 occupied, `server/.../main.py:259-266`). `--serve` keeps the HTTP API up
+afterwards for interactive `curl /status`, `/map-image`, `/start`, `/stop`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m jax_mapping.demo",
+        description="Run the simulated exploration + mapping stack.")
+    p.add_argument("--steps", type=int, default=150,
+                   help="sensor ticks to run (default 150)")
+    p.add_argument("--robots", type=int, default=1,
+                   help="fleet size (default 1)")
+    p.add_argument("--world", choices=["arena", "rooms"], default="rooms",
+                   help="generated world layout")
+    p.add_argument("--world-cells", type=int, default=192,
+                   help="world edge length in cells")
+    p.add_argument("--config", type=str, default=None,
+                   help="SlamConfig JSON file (default: tiny_config)")
+    p.add_argument("--out", type=str, default=None,
+                   help="write final map PNG here")
+    p.add_argument("--http-port", type=int, default=None,
+                   help="serve the HTTP API on this port (0 = pick free)")
+    p.add_argument("--serve", action="store_true",
+                   help="keep serving HTTP after stepping (Ctrl-C to exit)")
+    p.add_argument("--drop-prob", type=float, default=0.0,
+                   help="Best-Effort link loss injection (report.pdf §V.A)")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def _occupancy(stack):
+    import numpy as np
+
+    from jax_mapping.ops import grid as G
+    return np.asarray(G.to_occupancy(stack.cfg.grid, stack.mapper.merged_grid()))
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    args.robots = max(1, args.robots)
+
+    import numpy as np
+
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.config import SlamConfig, tiny_config
+    from jax_mapping.sim import world as W
+
+    if args.config:
+        with open(args.config) as f:
+            cfg = SlamConfig.from_json(f.read())
+    else:
+        cfg = tiny_config(n_robots=args.robots)
+
+    if args.world == "arena":
+        world = W.empty_arena(args.world_cells, cfg.grid.resolution_m)
+    else:
+        world = W.rooms_world(args.world_cells, cfg.grid.resolution_m,
+                              seed=args.seed)
+
+    port = args.http_port if args.http_port is not None else (
+        0 if args.serve else None)
+    stack = launch_sim_stack(cfg, world, n_robots=args.robots,
+                             http_port=port, drop_prob=args.drop_prob,
+                             seed=args.seed)
+    try:
+        stack.brain.start_exploring()
+        t0 = time.time()
+        report_every = max(1, args.steps // 5)
+        for step in range(args.steps):
+            stack.run_steps(1)
+            if (step + 1) % report_every == 0:
+                occ = _occupancy(stack)
+                n_free = int((occ == 0).sum())
+                n_occ = int((occ == 100).sum())
+                print(f"step {step + 1}/{args.steps}: "
+                      f"{n_free} free / {n_occ} occupied cells mapped",
+                      file=sys.stderr)
+        wall = time.time() - t0
+
+        occ = _occupancy(stack)
+        summary = {
+            "steps": args.steps,
+            "robots": args.robots,
+            "wall_s": round(wall, 2),
+            "steps_per_sec": round(args.steps / max(wall, 1e-9), 1),
+            "cells_free": int((occ == 0).sum()),
+            "cells_occupied": int((occ == 100).sum()),
+            "brain": stack.brain.status(),
+        }
+        if stack.api is not None:
+            summary["http"] = f"http://127.0.0.1:{stack.api.port}"
+        print(json.dumps(summary, indent=2))
+
+        if args.out:
+            from jax_mapping.bridge.png import encode_gray
+            from jax_mapping.ops.grid import occupancy_to_png_array
+            img = occupancy_to_png_array(occ)
+            with open(args.out, "wb") as f:
+                f.write(encode_gray(img))
+            print(f"map written to {args.out}", file=sys.stderr)
+
+        if args.serve and stack.api is not None:
+            print(f"serving on http://127.0.0.1:{stack.api.port} — Ctrl-C "
+                  f"to exit", file=sys.stderr)
+            try:
+                while True:
+                    time.sleep(1.0)
+            except KeyboardInterrupt:
+                pass
+        return 0
+    finally:
+        stack.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

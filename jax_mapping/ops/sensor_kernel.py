@@ -206,9 +206,6 @@ def scan_deltas(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     XLA classify path whose per-cell `ranges[beam]` gather dominates its
     runtime.
     """
-    P = grid_cfg.patch_cells
-    if P % TILE_R:
-        raise ValueError(f"patch_cells={P} not divisible by TILE_R={TILE_R}")
     return _per_scan_call(grid_cfg, scan_cfg, ranges_b, poses_b, origins_rc,
                           mode="delta")
 

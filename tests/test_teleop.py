@@ -1,0 +1,95 @@
+"""Teleop node semantics (joystick.yaml capability) + brain manual override."""
+
+import numpy as np
+import pytest
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.driver import (
+    MOTOR_LEFT_TARGET, MOTOR_RIGHT_TARGET, SimulatedThymioDriver,
+)
+from jax_mapping.bridge.messages import Twist
+from jax_mapping.bridge.teleop import JoystickConfig, TeleopNode
+
+
+def collect(bus, topic="/cmd_vel"):
+    out = []
+    bus.subscribe(topic, callback=out.append)
+    return out
+
+
+def test_teleop_requires_deadman():
+    bus = Bus()
+    out = collect(bus)
+    node = TeleopNode(bus)
+    node.update(axes=[0, 0, 0.5, 1.0], buttons=[0])   # deadman NOT held
+    node._tick()
+    assert out == []                                   # no motion commands
+
+
+def test_teleop_scales_axes():
+    bus = Bus()
+    out = collect(bus)
+    cfg = JoystickConfig()
+    node = TeleopNode(bus, cfg)
+    node.update(axes=[0, 0, -0.5, 1.0], buttons=[1])   # deadman held
+    node._tick()
+    node._tick()                                       # autorepeat
+    assert len(out) == 2
+    assert out[0].linear_x == pytest.approx(1.0 * cfg.scale_linear)
+    assert out[0].angular_z == pytest.approx(-0.5 * cfg.scale_angular)
+
+
+def test_teleop_stop_on_release():
+    bus = Bus()
+    out = collect(bus)
+    node = TeleopNode(bus)
+    node.update(axes=[0, 0, 0, 1.0], buttons=[1])
+    node._tick()
+    node.update(axes=[0, 0, 0, 1.0], buttons=[0])      # release deadman
+    node._tick()
+    node._tick()                                       # idle: nothing more
+    assert len(out) == 2
+    assert out[-1].linear_x == 0.0 and out[-1].angular_z == 0.0
+
+
+def test_brain_manual_override(tiny_cfg):
+    from jax_mapping.bridge.brain import ThymioBrain
+    bus = Bus()
+    driver = SimulatedThymioDriver(n_robots=1)
+    brain = ThymioBrain(tiny_cfg, bus, driver)
+    assert brain.link_up
+
+    # Exploring off + fresh cmd_vel -> wheel targets from the twist.
+    pub = bus.publisher("/cmd_vel")
+    k = tiny_cfg.robot.speed_coeff_m_per_unit_s
+    pub.publish(Twist(linear_x=100 * k, angular_z=0.0))
+    brain.update_loop()
+    assert driver[driver.first_node()][MOTOR_LEFT_TARGET] == 100
+    assert driver[driver.first_node()][MOTOR_RIGHT_TARGET] == 100
+
+    # While exploring, the autonomous policy owns the motors again.
+    brain.start_exploring()
+    pub.publish(Twist(linear_x=-100 * k, angular_z=0.0))
+    brain.update_loop()
+    assert driver[driver.first_node()][MOTOR_LEFT_TARGET] >= 0
+
+    # Stale command (timeout) -> no override.
+    brain.stop_exploring()
+    brain._last_cmd_vel_t = -1e9
+    brain.update_loop()
+    assert driver[driver.first_node()][MOTOR_LEFT_TARGET] == 0
+
+
+def test_teleop_input_watchdog_stops_robot():
+    bus = Bus()
+    out = collect(bus)
+    node = TeleopNode(bus, input_timeout_s=0.05)
+    node.update(axes=[0, 0, 0, 1.0], buttons=[1])
+    node._tick()
+    assert len(out) == 1 and out[0].linear_x > 0
+    import time as _t
+    _t.sleep(0.08)            # input source dies; autorepeat must not outlive it
+    node._tick()
+    node._tick()
+    assert len(out) == 2
+    assert out[-1].linear_x == 0.0 and out[-1].angular_z == 0.0
