@@ -176,6 +176,28 @@ class MapApiServer:
                 f"jax_mapping_brain_connected "
                 f"{int(bool(st.get('connected')))}",
             ]
+        # Process-wide registry (utils/profiling.py): event counters and
+        # per-stage timings fed by the mapper/brain loops.
+        from jax_mapping.utils import global_metrics
+        snap = global_metrics.snapshot()
+        for name, val in sorted(snap["counters"].items()):
+            metric = "jax_mapping_" + name.replace(".", "_") + "_total"
+            lines += [f"# TYPE {metric} counter", f"{metric} {val}"]
+        for name, st_ in sorted(snap["stages"].items()):
+            base = "jax_mapping_stage_" + name.replace(".", "_")
+            # Valid exposition: the summary family carries only _sum/_count;
+            # derived series are their own gauges.
+            lines += [
+                f"# TYPE {base}_ms summary",
+                f"{base}_ms_count {st_['count']}",
+                f"{base}_ms_sum {st_['sum_ms']:.3f}",
+                f"# TYPE {base}_ms_mean gauge",
+                f"{base}_ms_mean {st_['mean_ms']:.3f}",
+                f"# TYPE {base}_ms_ewma gauge",
+                f"{base}_ms_ewma {st_['ewma_ms']:.3f}",
+                f"# TYPE {base}_ms_max gauge",
+                f"{base}_ms_max {st_['max_ms']:.3f}",
+            ]
         return "\n".join(lines) + "\n"
 
     # -- lifecycle ----------------------------------------------------------
@@ -187,7 +209,10 @@ class MapApiServer:
         return self._thread
 
     def shutdown(self) -> None:
-        self.server.shutdown()
+        # server.shutdown() blocks until the serve_forever loop acknowledges
+        # — calling it when the loop never started would hang forever.
+        if self._thread is not None:
+            self.server.shutdown()
         self.server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5.0)

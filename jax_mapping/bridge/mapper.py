@@ -34,6 +34,7 @@ from jax_mapping.bridge.qos import QoSProfile, qos_map, qos_sensor_data
 from jax_mapping.bridge.tf import TfTree
 from jax_mapping.config import SlamConfig
 from jax_mapping.ops.odometry import twist_to_wheel_units
+from jax_mapping.utils import global_metrics as M
 
 
 class MapperNode(Node):
@@ -129,6 +130,7 @@ class MapperNode(Node):
                     od = self._pair_odom(i, scan.header.stamp)
                     if od is None:
                         self.n_scans_dropped_unpaired += 1
+                        M.counters.inc("mapper.scans_unpaired")
                         continue
                     work.append((i, scan, od))
                 self._scan_q[i].clear()
@@ -142,15 +144,24 @@ class MapperNode(Node):
             dt = 1.0 / self.cfg.robot.control_rate_hz
             wl, wr = twist_to_wheel_units(
                 self.cfg.robot, od.twist.linear_x, od.twist.angular_z)
-            state, diag = self._S.slam_step(
-                self.cfg, state, jnp.asarray(ranges),
-                jnp.float32(wl), jnp.float32(wr), jnp.float32(dt))
+            with M.stages.stage("mapper.slam_step"):
+                state, diag = self._S.slam_step(
+                    self.cfg, state, jnp.asarray(ranges),
+                    jnp.float32(wl), jnp.float32(wr), jnp.float32(dt))
+                # Dispatch is async; the host-side fetches force execution
+                # so the stage measures the device step, not the enqueue.
+                matched = bool(diag.matched)
+                closed = bool(diag.loop_closed)
             self._last_odom_pose[i] = od.pose
             with self._state_lock:
                 self.states[i] = state
             self.n_scans_fused += 1
-            if bool(diag.loop_closed):
+            M.counters.inc("mapper.scans_fused")
+            if matched:
+                M.counters.inc("mapper.scan_matches")
+            if closed:
                 self.n_loops_closed += 1
+                M.counters.inc("mapper.loops_closed")
 
             # map->odom correction TF: est ⊖ odom (slam_toolbox's role).
             est = np.asarray(state.pose)

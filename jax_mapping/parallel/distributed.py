@@ -1,0 +1,134 @@
+"""Multi-host distributed backend: DCN process group + hybrid meshes.
+
+The reference's distributed backend is DDS over Wi-Fi — CycloneDDS RMW,
+domain 42, Best-Effort QoS on `/scan` (`/root/reference/README.md:28,78-86`,
+report.pdf §III.B/§V.A; SURVEY.md §5 "Distributed communication backend").
+The TPU framework's equivalent is XLA collectives: ICI inside a pod slice,
+DCN between hosts, set up by `jax.distributed`. This module is the
+framework's one place that knows how to bring that up:
+
+  * `DistConfig.from_env()` — coordinator/process-count/process-id from the
+    standard JAX env vars (or the framework's `JAX_MAPPING_*` aliases),
+    mirroring how the reference carries `ROS_DOMAIN_ID` in the environment
+    (`pi/Dockerfile:3`);
+  * `initialize()` — idempotent `jax.distributed.initialize`, a no-op for
+    single-process runs so every entry point can call it unconditionally;
+  * `hybrid_fleet_mesh()` — ('fleet', 'space') mesh where the *fleet* axis
+    spans hosts over DCN and the *space* axis stays inside a host on ICI.
+
+Axis placement rationale (the scaling-book recipe applied to mapping): the
+fleet axis communicates once per step — a psum map-merge of log-odds deltas
+— which is bandwidth-bound and latency-tolerant, exactly what DCN offers;
+the space axis exchanges slab halos / gathered matcher context inside the
+step's critical path, so it must ride ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistConfig:
+    """Process-group wiring; fields mirror jax.distributed.initialize."""
+
+    coordinator_address: Optional[str] = None   # "host:port"
+    num_processes: int = 1
+    process_id: int = 0
+
+    @staticmethod
+    def from_env(env=None) -> "DistConfig":
+        """JAX_MAPPING_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID, falling
+        back to the standard JAX names used by launchers."""
+        e = os.environ if env is None else env
+
+        def pick(*names, default=None):
+            for n in names:
+                if e.get(n):
+                    return e[n]
+            return default
+
+        coord = pick("JAX_MAPPING_COORDINATOR", "JAX_COORDINATOR_ADDRESS")
+        nproc = int(pick("JAX_MAPPING_NUM_PROCESSES", "JAX_NUM_PROCESSES",
+                         default="1"))
+        pid = int(pick("JAX_MAPPING_PROCESS_ID", "JAX_PROCESS_ID",
+                       default="0"))
+        return DistConfig(coordinator_address=coord, num_processes=nproc,
+                          process_id=pid)
+
+
+def initialize(cfg: Optional[DistConfig] = None) -> bool:
+    """Bring up the DCN process group; returns True if multi-host.
+
+    Idempotent; a single-process config is a no-op so entry points call
+    this unconditionally (the reference's nodes likewise assume DDS is
+    just *there* once ROS_DOMAIN_ID is set).
+    """
+    global _initialized
+    cfg = cfg or DistConfig.from_env()
+    if cfg.num_processes <= 1:
+        return False
+    if cfg.coordinator_address is None:
+        # Half-configured multi-host must fail loudly: silently degrading
+        # to independent processes would skip the fleet psum map-merge and
+        # every host would build its own divergent map with no error.
+        raise ValueError(
+            f"num_processes={cfg.num_processes} but no coordinator address "
+            f"set (JAX_MAPPING_COORDINATOR / JAX_COORDINATOR_ADDRESS)")
+    if _initialized:
+        return True
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id)
+    _initialized = True
+    return True
+
+
+def hybrid_fleet_mesh(n_hosts: Optional[int] = None,
+                      space_per_host: Optional[int] = None) -> Mesh:
+    """('fleet', 'space') mesh with fleet across hosts (DCN) and space
+    within a host (ICI).
+
+    Single-host (or single-process) setups degrade to the local mesh
+    factoring. Multi-host: each host contributes `space_per_host` devices
+    to the space axis and `local devices / space_per_host` rows to the
+    fleet axis; fleet-axis neighbours on different hosts communicate over
+    DCN, which only carries the once-per-step psum map merge.
+    """
+    import numpy as np
+
+    from jax_mapping.parallel.mesh import factor_devices, make_mesh
+
+    n_hosts = n_hosts if n_hosts is not None else jax.process_count()
+    if n_hosts <= 1:
+        return make_mesh()
+
+    local = jax.local_device_count()
+    if space_per_host is None:
+        _, space_per_host = factor_devices(local)
+    if local % space_per_host:
+        raise ValueError(f"{local} local devices not divisible by "
+                         f"space_per_host={space_per_host}")
+    fleet_per_host = local // space_per_host
+
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(fleet_per_host, space_per_host),
+            dcn_mesh_shape=(n_hosts, 1))
+    except Exception:                               # noqa: BLE001
+        # Fallback: order devices by process so the fleet axis still maps
+        # host-major (each host's block is contiguous -> space stays local).
+        devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        arr = np.array(devs).reshape(n_hosts * fleet_per_host,
+                                     space_per_host)
+    return Mesh(np.asarray(arr).reshape(-1, space_per_host),
+                axis_names=("fleet", "space"))

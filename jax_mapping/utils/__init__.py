@@ -1,0 +1,3 @@
+from jax_mapping.utils.profiling import (  # noqa: F401
+    Counters, StageTimer, device_trace, global_metrics,
+)

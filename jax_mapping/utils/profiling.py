@@ -1,0 +1,135 @@
+"""Tracing, per-stage timing, and counters — the observability subsystem.
+
+The reference has none of this: its diagnostics are bare prints and the
+rclpy logger (SURVEY.md §5 "Tracing / profiling: none"); throughput was
+judged by watching RViz. The TPU framework needs real instrumentation
+because device work is asynchronous — wall-clock around a dispatch measures
+nothing (bench.py's methodology note). Three tools:
+
+  * `device_trace(dir)` — context manager around `jax.profiler` for XLA/TPU
+    traces viewable in TensorBoard/Perfetto;
+  * `StageTimer` — named wall-clock stages with count/mean/EWMA/max, for
+    host-side loops (brain tick, mapper tick, HTTP handlers);
+  * `Counters` — monotonic event counters (scans fused, drops, matches,
+    loop closures) with atomic increment.
+
+`global_metrics` is the process-wide registry the bridge nodes feed and the
+HTTP `/metrics` endpoint serves (the reference's `/status` grown into a
+proper metrics surface).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+
+class Counters:
+    """Thread-safe monotonic counters."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + by
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+class _Stage:
+    __slots__ = ("count", "total_s", "ewma_s", "max_s")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_s = 0.0
+        self.ewma_s = 0.0
+        self.max_s = 0.0
+
+
+class StageTimer:
+    """Named wall-clock stages: `with timer.stage("fuse"): ...`.
+
+    EWMA (alpha=0.1) gives a live rate estimate that survives startup
+    outliers (first-jit compile); max catches stalls.
+    """
+
+    def __init__(self, alpha: float = 0.1) -> None:
+        self._lock = threading.Lock()
+        self._stages: Dict[str, _Stage] = {}
+        self.alpha = alpha
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                st = self._stages.setdefault(name, _Stage())
+                st.count += 1
+                st.total_s += dt
+                st.max_s = max(st.max_s, dt)
+                st.ewma_s = (dt if st.count == 1
+                             else (1 - self.alpha) * st.ewma_s
+                             + self.alpha * dt)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {
+                    "count": st.count,
+                    "sum_ms": 1e3 * st.total_s,
+                    "mean_ms": 1e3 * st.total_s / max(st.count, 1),
+                    "ewma_ms": 1e3 * st.ewma_s,
+                    "max_ms": 1e3 * st.max_s,
+                } for name, st in self._stages.items()
+            }
+
+
+class Metrics:
+    """Process-wide bundle: counters + stage timers."""
+
+    def __init__(self) -> None:
+        self.counters = Counters()
+        self.stages = StageTimer()
+
+    def snapshot(self) -> dict:
+        return {"counters": self.counters.snapshot(),
+                "stages": self.stages.snapshot()}
+
+
+global_metrics = Metrics()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str,
+                 host_tracer_level: int = 2) -> Iterator[Optional[str]]:
+    """XLA/TPU profiler trace around a block; view with TensorBoard's
+    profile plugin or Perfetto. Yields the log dir, or None if the
+    profiler is unavailable (it must never take the control loop down)."""
+    import jax
+    try:
+        jax.profiler.start_trace(log_dir,
+                                 create_perfetto_trace=False)
+        started = True
+    except Exception:                               # noqa: BLE001
+        started = False
+    try:
+        yield log_dir if started else None
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:                       # noqa: BLE001
+                pass

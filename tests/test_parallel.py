@@ -88,3 +88,56 @@ def test_sharded_matches_single_device_fusion(cfg):
         out_specs=P("space", None), check_vma=False))
     got = fn(G.empty_grid(g), scans, jnp.asarray(poses))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Distributed (DCN) backend
+# ---------------------------------------------------------------------------
+
+def test_dist_config_from_env():
+    from jax_mapping.parallel.distributed import DistConfig
+    cfg = DistConfig.from_env(env={})
+    assert cfg.num_processes == 1 and cfg.coordinator_address is None
+    cfg = DistConfig.from_env(env={
+        "JAX_MAPPING_COORDINATOR": "10.0.0.1:1234",
+        "JAX_MAPPING_NUM_PROCESSES": "4",
+        "JAX_MAPPING_PROCESS_ID": "2"})
+    assert cfg.coordinator_address == "10.0.0.1:1234"
+    assert cfg.num_processes == 4 and cfg.process_id == 2
+    # Standard JAX names as fallback.
+    cfg = DistConfig.from_env(env={"JAX_COORDINATOR_ADDRESS": "h:1",
+                                   "JAX_NUM_PROCESSES": "2"})
+    assert cfg.coordinator_address == "h:1" and cfg.num_processes == 2
+
+
+def test_initialize_single_process_noop():
+    from jax_mapping.parallel.distributed import DistConfig, initialize
+    assert initialize(DistConfig()) is False          # no-op, no crash
+
+
+def test_hybrid_mesh_single_host_degrades_to_local():
+    from jax_mapping.parallel.distributed import hybrid_fleet_mesh
+    mesh = hybrid_fleet_mesh()
+    assert mesh.axis_names == ("fleet", "space")
+    assert mesh.devices.size == 8                     # virtual CPU mesh
+
+
+def test_hybrid_mesh_simulated_two_hosts(monkeypatch):
+    """Treat the 8 virtual CPU devices as 2 hosts x 4: fleet axis must be
+    host-major so the space axis stays intra-host (ICI)."""
+    import jax
+    from jax_mapping.parallel import distributed as D
+    monkeypatch.setattr(jax, "local_device_count", lambda: 4)
+    mesh = D.hybrid_fleet_mesh(n_hosts=2, space_per_host=2)
+    assert mesh.devices.shape == (4, 2)
+    # Each space row must use consecutive device ids (same "host" block).
+    ids = [[d.id for d in row] for row in mesh.devices]
+    for row in ids:
+        assert abs(row[0] - row[1]) == 1
+
+
+def test_initialize_half_configured_raises():
+    import pytest
+    from jax_mapping.parallel.distributed import DistConfig, initialize
+    with pytest.raises(ValueError):
+        initialize(DistConfig(num_processes=4, coordinator_address=None))
