@@ -164,3 +164,40 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
         return st2, diag
 
     return jax.lax.cond(is_key, key_branch, skip_branch, state)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def slam_step_window(cfg: SlamConfig, state: SlamState, ranges_w: Array,
+                     wheels_w: Array, dt: Array) -> tuple[SlamState, SlamDiag]:
+    """Windowed update: a burst of W consecutive scans in one device step.
+
+    The throughput path for scan rates far above the key-scan rate (the
+    BASELINE 50k scans/sec regime): odometry integrates through the window
+    with `lax.scan`, the leading W-1 scans fuse through the shared-patch
+    Pallas window kernel (one read-modify-write of the grid — these scans
+    add map evidence without pose-graph entries, like slam_toolbox's
+    sub-gate scans except their information is kept rather than dropped),
+    and the LAST scan runs the full `slam_step` pipeline (gate, match,
+    pose graph, loop closure).
+
+    Args:
+      ranges_w: (W, padded_beams); wheels_w: (W, 2) raw wheel speeds;
+      dt: per-scan interval. W is static. The window must satisfy the
+      shared-patch contract (poses within ~4 m — guaranteed at any
+      realistic speed x window length).
+    """
+    def integrate(p, w):
+        p2 = rk2_step(cfg.robot, p, w[0], w[1], dt)
+        return p2, p2
+
+    # Scan i is taken at the pose AFTER integrating wheels_w[i] (slam_step's
+    # convention): poses_w[i] = pose at scan i.
+    _, poses_w = jax.lax.scan(integrate, state.pose, wheels_w)   # (W, 3)
+
+    grid = G.fuse_scans_window(cfg.grid, cfg.scan, state.grid,
+                               ranges_w[:-1], poses_w[:-1])
+    # The last scan runs the full pipeline; starting it from the W-2th pose
+    # makes its internal odometry land exactly on poses_w[-1].
+    st = state._replace(grid=grid, pose=poses_w[-2])
+    return slam_step(cfg, st, ranges_w[-1],
+                     wheels_w[-1, 0], wheels_w[-1, 1], dt)
