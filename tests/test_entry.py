@@ -1,0 +1,56 @@
+"""Driver-entry contract tests: the two artifacts the round is judged on.
+
+Round 1's MULTICHIP artifact timed out (VERDICT.md: rc 124, >420 s on tiny
+shapes) because the ambient axon TPU plugin stalls backend init even under
+JAX_PLATFORMS=cpu. These tests pin the fix: the dry run must complete well
+inside the driver budget, from BOTH a clean in-process CPU mesh (the happy
+path) and a poisoned-looking environment (the subprocess hop).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as E  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    import jax
+    fn, args = E.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    state, pose_err = out
+    assert pose_err.shape[0] == 4
+
+
+def test_dryrun_multichip_under_budget():
+    """The whole 8-device dry run (compile + one step) in <= 120 s CPU,
+    exercising the IN-PROCESS branch (conftest pins cpu + 8 host devices
+    and scrubs the axon env, so _cpu_env_ready must hold here)."""
+    assert E._cpu_env_ready(8), "conftest env contract changed"
+    t0 = time.monotonic()
+    E.dryrun_multichip(8)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 120.0, f"dryrun_multichip(8) took {elapsed:.0f}s"
+
+
+def test_dryrun_subprocess_hop_from_poisoned_env(monkeypatch):
+    """With the axon marker set, the dry run must detect the poisoned
+    process and still succeed via the scrubbed subprocess."""
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    assert not E._cpu_env_ready(8)
+    t0 = time.monotonic()
+    E.dryrun_multichip(8)
+    assert time.monotonic() - t0 < 180.0
+
+
+def test_scrubbed_env_contents():
+    env = E._scrubbed_cpu_env(8)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert not any(k.startswith(("AXON", "PALLAS_AXON")) for k in env)
+    assert ".axon_site" not in env.get("PYTHONPATH", "")
+    repo = os.path.dirname(os.path.abspath(E.__file__))
+    assert env["PYTHONPATH"].split(os.pathsep)[0] == repo
