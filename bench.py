@@ -5,41 +5,134 @@ Headline metric per BASELINE.md: >= 50,000 scans/sec fused into a 4096^2
 (the driver provides one real chip) and pro-rates the baseline target by
 device count: vs_baseline = scans_per_sec / (50_000 * n_devices / 8).
 
-Also measures frontier recompute latency at 64 robots (target < 5 ms p50);
-the reported figure is the median-across-repetitions of per-iteration
-device time (see _chain_time), reported as `frontier_p50_ms_64robots`.
+Also measures frontier recompute latency at 64 robots (target < 5 ms p50)
+in BOTH cost modes: `frontier_p50_ms_64robots` is the product default
+(obstacle-aware BFS costs, config.py FrontierConfig.obstacle_aware=True);
+`frontier_euclid_p50_ms_64robots` is the cheap Euclidean mode.
+
+Round-1 lesson (VERDICT.md): the bench must emit its JSON line inside the
+driver budget no matter what the toolchain does. Three guards:
+
+  1. Backend probe in a BOUNDED SUBPROCESS before anything compiles — the
+     TPU tunnel in this image can hang backend init indefinitely (even
+     `jax.devices()`), which no in-process deadline can interrupt. If the
+     probe fails, re-exec once onto scrubbed virtual-CPU before burning
+     any compile time, and say so in the JSON ("platform" field).
+  2. A watchdog thread with a hard deadline (JAX_MAPPING_BENCH_DEADLINE_S,
+     default 540 s) that prints whatever sections completed and exits —
+     partial data over rc 124.
+  3. Pallas failures fall back to the parity-tested XLA paths IN PROCESS
+     (flip JAX_MAPPING_NO_PALLAS and re-trace) — no full-process re-exec.
 
 Methodology — honest device-side timing. On the tunneled TPU platform used
-here, `jax.block_until_ready` returns before execution finishes and any
-host-synchronising fetch pays a large fixed round-trip (~70 ms measured).
-So each workload is timed as a `lax.fori_loop` chain of K data-dependent
-iterations inside ONE jit, synchronised by fetching a scalar, at two chain
-lengths K1 < K2; per-iteration device time = (t(K2) - t(K1)) / (K2 - K1),
-which cancels the fixed dispatch + fetch overhead exactly. This is the
-device-kernel latency/throughput the BASELINE targets describe (on-pod
-there is no tunnel RTT).
+here, any host-synchronising fetch pays a large fixed round-trip. So each
+workload is timed as a `lax.fori_loop` chain of K data-dependent iterations
+inside ONE jit, synchronised by fetching a scalar, at two chain lengths
+K1 < K2; per-iteration device time = (t(K2) - t(K1)) / (K2 - K1), which
+cancels the fixed dispatch + fetch overhead exactly. This is the device
+kernel throughput the BASELINE targets describe (on-pod there is no tunnel
+RTT). If host jitter inverts the difference, fall back to t(K2)/K2 — an
+upper bound that errs against us.
 
-Prints exactly ONE JSON line.
+Prints exactly ONE JSON line (plus diagnostics on stderr).
 """
 
 import json
 import math
+import os
+import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
 
+DEADLINE_S = float(os.environ.get("JAX_MAPPING_BENCH_DEADLINE_S", "540"))
+PROBE_TIMEOUT_S = float(os.environ.get("JAX_MAPPING_BENCH_PROBE_S", "120"))
 
-def _chain_time(make_jit, k1: int = 2, k2: int = 10, reps: int = 5) -> float:
+_T0 = time.monotonic()
+_RESULT = {
+    "metric": "lidar_scan_fusion_throughput",
+    "value": None,
+    "unit": "scans/sec into 4096^2 0.05m grid",
+    "vs_baseline": None,
+    "devices": "unknown",
+    "frontier_p50_ms_64robots": None,
+    "frontier_euclid_p50_ms_64robots": None,
+    "path": None,
+    "sections_completed": [],
+}
+_EMITTED = threading.Event()
+
+
+def _emit_and_exit(code: int = 0) -> None:
+    if not _EMITTED.is_set():
+        _EMITTED.set()
+        print(json.dumps(_RESULT), flush=True)
+    os._exit(code)
+
+
+def _remaining() -> float:
+    return DEADLINE_S - (time.monotonic() - _T0)
+
+
+def _scrub_cpu_env() -> dict:
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("AXON", "PALLAS_AXON", "TPU_")):
+            env.pop(k)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and ".axon_site" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([repo] + keep)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_JAX_MAPPING_BENCH_CPU_FALLBACK"] = "1"
+    return env
+
+
+def _probe_backend() -> bool:
+    """Can this environment's default jax backend initialise promptly?
+
+    Runs `jax.devices()` in a bounded subprocess (a wedged TPU tunnel hangs
+    backend init in ways no in-process timeout can interrupt).
+    """
+    code = ("import jax; d = jax.devices(); "
+            "print(d[0].platform, len(d), flush=True)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0
+
+
+def main() -> None:
+    if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") != "1" \
+            and not _probe_backend():
+        print(f"bench: backend init did not finish in {PROBE_TIMEOUT_S:.0f}s "
+              "(wedged TPU tunnel?); falling back to virtual CPU",
+              file=sys.stderr, flush=True)
+        env = _scrub_cpu_env()
+        os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+
+    watchdog = threading.Timer(max(_remaining(), 1.0),
+                               lambda: _emit_and_exit(0))
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        _run()
+    except Exception:
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+    _emit_and_exit(0)
+
+
+def _chain_time(make_jit, k1: int, k2: int, reps: int) -> float:
     """Median per-iteration seconds for a chained-loop jit factory.
 
     make_jit(k) must return a nullary jitted fn whose result forces the
-    whole k-iteration chain (returns a scalar; we fetch it with float()).
-    The estimate is (median t(k2) - median t(k1)) / (k2 - k1). If host
-    jitter inverts the difference, the chain lengths are doubled once (a
-    larger spread drowns the jitter); if it still inverts, fall back to
-    median t(k2) / k2 — an upper bound that *includes* the fixed dispatch
-    overhead, i.e. errs against us rather than fabricating a fast result.
+    whole k-iteration chain (returns a scalar; fetched with float()).
     """
     def med(f):
         ts = []
@@ -49,42 +142,16 @@ def _chain_time(make_jit, k1: int = 2, k2: int = 10, reps: int = 5) -> float:
             ts.append(time.perf_counter() - t0)
         return float(np.median(ts))
 
-    for mult in (1, 4):
-        ka, kb = k1 * mult, k2 * mult
-        f1, f2 = make_jit(ka), make_jit(kb)
-        float(f1())  # compile + warm
-        float(f2())
-        t1, t2 = med(f1), med(f2)
-        if t2 > t1:
-            return (t2 - t1) / (kb - ka)
-    return t2 / kb
-
-
-def main() -> None:
-    try:
-        _run()
-    except Exception:
-        # A Mosaic/toolchain failure of the Pallas engine must not cost the
-        # round its benchmark record: re-exec once with the parity-tested
-        # XLA fallback paths (grid._use_pallas) and report that honestly in
-        # the JSON's "path" field. Fresh process, because jitted branches
-        # bake the engine choice at trace time. Only meaningful where the
-        # Pallas engine was actually in play (TPU backend).
-        import os
-        import traceback
-        from jax_mapping.ops.grid import _use_pallas
-        if not _use_pallas():
-            raise
-        traceback.print_exc(file=sys.stderr)
-        print("bench: pallas path failed, re-running with XLA fallback",
-              file=sys.stderr)
-        env = dict(os.environ, JAX_MAPPING_NO_PALLAS="1")
-        os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+    f1, f2 = make_jit(k1), make_jit(k2)
+    float(f1())  # compile + warm
+    float(f2())
+    t1, t2 = med(f1), med(f2)
+    if t2 > t1:
+        return (t2 - t1) / (k2 - k1)
+    return t2 / k2
 
 
 def _run() -> None:
-    import os
-
     import jax
     import jax.numpy as jnp
 
@@ -96,16 +163,47 @@ def _run() -> None:
     g, s = cfg.grid, cfg.scan
     dev = jax.devices()[0]
     n_dev = len(jax.devices())
+    on_cpu = dev.platform == "cpu"
+    _RESULT["devices"] = f"{n_dev}x {dev.platform}" + (
+        " (tpu tunnel unreachable, virtual-cpu fallback)"
+        if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") == "1" else "")
+
+    # ---- engine choice: probe the Pallas kernel once on tiny shapes ------
+    # A Mosaic/toolchain rejection must cost seconds, not the round: fall
+    # back to the parity-tested XLA paths in-process (fresh traces read the
+    # env var; nothing compiled yet has baked the choice in).
+    if G._use_pallas():
+        try:
+            from jax_mapping.config import tiny_config
+            from jax_mapping.ops import sensor_kernel as SK
+            tc = tiny_config()
+            tg, ts_ = tc.grid, tc.scan
+            r0 = jnp.zeros((2, ts_.padded_beams), jnp.float32)
+            p0 = jnp.zeros((2, 3), jnp.float32)
+            o0 = jnp.zeros(2, jnp.int32)
+            jax.block_until_ready(SK.window_delta(tg, ts_, r0, p0, o0))
+        except Exception as e:
+            print(f"bench: pallas probe failed ({type(e).__name__}: {e}); "
+                  "using XLA fallback paths", file=sys.stderr, flush=True)
+            os.environ["JAX_MAPPING_NO_PALLAS"] = "1"
+    _RESULT["path"] = ("pallas" if G._use_pallas()
+                       else ("xla-fallback"
+                             if os.environ.get("JAX_MAPPING_NO_PALLAS") == "1"
+                             else "xla"))
 
     # ---- workload: B scans along a realistic local trajectory -----------
     # One robot's temporal scan window: consecutive LD06 rotations while the
-    # robot drives a ~3 m loop (the shared-patch fast path's contract; the
-    # reference robot moves ~1 cm per scan rotation, server main.py:60).
+    # robot drives a 0.4 m-radius loop (a Thymio at cruise covers < 1 m in
+    # 256 scan rotations, server main.py:60). The radius must stay inside
+    # the shared patch's WORST-CASE slack of (P/2 - align/2 - max_range)
+    # cells = 0.8 m — patch-origin alignment can eat up to align_cols/2
+    # cells of the nominal 4 m margin, and a dead-centre mean pose lands
+    # exactly on that worst case.
     B = 256
     rng = np.random.default_rng(0)
     t = np.linspace(0, 2 * math.pi, B, endpoint=False)
     poses = np.stack([
-        1.5 * np.cos(t), 1.5 * np.sin(t), t + math.pi / 2
+        0.4 * np.cos(t), 0.4 * np.sin(t), t + math.pi / 2
     ], axis=1).astype(np.float32)
     # Plausible LD06 returns: walls 1-10 m away, 5% dropouts (zeros).
     ranges = rng.uniform(1.0, 10.0, (B, s.padded_beams)).astype(np.float32)
@@ -123,6 +221,10 @@ def _run() -> None:
     assert bool(SK.window_fits(g, poses_d, origin)), \
         "bench trajectory violates the shared-patch window contract"
 
+    # Chain lengths / repetitions sized for the platform (CPU fallback runs
+    # the same program ~2 orders slower; keep it inside the deadline).
+    k1, k2, reps = (1, 3, 2) if on_cpu else (2, 10, 5)
+
     def fuse_chain(k):
         def run():
             def body(_, gr):
@@ -131,45 +233,72 @@ def _run() -> None:
             return gr.sum()
         return jax.jit(run)
 
-    dt = _chain_time(fuse_chain)
-    scans_per_sec = B / dt
+    target = 50_000.0 * n_dev / 8.0
+    try:
+        dt = _chain_time(fuse_chain, k1, k2, reps)
+        scans_per_sec = B / dt
+        _RESULT["value"] = round(scans_per_sec, 1)
+        _RESULT["vs_baseline"] = round(scans_per_sec / target, 3)
+        _RESULT["sections_completed"].append("fuse")
+    except Exception:
+        if G._use_pallas():
+            # In-process engine fallback: re-trace with XLA paths.
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            print("bench: pallas fuse failed, re-tracing with XLA fallback",
+                  file=sys.stderr, flush=True)
+            os.environ["JAX_MAPPING_NO_PALLAS"] = "1"
+            _RESULT["path"] = "xla-fallback"
+            dt = _chain_time(fuse_chain, k1, k2, reps)
+            scans_per_sec = B / dt
+            _RESULT["value"] = round(scans_per_sec, 1)
+            _RESULT["vs_baseline"] = round(scans_per_sec / target, 3)
+            _RESULT["sections_completed"].append("fuse")
+        else:
+            raise
 
-    # ---- frontier recompute p50 at 64 robots ---------------------------
+    # ---- frontier recompute p50 at 64 robots, both cost modes -----------
     import dataclasses
-    fcfg = dataclasses.replace(cfg.frontier, obstacle_aware=False)
     robot_poses = jax.device_put(jnp.asarray(
         np.stack([rng.uniform(-50, 50, 64), rng.uniform(-50, 50, 64),
                   rng.uniform(-3, 3, 64)], 1).astype(np.float32)), dev)
     grid_arr = jax.jit(lambda: G.fuse_scans_window(
         g, s, G.empty_grid(g), ranges_d, poses_d))()
+    jax.block_until_ready(grid_arr)
 
-    def frontier_chain(k):
-        def run():
-            def body(_, carry):
-                gr, acc = carry
-                fr = F.compute_frontiers(fcfg, g, gr, robot_poses)
-                dep = fr.costs.sum() * 0.0    # data-dep chains iterations
-                return gr + dep, acc + fr.sizes.sum()
-            _, acc = jax.lax.fori_loop(0, k, body, (grid_arr, jnp.int32(0)))
-            return acc
-        return jax.jit(run)
+    def frontier_chain_factory(fcfg):
+        def frontier_chain(k):
+            def run():
+                def body(_, carry):
+                    gr, acc = carry
+                    fr = F.compute_frontiers(fcfg, g, gr, robot_poses)
+                    dep = fr.costs.sum() * 0.0    # data-dep chains iterations
+                    return (gr + dep, acc + fr.sizes.sum())
+                _, acc = jax.lax.fori_loop(0, k, body,
+                                           (grid_arr, jnp.int32(0)))
+                return acc
+            return jax.jit(run)
+        return frontier_chain
 
-    frontier_p50_ms = _chain_time(frontier_chain) * 1e3
-
-    target = 50_000.0 * n_dev / 8.0
-    print(json.dumps({
-        "metric": "lidar_scan_fusion_throughput",
-        "value": round(scans_per_sec, 1),
-        "unit": "scans/sec into 4096^2 0.05m grid",
-        "vs_baseline": round(scans_per_sec / target, 3),
-        "devices": f"{n_dev}x {dev.platform}",
-        "frontier_p50_ms_64robots": round(frontier_p50_ms, 2),
-        "path": ("pallas" if G._use_pallas()
-                 else ("xla-fallback"
-                       if os.environ.get("JAX_MAPPING_NO_PALLAS") == "1"
-                       else "xla")),
-    }))
+    # Product default first (obstacle-aware BFS — the advertised capability),
+    # cheap Euclidean mode second; each section is skipped, not fatal, when
+    # the remaining budget is too thin (the watchdog emits what completed).
+    for key, aware, min_budget in (
+            ("frontier_p50_ms_64robots", True, 60.0),
+            ("frontier_euclid_p50_ms_64robots", False, 30.0)):
+        if _remaining() < min_budget:
+            print(f"bench: skipping {key} ({_remaining():.0f}s left)",
+                  file=sys.stderr, flush=True)
+            continue
+        fcfg = dataclasses.replace(cfg.frontier, obstacle_aware=aware)
+        try:
+            p50 = _chain_time(frontier_chain_factory(fcfg), k1, k2, reps)
+            _RESULT[key] = round(p50 * 1e3, 2)
+            _RESULT["sections_completed"].append(key)
+        except Exception:
+            import traceback
+            traceback.print_exc(file=sys.stderr)
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    main()

@@ -59,9 +59,11 @@ class MapperNode(Node):
         self._odom_hist: List[List[Odometry]] = [[] for _ in range(n_robots)]
         self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
         self._last_odom_pose = [None] * n_robots    # pose used at last fuse
+        self._prev_paired: List[Optional[Odometry]] = [None] * n_robots
         self.n_scans_fused = 0
         self.n_scans_dropped_unpaired = 0
         self.n_loops_closed = 0
+        self.n_windows_fused = 0
 
         self.map_pub = self.create_publisher("/map", qos_map)
         self.map_updates_pub = self.create_publisher("/map_updates")
@@ -120,63 +122,133 @@ class MapperNode(Node):
             out[:n] = r[:n]
         return out
 
+    def _odom_motion(self, i: int, od: Odometry) -> tuple:
+        """(wl, wr, dt): equivalent wheel speeds + REAL interval from the
+        actual pose delta between consecutive paired odometry samples.
+
+        The reference integrates with the true wall-clock dt
+        (`server/.../main.py:90-115`); twist x fixed control-period dt
+        would systematically under/over-integrate motion whenever scans
+        arrive slower or faster than the control rate — guaranteed under
+        the Best-Effort drops this node is designed for. Inverting the RK2
+        midpoint model on the measured pose delta makes the device-side
+        integration land exactly on the paired odometry pose.
+        """
+        import math
+        prev = self._prev_paired[i]
+        self._prev_paired[i] = od
+        if prev is None or od.header.stamp <= prev.header.stamp:
+            # Bootstrap, or the same/out-of-order sample paired again: no
+            # new odometric evidence — integrate zero motion rather than
+            # fabricating some from a stale twist.
+            return 0.0, 0.0, 1.0 / self.cfg.robot.control_rate_hz
+        dt = od.header.stamp - prev.header.stamp
+        dth = math.atan2(math.sin(od.pose.theta - prev.pose.theta),
+                         math.cos(od.pose.theta - prev.pose.theta))
+        mid = prev.pose.theta + dth / 2.0         # RK2 midpoint heading
+        dx = od.pose.x - prev.pose.x
+        dy = od.pose.y - prev.pose.y
+        v = (math.cos(mid) * dx + math.sin(mid) * dy) / dt
+        w = dth / dt
+        wl, wr = twist_to_wheel_units(self.cfg.robot, v, w)
+        return float(wl), float(wr), dt
+
     def tick(self) -> None:
-        """Drain queues, run the device SLAM step per paired scan."""
+        """Drain queues, run the device SLAM step(s) per robot.
+
+        Full windows of `fleet.batch_scans` queued scans go through
+        `slam_step_window` (the shared-patch throughput path: one grid
+        read-modify-write per window); the remainder steps scan-by-scan.
+        """
         jnp = self._jnp
         with self._state_lock:
-            work = []
+            work: List[List] = [[] for _ in range(self.n_robots)]
             for i in range(self.n_robots):
-                for scan in self._scan_q[i]:
+                for scan in sorted(self._scan_q[i],
+                                   key=lambda s: s.header.stamp):
                     od = self._pair_odom(i, scan.header.stamp)
                     if od is None:
                         self.n_scans_dropped_unpaired += 1
                         M.counters.inc("mapper.scans_unpaired")
                         continue
-                    work.append((i, scan, od))
+                    work[i].append((scan, od))
                 self._scan_q[i].clear()
 
-        for i, scan, od in sorted(work, key=lambda w: w[1].header.stamp):
-            ranges = self._pad_ranges(scan)
-            state = self.states[i]
-            # Feed the odometric pose delta through the step's RK2 slot:
-            # synthesize equivalent wheel speeds from the measured twist
-            # over the inter-scan interval.
-            dt = 1.0 / self.cfg.robot.control_rate_hz
-            wl, wr = twist_to_wheel_units(
-                self.cfg.robot, od.twist.linear_x, od.twist.angular_z)
-            with M.stages.stage("mapper.slam_step"):
-                state, diag = self._S.slam_step(
-                    self.cfg, state, jnp.asarray(ranges),
-                    jnp.float32(wl), jnp.float32(wr), jnp.float32(dt))
-                # Dispatch is async; the host-side fetches force execution
-                # so the stage measures the device step, not the enqueue.
-                matched = bool(diag.matched)
-                closed = bool(diag.loop_closed)
-            self._last_odom_pose[i] = od.pose
-            with self._state_lock:
-                self.states[i] = state
-            self.n_scans_fused += 1
-            M.counters.inc("mapper.scans_fused")
-            if matched:
-                M.counters.inc("mapper.scan_matches")
-            if closed:
-                self.n_loops_closed += 1
-                M.counters.inc("mapper.loops_closed")
+        for i, items in enumerate(work):
+            W = max(2, self.cfg.fleet.batch_scans)
+            k = 0
+            while k < len(items):
+                if len(items) - k >= W:
+                    self._step_window(i, items[k:k + W])
+                    k += W
+                else:
+                    self._step_single(i, *items[k])
+                    k += 1
+            if items:
+                self._publish_correction(i, *items[-1])
 
-            # map->odom correction TF: est ⊖ odom (slam_toolbox's role).
-            est = np.asarray(state.pose)
-            o = od.pose
-            ns = robot_ns(i, self.n_robots)
-            c, s = np.cos(est[2] - o.theta), np.sin(est[2] - o.theta)
-            self.tf.set_transform(TransformStamped(
-                header=Header(stamp=scan.header.stamp, frame_id="map"),
-                child_frame_id=f"{ns}odom",
-                x=float(est[0] - (c * o.x - s * o.y)),
-                y=float(est[1] - (s * o.x + c * o.y)),
-                theta=float(est[2] - o.theta)))
-
-        if work:
+        if any(work):
             self.publish_frontiers()
+
+    def _step_window(self, i: int, items: List) -> None:
+        jnp = self._jnp
+        W = len(items)
+        ranges_w = np.stack([self._pad_ranges(s) for s, _ in items])
+        motion = [self._odom_motion(i, od) for _, od in items]
+        wheels_w = np.asarray([[m[0], m[1]] for m in motion], np.float32)
+        dts_w = np.asarray([m[2] for m in motion], np.float32)
+        state = self.states[i]
+        with M.stages.stage("mapper.slam_step_window"):
+            state, diag = self._S.slam_step_window(
+                self.cfg, state, jnp.asarray(ranges_w),
+                jnp.asarray(wheels_w), jnp.asarray(dts_w))
+            matched = bool(diag.matched)
+            closed = bool(diag.loop_closed)
+        self._finish_step(i, state, items[-1][1], W, matched, closed)
+        self.n_windows_fused += 1
+        M.counters.inc("mapper.windows_fused")
+
+    def _step_single(self, i: int, scan: LaserScan, od: Odometry) -> None:
+        jnp = self._jnp
+        ranges = self._pad_ranges(scan)
+        wl, wr, dt = self._odom_motion(i, od)
+        state = self.states[i]
+        with M.stages.stage("mapper.slam_step"):
+            state, diag = self._S.slam_step(
+                self.cfg, state, jnp.asarray(ranges),
+                jnp.float32(wl), jnp.float32(wr), jnp.float32(dt))
+            # Dispatch is async; the host-side fetches force execution
+            # so the stage measures the device step, not the enqueue.
+            matched = bool(diag.matched)
+            closed = bool(diag.loop_closed)
+        self._finish_step(i, state, od, 1, matched, closed)
+
+    def _finish_step(self, i: int, state, od: Odometry, n_scans: int,
+                     matched: bool, closed: bool) -> None:
+        self._last_odom_pose[i] = od.pose
+        with self._state_lock:
+            self.states[i] = state
+        self.n_scans_fused += n_scans
+        M.counters.inc("mapper.scans_fused", n_scans)
+        if matched:
+            M.counters.inc("mapper.scan_matches")
+        if closed:
+            self.n_loops_closed += 1
+            M.counters.inc("mapper.loops_closed")
+
+    def _publish_correction(self, i: int, scan: LaserScan,
+                            od: Odometry) -> None:
+        """map->odom correction TF: est ⊖ odom (slam_toolbox's role)."""
+        est = np.asarray(self.states[i].pose)
+        o = od.pose
+        ns = robot_ns(i, self.n_robots)
+        c, s = np.cos(est[2] - o.theta), np.sin(est[2] - o.theta)
+        self.tf.set_transform(TransformStamped(
+            header=Header(stamp=scan.header.stamp, frame_id="map"),
+            child_frame_id=f"{ns}odom",
+            x=float(est[0] - (c * o.x - s * o.y)),
+            y=float(est[1] - (s * o.x + c * o.y)),
+            theta=float(est[2] - o.theta)))
 
     # -- exports ------------------------------------------------------------
 

@@ -1,0 +1,336 @@
+"""Real ROS 2 boundary: bridge the in-process Bus to rclpy topics.
+
+SURVEY.md §7's design stance — "keep the ROS 2 node graph as the plugin
+boundary so the Thymio bridge, Nav2, and RViz remain untouched" — lands
+here. The framework's whole graph runs on the in-process Bus (bridge/bus.py)
+so it is testable anywhere; when rclpy IS installed, this adapter mirrors
+the reference's exact topic surface onto real DDS:
+
+  outbound (Bus -> ROS):  /map, /map_updates (nav_msgs/OccupancyGrid,
+                          `server/rviz_config.rviz:152-165`),
+                          /pose (geometry_msgs/PoseWithCovarianceStamped,
+                          rviz_config.rviz:133-143),
+                          /scan (sensor_msgs/LaserScan, rviz:94-106),
+                          /odom (nav_msgs/Odometry, main.py:217-224),
+                          /tf (tf2_ros broadcaster, main.py:202-215)
+  inbound  (ROS -> Bus):  /cmd_vel (geometry_msgs/Twist — Nav2 or
+                          teleop_twist_joy, report.pdf §III.A),
+                          and optionally /scan + /odom (live-hardware mode:
+                          a real ldlidar_stl_ros2 driver feeds the mapper)
+
+so RViz with `configs/jax_mapping.rviz` and Nav2 subscribe/publish exactly
+the contracts the reference wires up in
+`server/thymio_project/launch/pc_server.launch.py:12-34`.
+
+Import-guarded: everything degrades to a clear RuntimeError when rclpy is
+absent (this image has no ROS); CI exercises the adapter against a stub
+rclpy module (tests/test_rclpy_adapter.py).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.messages import (
+    Header, LaserScan, OccupancyGrid, Odometry, Twist,
+)
+from jax_mapping.bridge.qos import Reliability
+from jax_mapping.bridge.tf import TfTree
+from jax_mapping.config import SlamConfig
+
+
+def rclpy_available() -> bool:
+    """True when the real ROS 2 python stack can be imported."""
+    try:
+        import rclpy  # noqa: F401
+        import rclpy.node  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def _to_ros_time(TimeCls, stamp: float):
+    sec = int(stamp)
+    return TimeCls(sec=sec, nanosec=int((stamp - sec) * 1e9))
+
+
+def _from_ros_time(t) -> float:
+    return float(t.sec) + float(t.nanosec) * 1e-9
+
+
+class RclpyAdapter:
+    """One rclpy node pair of publishers/subscriptions mirroring the Bus.
+
+    Args:
+      bus: the in-process Bus carrying the framework graph.
+      cfg: SlamConfig (QoS + rates: scan is Best-Effort per report.pdf
+        §V.A; /map latches transient-local for late-joining RViz).
+      tf: TfTree to broadcast (map->odom, odom->base_link, static laser
+        mount) at cfg.tf_publish_period_s.
+      outbound: Bus topics re-published into ROS.
+      inbound: ROS topics re-published onto the Bus.
+      node_name: ROS node name.
+    """
+
+    OUTBOUND_DEFAULT = ("map", "map_updates", "pose", "scan", "odom")
+    INBOUND_DEFAULT = ("cmd_vel",)
+
+    def __init__(self, bus: Bus, cfg: SlamConfig,
+                 tf: Optional[TfTree] = None,
+                 outbound: Iterable[str] = OUTBOUND_DEFAULT,
+                 inbound: Iterable[str] = INBOUND_DEFAULT,
+                 node_name: str = "jax_mapping_bridge"):
+        if not rclpy_available():
+            raise RuntimeError(
+                "rclpy is not importable — the ROS 2 adapter needs a sourced "
+                "ROS 2 (Jazzy) environment; see README 'ROS 2 / RViz'. The "
+                "rest of the framework runs without it.")
+        import rclpy
+        from rclpy.node import Node as RosNode
+
+        self.bus = bus
+        self.cfg = cfg
+        self.tf = tf
+        self._subs: List = []
+        self._spin_thread: Optional[threading.Thread] = None
+        self._shutdown = threading.Event()
+
+        if not rclpy.ok():
+            rclpy.init()
+        self.node: "RosNode" = RosNode(node_name)
+
+        self._msgs = self._import_msgs()
+        self._wire_outbound(set(outbound))
+        self._wire_inbound(set(inbound))
+        if tf is not None:
+            self._wire_tf()
+
+    # -- wiring -------------------------------------------------------------
+
+    @staticmethod
+    def _import_msgs():
+        import geometry_msgs.msg as geo
+        import nav_msgs.msg as nav
+        import sensor_msgs.msg as sen
+        import builtin_interfaces.msg as bi
+        return {"geo": geo, "nav": nav, "sen": sen, "bi": bi}
+
+    def _ros_qos(self, *, best_effort: bool = False, latched: bool = False,
+                 depth: int = 10):
+        from rclpy.qos import (
+            DurabilityPolicy, QoSProfile, ReliabilityPolicy,
+        )
+        return QoSProfile(
+            depth=depth,
+            reliability=(ReliabilityPolicy.BEST_EFFORT if best_effort
+                         else ReliabilityPolicy.RELIABLE),
+            durability=(DurabilityPolicy.TRANSIENT_LOCAL if latched
+                        else DurabilityPolicy.VOLATILE),
+        )
+
+    def _wire_outbound(self, topics) -> None:
+        nav = self._msgs["nav"]
+        geo = self._msgs["geo"]
+        sen = self._msgs["sen"]
+        n = self.node
+        if "map" in topics:
+            pub = n.create_publisher(nav.OccupancyGrid, "/map",
+                                     self._ros_qos(latched=True, depth=1))
+            self._bus_to_ros("map", pub, self.occupancy_to_ros)
+        if "map_updates" in topics:
+            pub = n.create_publisher(nav.OccupancyGrid, "/map_updates",
+                                     self._ros_qos(depth=1))
+            self._bus_to_ros("map_updates", pub, self.occupancy_to_ros)
+        if "pose" in topics:
+            pub = n.create_publisher(geo.PoseWithCovarianceStamped, "/pose",
+                                     self._ros_qos())
+            self._bus_to_ros("pose", pub, self.pose_list_to_ros)
+        if "scan" in topics:
+            pub = n.create_publisher(sen.LaserScan, "/scan",
+                                     self._ros_qos(best_effort=True))
+            self._bus_to_ros("scan", pub, self.scan_to_ros)
+        if "odom" in topics:
+            pub = n.create_publisher(nav.Odometry, "/odom", self._ros_qos())
+            self._bus_to_ros("odom", pub, self.odom_to_ros)
+
+    def _bus_to_ros(self, topic: str, ros_pub, convert) -> None:
+        def cb(msg, _pub=ros_pub, _cv=convert):
+            out = _cv(msg)
+            if out is not None:
+                _pub.publish(out)
+        self._subs.append(self.bus.subscribe(topic, callback=cb))
+
+    def _wire_inbound(self, topics) -> None:
+        geo = self._msgs["geo"]
+        sen = self._msgs["sen"]
+        nav = self._msgs["nav"]
+        n = self.node
+        if "cmd_vel" in topics:
+            pub = self.bus.publisher("cmd_vel")
+            n.create_subscription(
+                geo.Twist, "/cmd_vel",
+                lambda m, _p=pub: _p.publish(self.twist_from_ros(m)),
+                self._ros_qos())
+        if "scan" in topics:
+            pub = self.bus.publisher("scan")
+            n.create_subscription(
+                sen.LaserScan, "/scan",
+                lambda m, _p=pub: _p.publish(self.scan_from_ros(m)),
+                self._ros_qos(best_effort=True))
+        if "odom" in topics:
+            pub = self.bus.publisher("odom")
+            n.create_subscription(
+                nav.Odometry, "/odom",
+                lambda m, _p=pub: _p.publish(self.odom_from_ros(m)),
+                self._ros_qos(depth=50))
+
+    def _wire_tf(self) -> None:
+        import tf2_ros
+        self._tf_bcast = tf2_ros.TransformBroadcaster(self.node)
+        self.node.create_timer(self.cfg.tf_publish_period_s,
+                               self.publish_tf_once)
+
+    # -- conversions (field-for-field per the ROS interface definitions) ----
+
+    def scan_to_ros(self, msg: LaserScan):
+        sen, bi = self._msgs["sen"], self._msgs["bi"]
+        out = sen.LaserScan()
+        out.header.stamp = _to_ros_time(bi.Time, msg.header.stamp)
+        out.header.frame_id = msg.header.frame_id or "base_laser"
+        out.angle_min = float(msg.angle_min)
+        out.angle_max = float(msg.angle_max)
+        out.angle_increment = float(msg.angle_increment)
+        out.time_increment = float(msg.time_increment)
+        out.scan_time = float(msg.scan_time)
+        out.range_min = float(msg.range_min)
+        out.range_max = float(msg.range_max)
+        out.ranges = [float(r) for r in np.asarray(msg.ranges)]
+        out.intensities = [float(v) for v in np.asarray(msg.intensities)]
+        return out
+
+    def scan_from_ros(self, m) -> LaserScan:
+        return LaserScan(
+            header=Header(stamp=_from_ros_time(m.header.stamp),
+                          frame_id=m.header.frame_id),
+            angle_min=float(m.angle_min), angle_max=float(m.angle_max),
+            angle_increment=float(m.angle_increment),
+            time_increment=float(m.time_increment),
+            scan_time=float(m.scan_time),
+            range_min=float(m.range_min), range_max=float(m.range_max),
+            ranges=np.asarray(m.ranges, np.float32),
+            intensities=np.asarray(m.intensities, np.float32),
+        )
+
+    def occupancy_to_ros(self, msg: OccupancyGrid):
+        nav, bi = self._msgs["nav"], self._msgs["bi"]
+        out = nav.OccupancyGrid()
+        out.header.stamp = _to_ros_time(bi.Time, msg.header.stamp)
+        out.header.frame_id = msg.header.frame_id or "map"
+        out.info.resolution = float(msg.info.resolution)
+        out.info.width = int(msg.info.width)
+        out.info.height = int(msg.info.height)
+        out.info.origin.position.x = float(msg.info.origin.x)
+        out.info.origin.position.y = float(msg.info.origin.y)
+        qx, qy, qz, qw = msg.info.origin.to_quaternion()
+        out.info.origin.orientation.z = qz
+        out.info.origin.orientation.w = qw
+        out.data = [int(v) for v in np.asarray(msg.data, np.int8)]
+        return out
+
+    def odom_to_ros(self, msg: Odometry):
+        nav, bi = self._msgs["nav"], self._msgs["bi"]
+        out = nav.Odometry()
+        out.header.stamp = _to_ros_time(bi.Time, msg.header.stamp)
+        out.header.frame_id = msg.header.frame_id or "odom"
+        out.child_frame_id = msg.child_frame_id
+        out.pose.pose.position.x = float(msg.pose.x)
+        out.pose.pose.position.y = float(msg.pose.y)
+        qx, qy, qz, qw = msg.pose.to_quaternion()
+        out.pose.pose.orientation.z = qz
+        out.pose.pose.orientation.w = qw
+        out.twist.twist.linear.x = float(msg.twist.linear_x)
+        out.twist.twist.angular.z = float(msg.twist.angular_z)
+        return out
+
+    def odom_from_ros(self, m) -> Odometry:
+        from jax_mapping.bridge.messages import Pose2D
+        yaw = 2.0 * math.atan2(m.pose.pose.orientation.z,
+                               m.pose.pose.orientation.w)
+        return Odometry(
+            header=Header(stamp=_from_ros_time(m.header.stamp),
+                          frame_id=m.header.frame_id),
+            child_frame_id=m.child_frame_id,
+            pose=Pose2D(float(m.pose.pose.position.x),
+                        float(m.pose.pose.position.y), yaw),
+            twist=Twist(linear_x=float(m.twist.twist.linear.x),
+                        angular_z=float(m.twist.twist.angular.z)),
+        )
+
+    def twist_from_ros(self, m) -> Twist:
+        return Twist(linear_x=float(m.linear.x),
+                     angular_z=float(m.angular.z))
+
+    def pose_list_to_ros(self, poses):
+        """The Bus `/pose` payload is a list of per-robot pose dicts
+        (bridge/mapper.py); ROS `/pose` is the FIRST robot's
+        PoseWithCovarianceStamped (the reference is single-robot,
+        rviz_config.rviz:133-143)."""
+        if not poses:
+            return None
+        geo, bi = self._msgs["geo"], self._msgs["bi"]
+        p = poses[0]
+        out = geo.PoseWithCovarianceStamped()
+        out.header.frame_id = "map"
+        out.pose.pose.position.x = float(p["x"])
+        out.pose.pose.position.y = float(p["y"])
+        out.pose.pose.orientation.z = math.sin(p["theta"] / 2.0)
+        out.pose.pose.orientation.w = math.cos(p["theta"] / 2.0)
+        return out
+
+    def publish_tf_once(self) -> None:
+        """Broadcast every transform currently in the TfTree."""
+        geo, bi = self._msgs["geo"], self._msgs["bi"]
+        out = []
+        for t in self.tf.all_transforms():
+            m = geo.TransformStamped()
+            m.header.stamp = _to_ros_time(bi.Time, t.header.stamp)
+            m.header.frame_id = t.header.frame_id
+            m.child_frame_id = t.child_frame_id
+            m.transform.translation.x = float(t.x)
+            m.transform.translation.y = float(t.y)
+            m.transform.translation.z = float(t.z)
+            m.transform.rotation.z = math.sin(t.theta / 2.0)
+            m.transform.rotation.w = math.cos(t.theta / 2.0)
+            out.append(m)
+        if out:
+            self._tf_bcast.sendTransform(out)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def spin(self) -> None:
+        """rclpy.spin in a daemon thread (the reference's pattern,
+        `server/.../main.py:285-286`)."""
+        import rclpy
+
+        def run():
+            while not self._shutdown.is_set() and rclpy.ok():
+                rclpy.spin_once(self.node, timeout_sec=0.1)
+
+        self._spin_thread = threading.Thread(target=run, daemon=True)
+        self._spin_thread.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._spin_thread is not None:
+            self._spin_thread.join(timeout=2.0)
+        for s in self._subs:
+            s.close()
+        try:
+            self.node.destroy_node()
+        except Exception:
+            pass

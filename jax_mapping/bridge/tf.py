@@ -88,6 +88,18 @@ class TfTree:
         with self._lock:
             self._static[(tf.header.frame_id, tf.child_frame_id)] = tf
 
+    def all_transforms(self) -> List[TransformStamped]:
+        """Latest sample of every edge (dynamic + static) — what a ROS TF
+        broadcaster re-publishes (bridge/rclpy_adapter.py)."""
+        out: List[TransformStamped] = []
+        with self._lock:
+            for buf in self._buffers.values():
+                tf = buf.sample(None)
+                if tf is not None:
+                    out.append(tf)
+            out.extend(self._static.values())
+        return out
+
     # -- lookup -------------------------------------------------------------
 
     def _edges(self) -> Dict[str, List[Tuple[str, Tuple[str, str], bool]]]:

@@ -133,6 +133,15 @@ class MatcherConfig:
     fine_angle_step_rad: float = 0.00349      # slam_config.yaml:64
     smear_cells: int = 2                      # likelihood-field smear radius (yaml:53)
     min_response: float = 0.1                 # acceptance gate
+    # Variance penalties (slam_config.yaml:61-62, Karto semantics): the
+    # matcher RANKS candidates by penalty * response — preferring solutions
+    # near the odometric prior when responses tie (kills translation-
+    # symmetric aliases, e.g. parallel walls) — but GATES on the raw
+    # response of the winner. Floors are Karto's defaults.
+    distance_variance_penalty_m2: float = 0.5   # yaml:61
+    angle_variance_penalty_rad2: float = 1.0    # yaml:62
+    min_distance_penalty: float = 0.5
+    min_angle_penalty: float = 0.9
     # Gating: only match when moved enough (slam_config.yaml:37-38).
     min_travel_m: float = 0.1
     min_heading_rad: float = 0.1
@@ -148,6 +157,9 @@ class LoopClosureConfig:
     response_coarse: float = 0.35             # yaml:47
     response_fine: float = 0.45               # yaml:48
     loop_window_m: float = 8.0                # yaml:56 loop search space dimension
+    # Wide-stage grid downsample: the 8 m loop window is swept on a grid
+    # this many times coarser (models/slam two-stage loop verification).
+    coarse_downsample: int = 4
     max_poses: int = 1024                     # pose ring-buffer capacity (static)
     max_edges: int = 4096                     # edge buffer capacity (static)
     gn_iters: int = 8                         # Gauss-Newton iterations per solve
@@ -239,7 +251,8 @@ def tiny_config(n_robots: int = 2) -> SlamConfig:
         scan=ScanConfig(n_beams=90, padded_beams=128, range_max_m=3.0,
                         angle_increment_rad=2.0 * math.pi / 90.0),
         matcher=MatcherConfig(search_half_extent_m=0.25),
-        loop=LoopClosureConfig(max_poses=64, max_edges=256, gn_iters=4),
+        loop=LoopClosureConfig(max_poses=64, max_edges=256, gn_iters=4,
+                               coarse_downsample=2),
         frontier=FrontierConfig(downsample=2, max_clusters=16,
                                 label_prop_iters=24, bfs_iters=64),
         fleet=FleetConfig(n_robots=n_robots, batch_scans=4),

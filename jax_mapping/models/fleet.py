@@ -6,14 +6,22 @@ machines and three processes (SURVEY.md §3.2-3.4):
 
   simulate LD06 scans (device raycast)           [was: LD06 driver on the Pi]
   -> odometry from measured wheel speeds         [was: ThymioBrain update_loop]
+  -> key-scan gate 0.1 m / 0.1 rad               [was: slam_toolbox gate,
+                                                  slam_config.yaml:37-38]
   -> batched correlative matching                [was: slam_toolbox matcher]
-  -> batched log-odds fusion into a shared grid  [was: slam_toolbox rasterizer]
+  -> masked log-odds fusion into a shared grid   [was: slam_toolbox rasterizer]
+  -> per-robot pose graphs + loop closure        [was: slam_toolbox graph,
+     with shared-map re-fusion on closure         slam_config.yaml:43-48]
   -> frontier detect/cluster/assign              [was: future work, §VI.2]
   -> explorer policy -> wheel targets            [was: subsumption navigator]
   -> fleet kinematics step                       [was: physical robots]
 
-Everything is (R, ...)-batched with vmap; `parallel.fleet_sharded` runs the
-same step under shard_map over a ('fleet', 'space') mesh.
+Everything is (R, ...)-batched with vmap; gating is by masking (all robots
+compute every tick — the batched-SIMD trade — but sub-gate robots add no
+map evidence and no graph nodes). Loop-closure verification and map repair
+run under one batch-level `lax.cond`, so their cost is paid only on ticks
+where some robot actually has a candidate. `parallel.fleet_sharded` runs
+the same step under shard_map over a ('fleet', 'space') mesh.
 """
 
 from __future__ import annotations
@@ -26,13 +34,18 @@ import jax.numpy as jnp
 
 from jax_mapping.config import SlamConfig
 from jax_mapping.models.explorer import PolicyOut, frontier_policy
+from jax_mapping.models.slam import _verify_loop
 from jax_mapping.ops import frontier as F
 from jax_mapping.ops import grid as G
+from jax_mapping.ops import posegraph as PG
 from jax_mapping.ops import scan_match as M
-from jax_mapping.ops.odometry import rk2_step
+from jax_mapping.ops.odometry import pose_between, rk2_step, wrap_angle
 from jax_mapping.sim import lidar, thymio
 
 Array = jax.Array
+
+_ODO_W = (50.0, 100.0)          # odometry edge information (t, theta)
+_LOOP_W = (200.0, 400.0)        # verified loop edge information
 
 
 class FleetState(NamedTuple):
@@ -40,6 +53,10 @@ class FleetState(NamedTuple):
     est_poses: Array            # (R, 3) SLAM estimates
     grid: Array                 # (N, N) shared log-odds map
     exploring: Array            # (R,) bool (the /start /stop flag)
+    last_key_poses: Array       # (R, 3) pose at each robot's last key-scan
+    graphs: PG.PoseGraph        # per-robot graphs, leading (R,) axis
+    scan_rings: Array           # (R, max_poses, padded_beams) key-scans
+    n_loops: Array              # (R,) int32 closed loops per robot
     t: Array                    # () int32 step counter
 
 
@@ -48,6 +65,8 @@ class FleetDiag(NamedTuple):
     frontiers: F.FrontierResult
     match_response: Array       # (R,)
     pose_err: Array             # (R,) |est - truth| (sim-only luxury)
+    is_key: Array               # (R,) bool: passed the key-scan gate
+    loop_closed: Array          # (R,) bool: closed a loop this tick
 
 
 def init_fleet_state(cfg: SlamConfig, key: Array) -> FleetState:
@@ -58,8 +77,108 @@ def init_fleet_state(cfg: SlamConfig, key: Array) -> FleetState:
         est_poses=sim.poses,               # start calibrated
         grid=G.empty_grid(cfg.grid),
         exploring=jnp.ones((R,), bool),
+        last_key_poses=jnp.full((R, 3), 1e9, jnp.float32),  # force first key
+        graphs=jax.vmap(lambda _: PG.empty_graph(cfg.loop))(jnp.arange(R)),
+        scan_rings=jnp.zeros((R, cfg.loop.max_poses, cfg.scan.padded_beams),
+                             jnp.float32),
+        n_loops=jnp.zeros((R,), jnp.int32),
         t=jnp.int32(0),
     )
+
+
+def _update_graphs(cfg: SlamConfig, graphs: PG.PoseGraph, est: Array,
+                   is_key: Array, scans: Array, rings: Array):
+    """Key robots append a pose + odometry edge + ring scan. Returns
+    (graphs, rings, k_idx) with k_idx the slot each robot's new pose used
+    (== pre-add n_poses; garbage for non-key robots, masked downstream)."""
+    cap = cfg.loop.max_poses
+    k_idx = graphs.n_poses                                     # (R,)
+
+    def upd(g, pose, flag):
+        k = g.n_poses
+        prev = g.poses[jnp.maximum(k - 1, 0)]
+        g2 = PG.add_pose_if(g, pose, flag)
+        meas = pose_between(prev, pose)
+        w = jnp.array([_ODO_W[0], _ODO_W[0], _ODO_W[1]], jnp.float32)
+        # k < cap: a full ring must not grow edges onto the never-written
+        # slot k == cap (clamped gathers would turn it into a corrupting
+        # self-edge in every later optimise).
+        return PG.add_edge_if(g2, jnp.maximum(k - 1, 0), k, meas, w,
+                              flag & (k > 0) & (k < cap))
+
+    graphs = jax.vmap(upd)(graphs, est, is_key)
+
+    def ring_upd(ring, k, ranges, flag):
+        slot = jnp.minimum(k, cap - 1)
+        ok = flag & (k < cap)
+        return jnp.where(ok, ring.at[slot].set(ranges), ring)
+
+    rings = jax.vmap(ring_upd)(rings, k_idx, scans, is_key)
+    return graphs, rings, k_idx
+
+
+def _verify_and_optimize(cfg: SlamConfig, graphs: PG.PoseGraph,
+                         rings: Array, est: Array, scans: Array,
+                         k_idx: Array, cand: Array, attempt: Array):
+    """Shared closure body for the local AND sharded fleet steps:
+    two-stage verification of every attempting robot against its
+    candidate's ghost-free chain map (models/slam._verify_loop), loop
+    edges, per-robot optimisation, pose update. Returns
+    (graphs, est, closed). Verification runs under `lax.map` over robots —
+    each iteration materialises one chain grid, so peak memory is one
+    extra full-size grid regardless of fleet size."""
+    cap = cfg.loop.max_poses
+
+    def one(r):
+        g_r = jax.tree.map(lambda x: x[r], graphs)
+        res = _verify_loop(cfg, g_r, rings[r], cand[r], k_idx[r],
+                           scans[r], est[r])
+        return res.pose, res.accepted, res.response
+
+    fine_pose, fine_acc, fine_resp = jax.lax.map(one, jnp.arange(est.shape[0]))
+    closed = attempt & fine_acc & (fine_resp >= cfg.loop.response_fine)
+
+    def add_loop(g, c, q, meas_pose, flag):
+        rel = pose_between(g.poses[c], meas_pose)
+        w = jnp.array([_LOOP_W[0], _LOOP_W[0], _LOOP_W[1]], jnp.float32)
+        return PG.add_edge_if(g, c, q, rel, w, flag & (q < cap))
+
+    graphs2 = jax.vmap(add_loop)(graphs, cand, k_idx, fine_pose, closed)
+    opt = jax.vmap(lambda g: PG.optimize(cfg.loop, g))(graphs2)
+    graphs3 = jax.tree.map(
+        lambda a, b: jnp.where(
+            closed.reshape((-1,) + (1,) * (a.ndim - 1)), a, b), opt, graphs2)
+
+    est2 = jnp.where(closed[:, None],
+                     jax.vmap(lambda g, q: g.poses[jnp.minimum(q, cap - 1)])(
+                         graphs3, k_idx), est)
+    return graphs3, est2, closed
+
+
+def _close_loops(cfg: SlamConfig, graphs: PG.PoseGraph, grid: Array,
+                 rings: Array, est: Array, scans: Array, k_idx: Array,
+                 cand: Array, attempt: Array, rings_complete: Array):
+    """Fleet closure: shared verify/optimise body + shared-map re-fusion.
+    Returns (graphs, grid, est, closed)."""
+    graphs3, est2, closed = _verify_and_optimize(
+        cfg, graphs, rings, est, scans, k_idx, cand, attempt)
+
+    # Shared-map repair: re-fuse EVERY robot's key-scan ring from the
+    # (possibly re-optimised) trajectories. The shared grid mixes all
+    # robots' evidence, so per-robot incremental patching is impossible —
+    # full re-fusion is the exact, TPU-cheap answer (ops/posegraph.py
+    # module docstring). Guarded by `rings_complete`: once any ring has
+    # overflowed, the live grid holds evidence the rings cannot reproduce
+    # and a from-scratch re-fusion would erase it — poses still optimise,
+    # the map keeps its ghosts (the bounded-capacity trade, SURVEY.md §7).
+    R, cap, beams = rings.shape
+    poses_flat = graphs3.poses[:, :cap].reshape(R * cap, 3)
+    valid_flat = graphs3.pose_valid[:, :cap].reshape(R * cap)
+    refused = G.fuse_scans_masked(cfg.grid, cfg.scan, G.empty_grid(cfg.grid),
+                                  rings.reshape(R * cap, beams), poses_flat,
+                                  valid_flat)
+    grid2 = jnp.where(closed.any() & rings_complete, refused, grid)
+    return graphs3, grid2, est2, closed
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
@@ -90,17 +209,48 @@ def fleet_step(cfg: SlamConfig, state: FleetState, world_res_m: float,
     est = jax.vmap(lambda p, w: rk2_step(cfg.robot, p, w[0], w[1], dt))(
         state.est_poses, measured)
 
-    # 5. Correlative correction against the shared map.
+    # 5. Key-scan gate (slam_config.yaml:37-38): matching, fusion, and
+    # graph growth only for robots that moved enough.
+    d = jnp.linalg.norm(est[:, :2] - state.last_key_poses[:, :2], axis=-1)
+    dth = jnp.abs(wrap_angle(est[:, 2] - state.last_key_poses[:, 2]))
+    is_key = (d > cfg.matcher.min_travel_m) | \
+        (dth > cfg.matcher.min_heading_rad)
+
+    # 6. Correlative correction against the shared map (key robots only).
     res = M.match_batch(cfg.grid, cfg.scan, cfg.matcher, state.grid,
                         scans, est)
-    est = jnp.where(res.accepted[:, None], res.pose, est)
+    est = jnp.where((is_key & res.accepted)[:, None], res.pose, est)
 
-    # 6. Fuse this tick's scans (batched fold, exact under overlap).
-    grid = G.fuse_scans(cfg.grid, cfg.scan, state.grid, scans, est)
+    # 7. Fuse this tick's key scans (masked batched fold, exact under
+    # overlap; sub-gate robots add nothing).
+    grid = G.fuse_scans_masked(cfg.grid, cfg.scan, state.grid, scans, est,
+                               is_key)
 
+    # 8. Pose graphs + loop closure.
+    graphs, rings, k_idx = _update_graphs(cfg, state.graphs, est, is_key,
+                                          scans, state.scan_rings)
+    cand, cand_found = jax.vmap(
+        lambda g, q: PG.loop_candidate(cfg.loop, g, q))(graphs, k_idx)
+    attempt = is_key & cand_found & bool(cfg.loop.enabled)
+    # Conservative ring-completeness: once any graph saturates, key scans
+    # escape the rings and map repair must stop (see _close_loops).
+    rings_complete = ~jnp.any(graphs.n_poses >= cfg.loop.max_poses)
+
+    graphs, grid, est, closed = jax.lax.cond(
+        attempt.any(),
+        lambda args: _close_loops(cfg, *args),
+        lambda args: (args[0], args[1], args[3], jnp.zeros_like(attempt)),
+        (graphs, grid, rings, est, scans, k_idx, cand, attempt,
+         rings_complete))
+
+    last_key = jnp.where(is_key[:, None], est, state.last_key_poses)
     state2 = FleetState(sim=sim2, est_poses=est, grid=grid,
-                        exploring=state.exploring, t=state.t + 1)
+                        exploring=state.exploring, last_key_poses=last_key,
+                        graphs=graphs, scan_rings=rings,
+                        n_loops=state.n_loops + closed.astype(jnp.int32),
+                        t=state.t + 1)
     diag = FleetDiag(policy=pol, frontiers=fr, match_response=res.response,
                      pose_err=jnp.linalg.norm(
-                         est[:, :2] - sim2.poses[:, :2], axis=-1))
+                         est[:, :2] - sim2.poses[:, :2], axis=-1),
+                     is_key=is_key, loop_closed=closed)
     return state2, diag

@@ -66,8 +66,8 @@ def init_state(cfg: SlamConfig, pose0=None) -> SlamState:
 
 
 def _loop_matcher_cfg(cfg: SlamConfig):
-    """Wider search window for loop verification (slam_config.yaml:56:
-    loop search space 8 m; here bounded by the patch margin)."""
+    """Fine-stage search window for loop verification: the regular online
+    window widened to the patch margin, around the wide-stage estimate."""
     m = cfg.matcher
     half = min(cfg.loop.search_radius_m,
                (cfg.grid.patch_cells / 2 - cfg.grid.align_cols / 2)
@@ -75,6 +75,75 @@ def _loop_matcher_cfg(cfg: SlamConfig):
     half = max(half, m.search_half_extent_m)
     return dataclasses.replace(m, search_half_extent_m=half,
                                coarse_step_m=m.coarse_step_m * 2)
+
+
+def _chain_grid(cfg: SlamConfig, graph: PG.PoseGraph, ring: Array,
+                cand: Array, k: Array) -> Array:
+    """Ghost-free loop-verification map: re-fuse the CANDIDATE's local
+    chain of stored key-scans at their graph poses.
+
+    Matching the current scan against the live map cannot verify a loop —
+    the live map already contains the drift ghosts the loop exists to fix
+    (report.pdf §V.B-C), so a ghost wall is a legitimate-looking basin.
+    Karto instead matches against the candidate chain (slam_config.yaml:45
+    `loop_match_minimum_chain_size`); the chain's poses are locally
+    consistent, so the resulting relative pose is exactly the loop-edge
+    measurement. Fixed chain length 2*min_chain_size+1 keeps shapes static.
+    """
+    CH = min(2 * cfg.loop.min_chain_size + 1, cfg.loop.max_poses)
+    start = jnp.clip(cand - CH // 2, 0, cfg.loop.max_poses - CH)
+    scans = jax.lax.dynamic_slice_in_dim(ring, start, CH, axis=0)
+    poses = jax.lax.dynamic_slice_in_dim(graph.poses, start, CH, axis=0)
+    valid = jax.lax.dynamic_slice_in_dim(graph.pose_valid, start, CH, axis=0)
+    # The query's own recent tail must not leak into the verification map
+    # (it would re-introduce the current drift frame).
+    sl_idx = start + jnp.arange(CH)
+    valid = valid & (sl_idx <= k - cfg.loop.min_chain_size)
+    return G.fuse_scans_masked(cfg.grid, cfg.scan, G.empty_grid(cfg.grid),
+                               scans, poses, valid)
+
+
+def _verify_loop(cfg: SlamConfig, graph: PG.PoseGraph, ring: Array,
+                 cand: Array, k: Array, ranges: Array, pose: Array):
+    """Two-stage loop verification against the candidate chain's map.
+
+    Stage 1 sweeps the full loop window (8 m, slam_config.yaml:56) on a
+    coarse view; stage 2 refines at full resolution. Returns the fine
+    MatchResult (gate on `.accepted` and `.response`).
+    """
+    grid_v = _chain_grid(cfg, graph, ring, cand, k)
+    g_c, m_c = _loop_wide_cfgs(cfg)
+    wide = M.match(g_c, cfg.scan, m_c,
+                   G.downsample_max(grid_v, cfg.loop.coarse_downsample),
+                   ranges, pose)
+    seed = jnp.where(wide.accepted, wide.pose, pose)
+    return M.match(cfg.grid, cfg.scan, _loop_matcher_cfg(cfg), grid_v,
+                   ranges, seed)
+
+
+def _loop_wide_cfgs(cfg: SlamConfig):
+    """(coarse GridConfig, wide MatcherConfig) for the 8 m loop sweep.
+
+    slam_toolbox searches loops in an 8 m window at 0.05 m
+    (`slam_config.yaml:56-58`); a full-res correlative sweep that wide is
+    pointless work, so stage one runs the SAME dense-conv matcher on a
+    `loop.coarse_downsample`x coarser view of the grid, whose patch covers
+    the whole window (grid.coarse_grid_config). Stage two refines on the
+    full-res patch (`_loop_matcher_cfg`). The wide half-extent is the
+    8 m window's half, clamped by the coarse patch's own margin.
+    """
+    g_c = G.coarse_grid_config(cfg.grid, cfg.loop.coarse_downsample)
+    half = min(cfg.loop.loop_window_m / 2.0,
+               (g_c.patch_cells / 2 - g_c.align_cols / 2)
+               * g_c.resolution_m - g_c.max_range_m)
+    half = max(half, g_c.resolution_m)
+    m_c = dataclasses.replace(
+        cfg.matcher,
+        search_half_extent_m=half,
+        coarse_step_m=g_c.resolution_m,       # one coarse cell per step
+        min_response=cfg.loop.response_coarse,  # yaml:47 coarse gate
+    )
+    return g_c, m_c
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
@@ -114,8 +183,12 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
 
         def close_loop(args):
             graph, grid, ring = args
-            lres = M.match(cfg.grid, cfg.scan, _loop_matcher_cfg(cfg),
-                           grid, ranges, pose)
+            # Two-stage verification (wide 8 m sweep -> fine) against the
+            # CANDIDATE CHAIN's ghost-free map (_verify_loop). Recovers
+            # drift far beyond the online matcher's reach (the report's
+            # §V.B-C wall-ghosting case); acceptance on the fine response
+            # gate (yaml:48).
+            lres = _verify_loop(cfg, graph, ring, cand, k, ranges, pose)
             good = lres.accepted & (lres.response >= cfg.loop.response_fine)
 
             def apply(args):
@@ -168,7 +241,8 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
 
 @functools.partial(jax.jit, static_argnums=(0,))
 def slam_step_window(cfg: SlamConfig, state: SlamState, ranges_w: Array,
-                     wheels_w: Array, dt: Array) -> tuple[SlamState, SlamDiag]:
+                     wheels_w: Array, dts_w: Array
+                     ) -> tuple[SlamState, SlamDiag]:
     """Windowed update: a burst of W consecutive scans in one device step.
 
     The throughput path for scan rates far above the key-scan rate (the
@@ -180,24 +254,37 @@ def slam_step_window(cfg: SlamConfig, state: SlamState, ranges_w: Array,
     and the LAST scan runs the full `slam_step` pipeline (gate, match,
     pose graph, loop closure).
 
+    The shared-patch contract is enforced on device: a window whose poses
+    spread beyond the patch falls back to the exact per-scan fold
+    (`grid.fuse_scans_window_checked`) instead of silently dropping map
+    evidence.
+
     Args:
       ranges_w: (W, padded_beams); wheels_w: (W, 2) raw wheel speeds;
-      dt: per-scan interval. W is static. The window must satisfy the
-      shared-patch contract (poses within ~4 m — guaranteed at any
-      realistic speed x window length).
+      dts_w: per-scan intervals — scalar or (W,) (irregular scan stamps
+      under Best-Effort delivery are first-class). W >= 2 and static.
     """
-    def integrate(p, w):
+    W = ranges_w.shape[0]
+    if W < 2:
+        raise ValueError(
+            f"slam_step_window needs a window of >= 2 scans, got W={W}; "
+            "feed single scans through slam_step")
+    dts_w = jnp.broadcast_to(jnp.asarray(dts_w, jnp.float32), (W,))
+
+    def integrate(p, wd):
+        w, dt = wd
         p2 = rk2_step(cfg.robot, p, w[0], w[1], dt)
         return p2, p2
 
     # Scan i is taken at the pose AFTER integrating wheels_w[i] (slam_step's
     # convention): poses_w[i] = pose at scan i.
-    _, poses_w = jax.lax.scan(integrate, state.pose, wheels_w)   # (W, 3)
+    _, poses_w = jax.lax.scan(integrate, state.pose,
+                              (wheels_w, dts_w))   # (W, 3)
 
-    grid = G.fuse_scans_window(cfg.grid, cfg.scan, state.grid,
-                               ranges_w[:-1], poses_w[:-1])
+    grid = G.fuse_scans_window_checked(cfg.grid, cfg.scan, state.grid,
+                                       ranges_w[:-1], poses_w[:-1])
     # The last scan runs the full pipeline; starting it from the W-2th pose
     # makes its internal odometry land exactly on poses_w[-1].
     st = state._replace(grid=grid, pose=poses_w[-2])
     return slam_step(cfg, st, ranges_w[-1],
-                     wheels_w[-1, 0], wheels_w[-1, 1], dt)
+                     wheels_w[-1, 0], wheels_w[-1, 1], dts_w[-1])

@@ -305,6 +305,23 @@ def fuse_scans(grid_cfg: GridConfig, scan_cfg: ScanConfig,
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
+def fuse_scans_masked(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                      grid_arr: Array, ranges_b: Array, poses_b: Array,
+                      mask_b: Array) -> Array:
+    """`fuse_scans` where scan b contributes iff mask_b[b].
+
+    The fleet step's key-scan gate (slam_config.yaml:37-38): sub-gate
+    robots' scans must add NO evidence — zeroing their ranges would still
+    carve free space (a zero range means "outlier, carve to 10 m",
+    server/.../main.py:152), so the mask multiplies the classified deltas
+    instead.
+    """
+    deltas, origins = _classify_batch(grid_cfg, scan_cfg, ranges_b, poses_b)
+    deltas = deltas * mask_b[:, None, None].astype(deltas.dtype)
+    return _fold(grid_cfg, grid_arr, deltas, origins, clamp=True)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
 def scan_deltas_full(grid_cfg: GridConfig, scan_cfg: ScanConfig,
                      ranges_b: Array, poses_b: Array) -> Array:
     """Batch of scans -> one full-size log-odds delta grid (no clamp).
@@ -349,10 +366,71 @@ def fuse_scans_window(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     return apply_patch(grid_cfg, grid_arr, delta, origin, clamp=True)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def fuse_scans_window_checked(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                              grid_arr: Array, ranges_b: Array,
+                              poses_b: Array) -> Array:
+    """`fuse_scans_window` that can NOT silently lose scan evidence.
+
+    Checks the shared-patch contract (`sensor_kernel.window_fits`) on
+    device and falls back to the exact per-scan fold (`fuse_scans`) for
+    windows whose poses spread beyond the patch. Callers on the hot path
+    that can guarantee the contract statically (e.g. bench.py's closed
+    trajectory) should call `fuse_scans_window` directly; everyone else —
+    the bridge mapper in particular — uses this.
+    """
+    from jax_mapping.ops import sensor_kernel as SK
+    mean_xy = poses_b[:, :2].mean(axis=0)
+    origin = patch_origin(grid_cfg, mean_xy)
+    return jax.lax.cond(
+        SK.window_fits(grid_cfg, poses_b, origin),
+        lambda args: fuse_scans_window(grid_cfg, scan_cfg, *args),
+        lambda args: fuse_scans(grid_cfg, scan_cfg, *args),
+        (grid_arr, ranges_b, poses_b))
+
+
 def merge_delta(grid_cfg: GridConfig, grid_arr: Array, delta_full: Array) -> Array:
     """Apply a full-size delta (e.g. the psum of a fleet's deltas)."""
     return jnp.clip(grid_arr + delta_full, grid_cfg.logodds_min,
                     grid_cfg.logodds_max)
+
+
+# ---------------------------------------------------------------------------
+# Coarse view (loop-closure wide search)
+# ---------------------------------------------------------------------------
+
+def coarse_grid_config(grid_cfg: GridConfig, factor: int) -> GridConfig:
+    """A GridConfig viewing the same world at `factor`x coarser resolution.
+
+    Same patch cell count — a coarse patch covers factor x the area, which
+    is what lets the correlative matcher sweep slam_toolbox's 8 m loop
+    search window (`slam_config.yaml:56-58`) with the identical dense-conv
+    machinery it uses for the 0.5 m online window.
+    """
+    import dataclasses
+    if grid_cfg.size_cells % factor:
+        raise ValueError(f"size_cells={grid_cfg.size_cells} not divisible "
+                         f"by coarse factor {factor}")
+    size = grid_cfg.size_cells // factor
+    return dataclasses.replace(
+        grid_cfg,
+        size_cells=size,
+        resolution_m=grid_cfg.resolution_m * factor,
+        patch_cells=min(grid_cfg.patch_cells, size),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def downsample_max(grid_arr: Array, factor: int) -> Array:
+    """Log-odds grid -> factor x coarser by block max.
+
+    Max keeps every occupied cell visible at coarse scale (free space may
+    vanish under a wall — conservative for a matcher that is attracted to
+    occupied mass only, `scan_match.likelihood_field`).
+    """
+    n0, n1 = grid_arr.shape
+    return grid_arr.reshape(n0 // factor, factor,
+                            n1 // factor, factor).max(axis=(1, 3))
 
 
 # ---------------------------------------------------------------------------

@@ -85,6 +85,33 @@ def add_edge(g: PoseGraph, i: Array, j: Array, meas: Array,
     )
 
 
+def add_pose_if(g: PoseGraph, pose: Array, enabled: Array) -> PoseGraph:
+    """`add_pose` gated by a traced bool — the vmapped fleet path's
+    per-robot key-scan gate (every robot computes, masked robots no-op)."""
+    i = g.n_poses
+    ok = enabled & (i < g.poses.shape[0])
+    poses = jnp.where(ok, g.poses.at[i].set(pose), g.poses)
+    valid = g.pose_valid.at[i].set(ok | g.pose_valid[i])
+    return g._replace(poses=poses, pose_valid=valid,
+                      n_poses=i + ok.astype(jnp.int32))
+
+
+def add_edge_if(g: PoseGraph, i: Array, j: Array, meas: Array,
+                weight: Array, enabled: Array) -> PoseGraph:
+    """`add_edge` gated by a traced bool (see add_pose_if)."""
+    e = g.n_edges
+    ok = enabled & (e < g.edge_ij.shape[0])
+    ij = jnp.stack([jnp.asarray(i, jnp.int32), jnp.asarray(j, jnp.int32)])
+    return g._replace(
+        edge_ij=jnp.where(ok, g.edge_ij.at[e].set(ij), g.edge_ij),
+        edge_meas=jnp.where(ok, g.edge_meas.at[e].set(meas), g.edge_meas),
+        edge_weight=jnp.where(ok, g.edge_weight.at[e].set(weight),
+                              g.edge_weight),
+        edge_valid=g.edge_valid.at[e].set(ok | g.edge_valid[e]),
+        n_edges=e + ok.astype(jnp.int32),
+    )
+
+
 def odometry_edge(g: PoseGraph, i: Array, j: Array,
                   weight_t: float = 50.0, weight_th: float = 100.0) -> PoseGraph:
     """Constrain j to its current relative pose from i (dead-reckoning link)."""
@@ -100,11 +127,26 @@ def odometry_edge(g: PoseGraph, i: Array, j: Array,
 def loop_candidate(cfg: LoopClosureConfig, g: PoseGraph,
                    query: Array) -> tuple[Array, Array]:
     """For pose index `query`, the nearest old pose within search_radius_m
-    whose index is at least min_chain_size behind. Returns (index, found)."""
+    whose index is at least min_chain_size behind AND whose chain to the
+    query actually LEFT the search radius in between. Returns (index, found).
+
+    The departure requirement is Karto's "near-linked scan" exclusion
+    (slam_toolbox loop search, `slam_config.yaml:43-48`): without it the
+    trailing chain of just-added poses is always the nearest "loop" and a
+    robot driving along closes fake loops onto its own tail. A genuine
+    loop must go away and come back.
+    """
     idx = jnp.arange(g.poses.shape[0])
     d = jnp.linalg.norm(g.poses[:, :2] - g.poses[query, :2], axis=-1)
     old_enough = idx <= query - cfg.min_chain_size
-    ok = g.pose_valid & old_enough & (d <= cfg.search_radius_m)
+    in_chain = g.pose_valid & (idx <= query)
+    # departed[i] = max_{i <= j <= query} d[j] > radius: the trajectory
+    # between candidate i and the query left the search disc (suffix max
+    # via reversed cummax).
+    dm = jnp.where(in_chain, d, -jnp.inf)
+    suffix_max = jax.lax.cummax(dm[::-1])[::-1]
+    departed = suffix_max > cfg.search_radius_m
+    ok = g.pose_valid & old_enough & (d <= cfg.search_radius_m) & departed
     d_masked = jnp.where(ok, d, jnp.inf)
     best = jnp.argmin(d_masked)
     return best.astype(jnp.int32), ok.any()

@@ -116,6 +116,20 @@ def _angle_grid(half: float, step: float) -> jnp.ndarray:
     return jnp.arange(-n, n + 1, dtype=jnp.float32) * step
 
 
+def _pen_dist(m_cfg: MatcherConfig, d2_m2: Array) -> Array:
+    """Karto's distance variance penalty (slam_config.yaml:61): ranking
+    multiplier for candidates offset d from the odometric prior."""
+    return jnp.maximum(m_cfg.min_distance_penalty,
+                       1.0 - 0.2 * d2_m2 / m_cfg.distance_variance_penalty_m2)
+
+
+def _pen_angle(m_cfg: MatcherConfig, dth_rad: Array) -> Array:
+    """Karto's angle variance penalty (slam_config.yaml:62)."""
+    return jnp.maximum(
+        m_cfg.min_angle_penalty,
+        1.0 - 0.2 * dth_rad * dth_rad / m_cfg.angle_variance_penalty_rad2)
+
+
 
 
 def _raster_batch(grid_cfg: GridConfig, scan_cfg: ScanConfig, ranges: Array,
@@ -129,13 +143,20 @@ def _raster_batch(grid_cfg: GridConfig, scan_cfg: ScanConfig, ranges: Array,
     return rasters, mass
 
 
-def _conv_scores(field: Array, rasters: Array, mass: Array,
+def _conv_scores(field: Array, rasters: Array, mass_ref: Array,
                  n_steps: int, stride: int = 1) -> Array:
     """resp[a, sy, sx] = <raster_a, field shifted by ((sy-n)*stride,
-    (sx-n)*stride) cells> normalised by raster mass — the whole correlative
-    window as ONE cross-correlation on the MXU (XLA conv kernels are not
-    flipped, so the conv IS the correlation). `stride` realises
-    MatcherConfig.coarse_step_m in cells."""
+    (sx-n)*stride) cells> / mass_ref — the whole correlative window as ONE
+    cross-correlation on the MXU (XLA conv kernels are not flipped, so the
+    conv IS the correlation). `stride` realises MatcherConfig.coarse_step_m
+    in cells.
+
+    mass_ref is one SHARED scalar denominator for every candidate of a
+    match (the fullest raster's in-patch mass): normalising each candidate
+    by its own mass would hand candidates whose hit band is clipped by the
+    patch edge a smaller denominator and a quietly inflated score. With a
+    shared denominator, clipping can only lower a response — conservative.
+    """
     pad = n_steps * stride
     inp = jnp.pad(field, pad)[None, None]          # (1, 1, P+2p, P+2p)
     ker = rasters[:, None]                          # (A, 1, P, P)
@@ -143,7 +164,7 @@ def _conv_scores(field: Array, rasters: Array, mass: Array,
         inp, ker, window_strides=(stride, stride), padding="VALID",
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         preferred_element_type=jnp.float32)         # (1, A, 2n+1, 2n+1)
-    return out[0] / mass[:, None, None]
+    return out[0] / mass_ref
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
@@ -184,13 +205,23 @@ def match(grid_cfg: GridConfig, scan_cfg: ScanConfig, m_cfg: MatcherConfig,
         (guess_pose[2] + dth_c)[:, None]], axis=1)
     rasters_c, mass_c = _raster_batch(grid_cfg, scan_cfg, ranges, poses_c,
                                       origin)
-    resp_c = _conv_scores(field, rasters_c, mass_c, n_steps, stride)
-    best_c = jnp.argmax(resp_c)
+    # One denominator for the whole match (see _conv_scores): the fullest
+    # candidate raster's mass. Rotations preserve band mass up to clipping,
+    # so this is the scan's unclipped in-patch mass for any candidate.
+    mass_ref = jnp.maximum(jnp.max(mass_c), 1e-6)
+    resp_c = _conv_scores(field, rasters_c, mass_ref, n_steps, stride)
+    # Rank by variance-penalized response (prior-proximity tie-break,
+    # yaml:61-62); gate on the winner's RAW response (Karto semantics).
+    step_m = stride * res
+    offs = jnp.arange(-n_steps, n_steps + 1, dtype=jnp.float32) * step_m
+    d2_c = offs[None, :] ** 2 + offs[:, None] ** 2          # (2n+1, 2n+1)
+    pen_c = _pen_dist(m_cfg, d2_c)[None] * \
+        _pen_angle(m_cfg, dth_c)[:, None, None]
+    best_c = jnp.argmax(resp_c * pen_c)
     ai_c, sy_c, sx_c = jnp.unravel_index(best_c, resp_c.shape)
     coarse_resp = resp_c[ai_c, sy_c, sx_c]
     dth0 = dth_c[ai_c]
     # Shift in metres ((sy, sx) strided steps; row = y, col = x).
-    step_m = stride * res
     shift0 = jnp.stack([(sx_c - n_steps).astype(jnp.float32) * step_m,
                         (sy_c - n_steps).astype(jnp.float32) * step_m])
 
@@ -201,10 +232,15 @@ def match(grid_cfg: GridConfig, scan_cfg: ScanConfig, m_cfg: MatcherConfig,
     poses_f = jnp.concatenate([
         jnp.broadcast_to(guess_pose[:2] + shift0, (A_f, 2)),
         (guess_pose[2] + dth_f)[:, None]], axis=1)
-    rasters_f, mass_f = _raster_batch(grid_cfg, scan_cfg, ranges, poses_f,
-                                      origin)
-    resp_f = _conv_scores(field, rasters_f, mass_f, stride)
-    best_f = jnp.argmax(resp_f)
+    rasters_f, _mass_f = _raster_batch(grid_cfg, scan_cfg, ranges, poses_f,
+                                       origin)
+    resp_f = _conv_scores(field, rasters_f, mass_ref, stride)
+    offs_f = jnp.arange(-stride, stride + 1, dtype=jnp.float32) * res
+    d2_f = (shift0[0] + offs_f[None, :]) ** 2 \
+        + (shift0[1] + offs_f[:, None]) ** 2
+    pen_f = _pen_dist(m_cfg, d2_f)[None] * \
+        _pen_angle(m_cfg, dth_f)[:, None, None]
+    best_f = jnp.argmax(resp_f * pen_f)
     ai_f, sy_f, sx_f = jnp.unravel_index(best_f, resp_f.shape)
     dth1 = dth_f[ai_f]
     shift1 = shift0 + jnp.stack([(sx_f - stride).astype(jnp.float32) * res,
@@ -219,10 +255,11 @@ def match(grid_cfg: GridConfig, scan_cfg: ScanConfig, m_cfg: MatcherConfig,
     poses_s = jnp.concatenate([
         guess_pose[:2] + shift1 + deltas,
         jnp.full((S, 1), guess_pose[2] + dth1)], axis=1)
-    rasters_s, mass_s = _raster_batch(grid_cfg, scan_cfg, ranges, poses_s,
-                                      origin)
-    resp_s = jnp.einsum("bhw,hw->b", rasters_s, field) / mass_s
-    si = jnp.argmax(resp_s)
+    rasters_s, _mass_s = _raster_batch(grid_cfg, scan_cfg, ranges, poses_s,
+                                       origin)
+    resp_s = jnp.einsum("bhw,hw->b", rasters_s, field) / mass_ref
+    d2_s = jnp.sum((shift1[None, :] + deltas) ** 2, axis=-1)
+    si = jnp.argmax(resp_s * _pen_dist(m_cfg, d2_s))
     fine_resp = resp_s[si]
 
     pose = jnp.stack([

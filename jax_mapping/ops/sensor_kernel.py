@@ -173,6 +173,10 @@ def window_delta(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     if P % TILE_R:
         raise ValueError(f"patch_cells={P} not divisible by TILE_R={TILE_R}")
     B = ranges_b.shape[0]
+    if B == 0:
+        # A grid of size 0 would never run the b==0 init step and return
+        # the output buffer uninitialised; an empty window adds nothing.
+        return jnp.zeros((P, P), jnp.float32)
     table = _beam_table(grid_cfg, scan_cfg, ranges_b)
     origin = origin_rc.astype(jnp.int32).reshape(1, 2)
     kernel = _make_kernel(grid_cfg, scan_cfg)
@@ -232,6 +236,8 @@ def _per_scan_call(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     if P % TILE_R:
         raise ValueError(f"patch_cells={P} not divisible by TILE_R={TILE_R}")
     B = ranges_b.shape[0]
+    if B == 0:
+        return jnp.zeros((0, P, P), jnp.float32)
     table = _beam_table(grid_cfg, scan_cfg, ranges_b)
     origins = origins_rc.astype(jnp.int32).reshape(B, 2)
     kernel = _make_kernel(grid_cfg, scan_cfg, accumulate=False, mode=mode)
@@ -260,7 +266,12 @@ def window_fits(grid_cfg: GridConfig, poses_b: Array,
 
     The window kernel silently drops updates outside the shared patch; a
     caller batching scans from a fast-moving robot should check (or chunk
-    by) this. Slack for the default config: (640/2 - 240) * 0.05 = 4 m.
+    by) this — or use `grid.fuse_scans_window_checked`, which falls back
+    to the exact per-scan fold on device. Slack for the default config:
+    (640/2 - 240) * 0.05 = 4 m from the patch CENTRE, but patch-origin
+    alignment (grid.patch_origin) can offset the centre by up to
+    align_cols/2 cells, leaving a worst-case guaranteed slack of
+    (640/2 - 128/2 - 240) * 0.05 = 0.8 m around the mean pose.
     """
     P = grid_cfg.patch_cells
     margin = grid_cfg.max_range_cells

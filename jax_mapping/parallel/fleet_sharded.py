@@ -35,10 +35,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jax_mapping.config import SlamConfig
 from jax_mapping.models.explorer import frontier_policy
+from jax_mapping.models.fleet import _update_graphs
+from jax_mapping.models.slam import _verify_loop
 from jax_mapping.ops import frontier as F
 from jax_mapping.ops import grid as G
+from jax_mapping.ops import posegraph as PG
 from jax_mapping.ops import scan_match as M
-from jax_mapping.ops.odometry import rk2_step
+from jax_mapping.ops.odometry import pose_between, rk2_step, wrap_angle
 from jax_mapping.sim import lidar, thymio
 
 Array = jax.Array
@@ -52,10 +55,22 @@ class ShardedFleetState(NamedTuple):
     est_poses: Array      # (R, 3)   P('fleet', None)
     grid: Array           # (N, N)   P('space', None)
     exploring: Array      # (R,)     P('fleet',)
+    last_key_poses: Array  # (R, 3)  P('fleet', None)
+    graphs: PG.PoseGraph  # per-robot graphs, leading (R,) axis, P('fleet',…)
+    scan_rings: Array     # (R, max_poses, beams) P('fleet', None, None)
+    n_loops: Array        # (R,)     P('fleet')
     t: Array              # ()       replicated
 
 
-def state_specs() -> ShardedFleetState:
+def _fleet_spec(x) -> P:
+    """P('fleet', None, ...) matching a leaf's rank."""
+    return P("fleet", *([None] * (x.ndim - 1)))
+
+
+def state_specs(cfg: SlamConfig) -> ShardedFleetState:
+    graphs0 = PG.empty_graph(cfg.loop)
+    graph_specs = jax.tree.map(
+        lambda leaf: P("fleet", *([None] * leaf.ndim)), graphs0)
     return ShardedFleetState(
         true_poses=P("fleet", None),
         wheel_speeds=P("fleet", None),
@@ -63,6 +78,10 @@ def state_specs() -> ShardedFleetState:
         est_poses=P("fleet", None),
         grid=P("space", None),
         exploring=P("fleet"),
+        last_key_poses=P("fleet", None),
+        graphs=graph_specs,
+        scan_rings=P("fleet", None, None),
+        n_loops=P("fleet"),
         t=P(),
     )
 
@@ -81,28 +100,37 @@ def init_sharded_state(cfg: SlamConfig, mesh: Mesh, seed: int = 0
         est_poses=poses.astype(jnp.float32),
         grid=G.empty_grid(cfg.grid),
         exploring=jnp.ones((R,), bool),
+        last_key_poses=jnp.full((R, 3), 1e9, jnp.float32),
+        graphs=jax.vmap(lambda _: PG.empty_graph(cfg.loop))(jnp.arange(R)),
+        scan_rings=jnp.zeros((R, cfg.loop.max_poses, cfg.scan.padded_beams),
+                             jnp.float32),
+        n_loops=jnp.zeros((R,), jnp.int32),
         t=jnp.int32(0),
     )
-    specs = state_specs()
+    specs = state_specs(cfg)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs,
         is_leaf=lambda x: isinstance(x, (jnp.ndarray, jax.Array)))
 
 
 def _slab_delta(cfg: SlamConfig, scans: Array, poses: Array,
-                slab_row0: Array, slab_rows: int) -> Array:
-    """Per-robot patches -> one (slab_rows, N) delta restricted to this slab.
+                slab_row0: Array, slab_rows: int,
+                mask: Array = None) -> Array:
+    """Per-scan patches -> one (slab_rows, N) delta restricted to this slab.
 
     A patch at global row origin o lands at canvas row o - slab_row0 + Pp
     in a (slab_rows + 2*Pp, N) canvas; non-overlapping patches clip into the
     discarded margins, overlap slices out exactly. Sequential fold keeps
-    overlapping patches deterministic (no scatter)."""
+    overlapping patches deterministic (no scatter). `mask` (B,) zeroes
+    masked scans' contributions (the key-scan gate / ring validity)."""
     g, s = cfg.grid, cfg.scan
     Pp = g.patch_cells
     N = g.size_cells
     origins = jax.vmap(lambda p: G.patch_origin(g, p[:2]))(poses)
     deltas = jax.vmap(
         lambda r, p, o: G.classify_patch(g, s, r, p, o))(scans, poses, origins)
+    if mask is not None:
+        deltas = deltas * mask[:, None, None].astype(deltas.dtype)
 
     canvas = jnp.zeros((slab_rows + 2 * Pp, N), jnp.float32)
 
@@ -166,37 +194,91 @@ def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
             cfg.robot, state.true_poses, state.wheel_speeds, state.keys,
             pol.targets.astype(jnp.float32), dt)
 
-        # 5. Odometry + matching against the gathered full grid.
+        # 5. Odometry + gated matching against the gathered full grid.
         est = jax.vmap(lambda p, w: rk2_step(cfg.robot, p, w[0], w[1], dt))(
             state.est_poses, measured)
         full_grid = jax.lax.all_gather(state.grid, "space", axis=0,
                                        tiled=True)
+        d_trav = jnp.linalg.norm(est[:, :2] - state.last_key_poses[:, :2],
+                                 axis=-1)
+        d_head = jnp.abs(wrap_angle(est[:, 2] - state.last_key_poses[:, 2]))
+        is_key = (d_trav > cfg.matcher.min_travel_m) | \
+            (d_head > cfg.matcher.min_heading_rad)
         res = M.match_batch(cfg.grid, cfg.scan, cfg.matcher, full_grid,
                             scans, est)
-        est = jnp.where(res.accepted[:, None], res.pose, est)
+        est = jnp.where((is_key & res.accepted)[:, None], res.pose, est)
 
-        # 6. Fuse: local robots' slab contributions, psum across the fleet.
-        delta = _slab_delta(cfg, scans, est, slab_row0, slab_rows)
+        # 6. Fuse: local KEY robots' slab contributions, psum over 'fleet'.
+        delta = _slab_delta(cfg, scans, est, slab_row0, slab_rows,
+                            mask=is_key)
         delta = jax.lax.psum(delta, "fleet")
         grid = jnp.clip(state.grid + delta, cfg.grid.logodds_min,
                         cfg.grid.logodds_max)
 
+        # 7. Pose graphs (local robots) + loop closure. The heavy
+        # verification runs under ONE cond whose predicate is psum'd so it
+        # is uniform across the mesh; the branch itself contains NO
+        # collectives (psums happen outside), so the cond cannot deadlock.
+        graphs, rings, k_idx = _update_graphs(cfg, state.graphs, est,
+                                              is_key, scans,
+                                              state.scan_rings)
+        cand, found = jax.vmap(
+            lambda g, q: PG.loop_candidate(cfg.loop, g, q))(graphs, k_idx)
+        attempt = is_key & found & bool(cfg.loop.enabled)
+        any_attempt = jax.lax.psum(attempt.sum(), "fleet") > 0
+        # Ring completeness must agree fleet-wide (see models/fleet
+        # _close_loops on why repair stops after any ring saturates).
+        rings_complete = jax.lax.psum(
+            (graphs.n_poses >= cfg.loop.max_poses).sum(), "fleet") == 0
+
+        def close(args):
+            graphs, est = args
+            graphs3, est2, closed = _verify_and_optimize(
+                cfg, graphs, rings, est, scans, k_idx, cand, attempt)
+            # Local repair slab from this shard's rings (psum'd OUTSIDE —
+            # the cond branches stay collective-free).
+            Rl, cap, beams = rings.shape
+            repair = _slab_delta(
+                cfg, rings.reshape(Rl * cap, beams),
+                graphs3.poses[:, :cap].reshape(Rl * cap, 3), slab_row0,
+                slab_rows, mask=graphs3.pose_valid[:, :cap].reshape(-1))
+            return graphs3, est2, closed, repair
+
+        def skip(args):
+            graphs, est = args
+            zero = jnp.zeros((slab_rows, N), jnp.float32)
+            return graphs, est, jnp.zeros_like(attempt), zero
+
+        graphs, est, closed, repair = jax.lax.cond(
+            any_attempt, close, skip, (graphs, est))
+        any_closed = jax.lax.psum(closed.sum(), "fleet") > 0
+        repair = jax.lax.psum(repair, "fleet")
+        grid = jnp.where(any_closed & rings_complete,
+                         jnp.clip(repair, cfg.grid.logodds_min,
+                                  cfg.grid.logodds_max), grid)
+
+        last_key = jnp.where(is_key[:, None], est, state.last_key_poses)
         state2 = ShardedFleetState(
             true_poses=tp, wheel_speeds=ws, keys=keys, est_poses=est,
-            grid=grid, exploring=state.exploring, t=state.t + 1)
+            grid=grid, exploring=state.exploring, last_key_poses=last_key,
+            graphs=graphs, scan_rings=rings,
+            n_loops=state.n_loops + closed.astype(jnp.int32),
+            t=state.t + 1)
         # Scalar fleet metrics (psum'd so they are true fleet aggregates).
         err = jnp.sum(jnp.linalg.norm(est[:, :2] - tp[:, :2], axis=-1))
         err = jax.lax.psum(err, "fleet") / R
         resp = jax.lax.psum(jnp.sum(res.response), "fleet") / R
+        n_loops_total = jax.lax.psum(state2.n_loops.sum(), "fleet")
         metrics = {"mean_pose_err_m": err, "mean_match_response": resp,
-                   "n_clusters": jnp.sum(fr.sizes > 0)}
+                   "n_clusters": jnp.sum(fr.sizes > 0),
+                   "n_loops": n_loops_total}
         return state2, metrics
 
-    specs = state_specs()
+    specs = state_specs(cfg)
     sharded = jax.shard_map(
         step, mesh=mesh,
         in_specs=(specs, P(None, None)),
         out_specs=(specs, {"mean_pose_err_m": P(), "mean_match_response": P(),
-                           "n_clusters": P()}),
+                           "n_clusters": P(), "n_loops": P()}),
         check_vma=False)
     return jax.jit(sharded)

@@ -44,11 +44,13 @@ def test_capacity_overflow_is_noop():
 
 def test_loop_candidate_gating(cfg):
     g = PG.empty_graph(cfg)
-    # A loop trajectory: 20 poses around a circle of radius 1 -> pose 19
-    # is close to pose 0 but far in index.
+    # A loop trajectory: 20 poses around a circle of radius 2.5 (diameter
+    # 5 m > the 3 m search radius, so the chain genuinely DEPARTS) ->
+    # pose 19 is close to pose 0 but far in index.
     for i in range(20):
         a = 2 * np.pi * i / 20
-        g = PG.add_pose(g, jnp.array([np.cos(a), np.sin(a), a], jnp.float32))
+        g = PG.add_pose(g, jnp.array([2.5 * np.cos(a), 2.5 * np.sin(a), a],
+                                     jnp.float32))
     idx, found = PG.loop_candidate(cfg, g, jnp.int32(19))
     assert bool(found)
     assert int(idx) == 0           # nearest old-enough pose
@@ -56,6 +58,28 @@ def test_loop_candidate_gating(cfg):
     # the chain gate (>=10 behind) excludes everything.
     idx, found = PG.loop_candidate(cfg, g, jnp.int32(5))
     assert not bool(found)
+
+
+def test_loop_candidate_excludes_near_linked_tail(cfg):
+    """Karto's near-linked exclusion: a robot creeping along a line keeps
+    its whole tail within the search radius — those are NOT loops."""
+    g = PG.empty_graph(cfg)
+    for i in range(20):
+        g = PG.add_pose(g, jnp.array([0.1 * i, 0.0, 0.0], jnp.float32))
+    # Pose 19 is 1.9 m from pose 0: inside the 3 m radius, >= 10 behind,
+    # but the chain never left the disc -> no candidate.
+    _idx, found = PG.loop_candidate(cfg, g, jnp.int32(19))
+    assert not bool(found)
+
+    # Extend the line beyond the radius and drive back near the start:
+    # now the chain departed and returning DOES yield pose 0.
+    for i in range(20, 45):
+        g = PG.add_pose(g, jnp.array([0.2 * (i - 20) + 2.0, 0.0, 0.0],
+                                     jnp.float32))
+    g = PG.add_pose(g, jnp.array([0.05, 0.0, 0.0], jnp.float32))  # back home
+    idx, found = PG.loop_candidate(cfg, g, g.n_poses - 1)
+    assert bool(found)
+    assert int(idx) <= 10
 
 
 def test_gn_recovers_noisy_loop(cfg, rng):
