@@ -35,7 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jax_mapping.config import SlamConfig
 from jax_mapping.models.explorer import frontier_policy
-from jax_mapping.models.fleet import _update_graphs
+from jax_mapping.models.fleet import _update_graphs, _verify_and_optimize
 from jax_mapping.models.slam import _verify_loop
 from jax_mapping.ops import frontier as F
 from jax_mapping.ops import grid as G
