@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from jax_mapping.config import GridConfig, ScanConfig
+from jax_mapping.ops import trig
 
 Array = jax.Array
 
@@ -148,7 +149,10 @@ def patch_geometry(grid: GridConfig, scan_cfg: ScanConfig, pose: Array,
     r_cell = jnp.sqrt(dx * dx + dy * dy)                    # (P,P) metres
 
     # Bearing of the cell in the sensor frame, wrapped to [0, 2*pi).
-    theta = jnp.arctan2(dy, dx) - pose[2]
+    # trig.atan2 (not jnp.arctan2) so beam assignment matches the Pallas
+    # kernel bit-for-bit — Mosaic can't lower atan2, and the two engines
+    # must not disagree on boundary cells.
+    theta = trig.atan2(dy, dx) - pose[2]
     if not scan_cfg.counterclockwise:
         theta = -theta
     theta = jnp.mod(theta - scan_cfg.angle_min_rad, 2.0 * jnp.pi)

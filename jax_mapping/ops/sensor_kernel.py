@@ -41,32 +41,64 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from jax_mapping.config import GridConfig, ScanConfig
+from jax_mapping.ops import trig
 
 Array = jax.Array
 
-# Rows of the patch strip each grid step computes. The one-hot intermediate
-# is (TILE_R * P, BEAMS) float32 in VMEM: 4 * 640 * 512 * 4B ~= 5.2 MB for
-# the full-size config — comfortably under the ~16 MB VMEM budget with the
-# output tile and table alongside.
-TILE_R = 4
+# Rows of the patch strip each grid step computes. Mosaic requires the
+# output block's sublane dim to be a multiple of 8. The one-hot
+# intermediate is (TILE_R * P, BEAMS) bfloat16 in VMEM: 8 * 640 * 512 * 2B
+# ~= 5.2 MB for the full-size config — inside the ~16 MB VMEM budget with
+# the output tile and table alongside.
+TILE_R = 8
 _TABLE_COLS = 8          # [carve, z, hit, 0...] padded to a lane-friendly 8
+
+
+def _bf16x3(x: Array):
+    """Exact f32 -> (hi, mid, lo) bf16 triple: hi + mid + lo == x.
+
+    The MXU multiplies f32 operands by truncating them to bf16 at default
+    precision (measured: max err = bf16 ulp), which perturbs table VALUES
+    coming out of the one-hot contraction and flips hit-band comparisons.
+    Splitting each value into three bf16 components (8 significand bits
+    each, 24 total = f32) keeps the contraction single-pass per column
+    while the f32 accumulator reconstructs the exact value — the one-hot
+    side is 0/1, exact in bf16, so one pass per component is all needed.
+
+    The split masks mantissa bits instead of round-tripping f32->bf16->f32:
+    XLA's excess-precision pass elides the convert pair on TPU (measured:
+    residuals collapse to zero and the table degrades to single-bf16), and
+    a bitmask is not a convert so it survives. Truncation toward zero makes
+    each component's sub-word exact, so hi + mid + lo == x bit-for-bit.
+    """
+    def trunc(v):
+        bits = jax.lax.bitcast_convert_type(v, jnp.uint32)
+        part = jax.lax.bitcast_convert_type(
+            bits & jnp.uint32(0xFFFF0000), jnp.float32)
+        # part's low mantissa bits are zero -> bf16 conversion is exact.
+        return part.astype(jnp.bfloat16), v - part
+    hi, r1 = trunc(x)
+    mid, r2 = trunc(r1)
+    lo, _ = trunc(r2)
+    return hi, mid, lo
 
 
 def _beam_table(grid_cfg: GridConfig, scan_cfg: ScanConfig,
                 ranges_b: Array) -> Array:
-    """(B, BEAMS) raw ranges -> (B, BEAMS, 8) f32 lookup table.
+    """(B, BEAMS) raw ranges -> (B, BEAMS, 8) bf16 lookup table.
 
-    Columns: 0 = carve distance (free-space limit), 1 = hit range z,
-    2 = hit flag. Sanitize semantics identical to grid.sanitize_ranges.
+    Columns: 0-2 = carve distance (free-space limit) bf16x3, 3-5 = hit
+    range z bf16x3, 6 = hit flag. Sanitize semantics identical to
+    grid.sanitize_ranges.
     """
     from jax_mapping.ops.grid import sanitize_ranges
     r_m, hit = jax.vmap(lambda r: sanitize_ranges(scan_cfg, r))(ranges_b)
     carve = jnp.minimum(jnp.where(r_m > 0.0, r_m, 0.0),
                         jnp.float32(grid_cfg.max_range_m))
-    cols = [carve, r_m, hit.astype(jnp.float32)]
-    zeros = jnp.zeros_like(carve)
+    cols = [*_bf16x3(carve), *_bf16x3(r_m), hit.astype(jnp.bfloat16)]
+    zeros = jnp.zeros_like(carve, dtype=jnp.bfloat16)
     table = jnp.stack(cols + [zeros] * (_TABLE_COLS - len(cols)), axis=-1)
-    return table.astype(jnp.float32)
+    return table
 
 
 def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig,
@@ -87,18 +119,25 @@ def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     ccw = scan_cfg.counterclockwise
 
     def kernel(table_ref, pose_ref, origin_ref, out_ref):
+        # pose/origin ride whole-array in SMEM (Mosaic rejects sub-row
+        # blocks over a (B, 3) array: block last-two dims must tile to
+        # (8, 128) or equal the array's); the kernel picks its scan's row
+        # with the grid index instead of a BlockSpec.
         b = pl.program_id(1)
         t = pl.program_id(0)
 
-        px = pose_ref[0, 0]
-        py = pose_ref[0, 1]
-        yaw = pose_ref[0, 2]
-        row0 = origin_ref[0, 0]
-        col0 = origin_ref[0, 1]
+        px = pose_ref[b, 0]
+        py = pose_ref[b, 1]
+        yaw = pose_ref[b, 2]
+        row0 = origin_ref[b, 0]
+        col0 = origin_ref[b, 1]
 
         # Cell-centre world coords for this (TILE_R, P) strip.
-        rr = jax.lax.broadcasted_iota(jnp.float32, (TILE_R, P), 0)
-        cc = jax.lax.broadcasted_iota(jnp.float32, (TILE_R, P), 1)
+        # Mosaic only lowers integer iota; cast after.
+        rr = jax.lax.broadcasted_iota(jnp.int32, (TILE_R, P), 0).astype(
+            jnp.float32)
+        cc = jax.lax.broadcasted_iota(jnp.int32, (TILE_R, P), 1).astype(
+            jnp.float32)
         gr = (row0 + t * TILE_R).astype(jnp.float32) + rr
         gc = col0.astype(jnp.float32) + cc
         y = (gr + 0.5) * res + oy
@@ -107,7 +146,7 @@ def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig,
         dy = y - py
         r_cell = jnp.sqrt(dx * dx + dy * dy)
 
-        theta = jnp.arctan2(dy, dx) - yaw
+        theta = trig.atan2(dy, dx) - yaw
         if not ccw:
             theta = -theta
         theta = theta - scan_cfg.angle_min_rad
@@ -118,17 +157,19 @@ def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig,
                   else beam_raw <= n_beams - 1)
 
         # z / carve / hit lookup as an MXU contraction; the one-hot only
-        # ever exists in VMEM.
+        # ever exists in VMEM. bf16 operands, f32 accumulate: the one-hot
+        # is exact in bf16 and the table columns are bf16x3 components, so
+        # the reconstructed values are exact f32 (see _bf16x3).
         bi = jax.lax.broadcasted_iota(jnp.int32, (TILE_R, P, beams), 2)
-        oh = (beam[:, :, None] == bi).astype(jnp.float32)
+        oh = (beam[:, :, None] == bi).astype(jnp.bfloat16)
         looked = jax.lax.dot_general(
             oh.reshape(TILE_R * P, beams), table_ref[0],
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ).reshape(TILE_R, P, _TABLE_COLS)
-        carve = looked[:, :, 0]
-        z = looked[:, :, 1]
-        beam_hit = (looked[:, :, 2] > 0.5) & in_fov
+        carve = looked[:, :, 0] + looked[:, :, 1] + looked[:, :, 2]
+        z = looked[:, :, 3] + looked[:, :, 4] + looked[:, :, 5]
+        beam_hit = (looked[:, :, 6] > 0.5) & in_fov
 
         if mode == "delta":
             free = ((r_cell < carve - tol)
@@ -178,7 +219,8 @@ def window_delta(grid_cfg: GridConfig, scan_cfg: ScanConfig,
         # the output buffer uninitialised; an empty window adds nothing.
         return jnp.zeros((P, P), jnp.float32)
     table = _beam_table(grid_cfg, scan_cfg, ranges_b)
-    origin = origin_rc.astype(jnp.int32).reshape(1, 2)
+    origin = jnp.broadcast_to(
+        origin_rc.astype(jnp.int32).reshape(1, 2), (B, 2))
     kernel = _make_kernel(grid_cfg, scan_cfg)
     interpret = jax.default_backend() != "tpu"
     return pl.pallas_call(
@@ -187,10 +229,8 @@ def window_delta(grid_cfg: GridConfig, scan_cfg: ScanConfig,
         in_specs=[
             pl.BlockSpec((1, scan_cfg.padded_beams, _TABLE_COLS),
                          lambda t, b: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 3), lambda t, b: (b, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 2), lambda t, b: (0, 0),
-                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((TILE_R, P), lambda t, b: (t, 0),
                                memory_space=pltpu.VMEM),
@@ -248,10 +288,8 @@ def _per_scan_call(grid_cfg: GridConfig, scan_cfg: ScanConfig,
         in_specs=[
             pl.BlockSpec((1, scan_cfg.padded_beams, _TABLE_COLS),
                          lambda t, b: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 3), lambda t, b: (b, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 2), lambda t, b: (b, 0),
-                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, TILE_R, P), lambda t, b: (b, t, 0),
                                memory_space=pltpu.VMEM),

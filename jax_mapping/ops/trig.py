@@ -1,0 +1,49 @@
+"""Polynomial atan2 usable inside Pallas TPU kernels.
+
+Mosaic (the Pallas TPU compiler) has no lowering for `atan2`, so the
+inverse-sensor kernel computes each cell's bearing with a polynomial
+instead. The XLA classify path (`ops/grid.py`) uses the SAME function so
+the Pallas and XLA formulations of the sensor model agree bit-for-bit on
+beam assignment — a cell exactly on a beam boundary must not flip beams
+depending on which engine fused it.
+
+Accuracy: max error ~3.4e-7 rad in float32 (the degree-8 core fit of
+atan(a)/a in s = a^2 on Chebyshev nodes over a in [0, 1] is 9.8e-8; the
+octant-reduction subtractions add f32 rounding on top). The LD06's beam
+pitch is 2*pi/512 ~= 1.2e-2 rad
+(`/root/reference/pi/src/.../launch/pi_hardware.launch.py:20` publishes
+full-circle scans), so the approximation error is ~5 orders of magnitude
+below the rounding quantum used for beam assignment.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_HALF_PI = 1.5707963267948966
+_PI = 3.141592653589793
+
+# atan(a)/a ~= sum c_i * (a^2)^i on a in [0, 1]; float32 max err 9.8e-8.
+_C = (1.0, -0.33333138, 0.19993694, -0.14211106, 0.10667487,
+      -0.075569004, 0.043278243, -0.01641319, 0.002932762)
+
+
+def atan2(y, x):
+    """Elementwise atan2(y, x) -> (-pi, pi], polynomial core.
+
+    Matches jnp.arctan2 conventions for signs and the x == y == 0 case
+    (returns 0.0) to within the polynomial error.
+    """
+    ax = jnp.abs(x)
+    ay = jnp.abs(y)
+    mx = jnp.maximum(ax, ay)
+    mn = jnp.minimum(ax, ay)
+    a = mn / jnp.maximum(mx, jnp.float32(1e-30))
+    s = a * a
+    p = jnp.float32(_C[-1])
+    for c in _C[-2::-1]:
+        p = p * s + jnp.float32(c)
+    r = p * a
+    r = jnp.where(ay > ax, _HALF_PI - r, r)
+    r = jnp.where(x < 0.0, _PI - r, r)
+    return jnp.where(y < 0.0, -r, r)

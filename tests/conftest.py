@@ -16,6 +16,7 @@ import sys
 # whole pytest process once with the axon env removed.
 if os.environ.get("PALLAS_AXON_POOL_IPS") and \
         not os.environ.get("_JAX_MAPPING_REEXEC") and \
+        not os.environ.get("JAX_MAPPING_TPU_TESTS") and \
         "pytest" in (sys.argv[0] or ""):
     # Only when launched as a pytest CLI (python -m pytest / pytest binary);
     # programmatic pytest.main() callers have a foreign sys.argv we must not
@@ -28,11 +29,14 @@ if os.environ.get("PALLAS_AXON_POOL_IPS") and \
                + sys.argv[1:], env)
 
 # Force CPU: the ambient environment may pin JAX_PLATFORMS=axon (TPU).
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# JAX_MAPPING_TPU_TESTS=1 opts out so the @skipif(tpu) lowering tests can
+# meet the real chip: `JAX_MAPPING_TPU_TESTS=1 pytest tests/ -k tpu`.
+if not os.environ.get("JAX_MAPPING_TPU_TESTS"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -82,6 +82,35 @@ def test_window_fits_rejects_far_pose(tiny_cfg):
     assert not bool(SK.window_fits(g, jnp.asarray(poses), origin))
 
 
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="needs a real TPU: asserts Mosaic lowering")
+def test_window_delta_lowers_on_tpu(rng):
+    """The flagship kernel must compile (not interpret) on the chip.
+
+    Guards the round-2 regression where Mosaic rejected the SMEM pose
+    BlockSpec and every caller silently ran the XLA fallback. Full-size
+    config on purpose: the production shapes are the ones that must lower.
+    """
+    from jax_mapping.config import SlamConfig
+    cfg = SlamConfig()
+    g, s = cfg.grid, cfg.scan
+    B = 8
+    ranges = rng.uniform(0.1, 8.0, (B, s.padded_beams)).astype(np.float32)
+    ranges[:, s.n_beams:] = 0.0
+    poses = np.tile(np.array([2.0, 1.5, 0.3], np.float32), (B, 1))
+    origin_j = G.patch_origin(g, jnp.asarray(poses[:, :2].mean(0)))
+    out = SK.window_delta(g, s, jnp.asarray(ranges), jnp.asarray(poses),
+                          origin_j)
+    out.block_until_ready()          # raises if Mosaic rejects the kernel
+    assert np.isfinite(np.asarray(out)).all()
+    # Parity with the XLA classify path on the same chip.
+    want = sum(
+        np.asarray(G.classify_patch(g, s, jnp.asarray(ranges[i]),
+                                    jnp.asarray(poses[i]), origin_j))
+        for i in range(B))
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
+
+
 def test_scan_deltas_per_scan_origin_matches_classify(tiny_cfg, rng):
     g, s = tiny_cfg.grid, tiny_cfg.scan
     # Scattered poses: each scan gets its own patch origin.
