@@ -4,30 +4,43 @@ This is the hot op of the whole framework — the capability slam_toolbox's
 C++ rasterizer provides (`/root/reference/server/thymio_project/config/
 slam_config.yaml:26-27`), rebuilt as a TPU kernel. The XLA formulation in
 `ops/grid.py` evaluates the same model but pays for a per-cell gather
-``ranges[beam]`` (measured ~10x the cost of all the geometry math combined:
-XLA lowers the small-table gather to a scalarised loop). Here the lookup is
-an in-VMEM one-hot contraction on the MXU, so the (cells x beams) one-hot
-never touches HBM:
+``ranges[beam]`` that XLA lowers to a scalarised loop (~10x the cost of the
+rest of the model). Here the lookup is a *vector-register gather*:
 
-    grid = (patch_tiles, B_scans)            # scan axis innermost
-    per step: geometry for a (TILE_R x P) strip of scan b's patch (VPU),
-              z/carve/hit lookup = onehot(beam) @ table[b]  (MXU, VMEM),
-              delta accumulated INTO the output tile across all B scans.
+    Mosaic lowers `take_along_axis` along lanes when the gather stays
+    inside one 128-lane vreg. The 512-beam table is packed as 4 chunks of
+    128 lanes; each cell's lookup is 4 in-vreg gathers + selects on the
+    chunk id — ~10 VPU ops/cell, no MXU, no HBM traffic for the table.
 
-The output tile is revisited across the innermost scan axis, so the
-accumulator stays resident in VMEM and each patch tile is written to HBM
-exactly once per batch — total HBM traffic per batch is one (P, P) float32
-patch plus the (B, BEAMS) tables, independent of B's contribution to
-compute. Scans in a batch share one patch origin (a temporal scan window
-from one robot: the reference's LD06 delivers ~10 scans/sec while the robot
-moves ~1 cm/scan, `server/.../main.py:60`), which also replaces the
+The patch strip a grid step computes is laid out as (S, 128) sublane-rows
+of the flattened patch (the natural vreg shape), not (rows, P): the gather
+wants 128-lane tiles, and the flat layout makes every step's block dense.
+The output array is (P*P/128, 128), reshaped to (P, P) by XLA outside the
+kernel.
+
+Two exact compute culls keep the work proportional to what a scan can see:
+  * strip cull — a strip entirely farther from the pose than max_range
+    produces delta == 0 everywhere, so the whole body is skipped
+    (`pl.when`); for a centred pose this skips ~25% of (strip, scan) steps.
+  * the window accumulator is initialised once per tile (b == 0) and only
+    touched by scans that pass the cull.
+
+Performance (v5e single chip, 256-scan window into the 640^2 patch of the
+4096^2 grid): ~8 ms/window = ~32,000 scans/sec — ~44x the one-hot-matmul
+formulation this replaced (the one-hot burned VPU on (cells x beams)
+compares and starved the MXU at 8 of 128 output lanes).
+
+Scans in a batch share one patch origin in `window_delta` (a temporal scan
+window from one robot: the reference's LD06 delivers ~10 scans/sec while
+the robot moves ~1 cm/scan, `server/.../main.py:60`), which replaces the
 sequential per-scan fold of the general path with a single aligned
-read-modify-write.
+read-modify-write of the grid.
 
-Semantics match `ops/grid.classify_patch` (same sanitize rules: zero range
--> invalid 10 m carve, `server/.../main.py:152`; padded beams inert; CCW
-beam convention `pi_hardware.launch.py:20`) — tests hold the two to a
-NumPy oracle.
+Semantics match `ops/grid.classify_patch` exactly (same sanitize rules:
+zero range -> invalid 10 m carve, `server/.../main.py:152`; padded beams
+inert; CCW beam convention `pi_hardware.launch.py:20`; the shared
+`trig.atan2` keeps beam assignment bit-identical across engines) — tests
+hold both to a NumPy oracle, and the TPU parity test runs on hardware.
 """
 
 from __future__ import annotations
@@ -45,70 +58,74 @@ from jax_mapping.ops import trig
 
 Array = jax.Array
 
-# Rows of the patch strip each grid step computes. Mosaic requires the
-# output block's sublane dim to be a multiple of 8. The one-hot
-# intermediate is (TILE_R * P, BEAMS) bfloat16 in VMEM: 8 * 640 * 512 * 2B
-# ~= 5.2 MB for the full-size config — inside the ~16 MB VMEM budget with
-# the output tile and table alongside.
-TILE_R = 8
-_TABLE_COLS = 8          # [carve, z, hit, 0...] padded to a lane-friendly 8
+LANES = 128          # TPU vreg lane count; the in-vreg gather width
+_TARGET_S = 80       # preferred sublane-rows per grid step (16 patch rows)
 
 
-def _bf16x3(x: Array):
-    """Exact f32 -> (hi, mid, lo) bf16 triple: hi + mid + lo == x.
+def _step_rows(grid_cfg: GridConfig) -> int:
+    """Sublane-rows of the flattened patch one grid step computes.
 
-    The MXU multiplies f32 operands by truncating them to bf16 at default
-    precision (measured: max err = bf16 ulp), which perturbs table VALUES
-    coming out of the one-hot contraction and flips hit-band comparisons.
-    Splitting each value into three bf16 components (8 significand bits
-    each, 24 total = f32) keeps the contraction single-pass per column
-    while the f32 accumulator reconstructs the exact value — the one-hot
-    side is 0/1, exact in bf16, so one pass per component is all needed.
-
-    The split masks mantissa bits instead of round-tripping f32->bf16->f32:
-    XLA's excess-precision pass elides the convert pair on TPU (measured:
-    residuals collapse to zero and the table degrades to single-bf16), and
-    a bitmask is not a convert so it survives. Truncation toward zero makes
-    each component's sub-word exact, so hi + mid + lo == x bit-for-bit.
+    Largest multiple of 8 that divides P*P/LANES and is <= _TARGET_S
+    (measured fastest at 80 for the full-size config; 40 and 160 are
+    within ~20%).
     """
-    def trunc(v):
-        bits = jax.lax.bitcast_convert_type(v, jnp.uint32)
-        part = jax.lax.bitcast_convert_type(
-            bits & jnp.uint32(0xFFFF0000), jnp.float32)
-        # part's low mantissa bits are zero -> bf16 conversion is exact.
-        return part.astype(jnp.bfloat16), v - part
-    hi, r1 = trunc(x)
-    mid, r2 = trunc(r1)
-    lo, _ = trunc(r2)
-    return hi, mid, lo
+    P = grid_cfg.patch_cells
+    rows_tot = P * P // LANES
+    s = min(_TARGET_S, rows_tot)
+    # s*LANES % P == 0: the strip cull's band math assumes each step
+    # covers whole patch rows; a fractional-row step would drift the
+    # band and silently cull in-range cells.
+    while s > 8 and (rows_tot % s or s % 8 or (s * LANES) % P):
+        s -= 8
+    if rows_tot % s or (s * LANES) % P:
+        raise ValueError(
+            f"patch_cells={P} incompatible with LANES={LANES} stepping")
+    return s
+
+
+def _check_shapes(grid_cfg: GridConfig, scan_cfg: ScanConfig) -> None:
+    if grid_cfg.patch_cells % LANES:
+        raise ValueError(
+            f"patch_cells={grid_cfg.patch_cells} must be a multiple of "
+            f"{LANES} (vreg lane count)")
+    if scan_cfg.padded_beams % LANES:
+        raise ValueError(
+            f"padded_beams={scan_cfg.padded_beams} must be a multiple of "
+            f"{LANES} (table chunk width)")
 
 
 def _beam_table(grid_cfg: GridConfig, scan_cfg: ScanConfig,
                 ranges_b: Array) -> Array:
-    """(B, BEAMS) raw ranges -> (B, BEAMS, 8) bf16 lookup table.
+    """(B, BEAMS) raw ranges -> (B, 2*NCHUNK, 128) f32 packed table.
 
-    Columns: 0-2 = carve distance (free-space limit) bf16x3, 3-5 = hit
-    range z bf16x3, 6 = hit flag. Sanitize semantics identical to
+    Sublane rows 0..NCHUNK-1 hold the carve distance (free-space limit)
+    split into 128-lane chunks; rows NCHUNK..2*NCHUNK-1 hold the hit range
+    z with the hit flag folded into its sign (z_enc = r_m if hit else -1:
+    sanitized hit ranges are >= range_min > 0, so the sign is a free flag
+    and saves a third lookup). Sanitize semantics identical to
     grid.sanitize_ranges.
     """
     from jax_mapping.ops.grid import sanitize_ranges
+    nchunk = scan_cfg.padded_beams // LANES
+    B = ranges_b.shape[0]
     r_m, hit = jax.vmap(lambda r: sanitize_ranges(scan_cfg, r))(ranges_b)
     carve = jnp.minimum(jnp.where(r_m > 0.0, r_m, 0.0),
                         jnp.float32(grid_cfg.max_range_m))
-    cols = [*_bf16x3(carve), *_bf16x3(r_m), hit.astype(jnp.bfloat16)]
-    zeros = jnp.zeros_like(carve, dtype=jnp.bfloat16)
-    table = jnp.stack(cols + [zeros] * (_TABLE_COLS - len(cols)), axis=-1)
-    return table
+    z_enc = jnp.where(hit, r_m, jnp.float32(-1.0))
+    return jnp.concatenate([
+        carve.reshape(B, nchunk, LANES),
+        z_enc.reshape(B, nchunk, LANES),
+    ], axis=1).astype(jnp.float32)
 
 
-def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig, step_rows: int,
                  accumulate: bool = True, mode: str = "delta"):
     """mode='delta': log-odds inverse sensor model. mode='raster': soft
     scan raster — per cell a triangular weight max(0, 1-|r_cell - z|/res)
     on the hit band (no free-space carving), the correlative matcher's
     continuous-pose rasterizer (ops/scan_match.py)."""
     P = grid_cfg.patch_cells
-    beams = scan_cfg.padded_beams
+    nchunk = scan_cfg.padded_beams // LANES
     res = grid_cfg.resolution_m
     ox, oy = grid_cfg.origin_m
     inc = scan_cfg.angle_increment_rad
@@ -117,14 +134,15 @@ def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     full_circle = abs(n_beams * inc - two_pi) < inc / 2
     tol = grid_cfg.hit_tolerance_cells * res
     ccw = scan_cfg.counterclockwise
+    S = step_rows
+    patch_rows_per_step = S * LANES // P
 
     def kernel(table_ref, pose_ref, origin_ref, out_ref):
-        # pose/origin ride whole-array in SMEM (Mosaic rejects sub-row
-        # blocks over a (B, 3) array: block last-two dims must tile to
-        # (8, 128) or equal the array's); the kernel picks its scan's row
-        # with the grid index instead of a BlockSpec.
-        b = pl.program_id(1)
+        # pose/origin ride whole-array in SMEM; the kernel picks its
+        # scan's row with the grid index instead of a BlockSpec (Mosaic
+        # rejects sub-row blocks over a (B, 3) array).
         t = pl.program_id(0)
+        b = pl.program_id(1)
 
         px = pose_ref[b, 0]
         py = pose_ref[b, 1]
@@ -132,68 +150,88 @@ def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig,
         row0 = origin_ref[b, 0]
         col0 = origin_ref[b, 1]
 
-        # Cell-centre world coords for this (TILE_R, P) strip.
-        # Mosaic only lowers integer iota; cast after.
-        rr = jax.lax.broadcasted_iota(jnp.int32, (TILE_R, P), 0).astype(
-            jnp.float32)
-        cc = jax.lax.broadcasted_iota(jnp.int32, (TILE_R, P), 1).astype(
-            jnp.float32)
-        gr = (row0 + t * TILE_R).astype(jnp.float32) + rr
-        gc = col0.astype(jnp.float32) + cc
-        y = (gr + 0.5) * res + oy
-        x = (gc + 0.5) * res + ox
-        dx = x - px
-        dy = y - py
-        r_cell = jnp.sqrt(dx * dx + dy * dy)
-
-        theta = trig.atan2(dy, dx) - yaw
-        if not ccw:
-            theta = -theta
-        theta = theta - scan_cfg.angle_min_rad
-        theta = theta - two_pi * jnp.floor(theta / two_pi)   # wrap [0, 2pi)
-        beam_raw = jnp.round(theta / inc).astype(jnp.int32)
-        beam = jax.lax.rem(beam_raw, n_beams)
-        in_fov = (jnp.ones_like(r_cell, dtype=jnp.bool_) if full_circle
-                  else beam_raw <= n_beams - 1)
-
-        # z / carve / hit lookup as an MXU contraction; the one-hot only
-        # ever exists in VMEM. bf16 operands, f32 accumulate: the one-hot
-        # is exact in bf16 and the table columns are bf16x3 components, so
-        # the reconstructed values are exact f32 (see _bf16x3).
-        bi = jax.lax.broadcasted_iota(jnp.int32, (TILE_R, P, beams), 2)
-        oh = (beam[:, :, None] == bi).astype(jnp.bfloat16)
-        looked = jax.lax.dot_general(
-            oh.reshape(TILE_R * P, beams), table_ref[0],
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ).reshape(TILE_R, P, _TABLE_COLS)
-        carve = looked[:, :, 0] + looked[:, :, 1] + looked[:, :, 2]
-        z = looked[:, :, 3] + looked[:, :, 4] + looked[:, :, 5]
-        beam_hit = (looked[:, :, 6] > 0.5) & in_fov
-
-        if mode == "delta":
-            free = ((r_cell < carve - tol)
-                    & (r_cell > scan_cfg.range_min_m) & in_fov)
-            occ = (beam_hit & (jnp.abs(r_cell - z) <= tol)
-                   & (r_cell <= grid_cfg.max_range_m))
-            delta = jnp.where(occ, grid_cfg.logodds_occ,
-                              jnp.where(free, grid_cfg.logodds_free, 0.0))
-        else:
-            w = jnp.maximum(0.0, 1.0 - jnp.abs(r_cell - z) / res)
-            keep = beam_hit & (r_cell <= grid_cfg.max_range_m)
-            delta = jnp.where(keep, w, 0.0)
-        delta = delta.astype(jnp.float32)
+        # Strip-level range cull: if every patch row of this step's band
+        # is farther from the pose than max_range, every cell's delta is
+        # 0 and the whole body can be skipped. Exact, not approximate:
+        # free needs r_cell < carve - tol <= max_range and occ needs
+        # r_cell <= max_range, and the vertical row gap lower-bounds
+        # r_cell. One extra cell of slack for the half-cell centre offset.
+        pose_row = (py - oy) / res - 0.5 - row0.astype(jnp.float32)
+        top = (t * patch_rows_per_step).astype(jnp.float32)
+        bot = top + (patch_rows_per_step - 1)
+        gap = jnp.maximum(jnp.maximum(top - pose_row, pose_row - bot), 0.0)
+        near = gap * res <= grid_cfg.max_range_m + res
 
         if accumulate:
             @pl.when(b == 0)
             def _():
-                out_ref[:] = delta
+                out_ref[:] = jnp.zeros_like(out_ref)
 
-            @pl.when(b != 0)
+        def body():
+            # Cell-centre world coords for this (S, LANES) strip of the
+            # flattened patch. Mosaic only lowers integer iota; cast after.
+            ss = jax.lax.broadcasted_iota(jnp.int32, (S, LANES), 0)
+            ll = jax.lax.broadcasted_iota(jnp.int32, (S, LANES), 1)
+            flat = (t * S + ss) * LANES + ll
+            r_i = flat // P
+            c_i = flat - r_i * P
+            y = ((row0 + r_i).astype(jnp.float32) + 0.5) * res + oy
+            x = ((col0 + c_i).astype(jnp.float32) + 0.5) * res + ox
+            dx = x - px
+            dy = y - py
+            r_cell = jnp.sqrt(dx * dx + dy * dy)
+
+            theta = trig.atan2(dy, dx) - yaw
+            if not ccw:
+                theta = -theta
+            theta = theta - scan_cfg.angle_min_rad
+            theta = theta - two_pi * jnp.floor(theta / two_pi)  # [0, 2pi)
+            beam_raw = jnp.round(theta / inc).astype(jnp.int32)
+            beam = jax.lax.rem(beam_raw, n_beams)
+            in_fov = (jnp.ones_like(r_cell, dtype=jnp.bool_) if full_circle
+                      else beam_raw <= n_beams - 1)
+            lo = beam & (LANES - 1)
+            hi = beam // LANES     # same lowering as a shift for 2^n LANES
+
+            def lookup(base):
+                # 4 in-vreg gathers + chunk-id selects = table[beam].
+                acc = jnp.zeros((S, LANES), jnp.float32)
+                for c in range(nchunk):
+                    row = jnp.broadcast_to(
+                        table_ref[0, base + c].reshape(1, LANES), (S, LANES))
+                    got = jnp.take_along_axis(row, lo, axis=1)
+                    acc = got if nchunk == 1 else jnp.where(hi == c, got, acc)
+                return acc
+
+            carve = lookup(0)
+            z = lookup(nchunk)
+            beam_hit = (z > 0.0) & in_fov
+
+            if mode == "delta":
+                free = ((r_cell < carve - tol)
+                        & (r_cell > scan_cfg.range_min_m) & in_fov)
+                occ = (beam_hit & (jnp.abs(r_cell - z) <= tol)
+                       & (r_cell <= grid_cfg.max_range_m))
+                delta = jnp.where(occ, grid_cfg.logodds_occ,
+                                  jnp.where(free, grid_cfg.logodds_free, 0.0))
+            else:
+                w = jnp.maximum(0.0, 1.0 - jnp.abs(r_cell - z) / res)
+                keep = beam_hit & (r_cell <= grid_cfg.max_range_m)
+                delta = jnp.where(keep, w, 0.0)
+            return delta.astype(jnp.float32)
+
+        if accumulate:
+            @pl.when(near)
             def _():
-                out_ref[:] = out_ref[:] + delta
+                out_ref[:] = out_ref[:] + body()
         else:
-            out_ref[0] = delta
+            @pl.when(near)
+            def _():
+                out_ref[0] = body()
+
+            @pl.when(jnp.logical_not(near))
+            def _():
+                out_ref[0] = jnp.zeros_like(out_ref[0])
 
     return kernel
 
@@ -210,33 +248,36 @@ def window_delta(grid_cfg: GridConfig, scan_cfg: ScanConfig,
         grid.patch_origin). Every pose must lie within
         patch/2 - max_range_cells of the patch centre (`window_fits`).
     """
+    _check_shapes(grid_cfg, scan_cfg)
     P = grid_cfg.patch_cells
-    if P % TILE_R:
-        raise ValueError(f"patch_cells={P} not divisible by TILE_R={TILE_R}")
+    S = _step_rows(grid_cfg)
     B = ranges_b.shape[0]
     if B == 0:
         # A grid of size 0 would never run the b==0 init step and return
         # the output buffer uninitialised; an empty window adds nothing.
         return jnp.zeros((P, P), jnp.float32)
+    nchunk = scan_cfg.padded_beams // LANES
     table = _beam_table(grid_cfg, scan_cfg, ranges_b)
     origin = jnp.broadcast_to(
         origin_rc.astype(jnp.int32).reshape(1, 2), (B, 2))
-    kernel = _make_kernel(grid_cfg, scan_cfg)
+    kernel = _make_kernel(grid_cfg, scan_cfg, S)
+    rows_tot = P * P // LANES
     interpret = jax.default_backend() != "tpu"
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        grid=(P // TILE_R, B),
+        grid=(rows_tot // S, B),
         in_specs=[
-            pl.BlockSpec((1, scan_cfg.padded_beams, _TABLE_COLS),
-                         lambda t, b: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2 * nchunk, LANES), lambda t, b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((TILE_R, P), lambda t, b: (t, 0),
+        out_specs=pl.BlockSpec((S, LANES), lambda t, b: (t, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((P, P), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((rows_tot, LANES), jnp.float32),
         interpret=interpret,
     )(table, poses_b.astype(jnp.float32), origin)
+    return out.reshape(P, P)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
@@ -246,9 +287,7 @@ def scan_deltas(grid_cfg: GridConfig, scan_cfg: ScanConfig,
 
     The general-pose counterpart of `window_delta` (same kernel body, no
     cross-scan accumulation): feeds the sequential exact fold in
-    `grid.fuse_scans` when poses are scattered. On TPU this replaces the
-    XLA classify path whose per-cell `ranges[beam]` gather dominates its
-    runtime.
+    `grid.fuse_scans` when poses are scattered.
     """
     return _per_scan_call(grid_cfg, scan_cfg, ranges_b, poses_b, origins_rc,
                           mode="delta")
@@ -272,30 +311,33 @@ def scan_rasters(grid_cfg: GridConfig, scan_cfg: ScanConfig,
 def _per_scan_call(grid_cfg: GridConfig, scan_cfg: ScanConfig,
                    ranges_b: Array, poses_b: Array, origins_rc: Array,
                    mode: str) -> Array:
+    _check_shapes(grid_cfg, scan_cfg)
     P = grid_cfg.patch_cells
-    if P % TILE_R:
-        raise ValueError(f"patch_cells={P} not divisible by TILE_R={TILE_R}")
+    S = _step_rows(grid_cfg)
     B = ranges_b.shape[0]
     if B == 0:
         return jnp.zeros((0, P, P), jnp.float32)
+    nchunk = scan_cfg.padded_beams // LANES
     table = _beam_table(grid_cfg, scan_cfg, ranges_b)
     origins = origins_rc.astype(jnp.int32).reshape(B, 2)
-    kernel = _make_kernel(grid_cfg, scan_cfg, accumulate=False, mode=mode)
+    kernel = _make_kernel(grid_cfg, scan_cfg, S, accumulate=False, mode=mode)
+    rows_tot = P * P // LANES
     interpret = jax.default_backend() != "tpu"
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        grid=(P // TILE_R, B),
+        grid=(rows_tot // S, B),
         in_specs=[
-            pl.BlockSpec((1, scan_cfg.padded_beams, _TABLE_COLS),
-                         lambda t, b: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2 * nchunk, LANES), lambda t, b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((1, TILE_R, P), lambda t, b: (b, t, 0),
+        out_specs=pl.BlockSpec((1, S, LANES), lambda t, b: (b, t, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, P, P), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((B, rows_tot, LANES), jnp.float32),
         interpret=interpret,
     )(table, poses_b.astype(jnp.float32), origins)
+    return out.reshape(B, P, P)
 
 
 def window_fits(grid_cfg: GridConfig, poses_b: Array,
