@@ -190,6 +190,13 @@ class FrontierConfig:
     # Obstacle-aware BFS costs (accurate, heavier) vs Euclidean centroid
     # distance (cheap; what the <5 ms @ 64 robots latency budget buys).
     obstacle_aware: bool = True
+    # Obstacle-aware engine: multigrid cost fields (ops/costfield.py) —
+    # upper-bound costs, narrow corridors (< 2 coarse cells) may stay
+    # overestimated within the refinement budget. exact_bfs=True restores
+    # the full-diameter single-level dilation (slow; bfs_iters bound).
+    exact_bfs: bool = False
+    mg_levels: int = 3                # multigrid resolutions
+    mg_refine_iters: int = 8          # doubled sweeps per refinement level
 
 
 @_frozen

@@ -1,0 +1,249 @@
+"""Batched obstacle-aware cost-to-go fields: multigrid min-plus on TPU.
+
+The frontier auction needs geodesic-ish travel costs from every robot to
+every frontier cluster (`ops/frontier.py`). The round-2 formulation ran a
+full-diameter min-plus dilation per robot at the clustering resolution —
+`bfs_iters` x 2 sweeps x 8 XLA shift ops over the whole grid, 173 ms at 64
+robots (VERDICT r2). Two structural fixes:
+
+  * **Multigrid**: solve the field at the coarsest level (where the map
+    diameter is only ~n/4 cells, so full convergence is cheap), then
+    upsample as an upper-bound initialiser and run a few refinement sweeps
+    per finer level. Min-plus relaxation converges monotonically downward,
+    so the initialiser must never underestimate: coarse passability pools
+    conservatively (any blocked child blocks the parent), which makes every
+    coarse path a valid fine path, and the upsample adds a +2c slack for
+    discretisation. Costs remain upper bounds at every iteration count —
+    a robot never underpays for a far cluster, which is the safe direction
+    for assignment. Narrow corridors (< 2 coarse cells wide) stay
+    overestimated unless the refinement budget reaches them; the exact
+    single-level path (`frontier.cost_to_go`) remains for callers that
+    need it.
+  * **Pallas relaxation kernel**: the fields for a chunk of robots live in
+    VMEM across ALL iterations of a level — HBM sees one read of the
+    blocked mask and one write of the finished fields, instead of 16
+    materialised full-grid arrays per sweep. Off-TPU the same kernel runs
+    in interpret mode (tests), and `JAX_MAPPING_NO_PALLAS=1` selects a
+    pure-XLA twin (`_relax_level_xla`, parity-tested).
+
+Units: distances are in cells of the level the call runs at; the caller
+scales to physical units. Blocked cells and unreachable cells hold _BIG.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+# Python floats (not jnp scalars): the Pallas kernel body closes over
+# these, and traced-array constants cannot be captured by a kernel.
+_BIG = 1e9
+_SQ2 = 1.41421356
+
+# VMEM budget for one chunk of per-robot fields (bytes); the chunk size is
+# chosen so chunk * n * n * 4 stays under it with room for the mask and
+# the shift temporaries.
+_FIELD_VMEM_BYTES = 4 * 1024 * 1024
+
+
+def _chunk_robots(n: int, n_robots: int) -> int:
+    """Fields per Pallas grid step; the caller pads n_robots up to a
+    multiple (a prime robot count must not collapse the chunk to 1)."""
+    return max(1, min(_FIELD_VMEM_BYTES // (n * n * 4), n_robots))
+
+
+def _relax_once(d: Array, blocked: Array) -> Array:
+    """One 8-neighbour min-plus sweep on (..., n, n); jnp ops only so the
+    same body lowers inside the Pallas kernel and traces as plain XLA."""
+    n = d.shape[-1]
+
+    def sh(x, dr, dc):
+        # Static-slice shift with _BIG fill, along the last two axes.
+        if dr:
+            fill = jnp.full_like(x[..., :1, :], _BIG)
+            x = (jnp.concatenate([fill, x[..., :-1, :]], axis=-2) if dr > 0
+                 else jnp.concatenate([x[..., 1:, :], fill], axis=-2))
+        if dc:
+            fill = jnp.full_like(x[..., :, :1], _BIG)
+            x = (jnp.concatenate([fill, x[..., :, :-1]], axis=-1) if dc > 0
+                 else jnp.concatenate([x[..., :, 1:], fill], axis=-1))
+        return x
+
+    best = d
+    for dr, dc, w in ((1, 0, 1.0), (-1, 0, 1.0), (0, 1, 1.0), (0, -1, 1.0),
+                      (1, 1, _SQ2), (1, -1, _SQ2),
+                      (-1, 1, _SQ2), (-1, -1, _SQ2)):
+        best = jnp.minimum(best, sh(d, dr, dc) + w)
+    return jnp.where(blocked, _BIG, best)
+
+
+def _relax_level_xla(blocked: Array, init: Array, iters: int) -> Array:
+    """(C, n, n) init -> relaxed fields after `iters` doubled sweeps."""
+    blk = blocked[None, :, :]
+    return jax.lax.fori_loop(
+        0, iters, lambda _, d: _relax_once(_relax_once(d, blk), blk), init)
+
+
+def _relax_level_pallas(blocked: Array, init: Array, iters: int) -> Array:
+    """Pallas twin of `_relax_level_xla`: fields stay in VMEM across all
+    iterations; robots are chunked to fit the VMEM budget."""
+    R, n, _ = init.shape
+    C = _chunk_robots(n, R)
+    pad = (-R) % C
+    if pad:
+        init = jnp.concatenate(
+            [init, jnp.full((pad, n, n), _BIG, init.dtype)], axis=0)
+    Rp = R + pad
+
+    def kernel(blocked_ref, init_ref, out_ref):
+        blk = blocked_ref[:] > 0.5
+        d = jax.lax.fori_loop(
+            0, iters,
+            lambda _, dm: _relax_once(_relax_once(dm, blk), blk),
+            init_ref[:])
+        out_ref[:] = d
+
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        kernel,
+        grid=(Rp // C,),
+        in_specs=[
+            pl.BlockSpec((n, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, n, n), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((C, n, n), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Rp, n, n), jnp.float32),
+        interpret=interpret,
+    )(blocked.astype(jnp.float32), init)
+    return out[:R] if pad else out
+
+
+def _use_pallas() -> bool:
+    """Pallas on TPU unless JAX_MAPPING_NO_PALLAS=1; the XLA twin
+    elsewhere (interpret-mode Pallas is far slower than XLA on CPU —
+    tests exercise the kernel explicitly via _relax_level_pallas)."""
+    import os
+    return (jax.default_backend() == "tpu"
+            and os.environ.get("JAX_MAPPING_NO_PALLAS") != "1")
+
+
+def _relax_level(blocked: Array, init: Array, iters: int) -> Array:
+    if _use_pallas():
+        return _relax_level_pallas(blocked, init, iters)
+    return _relax_level_xla(blocked, init, iters)
+
+
+def _pool_blocked(blocked: Array) -> Array:
+    """2x conservative pooling: a parent is blocked if ANY child is.
+
+    Guarantees every coarse path exists at fine resolution, which is what
+    makes the upsampled coarse solution an upper bound."""
+    n0, n1 = blocked.shape
+    return blocked.reshape(n0 // 2, 2, n1 // 2, 2).any(axis=(1, 3))
+
+
+def _seed(init: Array, robot_rc: Array, blocked: Array,
+          neighbours: bool) -> Array:
+    """Seed each robot's own field at one level.
+
+    The seed cell gets 0 only where it is OPEN at this level: the
+    relaxation re-applies the shared blocked mask every sweep, so a 0 in
+    a blocked cell cannot propagate — and it must NOT be made to (opening
+    a blocked cell that straddles a wall at coarse resolution would let
+    distance flow through the wall; that is true even within the robot's
+    own field, so neither the shared mask nor a per-field mask may be
+    punched open).
+
+    `neighbours=True` (finest level only): a wall-hugging robot whose
+    fine cell is conservatively blocked instead seeds its OPEN 8-neighbour
+    cells with their one-step costs — at the level whose cells the robot
+    physically occupies this is exact, while at coarser levels a
+    neighbouring cell can sit across the wall. The cost of this
+    conservatism: a wall-hugger forfeits the multigrid head start, so its
+    field only covers 2*refine_iters cells around it (an overestimate
+    beyond — the safe direction for the auction; far frontiers go to
+    robots in open space)."""
+    R = init.shape[0]
+    n = init.shape[-1]
+    rr = jnp.clip(robot_rc[:, 0], 0, n - 1)
+    cc = jnp.clip(robot_rc[:, 1], 0, n - 1)
+    ar = jnp.arange(R)
+    seed_open = ~blocked[rr, cc]
+    init = init.at[ar, rr, cc].min(jnp.where(seed_open, 0.0, _BIG))
+    if neighbours:
+        for dr, dc, w in ((1, 0, 1.0), (-1, 0, 1.0), (0, 1, 1.0),
+                          (0, -1, 1.0), (1, 1, _SQ2), (1, -1, _SQ2),
+                          (-1, 1, _SQ2), (-1, -1, _SQ2)):
+            r2 = rr + dr
+            c2 = cc + dc
+            inb = (r2 >= 0) & (r2 < n) & (c2 >= 0) & (c2 < n)
+            r2c = jnp.clip(r2, 0, n - 1)
+            c2c = jnp.clip(c2, 0, n - 1)
+            val = jnp.where(inb & ~blocked[r2c, c2c], jnp.float32(w),
+                            jnp.float32(_BIG))
+            init = init.at[ar, r2c, c2c].min(val)
+    return init
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def cost_fields(blocked: Array, robot_rc: Array, levels: int = 3,
+                refine_iters: int = 8) -> Array:
+    """(n, n) blocked mask + (R, 2) robot cells -> (R, n, n) cost fields.
+
+    Multigrid: `levels` resolutions, full-convergence relaxation at the
+    coarsest (diameter-bounded), `refine_iters` doubled sweeps per finer
+    level from the upsampled upper-bound initialiser. Distances in cells
+    of the FINEST level; robots' own cells are forced open (see
+    frontier.cost_to_go for why).
+    """
+    n = blocked.shape[0]
+    R = robot_rc.shape[0]
+    # Each pooling halves the grid, so n must be divisible by
+    # 2^(levels-1); clamp instead of crashing at trace time for grids
+    # with limited 2-divisibility (e.g. n=62 supports only 2 levels).
+    max_levels = 1
+    while n % (1 << max_levels) == 0 and (n >> max_levels) >= 8:
+        max_levels += 1
+    levels = max(1, min(levels, 6, max_levels))
+
+    blocked_pyr = [blocked]
+    for _ in range(levels - 1):
+        blocked_pyr.append(_pool_blocked(blocked_pyr[-1]))
+
+    rc_pyr = [robot_rc // (1 << lv) for lv in range(levels)]
+
+    # Coarsest level: full-diameter convergence. The doubled sweep moves
+    # the wavefront 2 cells per iteration; the diameter of an n_c x n_c
+    # grid along an 8-connected path is <= n_c (worst-case serpentine maps
+    # need more, but those are exactly what the exact path is for).
+    n_c = n >> (levels - 1)
+    blk_c = blocked_pyr[-1]
+    init = _seed(jnp.full((R, n_c, n_c), _BIG), rc_pyr[-1], blk_c,
+                 neighbours=(levels == 1))
+    d = _relax_level(blk_c, init, iters=max(1, n_c // 2))
+
+    for lv in range(levels - 2, -1, -1):
+        # Upsample: x2 in cells (so distances double), +2 cells slack for
+        # the corner a coarse step can cut inside a 2x2 block. Stays an
+        # upper bound; refinement only tightens.
+        d = jnp.repeat(jnp.repeat(d, 2, axis=1), 2, axis=2)
+        d = jnp.where(d >= _BIG, _BIG, d * 2.0 + 2.0)
+        blk = blocked_pyr[lv]
+        d = jnp.where(blk[None], _BIG, d)
+        d = _seed(d, rc_pyr[lv], blk, neighbours=(lv == 0))
+        d = _relax_level(blk, d, iters=refine_iters)
+
+    # The relaxation re-applies the mask every sweep, so a robot whose
+    # cell is conservatively blocked ends with _BIG at its own seed;
+    # report 0 there (its true distance to itself) like the exact path.
+    rr = jnp.clip(robot_rc[:, 0], 0, n - 1)
+    cc = jnp.clip(robot_rc[:, 1], 0, n - 1)
+    return d.at[jnp.arange(R), rr, cc].set(0.0)

@@ -1,0 +1,172 @@
+"""Multigrid batched cost fields (ops/costfield.py) vs the exact dilation.
+
+Properties pinned:
+  * open map: multigrid == exact geodesic (chamfer 8-neighbour) distance;
+  * walled map: multigrid never UNDERestimates the exact distance (the
+    upper-bound contract the frontier auction relies on), and reaches
+    cells the exact field reaches whenever corridors are >= 2 coarse cells;
+  * blocked cells hold _BIG; robot seed cell is 0 even inside a
+    conservatively-blocked cell;
+  * the XLA twin and the Pallas (interpret) kernel agree exactly;
+  * the frontier pipeline produces the same assignments as exact_bfs on a
+    toy map.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import FrontierConfig, GridConfig
+from jax_mapping.ops import costfield as CF
+from jax_mapping.ops import frontier as F
+
+BIG = float(CF._BIG)
+
+
+def exact_field(blocked, rc, iters=None):
+    """Reference: full-convergence single-field dilation in NumPy."""
+    n = blocked.shape[0]
+    d = np.full((n, n), BIG, np.float32)
+    blk = blocked.copy()
+    blk[rc[0], rc[1]] = False
+    d[rc[0], rc[1]] = 0.0
+    sq2 = np.float32(1.41421356)
+    for _ in range(iters or 2 * n):
+        best = d.copy()
+        for dr, dc, w in ((1, 0, 1), (-1, 0, 1), (0, 1, 1), (0, -1, 1),
+                          (1, 1, sq2), (1, -1, sq2), (-1, 1, sq2),
+                          (-1, -1, sq2)):
+            sh = np.full_like(d, BIG)
+            if dr >= 0 and dc >= 0:
+                sh[dr:, dc:] = d[:n - dr, :n - dc]
+            elif dr >= 0 > dc:
+                sh[dr:, :dc] = d[:n - dr, -dc:]
+            elif dr < 0 <= dc:
+                sh[:dr, dc:] = d[-dr:, :n - dc]
+            else:
+                sh[:dr, :dc] = d[-dr:, -dc:]
+            best = np.minimum(best, sh + w)
+        new = np.where(blk, BIG, best)
+        if np.array_equal(new, d):
+            break
+        d = new
+    return d
+
+
+def test_open_map_bounded_upper_bound():
+    n = 64
+    blocked = np.zeros((n, n), bool)
+    rc = np.array([[10, 12], [50, 40]], np.int32)
+    levels, refine = 3, 8
+    got = np.asarray(CF.cost_fields(jnp.asarray(blocked), jnp.asarray(rc),
+                                    levels=levels, refine_iters=refine))
+    for i in range(2):
+        want = exact_field(blocked, rc[i])
+        diff = got[i] - want
+        # Contract: strict upper bound, overestimate bounded by the
+        # accumulated per-level slack (+2 cells per upsample plus the
+        # corner-cut), and EXACT near the seed where the finest level's
+        # refinement fully converges (a doubled sweep moves 2 cells).
+        assert diff.min() >= -1e-3, "multigrid underestimated a distance"
+        assert diff.max() <= 3.0 * levels
+        rr, cc = np.mgrid[0:n, 0:n]
+        near = np.maximum(np.abs(rr - rc[i, 0]),
+                          np.abs(cc - rc[i, 1])) <= refine
+        np.testing.assert_allclose(got[i][near], want[near], atol=1e-3)
+
+
+def test_walled_map_upper_bound_and_reaches():
+    n = 64
+    blocked = np.zeros((n, n), bool)
+    blocked[20, :40] = True            # wall with an opening on the right
+    rc = np.array([[10, 10]], np.int32)
+    got = np.asarray(CF.cost_fields(jnp.asarray(blocked), jnp.asarray(rc),
+                                    levels=3, refine_iters=16))[0]
+    want = exact_field(blocked, rc[0])
+    reach = want < BIG
+    # Upper bound everywhere (small epsilon for float sweep ordering).
+    assert (got[reach] >= want[reach] - 1e-3).all()
+    # The far side of the wall is reached through the opening.
+    assert got[40, 10] < BIG
+    assert got[40, 10] >= want[40, 10] - 1e-3
+    # Blocked cells stay BIG.
+    assert (got[blocked] >= BIG).all()
+
+
+def test_wall_hugger_does_not_leak_for_fleet():
+    """Regression: a robot standing in a conservatively-blocked cell must
+    not open that cell in OTHER robots' fields — a shared opening punches
+    a hole through the wall for the whole fleet and produces finite costs
+    to unreachable cells."""
+    n = 64
+    blocked = np.zeros((n, n), bool)
+    blocked[:, 33] = True              # solid wall, no openings
+    rc = np.array([[16, 32],           # robot B hugging the wall
+                   [16, 4]], np.int32)  # robot A far west
+    got = np.asarray(CF.cost_fields(jnp.asarray(blocked), jnp.asarray(rc),
+                                    levels=3, refine_iters=8))
+    # Robot A must see the east side as unreachable.
+    assert got[1, 48, 50] >= BIG, \
+        "robot A crossed a solid wall through robot B's seed cell"
+    # Robot B itself also cannot cross (its cell is west of the wall —
+    # even though its POOLED coarse cell straddles it).
+    assert got[0, 48, 50] >= BIG
+    # A (open space) reaches the far west; B (wall-hugger, fine cell open
+    # here but coarse cells blocked) keeps a bounded local field — near
+    # cells reachable, and that's the documented conservatism.
+    assert got[1, 30, 10] < BIG
+    assert got[0, 20, 28] < BIG
+
+
+def test_seed_cell_zero_even_when_blocked():
+    n = 32
+    blocked = np.ones((n, n), bool)    # everything blocked
+    rc = np.array([[5, 5]], np.int32)
+    got = np.asarray(CF.cost_fields(jnp.asarray(blocked), jnp.asarray(rc),
+                                    levels=2, refine_iters=2))[0]
+    assert got[5, 5] == 0.0
+
+
+def test_xla_twin_matches_pallas_interpret():
+    n = 64
+    rng = np.random.default_rng(0)
+    blocked = rng.random((n, n)) < 0.2
+    rc = np.array([[3, 3], [60, 50], [32, 32], [8, 55]], np.int32)
+    init = np.full((len(rc), n, n), BIG, np.float32)
+    for i in range(len(rc)):
+        blocked[rc[i, 0], rc[i, 1]] = False
+        init[i, rc[i, 0], rc[i, 1]] = 0.0
+    blk = jnp.asarray(blocked)
+    a = np.asarray(CF._relax_level_pallas(blk, jnp.asarray(init), iters=12))
+    b = np.asarray(CF._relax_level_xla(blk, jnp.asarray(init), iters=12))
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_frontier_pipeline_multigrid_vs_exact_assignment():
+    gcfg = GridConfig(size_cells=128, patch_cells=64, max_range_m=2.0,
+                      align_rows=8, align_cols=8)
+    fcfg = FrontierConfig(downsample=2, cluster_downsample=1, max_clusters=8,
+                          min_cluster_cells=2, label_prop_iters=64,
+                          bfs_iters=256, obstacle_aware=True)
+    n = gcfg.size_cells
+    lo = np.zeros((n, n), np.float32)
+    lo[30:100, 30:100] = -2.0
+    lo[30:100, 64:66] = 2.0            # wall splitting the room
+    lo[60:70, 64:66] = -2.0            # door
+    import dataclasses
+    poses = jnp.asarray(np.array([[1.8, 1.8, 0.0], [4.2, 1.8, 0.0]],
+                                 np.float32))
+    res_mg = F.compute_frontiers(fcfg, gcfg, jnp.asarray(lo), poses)
+    res_ex = F.compute_frontiers(
+        dataclasses.replace(fcfg, exact_bfs=True), gcfg,
+        jnp.asarray(lo), poses)
+    # Same clusters detected; costs may differ (upper bound) but the
+    # greedy auction must land on the same assignment on this map.
+    assert (np.asarray(res_mg.sizes) == np.asarray(res_ex.sizes)).all()
+    assert (np.asarray(res_mg.assignment) == np.asarray(res_ex.assignment)).all()
+    # Multigrid costs never undercut exact costs where both are finite.
+    cm, ce = np.asarray(res_mg.costs), np.asarray(res_ex.costs)
+    both = (cm < BIG) & (ce < BIG)
+    assert (cm[both] >= ce[both] - 1e-2).all()
