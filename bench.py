@@ -59,6 +59,8 @@ _RESULT = {
     "devices": "unknown",
     "frontier_p50_ms_64robots": None,
     "frontier_euclid_p50_ms_64robots": None,
+    "match_p50_ms": None,
+    "slam_step_p50_ms": None,
     "path": None,
     "sections_completed": [],
 }
@@ -298,6 +300,60 @@ def _run() -> None:
         except Exception:
             import traceback
             traceback.print_exc(file=sys.stderr)
+
+    # ---- matcher + full slam_step at production config ------------------
+    # The per-key-scan costs: what slam_toolbox pays at 10 Hz
+    # (slam_config.yaml:24-38). Chained through the refined pose / carried
+    # state so iterations are data-dependent.
+    from jax_mapping.models import slam as SM
+    from jax_mapping.ops import scan_match as M
+
+    if _remaining() > 90.0:
+        def match_chain(k):
+            def run():
+                def body(_, p):
+                    r = M.match(g, s, cfg.matcher, grid_arr, ranges_d[0], p)
+                    return r.pose
+                p = jax.lax.fori_loop(
+                    0, k, body, jnp.zeros(3, jnp.float32) + 0.01)
+                return p.sum()
+            return jax.jit(run)
+        try:
+            p50 = _chain_time(match_chain, k1, k2, reps)
+            _RESULT["match_p50_ms"] = round(p50 * 1e3, 2)
+            _RESULT["sections_completed"].append("match")
+        except Exception:
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+    else:
+        print(f"bench: skipping match ({_remaining():.0f}s left)",
+              file=sys.stderr, flush=True)
+
+    if _remaining() > 90.0:
+        state0 = SM.init_state(cfg)
+        wl = jnp.float32(120.0)
+        wr = jnp.float32(118.0)
+        dts = jnp.float32(0.1)
+
+        def slam_chain(k):
+            def run():
+                def body(i, st):
+                    st2, _diag = SM.slam_step(cfg, st, ranges_d[0], wl, wr,
+                                              dts)
+                    return st2
+                st = jax.lax.fori_loop(0, k, body, state0)
+                return st.pose.sum() + st.grid.sum()
+            return jax.jit(run)
+        try:
+            p50 = _chain_time(slam_chain, k1, k2, reps)
+            _RESULT["slam_step_p50_ms"] = round(p50 * 1e3, 2)
+            _RESULT["sections_completed"].append("slam_step")
+        except Exception:
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+    else:
+        print(f"bench: skipping slam_step ({_remaining():.0f}s left)",
+              file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

@@ -164,6 +164,13 @@ class LoopClosureConfig:
     max_edges: int = 4096                     # edge buffer capacity (static)
     gn_iters: int = 8                         # Gauss-Newton iterations per solve
     damping: float = 1e-3
+    # Cross-robot closure: a key robot with no own-graph candidate may
+    # verify against ANOTHER robot's chain map and anchor its own graph to
+    # the result (models/fleet._cross_candidates). The reference gets
+    # inter-robot consistency for free from its single SLAM node fusing
+    # every scan (`pc_server.launch.py:14-19`); here per-robot graphs
+    # shard over the fleet axis, so cross-robot constraints are explicit.
+    cross_robot: bool = True
 
 
 @_frozen

@@ -117,33 +117,96 @@ def _update_graphs(cfg: SlamConfig, graphs: PG.PoseGraph, est: Array,
     return graphs, rings, k_idx
 
 
+def _cross_candidates(cfg: SlamConfig, graphs: PG.PoseGraph,
+                      est: Array) -> tuple[Array, Array, Array]:
+    """Nearest OTHER robot's established chain pose within the loop radius.
+
+    Inter-robot consistency: the reference's single SLAM node fuses every
+    robot's scan into one graph (`pc_server.launch.py:14-19`), so two
+    robots mapping the same wall share constraints for free. Here graphs
+    are per-robot (they shard over the fleet axis without collectives), so
+    the equivalent coupling is explicit: a robot may close a loop against
+    a fleet-mate's chain. Returns (robot (R,), pose_idx (R,), found (R,)).
+    """
+    R = est.shape[0]
+    cap = cfg.loop.max_poses
+    pos = graphs.poses[:, :, :2]                             # (R, cap, 2)
+    d = jnp.linalg.norm(pos[None, :, :, :] - est[:, None, None, :2],
+                        axis=-1)                             # (R, R, cap)
+    established = graphs.n_poses >= cfg.loop.min_chain_size  # (R,)
+    ok = (graphs.pose_valid & established[:, None])[None, :, :]
+    ok = ok & ~jnp.eye(R, dtype=bool)[:, :, None]
+    d = jnp.where(ok, d, jnp.inf)
+    flat = d.reshape(R, R * cap)
+    best = jnp.argmin(flat, axis=1)
+    found = jnp.take_along_axis(flat, best[:, None], 1)[:, 0] \
+        <= cfg.loop.search_radius_m
+    return ((best // cap).astype(jnp.int32),
+            (best % cap).astype(jnp.int32), found)
+
+
 def _verify_and_optimize(cfg: SlamConfig, graphs: PG.PoseGraph,
                          rings: Array, est: Array, scans: Array,
-                         k_idx: Array, cand: Array, attempt: Array):
+                         k_idx: Array, cand: Array, attempt: Array,
+                         xrobot: Array, xcand: Array, xattempt: Array):
     """Shared closure body for the local AND sharded fleet steps:
-    two-stage verification of every attempting robot against its
-    candidate's ghost-free chain map (models/slam._verify_loop), loop
-    edges, per-robot optimisation, pose update. Returns
-    (graphs, est, closed). Verification runs under `lax.map` over robots —
-    each iteration materialises one chain grid, so peak memory is one
-    extra full-size grid regardless of fleet size."""
+    two-stage verification of every attempting robot against a ghost-free
+    chain map (models/slam._verify_loop), loop edges, per-robot
+    optimisation, pose update. Returns (graphs, est, closed).
+
+    Own-graph loops verify against the robot's own candidate chain and add
+    the edge cand -> k. Cross-robot loops (xattempt, own candidates take
+    precedence) verify against robot `xrobot`'s chain — the full chain is
+    admitted (vk past the ring) because the query's drift frame cannot
+    leak into ANOTHER robot's map — and anchor the robot's OWN graph with
+    a strong (k-1) -> k edge re-measured from the verified pose. The
+    anchor approximates a joint-graph inter-robot edge in exchange for
+    graphs that stay per-robot (shardable without collectives); it encodes
+    "my pose in my neighbour's frame at verification time".
+
+    Verification runs under `lax.map` over robots — each iteration
+    materialises one chain grid, so peak memory is one extra full-size
+    grid regardless of fleet size."""
     cap = cfg.loop.max_poses
+    R = est.shape[0]
+    use_x = xattempt & ~attempt
+    vrobot = jnp.where(use_x, xrobot, jnp.arange(R))
+    vcand = jnp.where(use_x, xcand, cand)
+    # Own: exclude the query's recent tail from the chain map. Cross: the
+    # whole chain is admissible.
+    vk = jnp.where(use_x, jnp.int32(cap + cfg.loop.min_chain_size), k_idx)
 
     def one(r):
-        g_r = jax.tree.map(lambda x: x[r], graphs)
-        res = _verify_loop(cfg, g_r, rings[r], cand[r], k_idx[r],
+        g_v = jax.tree.map(lambda x: x[vrobot[r]], graphs)
+        res = _verify_loop(cfg, g_v, rings[vrobot[r]], vcand[r], vk[r],
                            scans[r], est[r])
         return res.pose, res.accepted, res.response
 
-    fine_pose, fine_acc, fine_resp = jax.lax.map(one, jnp.arange(est.shape[0]))
-    closed = attempt & fine_acc & (fine_resp >= cfg.loop.response_fine)
+    fine_pose, fine_acc, fine_resp = jax.lax.map(one, jnp.arange(R))
+    closed = (attempt | use_x) & fine_acc & \
+        (fine_resp >= cfg.loop.response_fine)
 
-    def add_loop(g, c, q, meas_pose, flag):
-        rel = pose_between(g.poses[c], meas_pose)
+    def add_loop(g, c, q, meas_pose, flag, isx):
+        # Own loop: edge c -> q. Cross relocalization: the verified pose
+        # overwrites the robot's newest node directly (its drifted value
+        # was pure dead reckoning), and when a previous node exists an
+        # anchor edge (q-1) -> q re-measured from the verified pose pulls
+        # the chain (the weak odometry edge between the same nodes stays;
+        # the optimiser blends them by information weight).
+        # q < cap gate matches the edge add below: a saturated graph's
+        # k_idx == cap would alias onto slot cap-1, corrupting an
+        # established keyframe other robots may be matching against.
+        qc = jnp.minimum(q, cap - 1)
+        g = g._replace(poses=g.poses.at[qc].set(
+            jnp.where(flag & isx & (q < cap), meas_pose, g.poses[qc])))
+        src = jnp.where(isx, jnp.maximum(q - 1, 0), c)
+        rel = pose_between(g.poses[src], meas_pose)
         w = jnp.array([_LOOP_W[0], _LOOP_W[0], _LOOP_W[1]], jnp.float32)
-        return PG.add_edge_if(g, c, q, rel, w, flag & (q < cap))
+        ok = flag & (q < cap) & (~isx | (q > 0))
+        return PG.add_edge_if(g, src, q, rel, w, ok)
 
-    graphs2 = jax.vmap(add_loop)(graphs, cand, k_idx, fine_pose, closed)
+    graphs2 = jax.vmap(add_loop)(graphs, cand, k_idx, fine_pose, closed,
+                                 use_x)
     opt = jax.vmap(lambda g: PG.optimize(cfg.loop, g))(graphs2)
     graphs3 = jax.tree.map(
         lambda a, b: jnp.where(
@@ -157,11 +220,13 @@ def _verify_and_optimize(cfg: SlamConfig, graphs: PG.PoseGraph,
 
 def _close_loops(cfg: SlamConfig, graphs: PG.PoseGraph, grid: Array,
                  rings: Array, est: Array, scans: Array, k_idx: Array,
-                 cand: Array, attempt: Array, rings_complete: Array):
+                 cand: Array, attempt: Array, rings_complete: Array,
+                 xrobot: Array, xcand: Array, xattempt: Array):
     """Fleet closure: shared verify/optimise body + shared-map re-fusion.
     Returns (graphs, grid, est, closed)."""
     graphs3, est2, closed = _verify_and_optimize(
-        cfg, graphs, rings, est, scans, k_idx, cand, attempt)
+        cfg, graphs, rings, est, scans, k_idx, cand, attempt,
+        xrobot, xcand, xattempt)
 
     # Shared-map repair: re-fuse EVERY robot's key-scan ring from the
     # (possibly re-optimised) trajectories. The shared grid mixes all
@@ -232,16 +297,24 @@ def fleet_step(cfg: SlamConfig, state: FleetState, world_res_m: float,
     cand, cand_found = jax.vmap(
         lambda g, q: PG.loop_candidate(cfg.loop, g, q))(graphs, k_idx)
     attempt = is_key & cand_found & bool(cfg.loop.enabled)
+    # Cross-robot closure for key robots without an own candidate, gated
+    # on the robot being LOST: its narrow-window match against the shared
+    # map was rejected. A robot matching happily is already coupled to the
+    # fleet through the shared grid; cross-verification is the wide-window
+    # relocalization against a fleet-mate's chain for the drifted one.
+    xrobot, xcand, xfound = _cross_candidates(cfg, graphs, est)
+    xattempt = is_key & ~res.accepted & xfound & ~attempt & \
+        bool(cfg.loop.enabled) & bool(cfg.loop.cross_robot)
     # Conservative ring-completeness: once any graph saturates, key scans
     # escape the rings and map repair must stop (see _close_loops).
     rings_complete = ~jnp.any(graphs.n_poses >= cfg.loop.max_poses)
 
     graphs, grid, est, closed = jax.lax.cond(
-        attempt.any(),
+        (attempt | xattempt).any(),
         lambda args: _close_loops(cfg, *args),
         lambda args: (args[0], args[1], args[3], jnp.zeros_like(attempt)),
         (graphs, grid, rings, est, scans, k_idx, cand, attempt,
-         rings_complete))
+         rings_complete, xrobot, xcand, xattempt))
 
     last_key = jnp.where(is_key[:, None], est, state.last_key_poses)
     state2 = FleetState(sim=sim2, est_poses=est, grid=grid,

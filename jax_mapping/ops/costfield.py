@@ -127,12 +127,12 @@ def _relax_level_pallas(blocked: Array, init: Array, iters: int) -> Array:
 
 
 def _use_pallas() -> bool:
-    """Pallas on TPU unless JAX_MAPPING_NO_PALLAS=1; the XLA twin
-    elsewhere (interpret-mode Pallas is far slower than XLA on CPU —
-    tests exercise the kernel explicitly via _relax_level_pallas)."""
-    import os
-    return (jax.default_backend() == "tpu"
-            and os.environ.get("JAX_MAPPING_NO_PALLAS") != "1")
+    """Shared engine toggle (grid._use_pallas): Pallas on TPU unless
+    JAX_MAPPING_NO_PALLAS=1; the XLA twin elsewhere (interpret-mode
+    Pallas is far slower than XLA on CPU — tests exercise the kernel
+    explicitly via _relax_level_pallas)."""
+    from jax_mapping.ops.grid import _use_pallas as _gp
+    return _gp()
 
 
 def _relax_level(blocked: Array, init: Array, iters: int) -> Array:

@@ -35,7 +35,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jax_mapping.config import SlamConfig
 from jax_mapping.models.explorer import frontier_policy
-from jax_mapping.models.fleet import _update_graphs, _verify_and_optimize
+from jax_mapping.models.fleet import (_cross_candidates, _update_graphs,
+                                      _verify_and_optimize)
 from jax_mapping.models.slam import _verify_loop
 from jax_mapping.ops import frontier as F
 from jax_mapping.ops import grid as G
@@ -225,7 +226,15 @@ def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
         cand, found = jax.vmap(
             lambda g, q: PG.loop_candidate(cfg.loop, g, q))(graphs, k_idx)
         attempt = is_key & found & bool(cfg.loop.enabled)
-        any_attempt = jax.lax.psum(attempt.sum(), "fleet") > 0
+        # Cross-robot relocalization stays SHARD-LOCAL: candidates come
+        # from this shard's graphs only (a fleet-wide search would drag
+        # every shard's rings through collectives; locality is the trade
+        # the fleet axis buys — see models/fleet._cross_candidates).
+        xrobot, xcand, xfound = _cross_candidates(cfg, graphs, est)
+        xattempt = is_key & ~res.accepted & xfound & ~attempt & \
+            bool(cfg.loop.enabled) & bool(cfg.loop.cross_robot)
+        attempt_any_local = attempt | xattempt
+        any_attempt = jax.lax.psum(attempt_any_local.sum(), "fleet") > 0
         # Ring completeness must agree fleet-wide (see models/fleet
         # _close_loops on why repair stops after any ring saturates).
         rings_complete = jax.lax.psum(
@@ -234,7 +243,8 @@ def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
         def close(args):
             graphs, est = args
             graphs3, est2, closed = _verify_and_optimize(
-                cfg, graphs, rings, est, scans, k_idx, cand, attempt)
+                cfg, graphs, rings, est, scans, k_idx, cand, attempt,
+                xrobot, xcand, xattempt)
             # Local repair slab from this shard's rings (psum'd OUTSIDE —
             # the cond branches stay collective-free).
             Rl, cap, beams = rings.shape

@@ -1,0 +1,190 @@
+"""Cross-robot loop closure and inter-robot map consistency.
+
+The reference gets inter-robot consistency for free: one SLAM node fuses
+every scan into one graph (`pc_server.launch.py:14-19`). Here graphs are
+per-robot (models/fleet.py), so a drifted robot relocalises against a
+fleet-mate's chain map (`_cross_candidates` + the cross branch of
+`_verify_and_optimize`). Pinned here:
+
+  * candidate search semantics (nearest other-established-chain pose,
+    radius gate, self-exclusion);
+  * a drifted robot B verifying against robot A's chain snaps to its true
+    pose (drift beyond the online matcher's window);
+  * map consistency: fusing B's scans at the corrected poses yields ONE
+    wall, while the uncorrected poses ghost it into two.
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.models import fleet as FM
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import posegraph as PG
+from jax_mapping.sim import lidar
+from tests.conftest import *  # noqa: F401,F403
+
+
+@pytest.fixture()
+def cfg(tiny_cfg):
+    return dataclasses.replace(
+        tiny_cfg,
+        loop=dataclasses.replace(tiny_cfg.loop, max_poses=64, max_edges=256,
+                                 gn_iters=4, coarse_downsample=2,
+                                 min_chain_size=6))
+
+
+def _world(res):
+    """12.8 m world with one long wall and a perpendicular stub (the
+    symmetry breaker a correlative matcher needs)."""
+    w = np.zeros((256, 256), bool)
+    w[150:152, 60:200] = True      # wall at y ~= +1.1 m
+    w[120:150, 98:100] = True      # stub south of it at x ~= -1.5 m
+    return jnp.asarray(w)
+
+
+def _scan_at(cfg, world, pose):
+    n_samples = int(cfg.scan.range_max_m / (cfg.grid.resolution_m * 0.5))
+    return lidar.simulate_scans(cfg.scan, world, cfg.grid.resolution_m,
+                                n_samples, jnp.asarray(pose)[None])[0]
+
+
+def _chain_along_wall(cfg, world, n=8, y=0.0, x0=-1.2, dx=0.35):
+    """Robot A's graph: n key poses driving east under the wall, scans
+    simulated at the TRUE poses (A is well-localised)."""
+    g = PG.empty_graph(cfg.loop)
+    ring = jnp.zeros((cfg.loop.max_poses, cfg.scan.padded_beams), jnp.float32)
+    poses = []
+    for i in range(n):
+        pose = jnp.asarray(np.array([x0 + i * dx, y, 0.0], np.float32))
+        poses.append(pose)
+        g = PG.add_pose_if(g, pose, jnp.bool_(True))
+        ring = ring.at[i].set(_scan_at(cfg, world, pose))
+    return g, ring, poses
+
+
+def test_cross_candidates_semantics(cfg):
+    R = 3
+    graphs = jax.vmap(lambda _: PG.empty_graph(cfg.loop))(jnp.arange(R))
+    # Robot 0: an established chain near the origin. Robot 1: too short.
+    def fill(g, n, ox):
+        for i in range(n):
+            g = PG.add_pose_if(
+                g, jnp.array([ox + 0.3 * i, 0.0, 0.0]), jnp.bool_(True))
+        return g
+    g0 = fill(jax.tree.map(lambda x: x[0], graphs), 8, 0.0)
+    g1 = fill(jax.tree.map(lambda x: x[1], graphs), 3, 5.0)
+    graphs = jax.tree.map(
+        lambda full, a, b: full.at[0].set(a).at[1].set(b),
+        graphs, g0, g1)
+    est = jnp.asarray(np.array([[0.0, 0.0, 0.0],      # robot 0
+                                [0.5, 0.4, 0.0],      # robot 1: near 0's chain
+                                [50.0, 50.0, 0.0]],   # robot 2: far away
+                               np.float32))
+    xr, xc, found = FM._cross_candidates(cfg, graphs, est)
+    xr, xc, found = map(np.asarray, (xr, xc, found))
+    assert found[1] and xr[1] == 0, "robot 1 should find robot 0's chain"
+    assert not found[2], "far robot must find nothing"
+    # Robot 0 must not match its own chain; robot 1's chain is too short
+    # to be a target, so robot 0 finds nothing.
+    assert not found[0]
+
+
+def test_drifted_robot_relocalises_against_fleet_mate(cfg):
+    world = _world(cfg.grid.resolution_m)
+    R = 2
+    gA, ringA, _ = _chain_along_wall(cfg, world)
+
+    graphs = jax.vmap(lambda _: PG.empty_graph(cfg.loop))(jnp.arange(R))
+    graphs = jax.tree.map(lambda full, a: full.at[0].set(a), graphs, gA)
+    rings = jnp.zeros((R, cfg.loop.max_poses, cfg.scan.padded_beams),
+                      jnp.float32)
+    rings = rings.at[0].set(ringA)
+
+    # Robot B's TRUE pose sits inside A's mapped region; B's estimate has
+    # drifted 0.7 m — beyond the online matcher's +-0.5 m window, inside
+    # the loop search radius.
+    true_B = jnp.asarray(np.array([-0.5, 0.3, 0.4], np.float32))
+    est_B = true_B + jnp.asarray(np.array([0.55, -0.45, 0.0], np.float32))
+    scan_B = _scan_at(cfg, world, true_B)
+
+    # B has one node in its own graph (its current key pose).
+    gB = PG.add_pose_if(jax.tree.map(lambda x: x[1], graphs), est_B,
+                        jnp.bool_(True))
+    graphs = jax.tree.map(lambda full, b: full.at[1].set(b), graphs, gB)
+
+    est = jnp.stack([jnp.zeros(3), est_B])
+    scans = jnp.stack([jnp.zeros_like(scan_B), scan_B])
+    k_idx = jnp.array([99, 0], jnp.int32)     # B's node slot (A's unused)
+    attempt = jnp.array([False, False])
+    xr, xc, xfound = FM._cross_candidates(cfg, graphs, est)
+    assert bool(xfound[1]) and int(xr[1]) == 0
+    xattempt = jnp.array([False, True])
+
+    graphs3, est2, closed = FM._verify_and_optimize(
+        cfg, graphs, rings, est, scans, k_idx,
+        jnp.zeros(R, jnp.int32), attempt, xr, xc, xattempt)
+    assert bool(closed[1]), "cross verification should accept"
+    err = float(jnp.linalg.norm(est2[1, :2] - true_B[:2]))
+    assert err < 0.1, f"relocalised pose off by {err:.3f} m"
+    dth = float(jnp.abs(est2[1, 2] - true_B[2]))
+    assert dth < 0.1
+
+
+def test_map_consistency_one_wall_not_two(cfg):
+    """Fuse B's scans at corrected vs drifted poses on top of A's map: the
+    corrected merge keeps one wall, the drifted merge ghosts it."""
+    world = _world(cfg.grid.resolution_m)
+    gA, ringA, posesA = _chain_along_wall(cfg, world)
+    g = cfg.grid
+
+    # A's map: fuse its chain.
+    grid = G.empty_grid(g)
+    for i, p in enumerate(posesA):
+        grid = G.fuse_scans(g, cfg.scan, grid, ringA[i][None], p[None])
+
+    # Enough drifted scans that the displaced wall overcomes the free-space
+    # evidence A already fused there (log-odds fusion suppresses a few
+    # inconsistent hits by design — ghosting needs sustained drift).
+    drift = jnp.asarray(np.array([0.55, -0.45, 0.0], np.float32))
+    true_Bs = [jnp.asarray(np.array([-0.9 + 0.2 * i, 0.25, 0.5], np.float32))
+               for i in range(10)]
+    scans_B = jnp.stack([_scan_at(cfg, world, p) for p in true_Bs])
+
+    good = bad = grid
+    for i, p in enumerate(true_Bs):
+        good = G.fuse_scans(g, cfg.scan, good, scans_B[i][None], p[None])
+        bad = G.fuse_scans(g, cfg.scan, bad, scans_B[i][None],
+                           (p + drift)[None])
+
+    # Ghost metric against world truth: occupied grid cells farther than
+    # 2 cells from ANY true wall cell. (A plain occupied-cell count hides
+    # ghosting: the drifted rays carve the true wall down while painting
+    # the displaced copy, so totals barely move.)
+    world_np = np.asarray(_world(g.resolution_m))
+    # world cell (r, c) -> grid cell: same resolution, different origins.
+    wr, wc = np.nonzero(world_np)
+    wy = (wr - 128 + 0.5) * g.resolution_m
+    wx = (wc - 128 + 0.5) * g.resolution_m
+    gr_r = ((wy - g.origin_m[1]) / g.resolution_m).astype(int)
+    gr_c = ((wx - g.origin_m[0]) / g.resolution_m).astype(int)
+    true_wall = np.zeros((g.size_cells, g.size_cells), bool)
+    true_wall[gr_r, gr_c] = True
+
+    def ghosts(gr_arr):
+        occ = np.asarray(gr_arr) > g.occ_threshold
+        near = true_wall.copy()
+        for _ in range(2):   # dilate truth by 2 cells
+            near = (near | np.roll(near, 1, 0) | np.roll(near, -1, 0)
+                    | np.roll(near, 1, 1) | np.roll(near, -1, 1))
+        return int((occ & ~near).sum())
+
+    g_good = ghosts(good)
+    g_bad = ghosts(bad)
+    assert g_good <= 3, f"consistent fusion ghosted {g_good} cells"
+    assert g_bad > 30, f"drifted fusion should ghost (got {g_bad})"
