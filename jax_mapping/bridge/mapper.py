@@ -278,11 +278,13 @@ class MapperNode(Node):
         fr = self._F.compute_frontiers(self.cfg.frontier, self.cfg.grid,
                                        self.merged_grid(),
                                        self._jnp.asarray(poses))
+        hdr = Header.now("map")    # one stamp for the whole publish cycle
         self.frontiers_pub.publish(FrontierArray(
-            header=Header.now("map"),
+            header=hdr,
             targets_xy=np.asarray(fr.targets),
             sizes=np.asarray(fr.sizes),
             assignment=np.asarray(fr.assignment)))
         self.pose_pub.publish([
-            {"x": float(p[0]), "y": float(p[1]), "theta": float(p[2])}
+            {"x": float(p[0]), "y": float(p[1]), "theta": float(p[2]),
+             "stamp": hdr.stamp}
             for p in poses])

@@ -133,6 +133,18 @@ class RclpyAdapter:
                         else DurabilityPolicy.VOLATILE),
         )
 
+    # Bus-side topic names for each logical topic. The internal graph's
+    # names are uneven on purpose-mirroring-the-reference grounds: the
+    # mapper publishes slashed absolute names ("/map", "/pose"), while
+    # per-robot sensor topics are namespaced UNslashed ("scan",
+    # "robot0/scan" — brain.robot_ns). The adapter must use the exact
+    # strings (Bus lookups are exact; tests/test_stack.py pins the graph).
+    BUS_TOPICS = {
+        "map": "/map", "map_updates": "/map_updates", "pose": "/pose",
+        "frontiers": "/frontiers", "cmd_vel": "/cmd_vel",
+        "scan": "scan", "odom": "odom",
+    }
+
     def _wire_outbound(self, topics) -> None:
         nav = self._msgs["nav"]
         geo = self._msgs["geo"]
@@ -150,6 +162,9 @@ class RclpyAdapter:
             pub = n.create_publisher(geo.PoseWithCovarianceStamped, "/pose",
                                      self._ros_qos())
             self._bus_to_ros("pose", pub, self.pose_list_to_ros)
+            pub_all = n.create_publisher(geo.PoseArray, "/poses",
+                                         self._ros_qos())
+            self._bus_to_ros("pose", pub_all, self.pose_list_to_ros_array)
         if "scan" in topics:
             pub = n.create_publisher(sen.LaserScan, "/scan",
                                      self._ros_qos(best_effort=True))
@@ -163,7 +178,8 @@ class RclpyAdapter:
             out = _cv(msg)
             if out is not None:
                 _pub.publish(out)
-        self._subs.append(self.bus.subscribe(topic, callback=cb))
+        self._subs.append(
+            self.bus.subscribe(self.BUS_TOPICS[topic], callback=cb))
 
     def _wire_inbound(self, topics) -> None:
         geo = self._msgs["geo"]
@@ -171,19 +187,19 @@ class RclpyAdapter:
         nav = self._msgs["nav"]
         n = self.node
         if "cmd_vel" in topics:
-            pub = self.bus.publisher("cmd_vel")
+            pub = self.bus.publisher(self.BUS_TOPICS["cmd_vel"])
             n.create_subscription(
                 geo.Twist, "/cmd_vel",
                 lambda m, _p=pub: _p.publish(self.twist_from_ros(m)),
                 self._ros_qos())
         if "scan" in topics:
-            pub = self.bus.publisher("scan")
+            pub = self.bus.publisher(self.BUS_TOPICS["scan"])
             n.create_subscription(
                 sen.LaserScan, "/scan",
                 lambda m, _p=pub: _p.publish(self.scan_from_ros(m)),
                 self._ros_qos(best_effort=True))
         if "odom" in topics:
-            pub = self.bus.publisher("odom")
+            pub = self.bus.publisher(self.BUS_TOPICS["odom"])
             n.create_subscription(
                 nav.Odometry, "/odom",
                 lambda m, _p=pub: _p.publish(self.odom_from_ros(m)),
@@ -236,6 +252,8 @@ class RclpyAdapter:
         out.info.height = int(msg.info.height)
         out.info.origin.position.x = float(msg.info.origin.x)
         out.info.origin.position.y = float(msg.info.origin.y)
+        # Planar map: the origin rotation is pure yaw, so the quaternion's
+        # x and y components are identically zero and only z/w are set.
         qx, qy, qz, qw = msg.info.origin.to_quaternion()
         out.info.origin.orientation.z = qz
         out.info.origin.orientation.w = qw
@@ -279,17 +297,38 @@ class RclpyAdapter:
         """The Bus `/pose` payload is a list of per-robot pose dicts
         (bridge/mapper.py); ROS `/pose` is the FIRST robot's
         PoseWithCovarianceStamped (the reference is single-robot,
-        rviz_config.rviz:133-143)."""
+        rviz_config.rviz:133-143). The fleet view goes out as a
+        PoseArray on `/poses` (see pose_list_to_ros_array)."""
         if not poses:
             return None
         geo, bi = self._msgs["geo"], self._msgs["bi"]
         p = poses[0]
         out = geo.PoseWithCovarianceStamped()
+        out.header.stamp = _to_ros_time(bi.Time, p.get("stamp", 0.0))
         out.header.frame_id = "map"
         out.pose.pose.position.x = float(p["x"])
         out.pose.pose.position.y = float(p["y"])
         out.pose.pose.orientation.z = math.sin(p["theta"] / 2.0)
         out.pose.pose.orientation.w = math.cos(p["theta"] / 2.0)
+        return out
+
+    def pose_list_to_ros_array(self, poses):
+        """All robots' poses as one geometry_msgs/PoseArray (`/poses`)."""
+        if not poses:
+            return None
+        geo, bi = self._msgs["geo"], self._msgs["bi"]
+        out = geo.PoseArray()
+        out.header.stamp = _to_ros_time(bi.Time, poses[0].get("stamp", 0.0))
+        out.header.frame_id = "map"
+        arr = []
+        for p in poses:
+            m = geo.Pose()
+            m.position.x = float(p["x"])
+            m.position.y = float(p["y"])
+            m.orientation.z = math.sin(p["theta"] / 2.0)
+            m.orientation.w = math.cos(p["theta"] / 2.0)
+            arr.append(m)
+        out.poses = arr
         return out
 
     def publish_tf_once(self) -> None:

@@ -1,0 +1,169 @@
+"""`jax-mapping-ros`: one-command ROS 2 bring-up, the reference's
+`ros2 launch thymio_project pc_server.launch.py` equivalent
+(`/root/reference/server/thymio_project/launch/pc_server.launch.py:12-34`
+starts slam_toolbox + the brain node + RViz; here one process boots the
+whole simulated stack, mirrors it onto real DDS through the rclpy adapter,
+and prints the RViz command).
+
+Usage (with a ROS 2 Jazzy environment sourced):
+
+    jax-mapping-ros                     # sim stack + /map /scan /pose ...
+    jax-mapping-ros --robots 4          # fleet
+    jax-mapping-ros --live-hardware     # inbound /scan + /odom feed the
+                                        # mapper (a real ldlidar driver
+                                        # publishes; nothing is simulated)
+    rviz2 -d "$(jax-mapping-ros --print-rviz-config)"
+
+Without rclpy importable this exits with the adapter's explanatory error
+(the rest of the framework runs without ROS; see bridge/rclpy_adapter.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="jax-mapping-ros",
+        description="Bridge the jax_mapping stack onto a live ROS 2 graph.")
+    p.add_argument("--robots", type=int, default=1)
+    p.add_argument("--world", choices=("arena", "rooms"), default="rooms")
+    p.add_argument("--world-cells", type=int, default=256)
+    p.add_argument("--http-port", type=int, default=None,
+                   help="also serve the map HTTP API on this port")
+    p.add_argument("--config", type=str, default=None,
+                   help="SlamConfig JSON file (default: tiny sim config)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--duration-s", type=float, default=0.0,
+                   help="run this long then exit (0 = until Ctrl-C)")
+    p.add_argument("--live-hardware", action="store_true",
+                   help="inbound /scan + /odom from real drivers feed the "
+                        "mapper; the simulator is not started")
+    p.add_argument("--print-rviz-config", action="store_true",
+                   help="print the bundled RViz config path and exit")
+    return p
+
+
+def rviz_config_path() -> str:
+    import os
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "configs", "jax_mapping.rviz")
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.print_rviz_config:
+        print(rviz_config_path())
+        return 0
+
+    from jax_mapping.bridge.rclpy_adapter import RclpyAdapter, rclpy_available
+    if not rclpy_available():
+        print("jax-mapping-ros: rclpy is not importable — source a ROS 2 "
+              "(Jazzy) environment first; see README 'ROS 2 / RViz'.",
+              file=sys.stderr)
+        return 2
+
+    from jax_mapping.config import SlamConfig, tiny_config
+
+    if args.config:
+        with open(args.config) as f:
+            cfg = SlamConfig.from_json(f.read())
+    else:
+        cfg = tiny_config(n_robots=max(1, args.robots))
+
+    if args.live_hardware:
+        # Live mode = the reference's PC-server role alone
+        # (pc_server.launch.py: slam + map server; the robot side runs on
+        # real hardware): mapper + HTTP API only. No simulator — real
+        # /scan and /odom arrive via the inbound adapter on the SAME bus
+        # topics the sim would use, so booting the sim would interleave
+        # simulated and real sensor data. Outbound excludes scan/odom for
+        # the same reason mirrored: this node subscribing /scan while
+        # republishing its bus copy back to /scan would echo-loop DDS.
+        stack = _launch_live_stack(cfg, http_port=args.http_port)
+        inbound = ("cmd_vel", "scan", "odom")
+        outbound = ("map", "map_updates", "pose")
+    else:
+        from jax_mapping.bridge.launch import launch_sim_stack
+        from jax_mapping.sim import world as W
+        if args.world == "arena":
+            world = W.empty_arena(args.world_cells, cfg.grid.resolution_m)
+        else:
+            world = W.rooms_world(args.world_cells, cfg.grid.resolution_m,
+                                  seed=args.seed)
+        stack = launch_sim_stack(cfg, world, n_robots=max(1, args.robots),
+                                 http_port=args.http_port, realtime=True,
+                                 seed=args.seed)
+        inbound = ("cmd_vel",)
+        outbound = RclpyAdapter.OUTBOUND_DEFAULT
+
+    adapter = RclpyAdapter(stack.bus, cfg, tf=stack.tf, inbound=inbound,
+                           outbound=outbound)
+    adapter.spin()
+    if not args.live_hardware:
+        stack.brain.start_exploring()
+        print("jax-mapping-ros: sim stack up — /map /map_updates /pose "
+              "/poses /scan /odom /tf out, /cmd_vel in")
+    else:
+        print("jax-mapping-ros: live stack up — /map /map_updates /pose "
+              "/poses /tf out; /scan /odom /cmd_vel in feed the mapper")
+    print(f"  rviz2 -d {rviz_config_path()}")
+    try:
+        t0 = time.time()
+        while args.duration_s <= 0 or time.time() - t0 < args.duration_s:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        adapter.shutdown()
+        stack.shutdown()
+    return 0
+
+
+def _launch_live_stack(cfg, http_port=None):
+    """Mapper + API + TF only, fed by real inbound /scan + /odom."""
+    import dataclasses as _dc
+
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.http_api import MapApiServer
+    from jax_mapping.bridge.launch import LASER_MOUNT_Z_M
+    from jax_mapping.bridge.mapper import MapperNode
+    from jax_mapping.bridge.messages import Header, TransformStamped
+    from jax_mapping.bridge.node import Executor
+    from jax_mapping.bridge.tf import TfTree
+
+    bus = Bus(domain_id=cfg.domain_id)
+    tf = TfTree()
+    tf.set_static_transform(TransformStamped(
+        header=Header(frame_id="base_link"), child_frame_id="base_laser",
+        z=LASER_MOUNT_Z_M))
+    mapper = MapperNode(cfg, bus, tf=tf, n_robots=1)
+    api = None
+    if http_port is not None:
+        api = MapApiServer(bus, brain=None, port=http_port)
+        api.serve_thread()
+    executor = Executor([mapper])
+    executor.spin_thread()
+
+    @_dc.dataclass
+    class LiveStack:
+        bus: object
+        tf: object
+        mapper: object
+        api: object
+        executor: object
+
+        def shutdown(self):
+            if self.api is not None:
+                self.api.shutdown()
+            self.executor.shutdown()
+
+    return LiveStack(bus=bus, tf=tf, mapper=mapper, api=api,
+                     executor=executor)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
