@@ -64,6 +64,7 @@ class MapperNode(Node):
         self.n_scans_dropped_unpaired = 0
         self.n_loops_closed = 0
         self.n_windows_fused = 0
+        self.n_low_agreement_windows = 0
 
         self.map_pub = self.create_publisher("/map", qos_map)
         self.map_updates_pub = self.create_publisher("/map_updates")
@@ -204,9 +205,16 @@ class MapperNode(Node):
                 jnp.asarray(wheels_w), jnp.asarray(dts_w))
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
+            agreement = float(diag.window_agreement)
         self._finish_step(i, state, items[-1][1], W, matched, closed)
         self.n_windows_fused += 1
         M.counters.inc("mapper.windows_fused")
+        # Surface the leading scans' health (they fuse with no match
+        # telemetry): a low-agreement window means evidence landed in
+        # known-free space — misaligned odometry or a garbage burst.
+        if agreement < 0.5:
+            self.n_low_agreement_windows += 1
+            M.counters.inc("mapper.low_agreement_windows")
 
     def _step_single(self, i: int, scan: LaserScan, od: Odometry) -> None:
         jnp = self._jnp

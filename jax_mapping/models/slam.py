@@ -48,6 +48,11 @@ class SlamDiag(NamedTuple):
     response: Array      # () float
     key_added: Array     # () bool
     loop_closed: Array   # () bool
+    # Windowed path only (slam_step_window): mean map-agreement of the
+    # W-1 leading scans that fuse WITHOUT match/acceptance telemetry — a
+    # window of garbage scans must not be invisible in the diag. 1.0 for
+    # the single-scan path (no leading scans to disagree).
+    window_agreement: Array  # () float in [0, 1]
 
 
 def init_state(cfg: SlamConfig, pose0=None) -> SlamState:
@@ -226,14 +231,16 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
                         n_loops=st.n_loops + closed.astype(jnp.int32),
                         n_keyscans=st.n_keyscans + 1)
         diag = SlamDiag(matched=res.accepted, response=res.response,
-                        key_added=jnp.bool_(True), loop_closed=closed)
+                        key_added=jnp.bool_(True), loop_closed=closed,
+                        window_agreement=jnp.float32(1.0))
         return st2, diag
 
     def skip_branch(st: SlamState):
         st2 = st._replace(pose=pose_odo)
         diag = SlamDiag(matched=jnp.bool_(False), response=jnp.float32(0),
                         key_added=jnp.bool_(False),
-                        loop_closed=jnp.bool_(False))
+                        loop_closed=jnp.bool_(False),
+                        window_agreement=jnp.float32(1.0))
         return st2, diag
 
     return jax.lax.cond(is_key, key_branch, skip_branch, state)
@@ -281,10 +288,49 @@ def slam_step_window(cfg: SlamConfig, state: SlamState, ranges_w: Array,
     _, poses_w = jax.lax.scan(integrate, state.pose,
                               (wheels_w, dts_w))   # (W, 3)
 
+    agreement = _window_agreement(cfg, state.grid, ranges_w[:-1],
+                                  poses_w[:-1])
     grid = G.fuse_scans_window_checked(cfg.grid, cfg.scan, state.grid,
                                        ranges_w[:-1], poses_w[:-1])
     # The last scan runs the full pipeline; starting it from the W-2th pose
     # makes its internal odometry land exactly on poses_w[-1].
     st = state._replace(grid=grid, pose=poses_w[-2])
-    return slam_step(cfg, st, ranges_w[-1],
-                     wheels_w[-1, 0], wheels_w[-1, 1], dts_w[-1])
+    st2, diag = slam_step(cfg, st, ranges_w[-1],
+                          wheels_w[-1, 0], wheels_w[-1, 1], dts_w[-1])
+    return st2, diag._replace(window_agreement=agreement)
+
+
+def _window_agreement(cfg: SlamConfig, grid: Array, ranges_w: Array,
+                      poses_w: Array) -> Array:
+    """Mean map-agreement of a window's leading scans, BEFORE they fuse.
+
+    These scans add evidence with no match/acceptance telemetry
+    (throughput path); this is their health signal: the fraction of hit
+    endpoints landing on cells the map does NOT call confidently free.
+    Misaligned scans put walls inside known-free space -> low agreement;
+    hits in unknown territory are fine (that is what exploring looks
+    like). A (W * beams)-point gather — microscopic next to the window
+    fusion itself.
+    """
+    g, s = cfg.grid, cfg.scan
+    pts, hit = jax.vmap(lambda r: M.scan_points(s, r))(ranges_w)
+    cs = jnp.cos(poses_w[:, 2])[:, None]
+    sn = jnp.sin(poses_w[:, 2])[:, None]
+    x = poses_w[:, 0:1] + pts[:, :, 0] * cs - pts[:, :, 1] * sn
+    y = poses_w[:, 1:2] + pts[:, :, 0] * sn + pts[:, :, 1] * cs
+    cr = G.world_to_cell(g, jnp.stack([x, y], axis=-1))
+    cols = jnp.floor(cr[..., 0]).astype(jnp.int32)
+    rows = jnp.floor(cr[..., 1]).astype(jnp.int32)
+    inb = ((rows >= 0) & (rows < g.size_cells)
+           & (cols >= 0) & (cols < g.size_cells))
+    vals = grid[jnp.clip(rows, 0, g.size_cells - 1),
+                jnp.clip(cols, 0, g.size_cells - 1)]
+    ok = hit & inb
+    agree = (vals > g.free_threshold) & ok
+    n_ok = ok.sum()
+    # No valid in-bounds hits (open space beyond range_max, dropouts):
+    # neutral 1.0, not maximum-alarm 0.0 — "no evidence" != "disagrees".
+    return jnp.where(
+        n_ok == 0, jnp.float32(1.0),
+        agree.sum().astype(jnp.float32)
+        / jnp.maximum(n_ok, 1).astype(jnp.float32))
