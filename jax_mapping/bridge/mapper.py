@@ -79,6 +79,11 @@ class MapperNode(Node):
                 f"{ns}odom", functools.partial(self._odom_cb, i),
                 QoSProfile(depth=50))
 
+        # RViz SetInitialPose tool (via the rclpy adapter): relocalize
+        # robot 0's SLAM estimate — slam_toolbox's pose-initialization
+        # capability, applied to the reference's single-robot convention.
+        self.create_subscription("/initialpose", self._initialpose_cb)
+
         period = tick_period_s if tick_period_s is not None \
             else 1.0 / cfg.robot.control_rate_hz
         self.create_timer(period, self.tick)
@@ -86,6 +91,26 @@ class MapperNode(Node):
         self._last_map_stamp = 0.0
 
     # -- callbacks ----------------------------------------------------------
+
+    def _initialpose_cb(self, msg) -> None:
+        jnp = self._jnp
+        pose = jnp.asarray([float(msg.x), float(msg.y), float(msg.theta)],
+                           dtype="float32")
+        with self._state_lock:
+            st = self.states[0]
+            # A user-asserted pose starts a FRESH chain: keeping the old
+            # graph would leave an odometry edge spanning the teleport,
+            # and the next loop optimisation would drag the estimate back
+            # toward the pre-reset frame (silently undoing the user). The
+            # map is kept — mapping continues in the same grid from the
+            # asserted pose (slam_toolbox's localization-reset semantics).
+            fresh = self._S.init_state(self.cfg, pose0=pose)
+            # fresh.last_key_pose forces an immediate key scan, promptly
+            # re-anchoring graph node 0 at the asserted pose.
+            self.states[0] = fresh._replace(grid=st.grid)
+            self._prev_paired[0] = None
+            self._last_odom_pose[0] = None
+        M.counters.inc("mapper.initialpose_resets")
 
     def _scan_cb(self, i: int, msg: LaserScan) -> None:
         with self._state_lock:

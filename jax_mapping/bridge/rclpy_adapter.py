@@ -63,6 +63,11 @@ def _from_ros_time(t) -> float:
     return float(t.sec) + float(t.nanosec) * 1e-9
 
 
+def _yaw_from_quat(q) -> float:
+    """Planar yaw from a ROS quaternion (x/y ignored: yaw-only maps)."""
+    return 2.0 * math.atan2(float(q.z), float(q.w))
+
+
 class RclpyAdapter:
     """One rclpy node pair of publishers/subscriptions mirroring the Bus.
 
@@ -78,7 +83,7 @@ class RclpyAdapter:
     """
 
     OUTBOUND_DEFAULT = ("map", "map_updates", "pose", "scan", "odom")
-    INBOUND_DEFAULT = ("cmd_vel",)
+    INBOUND_DEFAULT = ("cmd_vel", "initialpose", "goal_pose")
 
     def __init__(self, bus: Bus, cfg: SlamConfig,
                  tf: Optional[TfTree] = None,
@@ -142,6 +147,7 @@ class RclpyAdapter:
     BUS_TOPICS = {
         "map": "/map", "map_updates": "/map_updates", "pose": "/pose",
         "frontiers": "/frontiers", "cmd_vel": "/cmd_vel",
+        "initialpose": "/initialpose", "goal_pose": "/goal_pose",
         "scan": "scan", "odom": "odom",
     }
 
@@ -204,6 +210,24 @@ class RclpyAdapter:
                 nav.Odometry, "/odom",
                 lambda m, _p=pub: _p.publish(self.odom_from_ros(m)),
                 self._ros_qos(depth=50))
+        if "initialpose" in topics:
+            # RViz's SetInitialPose tool (configs/jax_mapping.rviz, the
+            # reference's rviz_config.rviz:186-198 carries the same tool):
+            # relocalize the SLAM estimate (mapper consumes the bus topic).
+            pub = self.bus.publisher(self.BUS_TOPICS["initialpose"])
+            n.create_subscription(
+                geo.PoseWithCovarianceStamped, "/initialpose",
+                lambda m, _p=pub: _p.publish(self.pose_cov_from_ros(m)),
+                self._ros_qos())
+        if "goal_pose" in topics:
+            # RViz's SetGoal tool; bridged for Nav2-style consumers (the
+            # reference never launched a consumer either — Nav2 was future
+            # work, report.pdf VI.2).
+            pub = self.bus.publisher(self.BUS_TOPICS["goal_pose"])
+            n.create_subscription(
+                geo.PoseStamped, "/goal_pose",
+                lambda m, _p=pub: _p.publish(self.pose_stamped_from_ros(m)),
+                self._ros_qos())
 
     def _wire_tf(self) -> None:
         import tf2_ros
@@ -277,8 +301,7 @@ class RclpyAdapter:
 
     def odom_from_ros(self, m) -> Odometry:
         from jax_mapping.bridge.messages import Pose2D
-        yaw = 2.0 * math.atan2(m.pose.pose.orientation.z,
-                               m.pose.pose.orientation.w)
+        yaw = _yaw_from_quat(m.pose.pose.orientation)
         return Odometry(
             header=Header(stamp=_from_ros_time(m.header.stamp),
                           frame_id=m.header.frame_id),
@@ -292,6 +315,20 @@ class RclpyAdapter:
     def twist_from_ros(self, m) -> Twist:
         return Twist(linear_x=float(m.linear.x),
                      angular_z=float(m.angular.z))
+
+    def pose_cov_from_ros(self, msg) -> "Pose2D":
+        """geometry_msgs/PoseWithCovarianceStamped -> planar Pose2D."""
+        from jax_mapping.bridge.messages import Pose2D
+        p = msg.pose.pose
+        return Pose2D(float(p.position.x), float(p.position.y),
+                      _yaw_from_quat(p.orientation))
+
+    def pose_stamped_from_ros(self, msg) -> "Pose2D":
+        """geometry_msgs/PoseStamped -> planar Pose2D."""
+        from jax_mapping.bridge.messages import Pose2D
+        p = msg.pose
+        return Pose2D(float(p.position.x), float(p.position.y),
+                      _yaw_from_quat(p.orientation))
 
     def pose_list_to_ros(self, poses):
         """The Bus `/pose` payload is a list of per-robot pose dicts

@@ -84,7 +84,7 @@ def main(argv=None) -> int:
         # the same reason mirrored: this node subscribing /scan while
         # republishing its bus copy back to /scan would echo-loop DDS.
         stack = _launch_live_stack(cfg, http_port=args.http_port)
-        inbound = ("cmd_vel", "scan", "odom")
+        inbound = ("cmd_vel", "scan", "odom", "initialpose", "goal_pose")
         outbound = ("map", "map_updates", "pose")
     else:
         from jax_mapping.bridge.launch import launch_sim_stack
@@ -97,7 +97,7 @@ def main(argv=None) -> int:
         stack = launch_sim_stack(cfg, world, n_robots=max(1, args.robots),
                                  http_port=args.http_port, realtime=True,
                                  seed=args.seed)
-        inbound = ("cmd_vel",)
+        inbound = ("cmd_vel", "initialpose", "goal_pose")
         outbound = RclpyAdapter.OUTBOUND_DEFAULT
 
     adapter = RclpyAdapter(stack.bus, cfg, tf=stack.tf, inbound=inbound,

@@ -108,6 +108,7 @@ def stub_ros(monkeypatch):
     geo.Twist = _msg("Twist")
     geo.PoseWithCovarianceStamped = _msg("PoseWithCovarianceStamped")
     geo.PoseArray = _msg("PoseArray")
+    geo.PoseStamped = _msg("PoseStamped")
     geo.Pose = _msg("Pose")
     geo.TransformStamped = _msg("TransformStamped")
     bi = types.ModuleType("builtin_interfaces.msg")
@@ -332,3 +333,47 @@ def test_live_hardware_mode_no_sim_no_echo(tiny_cfg, stub_ros, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "live stack up" in out
+
+
+def test_inbound_initialpose_relocalizes_mapper(tiny_cfg, stub_ros):
+    """RViz SetInitialPose -> adapter -> bus -> mapper pose reset."""
+    import math as _m
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.mapper import MapperNode
+    from jax_mapping.bridge.rclpy_adapter import RclpyAdapter
+
+    bus = Bus()
+    mapper = MapperNode(tiny_cfg, bus, n_robots=1)
+    ad = RclpyAdapter(bus, tiny_cfg)
+    m = Obj()
+    m.pose.pose.position.x = 1.5
+    m.pose.pose.position.y = -0.5
+    m.pose.pose.orientation.z = _m.sin(0.4 / 2)
+    m.pose.pose.orientation.w = _m.cos(0.4 / 2)
+    grid_before = mapper.states[0].grid
+    ad.node.subs["/initialpose"](m)
+    st = mapper.states[0]
+    pose = np.asarray(st.pose)
+    assert pose[0] == pytest.approx(1.5)
+    assert pose[1] == pytest.approx(-0.5)
+    assert pose[2] == pytest.approx(0.4, abs=1e-6)
+    # Fresh chain, kept map: the graph restarts (no odometry edge will
+    # span the teleport) while the grid carries on.
+    assert int(st.graph.n_poses) == 0 and int(st.n_keyscans) == 0
+    assert st.grid is grid_before
+
+
+def test_inbound_goal_pose_reaches_bus(tiny_cfg, stub_ros):
+    import math as _m
+    bus, _tf, ad = _adapter(tiny_cfg, stub_ros)
+    got = []
+    bus.subscribe("/goal_pose", callback=got.append)
+    m = Obj()
+    m.pose.position.x = 2.0
+    m.pose.position.y = 3.0
+    m.pose.orientation.z = _m.sin(-0.3 / 2)
+    m.pose.orientation.w = _m.cos(-0.3 / 2)
+    ad.node.subs["/goal_pose"](m)
+    assert len(got) == 1
+    assert got[0].x == pytest.approx(2.0)
+    assert got[0].theta == pytest.approx(-0.3, abs=1e-6)
