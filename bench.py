@@ -62,6 +62,9 @@ _RESULT = {
     "match_p50_ms": None,
     "slam_step_p50_ms": None,
     "path": None,
+    # Engine actually used by the frontier cost fields ("pallas" unless
+    # the probe or the production-shape run rejected the kernel).
+    "costfield_path": None,
     "sections_completed": [],
 }
 _EMITTED = threading.Event()
@@ -130,6 +133,17 @@ def main() -> None:
     _emit_and_exit(0)
 
 
+def _costfield_xla_fallback() -> None:
+    """Flip the frontier cost-field engine to its XLA twin and drop any
+    Pallas trace already cached (the env var is read at trace time)."""
+    import os
+
+    from jax_mapping.ops import costfield as CF
+    os.environ["JAX_MAPPING_COSTFIELD_XLA"] = "1"
+    CF.cost_fields.clear_cache()
+    _RESULT["costfield_path"] = "xla-fallback"
+
+
 def _chain_time(make_jit, k1: int, k2: int, reps: int) -> float:
     """Median per-iteration seconds for a chained-loop jit factory.
 
@@ -188,10 +202,29 @@ def _run() -> None:
             print(f"bench: pallas probe failed ({type(e).__name__}: {e}); "
                   "using XLA fallback paths", file=sys.stderr, flush=True)
             os.environ["JAX_MAPPING_NO_PALLAS"] = "1"
+        # The cost-field relaxation kernel is probed separately: a Mosaic
+        # rejection there must only flip the frontier engine to its XLA
+        # twin, not take down the (independent) fusion kernel. Probes are
+        # shape-dependent evidence only — the frontier section below has
+        # its own production-shape fallback.
+        if os.environ.get("JAX_MAPPING_NO_PALLAS") != "1":
+            try:
+                from jax_mapping.ops import costfield as CF
+                blk = jnp.zeros((64, 64), bool)
+                rc = jnp.zeros((2, 2), jnp.int32)
+                jax.block_until_ready(CF.cost_fields(blk, rc, 2, 2))
+            except Exception as e:
+                print(f"bench: costfield pallas probe failed "
+                      f"({type(e).__name__}: {e}); frontier uses the XLA "
+                      "twin", file=sys.stderr, flush=True)
+                _costfield_xla_fallback()
     _RESULT["path"] = ("pallas" if G._use_pallas()
                        else ("xla-fallback"
                              if os.environ.get("JAX_MAPPING_NO_PALLAS") == "1"
                              else "xla"))
+    if _RESULT["costfield_path"] is None:
+        from jax_mapping.ops import costfield as CF
+        _RESULT["costfield_path"] = ("pallas" if CF._use_pallas() else "xla")
 
     # ---- workload: B scans along a realistic local trajectory -----------
     # One robot's temporal scan window: consecutive LD06 rotations while the
@@ -270,16 +303,20 @@ def _run() -> None:
 
     def frontier_chain_factory(fcfg):
         def frontier_chain(k):
-            def run():
+            # grid rides as an ARGUMENT: closure capture makes it an XLA
+            # constant and const-folding the coarsen masks costs ~40 s of
+            # compile per chain (measured) against the bench deadline.
+            def run_g(gr0):
                 def body(_, carry):
                     gr, acc = carry
                     fr = F.compute_frontiers(fcfg, g, gr, robot_poses)
                     dep = fr.costs.sum() * 0.0    # data-dep chains iterations
                     return (gr + dep, acc + fr.sizes.sum())
                 _, acc = jax.lax.fori_loop(0, k, body,
-                                           (grid_arr, jnp.int32(0)))
+                                           (gr0, jnp.int32(0)))
                 return acc
-            return jax.jit(run)
+            jitted = jax.jit(run_g)
+            return lambda: jitted(grid_arr)
         return frontier_chain
 
     # Product default first (obstacle-aware BFS — the advertised capability),
@@ -300,6 +337,21 @@ def _run() -> None:
         except Exception:
             import traceback
             traceback.print_exc(file=sys.stderr)
+            if aware and _RESULT.get("costfield_path") != "xla-fallback":
+                # Production-shape Mosaic/VMEM failures get past the tiny
+                # probe; retry the headline frontier metric on the XLA twin
+                # rather than dropping it.
+                print("bench: frontier failed at production shape; "
+                      "retrying with the costfield XLA twin",
+                      file=sys.stderr, flush=True)
+                _costfield_xla_fallback()
+                try:
+                    p50 = _chain_time(frontier_chain_factory(fcfg), k1, k2,
+                                      reps)
+                    _RESULT[key] = round(p50 * 1e3, 2)
+                    _RESULT["sections_completed"].append(key)
+                except Exception:
+                    traceback.print_exc(file=sys.stderr)
 
     # ---- matcher + full slam_step at production config ------------------
     # The per-key-scan costs: what slam_toolbox pays at 10 Hz
@@ -310,14 +362,15 @@ def _run() -> None:
 
     if _remaining() > 90.0:
         def match_chain(k):
-            def run():
+            def run_g(gr0):
                 def body(_, p):
-                    r = M.match(g, s, cfg.matcher, grid_arr, ranges_d[0], p)
+                    r = M.match(g, s, cfg.matcher, gr0, ranges_d[0], p)
                     return r.pose
                 p = jax.lax.fori_loop(
                     0, k, body, jnp.zeros(3, jnp.float32) + 0.01)
                 return p.sum()
-            return jax.jit(run)
+            jitted = jax.jit(run_g)
+            return lambda: jitted(grid_arr)
         try:
             p50 = _chain_time(match_chain, k1, k2, reps)
             _RESULT["match_p50_ms"] = round(p50 * 1e3, 2)
@@ -331,19 +384,24 @@ def _run() -> None:
 
     if _remaining() > 90.0:
         state0 = SM.init_state(cfg)
-        wl = jnp.float32(120.0)
-        wr = jnp.float32(118.0)
+        # Wheel speed sized so EVERY iteration passes the 0.1 m key-scan
+        # gate (0.12 m per 0.1 s step): the metric is the per-KEY-scan
+        # cost — match + fuse + graph — not the cheap sub-gate branch a
+        # slow robot would mostly take.
+        wl = jnp.float32(4000.0)
+        wr = jnp.float32(4000.0)
         dts = jnp.float32(0.1)
 
         def slam_chain(k):
-            def run():
+            def run_g(st0):
                 def body(i, st):
                     st2, _diag = SM.slam_step(cfg, st, ranges_d[0], wl, wr,
                                               dts)
                     return st2
-                st = jax.lax.fori_loop(0, k, body, state0)
+                st = jax.lax.fori_loop(0, k, body, st0)
                 return st.pose.sum() + st.grid.sum()
-            return jax.jit(run)
+            jitted = jax.jit(run_g)
+            return lambda: jitted(state0)
         try:
             p50 = _chain_time(slam_chain, k1, k2, reps)
             _RESULT["slam_step_p50_ms"] = round(p50 * 1e3, 2)

@@ -130,7 +130,12 @@ def _use_pallas() -> bool:
     """Shared engine toggle (grid._use_pallas): Pallas on TPU unless
     JAX_MAPPING_NO_PALLAS=1; the XLA twin elsewhere (interpret-mode
     Pallas is far slower than XLA on CPU — tests exercise the kernel
-    explicitly via _relax_level_pallas)."""
+    explicitly via _relax_level_pallas). JAX_MAPPING_COSTFIELD_XLA=1
+    disables THIS kernel alone (bench probes it separately: a Mosaic
+    rejection here must not also take down the proven fusion kernel)."""
+    import os
+    if os.environ.get("JAX_MAPPING_COSTFIELD_XLA") == "1":
+        return False
     from jax_mapping.ops.grid import _use_pallas as _gp
     return _gp()
 
