@@ -46,10 +46,12 @@ Array = jax.Array
 _BIG = 1e9
 _SQ2 = 1.41421356
 
-# VMEM budget for one chunk of per-robot fields (bytes); the chunk size is
-# chosen so chunk * n * n * 4 stays under it with room for the mask and
-# the shift temporaries.
-_FIELD_VMEM_BYTES = 4 * 1024 * 1024
+# VMEM budget for one chunk of per-robot fields (bytes). Mosaic stack-
+# allocates the relaxation body's shift temporaries alongside the block:
+# the measured scoped peak is ~17x the field block (a 1 MB block hit
+# 17.42 M scoped vs the 16 M VMEM limit on v5e), so the block must stay
+# near 512 KB for the whole allocation to fit with margin.
+_FIELD_VMEM_BYTES = 512 * 1024
 
 
 def _chunk_robots(n: int, n_robots: int) -> int:
@@ -141,7 +143,12 @@ def _use_pallas() -> bool:
 
 
 def _relax_level(blocked: Array, init: Array, iters: int) -> Array:
-    if _use_pallas():
+    n = init.shape[-1]
+    # A single field larger than the budget cannot be chunked down
+    # (_chunk_robots floors at 1 whole field) — the Mosaic stack for the
+    # shift temporaries would over-run VMEM exactly the way the budget
+    # exists to prevent, so such levels run the XLA twin instead.
+    if _use_pallas() and n * n * 4 <= _FIELD_VMEM_BYTES:
         return _relax_level_pallas(blocked, init, iters)
     return _relax_level_xla(blocked, init, iters)
 

@@ -282,6 +282,52 @@ def _fold(grid_cfg: GridConfig, grid_arr: Array, deltas: Array,
     return out
 
 
+# Scans classified per fold chunk. Two ceilings bind the batch axis:
+# Mosaic's scoped SMEM grows with the Pallas grid's step count (B > 512
+# over-runs the 1 MB budget at the full-size 640-patch config — measured
+# on v5e), and the (B, P, P) deltas array is B x 1.6 MB of HBM (the
+# 1024-scan loop-repair refuse would materialise 1.7 GB at once).
+_FUSE_CHUNK = 256
+
+
+def _classify_fold(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                   grid_arr: Array, ranges_b: Array, poses_b: Array,
+                   mask_b: Array, clamp: bool) -> Array:
+    """Chunked classify->fold over the batch: peak memory and Pallas grid
+    size are bounded by `_FUSE_CHUNK` regardless of B; results are exact
+    (the fold is sequential either way). Scan b contributes iff mask_b[b]
+    (multiplied on the classified deltas: zeroing ranges instead would
+    still carve free space — a zero range means "outlier, carve to 10 m",
+    server/.../main.py:152)."""
+    B = ranges_b.shape[0]
+    if B == 0:
+        return grid_arr
+
+    def chunk(g, rpm):
+        r, p, m = rpm
+        deltas, origins = _classify_batch(grid_cfg, scan_cfg, r, p)
+        deltas = deltas * m[:, None, None].astype(deltas.dtype)
+        return _fold(grid_cfg, g, deltas, origins, clamp=clamp), None
+
+    # Full chunks ride one lax.scan; the remainder is a smaller final call
+    # (classifying padded dummy scans would cost full kernel work each —
+    # zero ranges are outliers that carve to max range).
+    CB = min(_FUSE_CHUNK, B)
+    nc, rem = B // CB, B % CB
+    out = grid_arr
+    if nc:
+        cut = nc * CB
+        out, _ = jax.lax.scan(
+            chunk, out,
+            (ranges_b[:cut].reshape(nc, CB, -1),
+             poses_b[:cut].reshape(nc, CB, 3),
+             mask_b[:cut].reshape(nc, CB)))
+    if rem:
+        out, _ = chunk(out, (ranges_b[B - rem:], poses_b[B - rem:],
+                             mask_b[B - rem:]))
+    return out
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def fuse_scan(grid_cfg: GridConfig, scan_cfg: ScanConfig,
               grid_arr: Array, ranges: Array, pose: Array) -> Array:
@@ -304,8 +350,9 @@ def fuse_scans(grid_cfg: GridConfig, scan_cfg: ScanConfig,
       ranges_b: (B, padded_beams) metres.
       poses_b:  (B, 3) [x, y, yaw].
     """
-    deltas, origins = _classify_batch(grid_cfg, scan_cfg, ranges_b, poses_b)
-    return _fold(grid_cfg, grid_arr, deltas, origins, clamp=True)
+    mask = jnp.ones((ranges_b.shape[0],), jnp.bool_)
+    return _classify_fold(grid_cfg, scan_cfg, grid_arr, ranges_b, poses_b,
+                          mask, clamp=True)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
@@ -320,9 +367,8 @@ def fuse_scans_masked(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     server/.../main.py:152), so the mask multiplies the classified deltas
     instead.
     """
-    deltas, origins = _classify_batch(grid_cfg, scan_cfg, ranges_b, poses_b)
-    deltas = deltas * mask_b[:, None, None].astype(deltas.dtype)
-    return _fold(grid_cfg, grid_arr, deltas, origins, clamp=True)
+    return _classify_fold(grid_cfg, scan_cfg, grid_arr, ranges_b, poses_b,
+                          mask_b.astype(jnp.bool_), clamp=True)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
@@ -333,9 +379,10 @@ def scan_deltas_full(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     Used by the multi-robot merge path: per-robot deltas are `psum`-merged
     across the fleet mesh axis before a single clamped apply (parallel/fleet).
     """
-    deltas, origins = _classify_batch(grid_cfg, scan_cfg, ranges_b, poses_b)
     zero = jnp.zeros((grid_cfg.size_cells, grid_cfg.size_cells), jnp.float32)
-    return _fold(grid_cfg, zero, deltas, origins, clamp=False)
+    mask = jnp.ones((ranges_b.shape[0],), jnp.bool_)
+    return _classify_fold(grid_cfg, scan_cfg, zero, ranges_b, poses_b,
+                          mask, clamp=False)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))

@@ -61,6 +61,15 @@ Array = jax.Array
 LANES = 128          # TPU vreg lane count; the in-vreg gather width
 _TARGET_S = 80       # preferred sublane-rows per grid step (16 patch rows)
 
+# Max scans per pallas_call: Mosaic's scoped SMEM allocation grows with the
+# grid's total step count (~12.8 B/step at the full-size config) and the
+# 1 MB SMEM budget over-runs somewhere between B=512 and B=1024 (measured
+# on v5e; grid = (40, B) at the 640-patch config). Larger batches are
+# split across calls: per-scan outputs concatenate (bitwise identical);
+# window_delta adds chunk subtotals, which reassociates the cross-scan
+# float sum (last-ulp differences vs one sequential accumulation).
+_MAX_B_PER_CALL = 512
+
 
 def _step_rows(grid_cfg: GridConfig) -> int:
     """Sublane-rows of the flattened patch one grid step computes.
@@ -256,6 +265,13 @@ def window_delta(grid_cfg: GridConfig, scan_cfg: ScanConfig,
         # A grid of size 0 would never run the b==0 init step and return
         # the output buffer uninitialised; an empty window adds nothing.
         return jnp.zeros((P, P), jnp.float32)
+    if B > _MAX_B_PER_CALL:
+        total = jnp.zeros((P, P), jnp.float32)
+        for i in range(0, B, _MAX_B_PER_CALL):
+            total = total + window_delta(
+                grid_cfg, scan_cfg, ranges_b[i:i + _MAX_B_PER_CALL],
+                poses_b[i:i + _MAX_B_PER_CALL], origin_rc)
+        return total
     nchunk = scan_cfg.padded_beams // LANES
     table = _beam_table(grid_cfg, scan_cfg, ranges_b)
     origin = jnp.broadcast_to(
@@ -317,6 +333,13 @@ def _per_scan_call(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     B = ranges_b.shape[0]
     if B == 0:
         return jnp.zeros((0, P, P), jnp.float32)
+    if B > _MAX_B_PER_CALL:
+        return jnp.concatenate([
+            _per_scan_call(grid_cfg, scan_cfg,
+                           ranges_b[i:i + _MAX_B_PER_CALL],
+                           poses_b[i:i + _MAX_B_PER_CALL],
+                           origins_rc[i:i + _MAX_B_PER_CALL], mode)
+            for i in range(0, B, _MAX_B_PER_CALL)], axis=0)
     nchunk = scan_cfg.padded_beams // LANES
     table = _beam_table(grid_cfg, scan_cfg, ranges_b)
     origins = origins_rc.astype(jnp.int32).reshape(B, 2)
