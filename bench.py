@@ -141,6 +141,7 @@ def _costfield_xla_fallback() -> None:
     cache left the round-2 retry re-tracing the same rejected kernel)."""
     import jax
     os.environ["JAX_MAPPING_COSTFIELD_XLA"] = "1"
+    os.environ["JAX_MAPPING_FRONTIER_XLA"] = "1"
     jax.clear_caches()
     _RESULT["costfield_path"] = "xla-fallback"
 
@@ -338,7 +339,16 @@ def _run() -> None:
         except Exception:
             import traceback
             traceback.print_exc(file=sys.stderr)
-            if aware and _RESULT.get("costfield_path") == "pallas":
+            # Retry on the XLA twins iff a frontier-side Pallas engine was
+            # actually active (cost fields, or the label-prop kernel at
+            # this config's clustering grid size) — a pure-XLA failure
+            # would only repeat itself and burn the watchdog budget.
+            from jax_mapping.ops import frontier as FK
+            cluster_n = (g.size_cells // fcfg.downsample
+                         // fcfg.cluster_downsample)
+            lp_active = FK._use_pallas_labels(cluster_n)
+            if aware and (_RESULT.get("costfield_path") == "pallas"
+                          or lp_active):
                 # Production-shape Mosaic/VMEM failures get past the tiny
                 # probe; retry the headline frontier metric on the XLA twin
                 # rather than dropping it.

@@ -35,6 +35,10 @@ Array = jax.Array
 
 _BIG = jnp.float32(1e9)
 
+# Ceiling on the dense (n*n, K) one-hot membership matrices _summarize
+# builds (fp32 bytes); past it the segment/gather formulation runs instead.
+_SUMMARIZE_DENSE_BYTES = 32 * 1024 * 1024
+
 
 class FrontierResult(NamedTuple):
     mask: Array            # (n, n) bool frontier cells (coarse resolution)
@@ -63,11 +67,25 @@ def coarsen(cfg: FrontierConfig, grid_cfg: GridConfig, logodds: Array):
     A coarse cell is occupied if ANY child is occupied (conservative for
     planning), free if any child is free and none occupied, else unknown.
     Works on the full grid or a row slab (spatially sharded caller).
+
+    Any-child pooling is phrased as max/min reduce_window pools of the
+    log-odds BEFORE thresholding (any(x > t) == max(x) > t): XLA's TPU
+    reduce_window runs at HBM bandwidth, while the reshape(n/d, d, n/d, d)
+    .any((1, 3)) formulation's strided middle axes lowered ~67x slower at
+    the 4096^2 production shape (10.0 ms -> 0.15 ms measured on v5e).
     """
     d = cfg.downsample
-    x = logodds.reshape(logodds.shape[0] // d, d, logodds.shape[1] // d, d)
-    any_occ = (x > grid_cfg.occ_threshold).any(axis=(1, 3))
-    any_free = (x < grid_cfg.free_threshold).any(axis=(1, 3))
+    if logodds.shape[0] % d or logodds.shape[1] % d:
+        # VALID windows would silently truncate the trailing rows/cols the
+        # old reshape-pooling rejected at trace time; keep the loud error.
+        raise ValueError(
+            f"grid shape {logodds.shape} not divisible by downsample {d}")
+    mx = jax.lax.reduce_window(logodds, -jnp.inf, jax.lax.max,
+                               (d, d), (d, d), "VALID")
+    mn = jax.lax.reduce_window(logodds, jnp.inf, jax.lax.min,
+                               (d, d), (d, d), "VALID")
+    any_occ = mx > grid_cfg.occ_threshold
+    any_free = mn < grid_cfg.free_threshold
     free = any_free & ~any_occ
     unknown = ~any_occ & ~any_free
     return free, any_occ, unknown
@@ -92,31 +110,92 @@ def frontier_mask(free: Array, unknown: Array) -> Array:
 # Connected-component clustering by label propagation
 # ---------------------------------------------------------------------------
 
+# VMEM ceiling for the label-propagation kernel's (n, n) int32 block; the
+# Mosaic stack for the 8-shift sweep temporaries multiplies the block by
+# ~17x (measured on the structurally identical costfield relaxation), so
+# 512 KB keeps the scoped peak well under the 16 MB VMEM limit. Bigger
+# grids run the XLA loop.
+_LABEL_VMEM_BYTES = 512 * 1024
+
+
+def _use_pallas_labels(n: int) -> bool:
+    import os
+    if os.environ.get("JAX_MAPPING_FRONTIER_XLA") == "1":
+        return False
+    from jax_mapping.ops.grid import _use_pallas as _gp
+    return _gp() and n * n * 4 <= _LABEL_VMEM_BYTES
+
+
+def _neighbor_max_sweep(lab: Array, m: Array) -> Array:
+    """One 8-neighbour max propagation sweep; jnp ops only so the same
+    body lowers inside the Pallas kernel and traces as plain XLA."""
+    def sh(x, dr, dc):
+        if dr:
+            fill = jnp.full_like(x[:1, :], -1)
+            x = (jnp.concatenate([fill, x[:-1, :]], axis=0) if dr > 0
+                 else jnp.concatenate([x[1:, :], fill], axis=0))
+        if dc:
+            fill = jnp.full_like(x[:, :1], -1)
+            x = (jnp.concatenate([fill, x[:, :-1]], axis=1) if dc > 0
+                 else jnp.concatenate([x[:, 1:], fill], axis=1))
+        return x
+
+    best = lab
+    for dr in (-1, 0, 1):
+        for dc in (-1, 0, 1):
+            if dr == 0 and dc == 0:
+                continue
+            best = jnp.maximum(best, sh(lab, dr, dc))
+    return jnp.where(m, best, -1)
+
+
+def _label_prop_pallas(mask: Array, seed: Array, iters: int) -> Array:
+    """All 2*iters+1 sweeps with the labels resident in VMEM: the XLA
+    fori_loop's per-sweep slice/update ops lower to hundreds of small
+    un-fused kernels (5.6 ms at the 256^2 production clustering grid on
+    v5e); one Pallas dispatch runs the whole propagation."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    n = mask.shape[0]
+
+    def kernel(mask_ref, seed_ref, out_ref):
+        m = mask_ref[:] > 0
+        lab = _neighbor_max_sweep(seed_ref[:], m)
+        out_ref[:] = jax.lax.fori_loop(
+            0, iters,
+            lambda _, l: _neighbor_max_sweep(_neighbor_max_sweep(l, m), m),
+            lab)
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, n), jnp.int32),
+        interpret=jax.default_backend() != "tpu",
+    )(mask.astype(jnp.int32), seed)
+
+
 def label_components(cfg: FrontierConfig, mask: Array) -> Array:
     """8-connected components: every frontier cell takes the max linear index
-    reachable within its component. Fixed trip count (`lax.fori_loop`, two
-    sweeps per iteration so the bound is half the component diameter):
+    reachable within its component. Fixed trip count (two sweeps per
+    iteration so the bound is half the component diameter):
     data-independent latency, no per-iteration convergence predicate to
     serialise on (SURVEY.md §7: frontier BFS is data-dependent -> fixed-bound
-    loop)."""
+    loop). On TPU the propagation runs as one Pallas kernel when the grid
+    fits the VMEM budget; the XLA loop is the parity-tested fallback."""
     n = mask.shape[0]
     seed = jnp.where(mask,
                      jnp.arange(n * n, dtype=jnp.int32).reshape(n, n),
                      jnp.int32(-1))
-
-    def neighbor_max(lab):
-        best = lab
-        for dr in (-1, 0, 1):
-            for dc in (-1, 0, 1):
-                if dr == 0 and dc == 0:
-                    continue
-                best = jnp.maximum(best, _shift(lab, dr, dc, fill=-1))
-        return jnp.where(mask, best, -1)
+    if _use_pallas_labels(n):
+        return _label_prop_pallas(mask, seed, cfg.label_prop_iters)
 
     return jax.lax.fori_loop(
         0, cfg.label_prop_iters,
-        lambda _, lab: neighbor_max(neighbor_max(lab)),
-        neighbor_max(seed))
+        lambda _, lab: _neighbor_max_sweep(_neighbor_max_sweep(lab, mask),
+                                           mask),
+        _neighbor_max_sweep(seed, mask))
 
 
 def summarize_clusters(cfg: FrontierConfig, grid_cfg: GridConfig,
@@ -156,31 +235,62 @@ def _summarize(cfg: FrontierConfig, grid_cfg: GridConfig, labels: Array,
     lin = jnp.arange(n * n, dtype=jnp.int32)
     is_rep = present & (flat == lin)
     # Cluster size per representative: weighted count of cells sharing its
-    # label. segment_sum over labels (clamped for the -1s).
+    # label. segment_sum over labels (clamped for the -1s); indexing the
+    # result by `lin` is the identity, so no gather.
     sizes_by_cell = jax.ops.segment_sum(
         w, jnp.clip(flat, 0), num_segments=n * n)
-    rep_sizes = jnp.where(is_rep, sizes_by_cell[lin], 0)
+    rep_sizes = jnp.where(is_rep, sizes_by_cell, 0)
     rep_sizes = jnp.where(rep_sizes >= cfg.min_cluster_cells, rep_sizes, 0)
 
     # Top-K representative linear indices by size.
     top_sizes, top_idx = jax.lax.top_k(rep_sizes, K)       # (K,)
     slot_valid = top_sizes > 0
 
-    # Map every cell to its slot (or -1).
-    slot_of_label = jnp.full((n * n,), -1, jnp.int32)
-    slot_of_label = slot_of_label.at[top_idx].set(
-        jnp.where(slot_valid, jnp.arange(K, dtype=jnp.int32), -1))
-    slot_of_cell = jnp.where(present, slot_of_label[jnp.clip(flat, 0)], -1)
-
-    # Centroids via weighted segment sums over slots.
     rows = (lin // n).astype(jnp.float32)
     cols = (lin % n).astype(jnp.float32)
-    sel = slot_of_cell >= 0
-    seg = jnp.clip(slot_of_cell, 0)
-    wf = jnp.where(sel, w.astype(jnp.float32), 0.0)
-    cnt = jax.ops.segment_sum(wf, seg, num_segments=K)
-    sr = jax.ops.segment_sum(wf * rows, seg, num_segments=K)
-    sc = jax.ops.segment_sum(wf * cols, seg, num_segments=K)
+    # Dense-vs-segment engine choice: the (n*n, K) one-hot membership
+    # matrices are ~16 MB at the 256^2 production clustering shape but
+    # 268 MB at n=1024 (the cluster_downsample=1 exact path) — gate on
+    # their size and keep the O(n*n) segment/gather formulation beyond it.
+    # One flag for both slot-level blocks below (the second dereferences
+    # `member`, which only the dense branch defines).
+    use_dense = n * n * K * 4 <= _SUMMARIZE_DENSE_BYTES
+    if use_dense:
+        # Everything slot-level works on the dense (n*n, K) membership
+        # one-hot instead of segment/gather ops: TPU scatters and
+        # 65 K-entry table gathers dominated this function (~2.8 of
+        # 3.4 ms at the 256^2 production shape on v5e), while the one-hot
+        # compares fuse and the weighted sums ride the MXU. A cell
+        # matches at most one top_idx (its component's unique
+        # representative), so argmax/sum over K are exact.
+        member = (flat[:, None] == top_idx[None, :]) & slot_valid[None, :]
+        slot_of_cell = jnp.where(
+            member.any(axis=1),
+            jnp.argmax(member, axis=1).astype(jnp.int32), -1)
+
+        # Centroids: weighted per-slot sums as one (3, n*n) @ (n*n, K)
+        # matmul. HIGHEST precision: the default TPU matmul rounds
+        # operands to bf16, whose 8-bit mantissa would shift weighted
+        # centroid sums (wf*rows reaches ~4k in the hierarchical path)
+        # by up to a few coarse cells vs the exact fp32 segment_sum this
+        # replaced.
+        wf = w.astype(jnp.float32)
+        mem_f = member.astype(jnp.float32)
+        sums = jnp.dot(jnp.stack([wf, wf * rows, wf * cols], 0), mem_f,
+                       precision=jax.lax.Precision.HIGHEST)   # (3, K)
+        cnt, sr, sc = sums[0], sums[1], sums[2]
+    else:
+        slot_of_label = jnp.full((n * n,), -1, jnp.int32)
+        slot_of_label = slot_of_label.at[top_idx].set(
+            jnp.where(slot_valid, jnp.arange(K, dtype=jnp.int32), -1))
+        slot_of_cell = jnp.where(present,
+                                 slot_of_label[jnp.clip(flat, 0)], -1)
+        sel = slot_of_cell >= 0
+        seg = jnp.clip(slot_of_cell, 0)
+        wf = jnp.where(sel, w.astype(jnp.float32), 0.0)
+        cnt = jax.ops.segment_sum(wf, seg, num_segments=K)
+        sr = jax.ops.segment_sum(wf * rows, seg, num_segments=K)
+        sc = jax.ops.segment_sum(wf * cols, seg, num_segments=K)
     cnt_safe = jnp.maximum(cnt, 1.0)
     c_row = sr / cnt_safe
     c_col = sc / cnt_safe
@@ -193,16 +303,24 @@ def _summarize(cfg: FrontierConfig, grid_cfg: GridConfig, labels: Array,
     centroids = jnp.where(slot_valid[:, None],
                           jnp.stack([cx, cy], -1), _BIG)
 
-    # Representative cell per slot: the member closest to the centroid
-    # (min squared distance via segment_min) — always a real frontier cell.
-    d2 = (rows - c_row[jnp.clip(slot_of_cell, 0)]) ** 2 \
-        + (cols - c_col[jnp.clip(slot_of_cell, 0)]) ** 2
-    # d2 holds small integers-ish (< 2*n^2 < 2^24), exact in float32.
-    min_d2 = jax.ops.segment_min(jnp.where(sel, d2, jnp.inf), seg,
-                                 num_segments=K)
-    is_best = sel & (d2 <= min_d2[seg] + 0.5)
-    rep_lin = jax.ops.segment_min(jnp.where(is_best, lin, n * n), seg,
-                                  num_segments=K)
+    # Representative cell per slot: the member closest to the centroid —
+    # always a real frontier cell. d2 holds small integers-ish
+    # (< 2*n^2 < 2^24), exact in float32.
+    if use_dense:
+        d2 = (rows[:, None] - c_row[None, :]) ** 2 \
+            + (cols[:, None] - c_col[None, :]) ** 2              # (n*n, K)
+        min_d2 = jnp.min(jnp.where(member, d2, jnp.inf), axis=0)  # (K,)
+        is_best = member & (d2 <= min_d2[None, :] + 0.5)
+        rep_lin = jnp.min(jnp.where(is_best, lin[:, None], n * n),
+                          axis=0).astype(jnp.int32)               # (K,)
+    else:
+        d2 = (rows - c_row[jnp.clip(slot_of_cell, 0)]) ** 2 \
+            + (cols - c_col[jnp.clip(slot_of_cell, 0)]) ** 2
+        min_d2 = jax.ops.segment_min(jnp.where(sel, d2, jnp.inf), seg,
+                                     num_segments=K)
+        is_best = sel & (d2 <= min_d2[seg] + 0.5)
+        rep_lin = jax.ops.segment_min(jnp.where(is_best, lin, n * n), seg,
+                                      num_segments=K)
     has_rep = rep_lin < n * n
     rep_lin = jnp.clip(rep_lin, 0, n * n - 1)
     rep_row = (rep_lin // n).astype(jnp.int32)
@@ -216,14 +334,23 @@ def _summarize(cfg: FrontierConfig, grid_cfg: GridConfig, labels: Array,
         slot_of_cell.reshape(n, n), rep_rc
 
 
+def _check_pool_divisible(x: Array, c: int) -> None:
+    if x.shape[0] % c or x.shape[1] % c:
+        raise ValueError(f"shape {x.shape} not divisible by pool factor {c}")
+
+
 def _pool_any(x: Array, c: int) -> Array:
-    n0, n1 = x.shape
-    return x.reshape(n0 // c, c, n1 // c, c).any(axis=(1, 3))
+    # reduce_window max on i8 (bool windows are unsupported on TPU); same
+    # strided-reshape avoidance as coarsen().
+    _check_pool_divisible(x, c)
+    return jax.lax.reduce_window(x.astype(jnp.int8), jnp.int8(0),
+                                 jax.lax.max, (c, c), (c, c), "VALID") > 0
 
 
 def _pool_sum(x: Array, c: int) -> Array:
-    n0, n1 = x.shape
-    return x.astype(jnp.int32).reshape(n0 // c, c, n1 // c, c).sum(axis=(1, 3))
+    _check_pool_divisible(x, c)
+    return jax.lax.reduce_window(x.astype(jnp.int32), jnp.int32(0),
+                                 jax.lax.add, (c, c), (c, c), "VALID")
 
 
 def _upsample(x: Array, c: int) -> Array:

@@ -295,10 +295,11 @@ def _classify_fold(grid_cfg: GridConfig, scan_cfg: ScanConfig,
                    mask_b: Array, clamp: bool) -> Array:
     """Chunked classify->fold over the batch: peak memory and Pallas grid
     size are bounded by `_FUSE_CHUNK` regardless of B; results are exact
-    (the fold is sequential either way). Scan b contributes iff mask_b[b]
-    (multiplied on the classified deltas: zeroing ranges instead would
-    still carve free space — a zero range means "outlier, carve to 10 m",
-    server/.../main.py:152)."""
+    (the fold is sequential either way). With mask_b, scan b contributes
+    iff mask_b[b] (multiplied on the classified deltas: zeroing ranges
+    instead would still carve free space — a zero range means "outlier,
+    carve to 10 m", server/.../main.py:152); mask_b=None skips the
+    multiply on the unmasked hot paths."""
     B = ranges_b.shape[0]
     if B == 0:
         return grid_arr
@@ -306,7 +307,8 @@ def _classify_fold(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     def chunk(g, rpm):
         r, p, m = rpm
         deltas, origins = _classify_batch(grid_cfg, scan_cfg, r, p)
-        deltas = deltas * m[:, None, None].astype(deltas.dtype)
+        if m is not None:
+            deltas = deltas * m[:, None, None].astype(deltas.dtype)
         return _fold(grid_cfg, g, deltas, origins, clamp=clamp), None
 
     # Full chunks ride one lax.scan; the remainder is a smaller final call
@@ -321,10 +323,10 @@ def _classify_fold(grid_cfg: GridConfig, scan_cfg: ScanConfig,
             chunk, out,
             (ranges_b[:cut].reshape(nc, CB, -1),
              poses_b[:cut].reshape(nc, CB, 3),
-             mask_b[:cut].reshape(nc, CB)))
+             None if mask_b is None else mask_b[:cut].reshape(nc, CB)))
     if rem:
         out, _ = chunk(out, (ranges_b[B - rem:], poses_b[B - rem:],
-                             mask_b[B - rem:]))
+                             None if mask_b is None else mask_b[B - rem:]))
     return out
 
 
@@ -350,9 +352,8 @@ def fuse_scans(grid_cfg: GridConfig, scan_cfg: ScanConfig,
       ranges_b: (B, padded_beams) metres.
       poses_b:  (B, 3) [x, y, yaw].
     """
-    mask = jnp.ones((ranges_b.shape[0],), jnp.bool_)
     return _classify_fold(grid_cfg, scan_cfg, grid_arr, ranges_b, poses_b,
-                          mask, clamp=True)
+                          None, clamp=True)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
@@ -380,9 +381,8 @@ def scan_deltas_full(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     across the fleet mesh axis before a single clamped apply (parallel/fleet).
     """
     zero = jnp.zeros((grid_cfg.size_cells, grid_cfg.size_cells), jnp.float32)
-    mask = jnp.ones((ranges_b.shape[0],), jnp.bool_)
     return _classify_fold(grid_cfg, scan_cfg, zero, ranges_b, poses_b,
-                          mask, clamp=False)
+                          None, clamp=False)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))

@@ -188,3 +188,37 @@ def test_hierarchical_euclidean_mode(gcfg, fcfg):
     out = F.compute_frontiers(cfg, gcfg, jnp.asarray(lo), robots)
     assert (np.asarray(out.sizes) > 0).sum() >= 1
     assert (np.asarray(out.assignment) >= 0).all()
+
+
+def test_summarize_dense_segment_parity(gcfg, fcfg, monkeypatch):
+    """The dense one-hot/MXU slot formulation and the segment/gather
+    fallback (chosen by _SUMMARIZE_DENSE_BYTES) must agree exactly."""
+    lo = toy_logodds(gcfg)
+    free, _occ, unknown = F.coarsen(fcfg, gcfg, jnp.asarray(lo))
+    mask = F.frontier_mask(free, unknown)
+    labels = F.label_components(fcfg, mask)
+
+    dense = F._summarize(fcfg, gcfg, labels, None, 1)
+    monkeypatch.setattr(F, "_SUMMARIZE_DENSE_BYTES", 0)
+    seg = F._summarize(fcfg, gcfg, labels, None, 1)
+    for a, b in zip(dense, seg):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_label_prop_pallas_parity(fcfg):
+    """The Pallas label-propagation kernel (interpret mode off-TPU) matches
+    the XLA fori_loop path on an irregular multi-component mask."""
+    rng = np.random.default_rng(3)
+    mask = jnp.asarray(rng.random((32, 32)) < 0.3)
+    n = 32
+    seed = jnp.where(mask, jnp.arange(n * n, dtype=jnp.int32).reshape(n, n),
+                     jnp.int32(-1))
+    got = F._label_prop_pallas(mask, seed, fcfg.label_prop_iters)
+
+    import jax
+    want = jax.lax.fori_loop(
+        0, fcfg.label_prop_iters,
+        lambda _, lab: F._neighbor_max_sweep(
+            F._neighbor_max_sweep(lab, mask), mask),
+        F._neighbor_max_sweep(seed, mask))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
