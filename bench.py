@@ -347,8 +347,10 @@ def _run() -> None:
             cluster_n = (g.size_cells // fcfg.downsample
                          // fcfg.cluster_downsample)
             lp_active = FK._use_pallas_labels(cluster_n)
-            if aware and (_RESULT.get("costfield_path") == "pallas"
-                          or lp_active):
+            # The label-prop kernel runs in BOTH cost modes; the cost-field
+            # kernel only in the obstacle-aware one.
+            if lp_active or (aware
+                             and _RESULT.get("costfield_path") == "pallas"):
                 # Production-shape Mosaic/VMEM failures get past the tiny
                 # probe; retry the headline frontier metric on the XLA twin
                 # rather than dropping it.

@@ -156,15 +156,30 @@ def _conv_scores(field: Array, rasters: Array, mass_ref: Array,
     by its own mass would hand candidates whose hit band is clipped by the
     patch edge a smaller denominator and a quietly inflated score. With a
     shared denominator, clipping can only lower a response — conservative.
+
+    Lowering: phrased as a 1D conv whose CHANNEL axis is the patch rows
+    and whose batch axis is the y-shift (one sliced window of the padded
+    field per sy). The natural 2D form — C_in=1 input against (A, 1, P, P)
+    kernels — makes XLA stage the whole P^2 contraction through an
+    implicit im2col at C=1 and ran 3.7x slower at the production 640-patch
+    shape (7.5 -> 2.0 ms coarse, 2.0 -> 0.24 ms fine, measured on v5e);
+    with rows as channels the contraction is a clean (A, P*P) x (P*P, nx)
+    matmul per sy on the MXU. out[sy, a, sx] = sum_{r,c}
+    fpad[sy*stride + r, sx*stride + c] * raster[a, r, c] — identical
+    (unflipped-kernel) correlation semantics either way.
     """
     pad = n_steps * stride
-    inp = jnp.pad(field, pad)[None, None]          # (1, 1, P+2p, P+2p)
-    ker = rasters[:, None]                          # (A, 1, P, P)
+    A, P, _ = rasters.shape
+    fpad = jnp.pad(field, pad)
+    ny = 2 * n_steps + 1
+    windows = jax.vmap(lambda so: jax.lax.dynamic_slice(
+        fpad, (so, 0), (P, P + 2 * pad)))(
+            jnp.arange(ny) * stride)                # (ny, P, P+2p)
     out = jax.lax.conv_general_dilated(
-        inp, ker, window_strides=(stride, stride), padding="VALID",
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32)         # (1, A, 2n+1, 2n+1)
-    return out[0] / mass_ref
+        windows, rasters, window_strides=(stride,), padding="VALID",
+        dimension_numbers=("NCW", "OIW", "NCW"),
+        preferred_element_type=jnp.float32)         # (ny, A, nx)
+    return jnp.transpose(out, (1, 0, 2)) / mass_ref
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
