@@ -149,24 +149,29 @@ def _costfield_xla_fallback() -> None:
     # the already-recorded obstacle-aware number was measured on.
 
 
-def _chain_time(make_jit, k1: int, k2: int, reps: int) -> float:
-    """Median per-iteration seconds for a chained-loop jit factory.
+def _chain_time(make_fn, k1: int, k2: int, reps: int) -> float:
+    """Median per-iteration seconds for a chained-loop fn factory.
 
-    make_jit(k) must return a nullary jitted fn whose result forces the
-    whole k-iteration chain (returns a scalar; fetched with float()).
+    make_fn() must return f(k) that runs a k-iteration device chain and
+    fetches a scalar forcing it. The chain length is a TRACED argument
+    (lax.fori_loop with a dynamic trip count) so both lengths share ONE
+    compilation — the per-section compile cost through the remote TPU
+    compile tunnel dominated the bench wall clock when every section
+    compiled two chain lengths.
     """
-    def med(f):
+    f = make_fn()
+    f(k1)  # compile + warm (same executable serves both lengths)
+    f(k2)
+
+    def med(k):
         ts = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            float(f())
+            f(k)
             ts.append(time.perf_counter() - t0)
         return float(np.median(ts))
 
-    f1, f2 = make_jit(k1), make_jit(k2)
-    float(f1())  # compile + warm
-    float(f2())
-    t1, t2 = med(f1), med(f2)
+    t1, t2 = med(k1), med(k2)
     if t2 > t1:
         return (t2 - t1) / (k2 - k1)
     return t2 / k2
@@ -266,13 +271,14 @@ def _run() -> None:
     # the same program ~2 orders slower; keep it inside the deadline).
     k1, k2, reps = (1, 3, 2) if on_cpu else (2, 10, 5)
 
-    def fuse_chain(k):
-        def run():
+    def fuse_chain():
+        def run(k):
             def body(_, gr):
                 return G.fuse_scans_window(g, s, gr, ranges_d, poses_d)
             gr = jax.lax.fori_loop(0, k, body, G.empty_grid(g))
             return gr.sum()
-        return jax.jit(run)
+        jitted = jax.jit(run)
+        return lambda k: float(jitted(jnp.int32(k)))
 
     target = 50_000.0 * n_dev / 8.0
     try:
@@ -308,11 +314,11 @@ def _run() -> None:
     jax.block_until_ready(grid_arr)
 
     def frontier_chain_factory(fcfg):
-        def frontier_chain(k):
+        def frontier_chain():
             # grid rides as an ARGUMENT: closure capture makes it an XLA
             # constant and const-folding the coarsen masks costs ~40 s of
             # compile per chain (measured) against the bench deadline.
-            def run_g(gr0):
+            def run_g(gr0, k):
                 def body(_, carry):
                     gr, acc = carry
                     fr = F.compute_frontiers(fcfg, g, gr, robot_poses)
@@ -322,7 +328,7 @@ def _run() -> None:
                                            (gr0, jnp.int32(0)))
                 return acc
             jitted = jax.jit(run_g)
-            return lambda: jitted(grid_arr)
+            return lambda k: float(jitted(grid_arr, jnp.int32(k)))
         return frontier_chain
 
     # Product default first (obstacle-aware BFS — the advertised capability),
@@ -380,8 +386,8 @@ def _run() -> None:
     from jax_mapping.ops import scan_match as M
 
     if _remaining() > 90.0:
-        def match_chain(k):
-            def run_g(gr0):
+        def match_chain():
+            def run_g(gr0, k):
                 def body(_, p):
                     r = M.match(g, s, cfg.matcher, gr0, ranges_d[0], p)
                     return r.pose
@@ -389,7 +395,7 @@ def _run() -> None:
                     0, k, body, jnp.zeros(3, jnp.float32) + 0.01)
                 return p.sum()
             jitted = jax.jit(run_g)
-            return lambda: jitted(grid_arr)
+            return lambda k: float(jitted(grid_arr, jnp.int32(k)))
         try:
             p50 = _chain_time(match_chain, k1, k2, reps)
             _RESULT["match_p50_ms"] = round(p50 * 1e3, 2)
@@ -411,8 +417,8 @@ def _run() -> None:
         wr = jnp.float32(4000.0)
         dts = jnp.float32(0.1)
 
-        def slam_chain(k):
-            def run_g(st0):
+        def slam_chain():
+            def run_g(st0, k):
                 def body(i, st):
                     st2, _diag = SM.slam_step(cfg, st, ranges_d[0], wl, wr,
                                               dts)
@@ -420,7 +426,7 @@ def _run() -> None:
                 st = jax.lax.fori_loop(0, k, body, st0)
                 return st.pose.sum() + st.grid.sum()
             jitted = jax.jit(run_g)
-            return lambda: jitted(state0)
+            return lambda k: float(jitted(state0, jnp.int32(k)))
         try:
             p50 = _chain_time(slam_chain, k1, k2, reps)
             _RESULT["slam_step_p50_ms"] = round(p50 * 1e3, 2)
@@ -450,8 +456,8 @@ def _run() -> None:
         world_d = jax.device_put(jnp.asarray(world), dev)
         fstate0 = FL.init_fleet_state(cfg, jax.random.PRNGKey(0))
 
-        def fleet_chain(k):
-            def run_g(st):
+        def fleet_chain():
+            def run_g(st, k):
                 def body(_, s2):
                     s3, _diag = FL.fleet_step(cfg, s2, g.resolution_m,
                                               world_d)
@@ -459,7 +465,7 @@ def _run() -> None:
                 out = jax.lax.fori_loop(0, k, body, st)
                 return out.grid.sum() + out.est_poses.sum()
             jitted = jax.jit(run_g)
-            return lambda: jitted(fstate0)
+            return lambda k: float(jitted(fstate0, jnp.int32(k)))
         try:
             p50 = _chain_time(fleet_chain, 1, 3, min(reps, 3))
             _RESULT["fleet_tick_p50_ms_8robots"] = round(p50 * 1e3, 2)

@@ -142,6 +142,13 @@ class MatcherConfig:
     angle_variance_penalty_rad2: float = 1.0    # yaml:62
     min_distance_penalty: float = 0.5
     min_angle_penalty: float = 0.9
+    # Coarse-pass correlation in bfloat16 (fp32 accumulate): ~6x faster on
+    # the MXU, and a worst-case ~0.4% score perturbation can only flip
+    # near-tie COARSE winners — the fine passes re-search +-1 coarse step
+    # and every gate (min_response, loop response_fine) reads fp32 scores.
+    # TPU-only: off-TPU the matcher ignores it (XLA CPU has no fast bf16
+    # conv path and runs orders of magnitude slower than f32).
+    coarse_bf16: bool = True
     # Gating: only match when moved enough (slam_config.yaml:37-38).
     min_travel_m: float = 0.1
     min_heading_rad: float = 0.1

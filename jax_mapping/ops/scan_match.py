@@ -144,7 +144,8 @@ def _raster_batch(grid_cfg: GridConfig, scan_cfg: ScanConfig, ranges: Array,
 
 
 def _conv_scores(field: Array, rasters: Array, mass_ref: Array,
-                 n_steps: int, stride: int = 1) -> Array:
+                 n_steps: int, stride: int = 1,
+                 compute_dtype=jnp.float32) -> Array:
     """resp[a, sy, sx] = <raster_a, field shifted by ((sy-n)*stride,
     (sx-n)*stride) cells> / mass_ref — the whole correlative window as ONE
     cross-correlation on the MXU (XLA conv kernels are not flipped, so the
@@ -170,14 +171,14 @@ def _conv_scores(field: Array, rasters: Array, mass_ref: Array,
     """
     pad = n_steps * stride
     A, P, _ = rasters.shape
-    fpad = jnp.pad(field, pad)
+    fpad = jnp.pad(field, pad).astype(compute_dtype)
     ny = 2 * n_steps + 1
     windows = jax.vmap(lambda so: jax.lax.dynamic_slice(
         fpad, (so, 0), (P, P + 2 * pad)))(
             jnp.arange(ny) * stride)                # (ny, P, P+2p)
     out = jax.lax.conv_general_dilated(
-        windows, rasters, window_strides=(stride,), padding="VALID",
-        dimension_numbers=("NCW", "OIW", "NCW"),
+        windows, rasters.astype(compute_dtype), window_strides=(stride,),
+        padding="VALID", dimension_numbers=("NCW", "OIW", "NCW"),
         preferred_element_type=jnp.float32)         # (ny, A, nx)
     return jnp.transpose(out, (1, 0, 2)) / mass_ref
 
@@ -224,7 +225,18 @@ def match(grid_cfg: GridConfig, scan_cfg: ScanConfig, m_cfg: MatcherConfig,
     # candidate raster's mass. Rotations preserve band mass up to clipping,
     # so this is the scan's unclipped in-patch mass for any candidate.
     mass_ref = jnp.maximum(jnp.max(mass_c), 1e-6)
-    resp_c = _conv_scores(field, rasters_c, mass_ref, n_steps, stride)
+    # bf16 only where it pays: XLA CPU has no fast bf16 conv path (a tiny
+    # bf16 conv ran orders of magnitude slower than f32 — measured), so
+    # off-TPU the flag is ignored and everything stays f32. The process
+    # default backend is the best trace-time signal available under jit
+    # (input avals carry no device); arrays explicitly committed to CPU on
+    # a TPU host still trace bf16 — set coarse_bf16=False for that
+    # debugging pattern.
+    coarse_dtype = (jnp.bfloat16
+                    if m_cfg.coarse_bf16 and jax.default_backend() == "tpu"
+                    else jnp.float32)
+    resp_c = _conv_scores(field, rasters_c, mass_ref, n_steps, stride,
+                          compute_dtype=coarse_dtype)
     # Rank by variance-penalized response (prior-proximity tie-break,
     # yaml:61-62); gate on the winner's RAW response (Karto semantics).
     step_m = stride * res

@@ -107,3 +107,20 @@ def test_match_batch_matches_single(tiny_cfg, room_map):
                          jnp.asarray(poses[i]))
         np.testing.assert_allclose(np.asarray(batch.pose[i]),
                                    np.asarray(single.pose), atol=1e-6)
+
+
+def test_conv_scores_bf16_parity(tiny_cfg, rng):
+    """The bf16 coarse-scoring path (MatcherConfig.coarse_bf16, TPU
+    default) must track the f32 scores within bf16 rounding and keep the
+    same winner on a peaked response surface."""
+    field = jnp.asarray(rng.random((64, 64)).astype(np.float32))
+    rasters = jnp.asarray(
+        (rng.random((5, 64, 64)) < 0.05).astype(np.float32))
+    mass = jnp.float32(1.0)
+    f32 = M._conv_scores(field, rasters, mass, 3, 1)
+    bf16 = M._conv_scores(field, rasters, mass, 3, 1,
+                          compute_dtype=jnp.bfloat16)
+    assert bf16.dtype == jnp.float32          # fp32 accumulate/output
+    np.testing.assert_allclose(np.asarray(bf16), np.asarray(f32),
+                               rtol=2e-2, atol=1e-2)
+    assert int(jnp.argmax(bf16)) == int(jnp.argmax(f32))
