@@ -93,6 +93,11 @@ def _scrub_cpu_env() -> dict:
     env["PYTHONPATH"] = os.pathsep.join([repo] + keep)
     env["JAX_PLATFORMS"] = "cpu"
     env["_JAX_MAPPING_BENCH_CPU_FALLBACK"] = "1"
+    # The re-exec'd process restarts its deadline clock; hand it only the
+    # budget this process has left, or the probe's 120 s + a fresh 540 s
+    # watchdog would overshoot the caller's own timeout and the round
+    # would end with NO JSON line at all (the round-1 failure mode).
+    env["JAX_MAPPING_BENCH_DEADLINE_S"] = str(max(60.0, _remaining()))
     return env
 
 
