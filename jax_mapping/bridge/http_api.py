@@ -14,6 +14,13 @@ variants' routes merged:
   actually works.
 * `GET /frontiers` — JSON frontier targets + assignment (new capability).
 * `GET /metrics` — framework counters in Prometheus text format.
+* `GET /save[?name=x]`, `GET /load[?name=x]` — checkpoint / restore the
+  live SLAM state (grid, poses, graphs, scan rings) through
+  `io.checkpoint`. The capability slam_toolbox exposes as its
+  serialization service (`enable_interactive_mode`, slam_config.yaml:32)
+  but the reference never invokes — here a restart resumes the map
+  instead of losing it. Names are basenames inside `checkpoint_dir`
+  (no path traversal); load refuses config-drifted checkpoints.
 
 Served threaded like the reference (Flask's threaded dev server); shutdown
 uses the pi variant's graceful `make_server`/`shutdown` pattern
@@ -45,9 +52,12 @@ class MapApiServer:
 
     def __init__(self, bus: Bus, brain=None, host: str = "127.0.0.1",
                  port: int = 5000, png_cache_s: float = 1.0,
-                 extra_status: Optional[Callable[[], dict]] = None):
+                 extra_status: Optional[Callable[[], dict]] = None,
+                 mapper=None, checkpoint_dir: str = "checkpoints"):
         self.bus = bus
         self.brain = brain
+        self.mapper = mapper
+        self.checkpoint_dir = checkpoint_dir
         self.png_cache_s = png_cache_s
         self.extra_status = extra_status
         self._lock = threading.Lock()
@@ -121,8 +131,49 @@ class MapApiServer:
             return self._frontiers()
         if route == "/metrics":
             return 200, "text/plain", self._metrics().encode()
+        if route in ("/save", "/load"):
+            return self._checkpoint(route, path)
         return 404, "application/json", \
             json.dumps({"error": f"no route {route}"}).encode()
+
+    def _checkpoint(self, route: str, path: str) -> Tuple[int, str, bytes]:
+        if self.mapper is None:
+            return 404, "application/json", json.dumps(
+                {"error": "no mapper attached"}).encode()
+        import os
+        from urllib.parse import parse_qs, urlparse
+
+        from jax_mapping.io.checkpoint import (load_checkpoint,
+                                               save_checkpoint)
+        q = parse_qs(urlparse(path).query)
+        name = os.path.basename(q.get("name", ["slam_state"])[0]) or \
+            "slam_state"
+        fp = os.path.join(self.checkpoint_dir, name + ".npz")
+        if route == "/save":
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            with self.mapper._state_lock:
+                states = list(self.mapper.states)
+            save_checkpoint(fp, states,
+                            config_json=self.mapper.cfg.to_json())
+            return 200, "application/json", json.dumps(
+                {"status": "saved", "path": fp,
+                 "robots": len(states)}).encode()
+        if not os.path.exists(fp):
+            return 404, "application/json", json.dumps(
+                {"error": f"no checkpoint {fp}"}).encode()
+        from jax_mapping.models import slam as _S
+        template = [_S.init_state(self.mapper.cfg)
+                    for _ in self.mapper.states]
+        states, cfg_json = load_checkpoint(fp, template)
+        if cfg_json is not None and cfg_json != self.mapper.cfg.to_json():
+            return 409, "application/json", json.dumps(
+                {"error": "checkpoint config differs from the running "
+                          "config; refusing to load"}).encode()
+        with self.mapper._state_lock:
+            self.mapper.states = list(states)
+        return 200, "application/json", json.dumps(
+            {"status": "loaded", "path": fp,
+             "robots": len(states)}).encode()
 
     def _map_image(self) -> Tuple[int, str, bytes]:
         with self._lock:

@@ -95,7 +95,8 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
 
     api = None
     if http_port is not None:
-        api = MapApiServer(bus, brain=brain, port=http_port)
+        api = MapApiServer(bus, brain=brain, port=http_port,
+                           mapper=mapper)
         api.serve_thread()
 
     executor = Executor([sim, brain, mapper])

@@ -143,7 +143,8 @@ def _launch_live_stack(cfg, http_port=None):
     mapper = MapperNode(cfg, bus, tf=tf, n_robots=1)
     api = None
     if http_port is not None:
-        api = MapApiServer(bus, brain=None, port=http_port)
+        api = MapApiServer(bus, brain=None, port=http_port,
+                           mapper=mapper)
         api.serve_thread()
     executor = Executor([mapper])
     executor.spin_thread()
