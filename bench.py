@@ -452,12 +452,9 @@ def _run() -> None:
     # deployment replaces those with robots' actual scans.
     if _remaining() > 150.0:
         from jax_mapping.models import fleet as FL
-        world = np.zeros((g.size_cells, g.size_cells), bool)
-        world[:64, :] = world[-64:, :] = True
-        world[:, :64] = world[:, -64:] = True
-        for _ in range(40):
-            r0, c0 = rng.integers(256, g.size_cells - 256, 2)
-            world[r0:r0 + 8, c0:c0 + rng.integers(64, 512)] = True
+        from jax_mapping.sim import world as W
+        world = W.plank_course(g.size_cells, g.resolution_m, n_planks=40,
+                               seed=0)
         world_d = jax.device_put(jnp.asarray(world), dev)
         fstate0 = FL.init_fleet_state(cfg, jax.random.PRNGKey(0))
 

@@ -30,10 +30,12 @@ uses the pi variant's graceful `make_server`/`shutdown` pattern
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -140,9 +142,6 @@ class MapApiServer:
         if self.mapper is None:
             return 404, "application/json", json.dumps(
                 {"error": "no mapper attached"}).encode()
-        import os
-        from urllib.parse import parse_qs, urlparse
-
         from jax_mapping.io.checkpoint import (load_checkpoint,
                                                save_checkpoint)
         q = parse_qs(urlparse(path).query)

@@ -88,11 +88,20 @@ def coarsen(cfg: FrontierConfig, grid_cfg: GridConfig, logodds: Array):
 
 
 def _shift(x: Array, dr: int, dc: int, fill=False) -> Array:
-    """Shift a 2D bool/float array, filling vacated cells."""
-    out = jnp.full_like(x, fill)
-    H, W = x.shape
-    src = x[max(0, -dr):H - max(0, dr), max(0, -dc):W - max(0, dc)]
-    return jax.lax.dynamic_update_slice(out, src, (max(0, dr), max(0, dc)))
+    """Shift a 2D array, filling vacated cells.
+
+    Concatenate-based (not dynamic_update_slice) so the SAME helper lowers
+    inside Mosaic/Pallas kernel bodies and as plain XLA — this is the one
+    shift implementation every frontier path shares."""
+    if dr:
+        f = jnp.full_like(x[:1, :], fill)
+        x = (jnp.concatenate([f, x[:-1, :]], axis=0) if dr > 0
+             else jnp.concatenate([x[1:, :], f], axis=0))
+    if dc:
+        f = jnp.full_like(x[:, :1], fill)
+        x = (jnp.concatenate([f, x[:, :-1]], axis=1) if dc > 0
+             else jnp.concatenate([x[:, 1:], f], axis=1))
+    return x
 
 
 def frontier_mask(free: Array, unknown: Array) -> Array:
@@ -125,23 +134,12 @@ def _use_pallas_labels(n: int) -> bool:
 def _neighbor_max_sweep(lab: Array, m: Array) -> Array:
     """One 8-neighbour max propagation sweep; jnp ops only so the same
     body lowers inside the Pallas kernel and traces as plain XLA."""
-    def sh(x, dr, dc):
-        if dr:
-            fill = jnp.full_like(x[:1, :], -1)
-            x = (jnp.concatenate([fill, x[:-1, :]], axis=0) if dr > 0
-                 else jnp.concatenate([x[1:, :], fill], axis=0))
-        if dc:
-            fill = jnp.full_like(x[:, :1], -1)
-            x = (jnp.concatenate([fill, x[:, :-1]], axis=1) if dc > 0
-                 else jnp.concatenate([x[:, 1:], fill], axis=1))
-        return x
-
     best = lab
     for dr in (-1, 0, 1):
         for dc in (-1, 0, 1):
             if dr == 0 and dc == 0:
                 continue
-            best = jnp.maximum(best, sh(lab, dr, dc))
+            best = jnp.maximum(best, _shift(lab, dr, dc, fill=-1))
     return jnp.where(m, best, -1)
 
 
