@@ -299,3 +299,36 @@ def test_http_save_load_roundtrip(tiny_cfg, tmp_path):
             np.asarray(stack.mapper.states[0].grid), grid_before)
     finally:
         stack.shutdown()
+
+
+def test_http_load_refuses_config_drift(tiny_cfg, tmp_path):
+    """A checkpoint written under a different config must 409, not load."""
+    import dataclasses
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.io.checkpoint import save_checkpoint
+    from jax_mapping.models import slam as S
+    from jax_mapping.sim import world as W
+
+    other = dataclasses.replace(
+        tiny_cfg, matcher=dataclasses.replace(tiny_cfg.matcher,
+                                              min_response=0.42))
+    save_checkpoint(str(tmp_path / "drift.npz"), [S.init_state(tiny_cfg)],
+                    config_json=other.to_json())
+
+    world = W.plank_course(96, tiny_cfg.grid.resolution_m, n_planks=2,
+                           seed=1)
+    stack = launch_sim_stack(tiny_cfg, world, n_robots=1, http_port=0)
+    try:
+        stack.api.checkpoint_dir = str(tmp_path)
+        url = f"http://127.0.0.1:{stack.api.port}/load?name=drift"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url)
+        assert ei.value.code == 409
+        body = _json.loads(ei.value.read())
+        assert "config" in body["error"]
+    finally:
+        stack.shutdown()
