@@ -292,6 +292,32 @@ def _run() -> None:
         _RESULT["value"] = round(scans_per_sec, 1)
         _RESULT["vs_baseline"] = round(scans_per_sec / target, 3)
         _RESULT["sections_completed"].append("fuse")
+        # Stage budget on stderr (VERDICT r2 #3): the kernel alone vs the
+        # full fuse (kernel + grid read-modify-write + chain glue).
+        # Pallas path only: calling the kernel directly off-TPU would run
+        # interpret mode, which is pathologically slow at this shape. Own
+        # try: a stage-budget failure must not re-enter the fuse fallback
+        # and overwrite the recorded Pallas numbers.
+        if _RESULT["path"] == "pallas" and _remaining() > 90.0:
+            try:
+                def kernel_chain():
+                    def run(k):
+                        def body(_, d):
+                            d2 = SK.window_delta(g, s, ranges_d,
+                                                 poses_d + d * 0.0, origin)
+                            return d2[:1, :1].reshape(())[None, None]
+                        d = jax.lax.fori_loop(
+                            0, k, body, jnp.zeros((1, 1), jnp.float32))
+                        return d.sum()
+                    jitted = jax.jit(run)
+                    return lambda k: float(jitted(jnp.int32(k)))
+                kdt = _chain_time(kernel_chain, k1, k2, reps)
+                print(f"bench: fuse stage budget — window kernel "
+                      f"{kdt * 1e3:.2f} ms, full fuse {dt * 1e3:.2f} ms "
+                      f"({B} scans/window)", file=sys.stderr, flush=True)
+            except Exception:
+                import traceback
+                traceback.print_exc(file=sys.stderr)
     except Exception:
         if G._use_pallas():
             # In-process engine fallback: re-trace with XLA paths.
