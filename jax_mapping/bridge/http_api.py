@@ -150,8 +150,7 @@ class MapApiServer:
         fp = os.path.join(self.checkpoint_dir, name + ".npz")
         if route == "/save":
             os.makedirs(self.checkpoint_dir, exist_ok=True)
-            with self.mapper._state_lock:
-                states = list(self.mapper.states)
+            states = self.mapper.snapshot_states()
             save_checkpoint(fp, states,
                             config_json=self.mapper.cfg.to_json())
             return 200, "application/json", json.dumps(
@@ -168,8 +167,9 @@ class MapApiServer:
             return 409, "application/json", json.dumps(
                 {"error": "checkpoint config differs from the running "
                           "config; refusing to load"}).encode()
-        with self.mapper._state_lock:
-            self.mapper.states = list(states)
+        # No anchor poses: the /load contract is a server restart with
+        # robots holding still, so checkpoint poses are still valid.
+        self.mapper.restore_states(states)
         return 200, "application/json", json.dumps(
             {"status": "loaded", "path": fp,
              "robots": len(states)}).encode()

@@ -112,6 +112,48 @@ class MapperNode(Node):
             self._last_odom_pose[0] = None
         M.counters.inc("mapper.initialpose_resets")
 
+    # -- checkpoint surface --------------------------------------------------
+
+    def snapshot_states(self) -> List:
+        """Consistent copy of the per-robot SLAM states (for checkpoints)."""
+        with self._state_lock:
+            return list(self.states)
+
+    def restore_states(self, states, anchor_poses=None) -> None:
+        """Swap in checkpointed SLAM states and reset odometry pairing.
+
+        Both resume paths (HTTP /load, demo --resume) go through here so
+        the pairing reset can't be forgotten at one call site: without it
+        the first post-restore odometry pair would integrate the jump
+        between the stale and live odom frames into the pose estimate.
+
+        anchor_poses: optional (R, 3) rows. When given, robot i's chain is
+        RE-ANCHORED at anchor_poses[i] — fresh graph from that pose,
+        inherited grid (the `_initialpose_cb` localization-reset
+        semantics) — for resumes where the physical robot no longer sits
+        at the checkpointed pose (a relaunched sim respawns robots; scans
+        fused at the stale endpoint pose would corrupt the inherited
+        map). Omit it only when poses are still valid (a server restart
+        with robots holding still).
+        """
+        if len(states) != len(self.states):
+            raise ValueError(
+                f"checkpoint has {len(states)} robot state(s), the stack "
+                f"runs {len(self.states)}")
+        jnp = self._jnp
+        with self._state_lock:
+            self.states = list(states)
+            for i in range(len(self.states)):
+                if anchor_poses is not None:
+                    pose = jnp.asarray(anchor_poses[i], dtype="float32")
+                    fresh = self._S.init_state(self.cfg, pose0=pose)
+                    self.states[i] = fresh._replace(
+                        grid=self.states[i].grid)
+                self._prev_paired[i] = None
+                self._last_odom_pose[i] = None
+
+    # -- topic callbacks -----------------------------------------------------
+
     def _scan_cb(self, i: int, msg: LaserScan) -> None:
         with self._state_lock:
             self._scan_q[i].append(msg)

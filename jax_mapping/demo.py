@@ -43,6 +43,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve the HTTP API on this port (0 = pick free)")
     p.add_argument("--serve", action="store_true",
                    help="keep serving HTTP after stepping (Ctrl-C to exit)")
+    p.add_argument("--resume", type=str, default=None, metavar="CKPT",
+                   help="resume the SLAM state from a checkpoint written "
+                        "by --save-final or the HTTP /save endpoint")
+    p.add_argument("--save-final", type=str, default=None, metavar="CKPT",
+                   help="write the final SLAM state as a resumable "
+                        "checkpoint")
     p.add_argument("--drop-prob", type=float, default=0.0,
                    help="Best-Effort link loss injection (report.pdf §V.A)")
     p.add_argument("--seed", type=int, default=0)
@@ -84,6 +90,35 @@ def main(argv=None) -> int:
                              http_port=port, drop_prob=args.drop_prob,
                              seed=args.seed)
     try:
+        if args.resume:
+            from jax_mapping.io.checkpoint import load_checkpoint
+            from jax_mapping.models import slam as S
+            template = [S.init_state(cfg) for _ in stack.mapper.states]
+            try:
+                states, ckpt_cfg = load_checkpoint(args.resume, template)
+            except FileNotFoundError:
+                print(f"error: no checkpoint at {args.resume}",
+                      file=sys.stderr)
+                return 2
+            except ValueError as e:
+                # Wrong robot count / config shape drift raises before the
+                # config comparison below can explain it politely.
+                print(f"error: cannot resume from {args.resume}: {e}",
+                      file=sys.stderr)
+                return 2
+            if ckpt_cfg is not None and ckpt_cfg != cfg.to_json():
+                print("error: checkpoint config differs from the running "
+                      "config; pass the matching --config", file=sys.stderr)
+                return 2
+            # Anchor at the relaunched sim's ACTUAL spawn poses: the map
+            # is inherited, but robots respawned — fusing at the stale
+            # checkpoint poses would draw the spawn surroundings into the
+            # wrong part of the map (mapper.restore_states docstring).
+            stack.mapper.restore_states(states,
+                                        anchor_poses=stack.brain.poses)
+            print(f"resumed {len(states)} robot state(s) from "
+                  f"{args.resume}", file=sys.stderr)
+
         stack.brain.start_exploring()
         t0 = time.time()
         report_every = max(1, args.steps // 5)
@@ -119,6 +154,13 @@ def main(argv=None) -> int:
             with open(args.out, "wb") as f:
                 f.write(encode_gray(img))
             print(f"map written to {args.out}", file=sys.stderr)
+
+        if args.save_final:
+            from jax_mapping.io.checkpoint import save_checkpoint
+            save_checkpoint(args.save_final, stack.mapper.snapshot_states(),
+                            config_json=cfg.to_json())
+            print(f"checkpoint written to {args.save_final}",
+                  file=sys.stderr)
 
         if args.serve and stack.api is not None:
             print(f"serving on http://127.0.0.1:{stack.api.port} — Ctrl-C "
