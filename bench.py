@@ -198,6 +198,12 @@ def _run() -> None:
     _RESULT["devices"] = f"{n_dev}x {dev.platform}" + (
         " (tpu tunnel unreachable, virtual-cpu fallback)"
         if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") == "1" else "")
+    if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") == "1" and \
+            os.path.exists(os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "BENCH_LOCAL_r03.json")):
+        # Virtual-CPU numbers say nothing about the TPU framework; point
+        # the reader at the last builder-measured hardware run.
+        _RESULT["tpu_numbers_recorded_in"] = "BENCH_LOCAL_r03.json"
 
     # ---- engine choice: probe the Pallas kernel once on tiny shapes ------
     # A Mosaic/toolchain rejection must cost seconds, not the round: fall
