@@ -195,15 +195,18 @@ def _run() -> None:
     dev = jax.devices()[0]
     n_dev = len(jax.devices())
     on_cpu = dev.platform == "cpu"
+    cpu_fallback = os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") == "1"
     _RESULT["devices"] = f"{n_dev}x {dev.platform}" + (
-        " (tpu tunnel unreachable, virtual-cpu fallback)"
-        if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") == "1" else "")
-    if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") == "1" and \
-            os.path.exists(os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "BENCH_LOCAL_r03.json")):
+        " (tpu tunnel unreachable, virtual-cpu fallback)" if cpu_fallback
+        else "")
+    if cpu_fallback:
         # Virtual-CPU numbers say nothing about the TPU framework; point
-        # the reader at the last builder-measured hardware run.
-        _RESULT["tpu_numbers_recorded_in"] = "BENCH_LOCAL_r03.json"
+        # the reader at the NEWEST builder-measured hardware record.
+        import glob
+        recs = sorted(glob.glob(os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "BENCH_LOCAL_r*.json")))
+        if recs:
+            _RESULT["tpu_numbers_recorded_in"] = os.path.basename(recs[-1])
 
     # ---- engine choice: probe the Pallas kernel once on tiny shapes ------
     # A Mosaic/toolchain rejection must cost seconds, not the round: fall

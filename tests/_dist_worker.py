@@ -1,0 +1,54 @@
+"""Worker process for the multi-process DCN integration test.
+
+Run as: python _dist_worker.py <process_id> <num_processes> <port>.
+Each process owns 2 virtual CPU devices; the hybrid ('fleet', 'space')
+mesh places fleet across processes (the DCN axis) and space within one.
+The psum checked here is the fleet map-merge collective
+(parallel/fleet_sharded.py's per-step log-odds merge).
+"""
+import functools
+import os
+import sys
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_MAPPING_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["JAX_MAPPING_NUM_PROCESSES"] = str(nproc)
+os.environ["JAX_MAPPING_PROCESS_ID"] = str(pid)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax                                               # noqa: E402
+import jax.numpy as jnp                                  # noqa: E402
+from jax.experimental.shard_map import shard_map         # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P   # noqa: E402
+
+from jax_mapping.parallel import distributed as D        # noqa: E402
+
+assert D.initialize(D.DistConfig.from_env()), "initialize() returned False"
+assert jax.process_count() == nproc, jax.process_count()
+assert len(jax.devices()) == 2 * nproc, len(jax.devices())
+
+mesh = D.hybrid_fleet_mesh(n_hosts=nproc, space_per_host=2)
+assert dict(zip(mesh.axis_names, mesh.devices.shape)) == \
+    {"fleet": nproc, "space": 2}
+
+
+@functools.partial(shard_map, mesh=mesh, in_specs=P("fleet", "space"),
+                   out_specs=P(None, "space"))
+def merge(x):
+    return jax.lax.psum(x, "fleet")
+
+
+def shard_data(idx):
+    fleet_i = idx[0].start // 2          # 2 rows per fleet host
+    return jnp.ones((2, 2), jnp.float32) * (fleet_i + 1)
+
+
+arr = jax.make_array_from_callback(
+    (nproc * 2, 4), NamedSharding(mesh, P("fleet", "space")), shard_data)
+out = merge(arr)
+expect = float(sum(range(1, nproc + 1)))
+for sh in out.addressable_shards:
+    vals = {float(v) for v in sh.data.ravel()}
+    assert vals == {expect}, (vals, expect)
+print(f"DIST_OK proc {pid}: fleet psum == {expect}", flush=True)
