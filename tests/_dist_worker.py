@@ -52,3 +52,23 @@ for sh in out.addressable_shards:
     vals = {float(v) for v in sh.data.ravel()}
     assert vals == {expect}, (vals, expect)
 print(f"DIST_OK proc {pid}: fleet psum == {expect}", flush=True)
+
+# ---- phase 2: the FULL sharded fleet step across the process boundary ----
+# The same step the driver dry-runs on a single-process virtual mesh
+# (__graft_entry__.dryrun_multichip), here with the fleet axis genuinely
+# spanning two OS processes: the slab-delta psum map-merge and the coarse
+# frontier all_gather both cross Gloo.
+from __graft_entry__ import _tiny                        # noqa: E402
+from jax_mapping.parallel import fleet_sharded as FS     # noqa: E402
+from jax_mapping.sim import world as W                   # noqa: E402
+
+cfg = _tiny(2 * nproc)
+world = jnp.asarray(W.empty_arena(96, cfg.grid.resolution_m))
+state = FS.init_sharded_state(cfg, mesh)
+step = FS.make_fleet_step(cfg, mesh, cfg.grid.resolution_m)
+state, metrics = step(state, world)
+jax.block_until_ready(state)
+err = float(metrics["mean_pose_err_m"])
+assert err == err and err < 1.0, err
+print(f"DIST_OK proc {pid}: sharded fleet step across processes, "
+      f"mean_pose_err={err:.4f} m", flush=True)

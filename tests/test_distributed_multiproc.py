@@ -1,10 +1,14 @@
 """True multi-process DCN integration: two OS processes, jax.distributed
-over localhost, the fleet map-merge psum crossing the process boundary.
+over localhost, running (1) the fleet map-merge psum and (2) the FULL
+sharded fleet step — slab-delta psum merge, coarse-mask all_gather,
+matching, fusion, graphs — with the fleet mesh axis genuinely spanning
+the process boundary (Gloo CPU backend).
 
 The reference's distributed operation is two hosts over DDS
 (`/root/reference/README.md:78-86`); this is the XLA-collective
-equivalent actually exercised across processes (Gloo CPU backend), not
-just a single-process virtual mesh.
+equivalent actually exercised across processes, not just a
+single-process virtual mesh (which `__graft_entry__.dryrun_multichip`
+already covers).
 """
 
 import os
