@@ -43,6 +43,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve the HTTP API on this port (0 = pick free)")
     p.add_argument("--serve", action="store_true",
                    help="keep serving HTTP after stepping (Ctrl-C to exit)")
+    p.add_argument("--record", type=str, default=None, metavar="BAG",
+                   help="record /scan + /odom to a rosbag-style trace "
+                        "(io.trace) during the run")
+    p.add_argument("--replay", type=str, default=None, metavar="BAG",
+                   help="map from a recorded trace instead of simulating "
+                        "(no sim, no brain: scans + odometry come from "
+                        "the bag — the reference's rosbag workflow)")
     p.add_argument("--resume", type=str, default=None, metavar="CKPT",
                    help="resume the SLAM state from a checkpoint written "
                         "by --save-final or the HTTP /save endpoint")
@@ -62,6 +69,74 @@ def _occupancy(stack):
     return np.asarray(G.to_occupancy(stack.cfg.grid, stack.mapper.merged_grid()))
 
 
+def _write_png(path: str, occ) -> None:
+    from jax_mapping.bridge.png import encode_gray
+    from jax_mapping.ops.grid import occupancy_to_png_array
+    with open(path, "wb") as f:
+        f.write(encode_gray(occupancy_to_png_array(occ)))
+    print(f"map written to {path}", file=sys.stderr)
+
+
+def _replay_main(args, cfg) -> int:
+    """Map from a recorded /scan + /odom trace: no sim, no brain — the
+    reference's rosbag workflow (SURVEY.md §7 item 7), mapper only."""
+    import numpy as np
+
+    from jax_mapping.bridge.brain import robot_ns
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.mapper import MapperNode
+    from jax_mapping.io.trace import TraceReplayer
+    from jax_mapping.ops import grid as G
+
+    bus = Bus()
+    mapper = MapperNode(cfg, bus, n_robots=args.robots)
+
+    rep = TraceReplayer(args.replay)
+    # Cross-check the bag's topics against this robot count's namespaces:
+    # a bag recorded with --robots 2 replayed at the default 1 would
+    # publish every message to topics nothing subscribes to and "succeed"
+    # with an all-unknown map.
+    expected = set()
+    for i in range(args.robots):
+        ns = robot_ns(i, args.robots)
+        expected |= {f"{ns}scan", f"{ns}odom"}
+    bag_topics = {rec["topic"] for rec in rep.index}
+    if not (bag_topics & expected):
+        print(f"error: bag topics {sorted(bag_topics)} match none of the "
+              f"expected {sorted(expected)} — was the bag recorded with a "
+              "different --robots?", file=sys.stderr)
+        return 2
+    pubs = {}
+    n = 0
+    # Interleave publishing with mapper ticks: the odometry pairing
+    # history is bounded (mapper drops old entries), so a bag must not be
+    # dumped wholesale ahead of processing.
+    for stamp, topic, msg in rep.messages():
+        if topic not in pubs:
+            pubs[topic] = bus.publisher(topic)
+        pubs[topic].publish(msg)
+        n += 1
+        if n % 40 == 0:
+            mapper.tick()
+    for _ in range(4):
+        mapper.tick()
+
+    occ = np.asarray(G.to_occupancy(cfg.grid, mapper.merged_grid()))
+    summary = {
+        "replayed": n,
+        "bag": args.replay,
+        "robots": args.robots,
+        "cells_free": int((occ == 0).sum()),
+        "cells_occupied": int((occ == 100).sum()),
+        "scans_fused": int(mapper.n_scans_fused),
+        "scans_dropped_unpaired": int(mapper.n_scans_dropped_unpaired),
+    }
+    print(json.dumps(summary, indent=2))
+    if args.out:
+        _write_png(args.out, occ)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     args.robots = max(1, args.robots)
@@ -78,6 +153,16 @@ def main(argv=None) -> int:
     else:
         cfg = tiny_config(n_robots=args.robots)
 
+    if args.replay:
+        clash = [f for f in ("record", "save_final", "resume", "serve")
+                 if getattr(args, f)]
+        if clash:
+            flags = ", ".join("--" + f.replace("_", "-") for f in clash)
+            print(f"error: --replay cannot be combined with {flags}",
+                  file=sys.stderr)
+            return 2
+        return _replay_main(args, cfg)
+
     if args.world == "arena":
         world = W.empty_arena(args.world_cells, cfg.grid.resolution_m)
     else:
@@ -89,7 +174,17 @@ def main(argv=None) -> int:
     stack = launch_sim_stack(cfg, world, n_robots=args.robots,
                              http_port=port, drop_prob=args.drop_prob,
                              seed=args.seed)
+    recorder = None
     try:
+        if args.record:
+            from jax_mapping.bridge.brain import robot_ns
+            from jax_mapping.io.trace import TraceRecorder
+            topics = []
+            for i in range(args.robots):
+                ns = robot_ns(i, args.robots)
+                topics += [f"{ns}scan", f"{ns}odom"]
+            recorder = TraceRecorder(stack.bus, topics)
+
         if args.resume:
             from jax_mapping.io.checkpoint import load_checkpoint
             from jax_mapping.models import slam as S
@@ -147,13 +242,14 @@ def main(argv=None) -> int:
             summary["http"] = f"http://127.0.0.1:{stack.api.port}"
         print(json.dumps(summary, indent=2))
 
+        if args.record and recorder is not None:
+            recorder.stop()
+            n_rec = recorder.save(args.record)
+            print(f"recorded {n_rec} messages to {args.record}",
+                  file=sys.stderr)
+
         if args.out:
-            from jax_mapping.bridge.png import encode_gray
-            from jax_mapping.ops.grid import occupancy_to_png_array
-            img = occupancy_to_png_array(occ)
-            with open(args.out, "wb") as f:
-                f.write(encode_gray(img))
-            print(f"map written to {args.out}", file=sys.stderr)
+            _write_png(args.out, occ)
 
         if args.save_final:
             from jax_mapping.io.checkpoint import save_checkpoint

@@ -48,3 +48,44 @@ def test_demo_resume_friendly_errors(tmp_path, capsys):
                     "--world-cells", "96", "--resume", ck])
     assert rc == 2
     assert "cannot resume" in capsys.readouterr().err
+
+
+def test_demo_record_then_replay(tmp_path, capsys):
+    """--record writes a bag that --replay maps from WITHOUT the sim —
+    the rosbag workflow of SURVEY.md §7 item 7."""
+    import json
+    bag = str(tmp_path / "run.npz")
+    rc = demo.main(["--steps", "20", "--robots", "1", "--world", "arena",
+                    "--world-cells", "96", "--record", bag])
+    assert rc == 0
+    first = capsys.readouterr()
+    assert "recorded" in first.err
+    occ1 = _cells_occupied(first.out)
+    assert occ1 > 0
+
+    rc = demo.main(["--robots", "1", "--replay", bag])
+    assert rc == 0
+    out = capsys.readouterr().out
+    body = json.loads(out[out.index("{\n"):])
+    assert body["replayed"] > 0
+    assert body["scans_fused"] > 0
+    # Mapping from the bag reproduces the walls the live run saw.
+    assert body["cells_occupied"] > 0.5 * occ1
+
+
+def test_demo_replay_flag_and_topic_guards(tmp_path, capsys):
+    """--replay rejects conflicting flags and robot-count-mismatched bags
+    with exit 2, not silent empty maps."""
+    bag = str(tmp_path / "two.npz")
+    rc = demo.main(["--steps", "8", "--robots", "2", "--world", "arena",
+                    "--world-cells", "96", "--record", bag])
+    assert rc == 0
+    capsys.readouterr()
+
+    rc = demo.main(["--replay", bag, "--serve"])
+    assert rc == 2
+    assert "--serve" in capsys.readouterr().err
+
+    rc = demo.main(["--robots", "1", "--replay", bag])
+    assert rc == 2
+    assert "different --robots" in capsys.readouterr().err
