@@ -118,3 +118,53 @@ def test_frontier_full_size_on_chip(cfg):
     # Generous wall bound incl. one tunnel round-trip; the real latency
     # target lives in bench.py (frontier_p50_ms_64robots < 5).
     assert dt < 10.0, f"full-size frontier took {dt:.1f}s"
+
+
+def test_costfield_pallas_full_size_on_chip(cfg):
+    """The multigrid cost-field kernel lowers and runs at the production
+    clustering shape (n=256, 64 robots) — the VMEM chunk budget must hold
+    on real Mosaic, not just in interpret mode."""
+    from jax_mapping.ops import costfield as CF
+    rng = np.random.default_rng(0)
+    n = (cfg.grid.size_cells // cfg.frontier.downsample
+         // cfg.frontier.cluster_downsample)
+    blocked = jnp.asarray(rng.random((n, n)) < 0.2)
+    rc = jnp.asarray(rng.integers(0, n, (64, 2)), dtype=jnp.int32)
+    f = CF.cost_fields(blocked, rc, cfg.frontier.mg_levels,
+                       cfg.frontier.mg_refine_iters)
+    jax.block_until_ready(f)
+    t0 = time.perf_counter()
+    f = CF.cost_fields(blocked, rc, cfg.frontier.mg_levels,
+                       cfg.frontier.mg_refine_iters)
+    jax.block_until_ready(f)
+    assert time.perf_counter() - t0 < 2.0
+    fn = np.asarray(f)
+    assert fn.shape == (64, n, n)
+    assert np.isfinite(fn[fn < 1e8]).all()
+    # every robot reaches its own open cell at zero cost
+    rcn = np.asarray(rc)
+    assert (fn[np.arange(64), rcn[:, 0], rcn[:, 1]] == 0.0).all()
+
+
+def test_label_prop_pallas_full_size_on_chip(cfg):
+    """The label-propagation kernel lowers and runs at the production
+    clustering shape; components separated by gaps stay distinct."""
+    from jax_mapping.ops import frontier as F
+    n = (cfg.grid.size_cells // cfg.frontier.downsample
+         // cfg.frontier.cluster_downsample)
+    assert F._use_pallas_labels(n), "size gate should admit the kernel"
+    mask = np.zeros((n, n), bool)
+    mask[10, 10:40] = True           # component A
+    mask[100, 120:180] = True        # component B
+    import dataclasses
+    cfg_c = dataclasses.replace(
+        cfg.frontier, label_prop_iters=max(
+            1, -(-cfg.frontier.label_prop_iters
+                 // cfg.frontier.cluster_downsample)))
+    labels = F.label_components(cfg_c, jnp.asarray(mask))
+    jax.block_until_ready(labels)
+    ln = np.asarray(labels)
+    a = set(np.unique(ln[10, 10:40]).tolist())
+    b = set(np.unique(ln[100, 120:180]).tolist())
+    assert len(a) == 1 and len(b) == 1 and a != b
+    assert (ln[~mask] == -1).all()
