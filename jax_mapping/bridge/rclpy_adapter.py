@@ -80,6 +80,9 @@ class RclpyAdapter:
       outbound: Bus topics re-published into ROS.
       inbound: ROS topics re-published onto the Bus.
       node_name: ROS node name.
+      n_robots: fleet size; scan/odom bridge per robot namespace
+        ("/scan" for one robot, "/robot<i>/scan" for fleets — the same
+        brain.robot_ns convention the internal graph uses).
     """
 
     OUTBOUND_DEFAULT = ("map", "map_updates", "pose", "scan", "odom")
@@ -89,7 +92,8 @@ class RclpyAdapter:
                  tf: Optional[TfTree] = None,
                  outbound: Iterable[str] = OUTBOUND_DEFAULT,
                  inbound: Iterable[str] = INBOUND_DEFAULT,
-                 node_name: str = "jax_mapping_bridge"):
+                 node_name: str = "jax_mapping_bridge",
+                 n_robots: int = 1):
         if not rclpy_available():
             raise RuntimeError(
                 "rclpy is not importable — the ROS 2 adapter needs a sourced "
@@ -101,6 +105,7 @@ class RclpyAdapter:
         self.bus = bus
         self.cfg = cfg
         self.tf = tf
+        self.n_robots = max(1, n_robots)
         self._subs: List = []
         self._spin_thread: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
@@ -172,20 +177,31 @@ class RclpyAdapter:
                                          self._ros_qos())
             self._bus_to_ros("pose", pub_all, self.pose_list_to_ros_array)
         if "scan" in topics:
-            pub = n.create_publisher(sen.LaserScan, "/scan",
-                                     self._ros_qos(best_effort=True))
-            self._bus_to_ros("scan", pub, self.scan_to_ros)
+            for ns in self._robot_namespaces():
+                bus_t = ns + self.BUS_TOPICS["scan"]
+                pub = n.create_publisher(sen.LaserScan, "/" + bus_t,
+                                         self._ros_qos(best_effort=True))
+                self._bus_to_ros_raw(bus_t, pub, self.scan_to_ros)
         if "odom" in topics:
-            pub = n.create_publisher(nav.Odometry, "/odom", self._ros_qos())
-            self._bus_to_ros("odom", pub, self.odom_to_ros)
+            for ns in self._robot_namespaces():
+                bus_t = ns + self.BUS_TOPICS["odom"]
+                pub = n.create_publisher(nav.Odometry, "/" + bus_t,
+                                         self._ros_qos())
+                self._bus_to_ros_raw(bus_t, pub, self.odom_to_ros)
+
+    def _robot_namespaces(self):
+        from jax_mapping.bridge.brain import robot_ns
+        return [robot_ns(i, self.n_robots) for i in range(self.n_robots)]
 
     def _bus_to_ros(self, topic: str, ros_pub, convert) -> None:
+        self._bus_to_ros_raw(self.BUS_TOPICS[topic], ros_pub, convert)
+
+    def _bus_to_ros_raw(self, bus_topic: str, ros_pub, convert) -> None:
         def cb(msg, _pub=ros_pub, _cv=convert):
             out = _cv(msg)
             if out is not None:
                 _pub.publish(out)
-        self._subs.append(
-            self.bus.subscribe(self.BUS_TOPICS[topic], callback=cb))
+        self._subs.append(self.bus.subscribe(bus_topic, callback=cb))
 
     def _wire_inbound(self, topics) -> None:
         geo = self._msgs["geo"]
@@ -199,17 +215,21 @@ class RclpyAdapter:
                 lambda m, _p=pub: _p.publish(self.twist_from_ros(m)),
                 self._ros_qos())
         if "scan" in topics:
-            pub = self.bus.publisher(self.BUS_TOPICS["scan"])
-            n.create_subscription(
-                sen.LaserScan, "/scan",
-                lambda m, _p=pub: _p.publish(self.scan_from_ros(m)),
-                self._ros_qos(best_effort=True))
+            for ns in self._robot_namespaces():
+                bus_t = ns + self.BUS_TOPICS["scan"]
+                pub = self.bus.publisher(bus_t)
+                n.create_subscription(
+                    sen.LaserScan, "/" + bus_t,
+                    lambda m, _p=pub: _p.publish(self.scan_from_ros(m)),
+                    self._ros_qos(best_effort=True))
         if "odom" in topics:
-            pub = self.bus.publisher(self.BUS_TOPICS["odom"])
-            n.create_subscription(
-                nav.Odometry, "/odom",
-                lambda m, _p=pub: _p.publish(self.odom_from_ros(m)),
-                self._ros_qos(depth=50))
+            for ns in self._robot_namespaces():
+                bus_t = ns + self.BUS_TOPICS["odom"]
+                pub = self.bus.publisher(bus_t)
+                n.create_subscription(
+                    nav.Odometry, "/" + bus_t,
+                    lambda m, _p=pub: _p.publish(self.odom_from_ros(m)),
+                    self._ros_qos(depth=50))
         if "initialpose" in topics:
             # RViz's SetInitialPose tool (configs/jax_mapping.rviz, the
             # reference's rviz_config.rviz:186-198 carries the same tool):

@@ -68,11 +68,12 @@ def main(argv=None) -> int:
 
     from jax_mapping.config import SlamConfig, tiny_config
 
+    n_robots = max(1, args.robots)
     if args.config:
         with open(args.config) as f:
             cfg = SlamConfig.from_json(f.read())
     else:
-        cfg = tiny_config(n_robots=max(1, args.robots))
+        cfg = tiny_config(n_robots=n_robots)
 
     if args.live_hardware:
         # Live mode = the reference's PC-server role alone
@@ -83,7 +84,8 @@ def main(argv=None) -> int:
         # simulated and real sensor data. Outbound excludes scan/odom for
         # the same reason mirrored: this node subscribing /scan while
         # republishing its bus copy back to /scan would echo-loop DDS.
-        stack = _launch_live_stack(cfg, http_port=args.http_port)
+        stack = _launch_live_stack(cfg, http_port=args.http_port,
+                                   n_robots=n_robots)
         inbound = ("cmd_vel", "scan", "odom", "initialpose", "goal_pose")
         outbound = ("map", "map_updates", "pose")
     else:
@@ -94,14 +96,14 @@ def main(argv=None) -> int:
         else:
             world = W.rooms_world(args.world_cells, cfg.grid.resolution_m,
                                   seed=args.seed)
-        stack = launch_sim_stack(cfg, world, n_robots=max(1, args.robots),
+        stack = launch_sim_stack(cfg, world, n_robots=n_robots,
                                  http_port=args.http_port, realtime=True,
                                  seed=args.seed)
         inbound = ("cmd_vel", "initialpose", "goal_pose")
         outbound = RclpyAdapter.OUTBOUND_DEFAULT
 
     adapter = RclpyAdapter(stack.bus, cfg, tf=stack.tf, inbound=inbound,
-                           outbound=outbound)
+                           outbound=outbound, n_robots=n_robots)
     adapter.spin()
     if not args.live_hardware:
         stack.brain.start_exploring()
@@ -123,7 +125,7 @@ def main(argv=None) -> int:
     return 0
 
 
-def _launch_live_stack(cfg, http_port=None):
+def _launch_live_stack(cfg, http_port=None, n_robots: int = 1):
     """Mapper + API + TF only, fed by real inbound /scan + /odom."""
     import dataclasses as _dc
 
@@ -140,7 +142,7 @@ def _launch_live_stack(cfg, http_port=None):
     tf.set_static_transform(TransformStamped(
         header=Header(frame_id="base_link"), child_frame_id="base_laser",
         z=LASER_MOUNT_Z_M))
-    mapper = MapperNode(cfg, bus, tf=tf, n_robots=1)
+    mapper = MapperNode(cfg, bus, tf=tf, n_robots=n_robots)
     api = None
     if http_port is not None:
         api = MapApiServer(bus, brain=None, port=http_port,

@@ -377,3 +377,23 @@ def test_inbound_goal_pose_reaches_bus(tiny_cfg, stub_ros):
     assert len(got) == 1
     assert got[0].x == pytest.approx(2.0)
     assert got[0].theta == pytest.approx(-0.3, abs=1e-6)
+
+
+def test_fleet_namespaced_scan_odom_bridging(tiny_cfg, stub_ros):
+    """n_robots>1 bridges every robot's namespaced scan/odom topics both
+    ways (robot_ns convention: 'robot<i>/scan'), not just robot 0."""
+    from jax_mapping.bridge.messages import Header, LaserScan
+    bus, _tf, ad = _adapter(tiny_cfg, stub_ros, n_robots=2,
+                            inbound=("cmd_vel", "scan", "odom"))
+    assert "/robot0/scan" in ad.node.pubs and "/robot1/scan" in ad.node.pubs
+    assert "/robot0/odom" in ad.node.pubs and "/robot1/odom" in ad.node.pubs
+    assert "/robot1/scan" in ad.node.subs and "/robot1/odom" in ad.node.subs
+
+    # outbound: a bus scan on robot1's namespace reaches only its ROS pub
+    scan = LaserScan(header=Header(stamp=1.0, frame_id="robot1/base_laser"),
+                     angle_min=0.0, angle_max=6.283, angle_increment=0.0175,
+                     time_increment=0.0, scan_time=0.1, range_min=0.02,
+                     range_max=12.0, ranges=np.array([1.5, 2.5], np.float32))
+    bus.publisher("robot1/scan").publish(scan)
+    assert len(ad.node.pubs["/robot1/scan"].published) == 1
+    assert len(ad.node.pubs["/robot0/scan"].published) == 0
