@@ -9,14 +9,22 @@ the reference's exact topic surface onto real DDS:
   outbound (Bus -> ROS):  /map, /map_updates (nav_msgs/OccupancyGrid,
                           `server/rviz_config.rviz:152-165`),
                           /pose (geometry_msgs/PoseWithCovarianceStamped,
-                          rviz_config.rviz:133-143),
-                          /scan (sensor_msgs/LaserScan, rviz:94-106),
-                          /odom (nav_msgs/Odometry, main.py:217-224),
+                          rviz_config.rviz:133-143) + /poses (PoseArray,
+                          whole fleet),
+                          /scan, /odom — per robot namespace for fleets:
+                          /robot<i>/scan, /robot<i>/odom (brain.robot_ns;
+                          plain /scan /odom for one robot, rviz:94-106,
+                          main.py:217-224),
+                          /frontiers_markers (visualization_msgs/
+                          MarkerArray of clustered frontier goals — the
+                          bundled RViz config's Frontiers display),
                           /tf (tf2_ros broadcaster, main.py:202-215)
   inbound  (ROS -> Bus):  /cmd_vel (geometry_msgs/Twist — Nav2 or
                           teleop_twist_joy, report.pdf §III.A),
-                          and optionally /scan + /odom (live-hardware mode:
-                          a real ldlidar_stl_ros2 driver feeds the mapper)
+                          /initialpose + /goal_pose (RViz tools),
+                          and optionally per-namespace /scan + /odom
+                          (live-hardware mode: real ldlidar_stl_ros2
+                          drivers feed the mapper)
 
 so RViz with `configs/jax_mapping.rviz` and Nav2 subscribe/publish exactly
 the contracts the reference wires up in
@@ -85,7 +93,8 @@ class RclpyAdapter:
         brain.robot_ns convention the internal graph uses).
     """
 
-    OUTBOUND_DEFAULT = ("map", "map_updates", "pose", "scan", "odom")
+    OUTBOUND_DEFAULT = ("map", "map_updates", "pose", "scan", "odom",
+                        "frontiers")
     INBOUND_DEFAULT = ("cmd_vel", "initialpose", "goal_pose")
 
     def __init__(self, bus: Bus, cfg: SlamConfig,
@@ -128,7 +137,13 @@ class RclpyAdapter:
         import nav_msgs.msg as nav
         import sensor_msgs.msg as sen
         import builtin_interfaces.msg as bi
-        return {"geo": geo, "nav": nav, "sen": sen, "bi": bi}
+        try:
+            # common_interfaces ships it everywhere RViz runs, but a
+            # stripped ros-base without it only loses the marker display.
+            import visualization_msgs.msg as vis
+        except Exception:
+            vis = None
+        return {"geo": geo, "nav": nav, "sen": sen, "bi": bi, "vis": vis}
 
     def _ros_qos(self, *, best_effort: bool = False, latched: bool = False,
                  depth: int = 10):
@@ -176,6 +191,13 @@ class RclpyAdapter:
             pub_all = n.create_publisher(geo.PoseArray, "/poses",
                                          self._ros_qos())
             self._bus_to_ros("pose", pub_all, self.pose_list_to_ros_array)
+        if "frontiers" in topics and self._msgs["vis"] is not None:
+            # The bundled RViz config's MarkerArray display
+            # (configs/jax_mapping.rviz "/frontiers_markers") reads this.
+            pub = n.create_publisher(self._msgs["vis"].MarkerArray,
+                                     "/frontiers_markers",
+                                     self._ros_qos(depth=1))
+            self._bus_to_ros("frontiers", pub, self.frontiers_to_ros_markers)
         if "scan" in topics:
             for ns in self._robot_namespaces():
                 bus_t = ns + self.BUS_TOPICS["scan"]
@@ -386,6 +408,46 @@ class RclpyAdapter:
             m.orientation.w = math.cos(p["theta"] / 2.0)
             arr.append(m)
         out.poses = arr
+        return out
+
+    def frontiers_to_ros_markers(self, msg):
+        """FrontierArray -> visualization_msgs/MarkerArray: one sphere per
+        live cluster at its goal target, sized by cluster size, green when
+        some robot claimed it, orange when unassigned. A DELETEALL leads
+        so stale clusters vanish between updates."""
+        vis = self._msgs["vis"]
+        if vis is None:
+            return None
+        bi = self._msgs["bi"]
+        out = vis.MarkerArray()
+        clear = vis.Marker()
+        clear.action = 3                      # DELETEALL
+        markers = [clear]
+        assigned = {int(a) for a in np.asarray(msg.assignment) if a >= 0}
+        for k, (xy, size) in enumerate(zip(np.asarray(msg.targets_xy),
+                                           np.asarray(msg.sizes))):
+            if size <= 0:
+                continue
+            m = vis.Marker()
+            m.header.stamp = _to_ros_time(bi.Time, msg.header.stamp)
+            m.header.frame_id = "map"
+            m.ns = "frontiers"
+            m.id = k
+            m.type = 2                        # SPHERE
+            m.action = 0                      # ADD
+            m.pose.position.x = float(xy[0])
+            m.pose.position.y = float(xy[1])
+            m.pose.orientation.w = 1.0
+            s = 0.15 + 0.01 * min(float(size), 50.0)
+            m.scale.x = m.scale.y = m.scale.z = s
+            m.color.a = 0.9
+            if k in assigned:
+                m.color.g = 1.0
+            else:
+                m.color.r = 1.0
+                m.color.g = 0.6
+            markers.append(m)
+        out.markers = markers
         return out
 
     def publish_tf_once(self) -> None:

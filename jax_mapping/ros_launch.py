@@ -87,7 +87,9 @@ def main(argv=None) -> int:
         stack = _launch_live_stack(cfg, http_port=args.http_port,
                                    n_robots=n_robots)
         inbound = ("cmd_vel", "scan", "odom", "initialpose", "goal_pose")
-        outbound = ("map", "map_updates", "pose")
+        # No scan/odom echo (see above), but the live mapper still
+        # publishes /frontiers — keep the RViz marker display fed.
+        outbound = ("map", "map_updates", "pose", "frontiers")
     else:
         from jax_mapping.bridge.launch import launch_sim_stack
         from jax_mapping.sim import world as W

@@ -113,6 +113,9 @@ def stub_ros(monkeypatch):
     geo.TransformStamped = _msg("TransformStamped")
     bi = types.ModuleType("builtin_interfaces.msg")
     bi.Time = StubTime
+    vis = types.ModuleType("visualization_msgs.msg")
+    vis.Marker = _msg("Marker")
+    vis.MarkerArray = _msg("MarkerArray")
     tf2 = types.ModuleType("tf2_ros")
     tf2.TransformBroadcaster = StubBroadcaster
 
@@ -125,6 +128,8 @@ def stub_ros(monkeypatch):
         "geometry_msgs.msg": geo,
         "builtin_interfaces": types.ModuleType("builtin_interfaces"),
         "builtin_interfaces.msg": bi,
+        "visualization_msgs": types.ModuleType("visualization_msgs"),
+        "visualization_msgs.msg": vis,
         "tf2_ros": tf2,
     }
     for k, v in mods.items():
@@ -397,3 +402,28 @@ def test_fleet_namespaced_scan_odom_bridging(tiny_cfg, stub_ros):
     bus.publisher("robot1/scan").publish(scan)
     assert len(ad.node.pubs["/robot1/scan"].published) == 1
     assert len(ad.node.pubs["/robot0/scan"].published) == 0
+
+
+def test_frontiers_markers_outbound(tiny_cfg, stub_ros):
+    """/frontiers becomes the /frontiers_markers MarkerArray the bundled
+    RViz config displays: DELETEALL lead, one sphere per live cluster,
+    claimed clusters green."""
+    from jax_mapping.bridge.messages import FrontierArray, Header
+    bus, _tf, ad = _adapter(tiny_cfg, stub_ros)
+    assert "/frontiers_markers" in ad.node.pubs
+    fa = FrontierArray(
+        header=Header(stamp=4.5),
+        targets_xy=np.array([[1.0, 2.0], [3.0, -1.0], [0.0, 0.0]],
+                            np.float32),
+        sizes=np.array([10, 5, 0], np.int32),     # third slot empty
+        assignment=np.array([1, -1], np.int32))   # robot 0 claims slot 1
+    bus.publisher("/frontiers").publish(fa)
+    sent = ad.node.pubs["/frontiers_markers"].published
+    assert len(sent) == 1
+    ms = sent[0].markers
+    assert ms[0].action == 3                      # DELETEALL lead
+    live = ms[1:]
+    assert len(live) == 2                         # empty slot skipped
+    assert live[0].pose.position.x == pytest.approx(1.0)
+    assert live[1].color.g == pytest.approx(1.0)  # claimed slot 1: green
+    assert live[0].color.r == pytest.approx(1.0)  # unclaimed: orange
