@@ -143,7 +143,14 @@ class RclpyAdapter:
             import visualization_msgs.msg as vis
         except Exception:
             vis = None
-        return {"geo": geo, "nav": nav, "sen": sen, "bi": bi, "vis": vis}
+        try:
+            # rviz_default_plugins depends on map_msgs; its absence only
+            # downgrades /map_updates to full-grid republish.
+            import map_msgs.msg as map_msgs
+        except Exception:
+            map_msgs = None
+        return {"geo": geo, "nav": nav, "sen": sen, "bi": bi, "vis": vis,
+                "map_msgs": map_msgs}
 
     def _ros_qos(self, *, best_effort: bool = False, latched: bool = False,
                  depth: int = 10):
@@ -181,9 +188,19 @@ class RclpyAdapter:
                                      self._ros_qos(latched=True, depth=1))
             self._bus_to_ros("map", pub, self.occupancy_to_ros)
         if "map_updates" in topics:
-            pub = n.create_publisher(nav.OccupancyGrid, "/map_updates",
-                                     self._ros_qos(depth=1))
-            self._bus_to_ros("map_updates", pub, self.occupancy_to_ros)
+            # RViz's Map display reads map_msgs/OccupancyGridUpdate on its
+            # update topic; publishing a full OccupancyGrid there is a
+            # silent type clash. Convert when map_msgs is available.
+            if self._msgs["map_msgs"] is not None:
+                pub = n.create_publisher(
+                    self._msgs["map_msgs"].OccupancyGridUpdate,
+                    "/map_updates", self._ros_qos(depth=1))
+                self._bus_to_ros("map_updates", pub,
+                                 self.occupancy_to_ros_update)
+            else:
+                pub = n.create_publisher(nav.OccupancyGrid, "/map_updates",
+                                         self._ros_qos(depth=1))
+                self._bus_to_ros("map_updates", pub, self.occupancy_to_ros)
         if "pose" in topics:
             pub = n.create_publisher(geo.PoseWithCovarianceStamped, "/pose",
                                      self._ros_qos())
@@ -409,6 +426,21 @@ class RclpyAdapter:
             arr.append(m)
         out.poses = arr
         return out
+
+    def occupancy_to_ros_update(self, msg: OccupancyGrid):
+        """Full-extent map_msgs/OccupancyGridUpdate (x=y=0, whole grid):
+        the type RViz's Map display expects on its update topic."""
+        mm = self._msgs["map_msgs"]
+        bi = self._msgs["bi"]
+        u = mm.OccupancyGridUpdate()
+        u.header.stamp = _to_ros_time(bi.Time, msg.header.stamp)
+        u.header.frame_id = msg.header.frame_id or "map"
+        u.x = 0
+        u.y = 0
+        u.width = int(msg.info.width)
+        u.height = int(msg.info.height)
+        u.data = [int(v) for v in np.asarray(msg.data).ravel()]
+        return u
 
     def frontiers_to_ros_markers(self, msg):
         """FrontierArray -> visualization_msgs/MarkerArray: one sphere per

@@ -116,6 +116,8 @@ def stub_ros(monkeypatch):
     vis = types.ModuleType("visualization_msgs.msg")
     vis.Marker = _msg("Marker")
     vis.MarkerArray = _msg("MarkerArray")
+    mapm = types.ModuleType("map_msgs.msg")
+    mapm.OccupancyGridUpdate = _msg("OccupancyGridUpdate")
     tf2 = types.ModuleType("tf2_ros")
     tf2.TransformBroadcaster = StubBroadcaster
 
@@ -130,6 +132,8 @@ def stub_ros(monkeypatch):
         "builtin_interfaces.msg": bi,
         "visualization_msgs": types.ModuleType("visualization_msgs"),
         "visualization_msgs.msg": vis,
+        "map_msgs": types.ModuleType("map_msgs"),
+        "map_msgs.msg": mapm,
         "tf2_ros": tf2,
     }
     for k, v in mods.items():
@@ -427,3 +431,21 @@ def test_frontiers_markers_outbound(tiny_cfg, stub_ros):
     assert live[0].pose.position.x == pytest.approx(1.0)
     assert live[1].color.g == pytest.approx(1.0)  # claimed slot 1: green
     assert live[0].color.r == pytest.approx(1.0)  # unclaimed: orange
+
+
+def test_map_updates_outbound_is_grid_update_type(tiny_cfg, stub_ros):
+    """/map_updates carries map_msgs/OccupancyGridUpdate (full extent) —
+    the type RViz's Map display reads on its update topic — not a second
+    OccupancyGrid."""
+    from jax_mapping.bridge.messages import occupancy_from_logodds
+    bus, _tf, ad = _adapter(tiny_cfg, stub_ros)
+    lo = np.zeros((3, 4), np.float32)
+    lo[1, 1] = 2.0
+    bus.publisher("/map_updates").publish(occupancy_from_logodds(
+        lo, 0.5, -0.5, 0.05, (0.0, 0.0)))
+    sent = ad.node.pubs["/map_updates"].published
+    assert len(sent) == 1
+    u = sent[0]
+    assert type(u).__name__ == "OccupancyGridUpdate"
+    assert (u.x, u.y, u.width, u.height) == (0, 0, 4, 3)
+    assert len(u.data) == 12 and max(u.data) == 100
