@@ -449,3 +449,30 @@ def test_map_updates_outbound_is_grid_update_type(tiny_cfg, stub_ros):
     assert type(u).__name__ == "OccupancyGridUpdate"
     assert (u.x, u.y, u.width, u.height) == (0, 0, 4, 3)
     assert len(u.data) == 12 and max(u.data) == 100
+
+
+def test_integrated_fleet_stack_bridges_namespaced_topics(tiny_cfg,
+                                                          stub_ros):
+    """The REAL 2-robot sim stack bridges every robot's namespaced
+    scan/odom into ROS plus the fleet PoseArray and frontier markers —
+    end-to-end over the actual bus topic strings."""
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.bridge.rclpy_adapter import RclpyAdapter
+    from jax_mapping.sim import world as W
+
+    world = W.empty_arena(96, tiny_cfg.grid.resolution_m)
+    stack = launch_sim_stack(tiny_cfg, world, n_robots=2)
+    try:
+        ad = RclpyAdapter(stack.bus, tiny_cfg, tf=stack.tf, n_robots=2)
+        stack.brain.start_exploring()
+        stack.run_steps(6)
+        stack.mapper.publish_map()
+        stack.mapper.publish_frontiers()
+        for ns in ("robot0/", "robot1/"):
+            assert ad.node.pubs[f"/{ns}scan"].published, f"{ns}scan dropped"
+            assert ad.node.pubs[f"/{ns}odom"].published, f"{ns}odom dropped"
+        arr = ad.node.pubs["/poses"].published[-1]
+        assert len(arr.poses) == 2
+        assert ad.node.pubs["/frontiers_markers"].published
+    finally:
+        stack.shutdown()
