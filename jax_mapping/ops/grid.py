@@ -246,7 +246,7 @@ def _classify_batch(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     On TPU the per-scan Pallas kernel computes the deltas (the XLA
     formulation's per-cell `ranges[beam]` gather lowers to a scalarised
     loop ~10x the cost of the rest of the model; the kernel does the
-    lookup as an in-VMEM one-hot contraction on the MXU). Elsewhere the
+    lookup as an in-vreg gather over the packed beam table). Elsewhere the
     vmapped XLA path runs; the two are parity-tested in
     tests/test_sensor_kernel.py.
     """

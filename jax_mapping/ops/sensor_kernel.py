@@ -26,9 +26,10 @@ Two exact compute culls keep the work proportional to what a scan can see:
     touched by scans that pass the cull.
 
 Performance (v5e single chip, 256-scan window into the 640^2 patch of the
-4096^2 grid): ~8 ms/window = ~32,000 scans/sec — ~44x the one-hot-matmul
-formulation this replaced (the one-hot burned VPU on (cells x beams)
-compares and starved the MXU at 8 of 128 output lanes).
+4096^2 grid): ~5.9 ms/window = ~43,700 scans/sec (BENCH_LOCAL_r03.json) —
+~60x the one-hot-matmul formulation this replaced (the one-hot burned VPU
+on (cells x beams) compares and starved the MXU at 8 of 128 output
+lanes).
 
 Scans in a batch share one patch origin in `window_delta` (a temporal scan
 window from one robot: the reference's LD06 delivers ~10 scans/sec while
