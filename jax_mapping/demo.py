@@ -106,6 +106,11 @@ def _replay_main(args, cfg) -> int:
               f"expected {sorted(expected)} — was the bag recorded with a "
               "different --robots?", file=sys.stderr)
         return 2
+    if rep.config_json is not None and rep.config_json != cfg.to_json():
+        print("error: bag was recorded under a different config; pass the "
+              "matching --config (the bag stores the recording config)",
+              file=sys.stderr)
+        return 2
     pubs = {}
     n = 0
     # Interleave publishing with mapper ticks: the odometry pairing
@@ -244,7 +249,7 @@ def main(argv=None) -> int:
 
         if args.record and recorder is not None:
             recorder.stop()
-            n_rec = recorder.save(args.record)
+            n_rec = recorder.save(args.record, config_json=cfg.to_json())
             print(f"recorded {n_rec} messages to {args.record}",
                   file=sys.stderr)
 

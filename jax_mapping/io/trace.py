@@ -106,8 +106,14 @@ class TraceRecorder:
         for s in self._subs:
             s.close()
 
-    def save(self, path: str) -> int:
-        """Write the bag; returns the record count."""
+    def save(self, path: str, config_json: Optional[str] = None) -> int:
+        """Write the bag; returns the record count.
+
+        config_json: optional SlamConfig.to_json() of the recording run,
+        so replay tooling can detect config drift (shape-incompatible
+        scans fused silently otherwise). Stored as a wrapper dict; bags
+        written by older versions (bare list index) still load.
+        """
         index = []
         arrays: Dict[str, np.ndarray] = {}
         for i, (stamp, topic, msg) in enumerate(
@@ -120,8 +126,9 @@ class TraceRecorder:
                           "type": type_name, "scalars": scalars})
             for k, a in arrs.items():
                 arrays[f"r{i}.{k}"] = a
+        meta = {"records": index, "config": config_json, "version": 2}
         arrays[_INDEX_KEY] = np.frombuffer(
-            json.dumps(index).encode(), np.uint8)
+            json.dumps(meta).encode(), np.uint8)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez_compressed(f, **arrays)
@@ -135,7 +142,13 @@ class TraceReplayer:
     def __init__(self, path: str):
         self.path = path
         with np.load(path) as z:
-            self.index = json.loads(bytes(z[_INDEX_KEY].tobytes()).decode())
+            meta = json.loads(bytes(z[_INDEX_KEY].tobytes()).decode())
+            if isinstance(meta, dict):              # v2 wrapper
+                self.index = meta["records"]
+                self.config_json: Optional[str] = meta.get("config")
+            else:                                    # v1 bare list
+                self.index = meta
+                self.config_json = None
             self._arrays = {k: z[k] for k in z.files if k != _INDEX_KEY}
 
     def __len__(self) -> int:

@@ -89,3 +89,27 @@ def test_demo_replay_flag_and_topic_guards(tmp_path, capsys):
     rc = demo.main(["--robots", "1", "--replay", bag])
     assert rc == 2
     assert "different --robots" in capsys.readouterr().err
+
+
+def test_demo_replay_rejects_config_drift(tmp_path, capsys):
+    """A bag recorded under one config replayed under another exits 2
+    (the bag stores the recording config; v2 trace format)."""
+    bag = str(tmp_path / "drift.npz")
+    rc = demo.main(["--steps", "8", "--robots", "1", "--world", "arena",
+                    "--world-cells", "96", "--record", bag])
+    assert rc == 0
+    capsys.readouterr()
+
+    import json
+
+    from jax_mapping.config import tiny_config
+    other = tiny_config(n_robots=1)
+    import dataclasses
+    other = dataclasses.replace(
+        other, matcher=dataclasses.replace(other.matcher, min_response=0.42))
+    cfgfile = tmp_path / "other.json"
+    cfgfile.write_text(other.to_json())
+    rc = demo.main(["--robots", "1", "--replay", bag,
+                    "--config", str(cfgfile)])
+    assert rc == 2
+    assert "different config" in capsys.readouterr().err
