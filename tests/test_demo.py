@@ -113,3 +113,27 @@ def test_demo_replay_rejects_config_drift(tmp_path, capsys):
                     "--config", str(cfgfile)])
     assert rc == 2
     assert "different config" in capsys.readouterr().err
+
+
+def test_demo_replay_is_deterministic(tmp_path, capsys):
+    """Replaying the same bag twice produces bitwise-identical maps —
+    the jit'd pipeline plus the interleaved replay schedule is fully
+    deterministic (no wall-clock or thread-order dependence)."""
+    import json
+    bag = str(tmp_path / "det.npz")
+    rc = demo.main(["--steps", "14", "--robots", "1", "--world", "arena",
+                    "--world-cells", "96", "--record", bag])
+    assert rc == 0
+    capsys.readouterr()
+
+    outs = []
+    for i in range(2):
+        png = str(tmp_path / f"replay{i}.png")
+        rc = demo.main(["--robots", "1", "--replay", bag, "--out", png])
+        assert rc == 0
+        out = capsys.readouterr().out
+        outs.append(json.loads(out[out.index("{\n"):]))
+    assert outs[0] == {**outs[1], "bag": outs[0]["bag"]}
+    a = (tmp_path / "replay0.png").read_bytes()
+    b = (tmp_path / "replay1.png").read_bytes()
+    assert a == b
