@@ -154,6 +154,17 @@ def _costfield_xla_fallback() -> None:
     # the already-recorded obstacle-aware number was measured on.
 
 
+def _is_tunnel_failure(e: Exception) -> bool:
+    """Is the remote TPU compile TRANSPORT dead (vs. a rejectable
+    kernel)? Kernel rejections also arrive via the remote helper (HTTP
+    500 + Mosaic details) and MUST keep taking the XLA-twin fallback, so
+    only connection-level markers count."""
+    msg = str(e)
+    return any(m in msg for m in (
+        "Connection refused", "Failed to connect", "Connection reset",
+        "Couldn't connect", "timed out", "Deadline Exceeded"))
+
+
 def _chain_time(make_fn, k1: int, k2: int, reps: int) -> float:
     """Median per-iteration seconds for a chained-loop fn factory.
 
@@ -327,11 +338,21 @@ def _run() -> None:
             except Exception:
                 import traceback
                 traceback.print_exc(file=sys.stderr)
-    except Exception:
+    except Exception as e:
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        if _is_tunnel_failure(e) and not cpu_fallback:
+            # Half-up tunnel: backend init answered the probe but every
+            # compile dies in the remote helper. No engine swap can help —
+            # limping on would fail all six sections and emit an all-null
+            # JSON. Take the virtual-CPU path instead (same re-exec the
+            # init probe uses; deadline already capped by _scrub_cpu_env).
+            print("bench: remote TPU compile tunnel failing; re-exec onto "
+                  "virtual CPU", file=sys.stderr, flush=True)
+            os.execvpe(sys.executable, [sys.executable] + sys.argv,
+                       _scrub_cpu_env())
         if G._use_pallas():
             # In-process engine fallback: re-trace with XLA paths.
-            import traceback
-            traceback.print_exc(file=sys.stderr)
             print("bench: pallas fuse failed, re-tracing with XLA fallback",
                   file=sys.stderr, flush=True)
             os.environ["JAX_MAPPING_NO_PALLAS"] = "1"
