@@ -14,13 +14,15 @@ variants' routes merged:
   actually works.
 * `GET /frontiers` — JSON frontier targets + assignment (new capability).
 * `GET /metrics` — framework counters in Prometheus text format.
-* `GET /save[?name=x]`, `GET /load[?name=x]` — checkpoint / restore the
+* `POST /save[?name=x]`, `POST /load[?name=x]` — checkpoint / restore the
   live SLAM state (grid, poses, graphs, scan rings) through
   `io.checkpoint`. The capability slam_toolbox exposes as its
   serialization service (`enable_interactive_mode`, slam_config.yaml:32)
   but the reference never invokes — here a restart resumes the map
   instead of losing it. Names are basenames inside `checkpoint_dir`
-  (no path traversal); load refuses config-drifted checkpoints.
+  (no path traversal); load refuses config-drifted checkpoints. POST
+  only (ADVICE r3): GET /load would let a link prefetcher or stray
+  browser request silently replace the running map; GET answers 405.
 
 Served threaded like the reference (Flask's threaded dev server); shutdown
 uses the pi variant's graceful `make_server`/`shutdown` pattern
@@ -81,18 +83,32 @@ class MapApiServer:
             def log_message(self, fmt, *args):    # silence per-request spam
                 pass
 
-            def do_GET(self):
+            def _dispatch(self, method):
                 api.n_requests += 1
                 try:
-                    status, ctype, body = api.handle(self.path)
+                    status, ctype, body = api.handle(self.path,
+                                                     method=method)
                 except Exception as e:            # noqa: BLE001
                     status, ctype, body = 500, "application/json", json.dumps(
                         {"error": str(e)}).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if status == 405:
+                    self.send_header("Allow", "POST")
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                # Drain any request body so keep-alive clients don't
+                # desync the connection.
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    self.rfile.read(n)
+                self._dispatch("POST")
 
         self.server = ThreadingHTTPServer((host, port), Handler)
         self.port = self.server.server_address[1]
@@ -110,7 +126,7 @@ class MapApiServer:
 
     # -- request handling ---------------------------------------------------
 
-    def handle(self, path: str) -> Tuple[int, str, bytes]:
+    def handle(self, path: str, method: str = "GET") -> Tuple[int, str, bytes]:
         route = path.split("?")[0].rstrip("/") or "/"
         if route == "/start":
             if self.brain is not None:
@@ -134,6 +150,12 @@ class MapApiServer:
         if route == "/metrics":
             return 200, "text/plain", self._metrics().encode()
         if route in ("/save", "/load"):
+            # Mutations are POST-only (ADVICE r3): GET /load from a link
+            # prefetcher would silently replace the running map.
+            if method != "POST":
+                return 405, "application/json", json.dumps(
+                    {"error": f"{route} requires POST "
+                              f"(curl -X POST ...{route})"}).encode()
             return self._checkpoint(route, path)
         return 404, "application/json", \
             json.dumps({"error": f"no route {route}"}).encode()

@@ -88,11 +88,17 @@ def coarsen(cfg: FrontierConfig, grid_cfg: GridConfig, logodds: Array):
 
 
 def _shift(x: Array, dr: int, dc: int, fill=False) -> Array:
-    """Shift a 2D array, filling vacated cells.
+    """Shift a 2D array by ONE step per axis (dr, dc in {-1, 0, +1}),
+    filling vacated cells.
 
     Concatenate-based (not dynamic_update_slice) so the SAME helper lowers
     inside Mosaic/Pallas kernel bodies and as plain XLA — this is the one
-    shift implementation every frontier path shares."""
+    shift implementation every frontier path shares. Single-step only: the
+    concat formulation moves one row/col regardless of |d|, so larger
+    offsets are rejected loudly rather than silently under-shifting
+    (ADVICE r3)."""
+    if abs(dr) > 1 or abs(dc) > 1:
+        raise ValueError(f"_shift is single-step only, got ({dr}, {dc})")
     if dr:
         f = jnp.full_like(x[:1, :], fill)
         x = (jnp.concatenate([f, x[:-1, :]], axis=0) if dr > 0

@@ -66,6 +66,16 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    # Operator guard (VERDICT r3 weak #1): a wedged TPU tunnel hangs jax
+    # backend init forever; probe in a bounded subprocess and restart on
+    # virtual CPU if so. After the rclpy check so a no-ROS environment
+    # still gets its fast explanatory exit.
+    from jax_mapping.utils.backend_guard import ensure_responsive_backend
+    ensure_responsive_backend(
+        "jax-mapping-ros",
+        argv=["-m", "jax_mapping.ros_launch"]
+             + (list(argv) if argv is not None else sys.argv[1:]))
+
     from jax_mapping.config import SlamConfig, tiny_config
 
     n_robots = max(1, args.robots)

@@ -1,0 +1,97 @@
+"""The shared wedged-tunnel guard (utils/backend_guard.py).
+
+Round 3's verdict: the operator entry points (`demo.py`, `ros_launch.py`)
+hung >= 300 s under the ambient wedged-TPU-tunnel env because the bounded
+probe + scrubbed re-exec lived only in bench/conftest/__graft_entry__
+copies. These tests pin the shared helper's contract without spawning a
+real probe against a wedged backend (the e2e proof is running the demo
+under the ambient env, which the driver and operator do for real).
+"""
+
+import os
+import sys
+from unittest import mock
+
+from jax_mapping.utils import backend_guard as BG
+
+
+def test_scrubbed_env_drops_axon_hooks():
+    env_in = {
+        "PALLAS_AXON_POOL_IPS": "127.0.0.1",
+        "AXON_LOOPBACK_RELAY": "1",
+        "TPU_SKIP_MDS_QUERY": "1",
+        "JAX_PLATFORMS": "axon",
+        "PYTHONPATH": "/root/.axon_site:/somewhere/else",
+        "HOME": "/root",
+    }
+    with mock.patch.dict(os.environ, env_in, clear=True):
+        env = BG.scrubbed_cpu_env()
+    assert not any(k.startswith(("AXON", "PALLAS_AXON", "TPU_"))
+                   for k in env)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env[BG.FALLBACK_FLAG] == "1"
+    assert ".axon_site" not in env["PYTHONPATH"]
+    # The child must still find the package and the untouched entries.
+    assert BG._PKG_PARENT in env["PYTHONPATH"].split(os.pathsep)
+    assert "/somewhere/else" in env["PYTHONPATH"].split(os.pathsep)
+    assert env["HOME"] == "/root"
+
+
+def test_scrubbed_env_extra_keys_win():
+    with mock.patch.dict(os.environ, {}, clear=True):
+        env = BG.scrubbed_cpu_env(extra_env={"X_DEADLINE": "42"})
+    assert env["X_DEADLINE"] == "42"
+
+
+def test_suspect_only_when_wedge_possible():
+    with mock.patch.dict(os.environ, {}, clear=True):
+        assert not BG.backend_env_suspect()          # plain CPU image
+    with mock.patch.dict(os.environ,
+                         {"PALLAS_AXON_POOL_IPS": "127.0.0.1"}, clear=True):
+        assert BG.backend_env_suspect()              # plugin registered
+    with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "axon"}, clear=True):
+        assert BG.backend_env_suspect()              # platform pinned
+    with mock.patch.dict(os.environ,
+                         {"PALLAS_AXON_POOL_IPS": "127.0.0.1",
+                          BG.FALLBACK_FLAG: "1"}, clear=True):
+        assert not BG.backend_env_suspect()          # already fell back
+
+
+def test_ensure_noop_when_env_clean():
+    """No probe subprocess, no re-exec on a clean env (common case must
+    stay free)."""
+    with mock.patch.dict(os.environ, {}, clear=True), \
+            mock.patch.object(BG, "backend_probe_ok") as probe, \
+            mock.patch.object(os, "execvpe") as ex:
+        BG.ensure_responsive_backend("t")
+    probe.assert_not_called()
+    ex.assert_not_called()
+
+
+def test_ensure_reexecs_on_wedged_probe():
+    """Wedged probe -> re-exec with the CALLER-BUILT argv, scrubbed env."""
+    with mock.patch.dict(os.environ,
+                         {"PALLAS_AXON_POOL_IPS": "127.0.0.1"}, clear=True), \
+            mock.patch.object(BG, "backend_probe_ok", return_value=False), \
+            mock.patch.object(os, "execvpe") as ex:
+        BG.ensure_responsive_backend(
+            "t", argv=["-m", "jax_mapping.demo", "--steps", "2"])
+    (prog, argv, env), _ = ex.call_args
+    assert prog == sys.executable
+    assert argv == [sys.executable, "-m", "jax_mapping.demo", "--steps", "2"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env[BG.FALLBACK_FLAG] == "1"
+
+
+def test_ensure_proceeds_on_healthy_probe():
+    with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "axon"}, clear=True), \
+            mock.patch.object(BG, "backend_probe_ok", return_value=True), \
+            mock.patch.object(os, "execvpe") as ex:
+        BG.ensure_responsive_backend("t")
+    ex.assert_not_called()
+
+
+def test_probe_ok_real_subprocess():
+    """The probe really runs jax.devices() in a child; on this test env
+    (scrubbed CPU) it must succeed well inside the timeout."""
+    assert BG.backend_probe_ok(timeout_s=120)

@@ -286,14 +286,21 @@ def test_http_save_load_roundtrip(tiny_cfg, tmp_path):
         grid_before = np.asarray(stack.mapper.states[0].grid).copy()
         assert np.abs(grid_before).sum() > 0    # fused something
         url = f"http://127.0.0.1:{stack.api.port}"
-        body = _json.loads(urllib.request.urlopen(url + "/save").read())
+        # GET must NOT mutate (ADVICE r3: prefetcher-safe); POST does.
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/save")
+        assert ei.value.code == 405
+        body = _json.loads(urllib.request.urlopen(
+            urllib.request.Request(url + "/save", method="POST")).read())
         assert body["status"] == "saved"
 
         # wipe the live state, then restore
         from jax_mapping.models import slam as S
         stack.mapper.states[0] = S.init_state(tiny_cfg)
         assert np.abs(np.asarray(stack.mapper.states[0].grid)).sum() == 0
-        body = _json.loads(urllib.request.urlopen(url + "/load").read())
+        body = _json.loads(urllib.request.urlopen(
+            urllib.request.Request(url + "/load", method="POST")).read())
         assert body["status"] == "loaded"
         np.testing.assert_array_equal(
             np.asarray(stack.mapper.states[0].grid), grid_before)
@@ -326,7 +333,8 @@ def test_http_load_refuses_config_drift(tiny_cfg, tmp_path):
         stack.api.checkpoint_dir = str(tmp_path)
         url = f"http://127.0.0.1:{stack.api.port}/load?name=drift"
         with pytest.raises(urllib.error.HTTPError) as ei:
-            urllib.request.urlopen(url)
+            urllib.request.urlopen(urllib.request.Request(url,
+                                                          method="POST"))
         assert ei.value.code == 409
         body = _json.loads(ei.value.read())
         assert "config" in body["error"]
