@@ -3,9 +3,13 @@
 Re-creates the reference's management plane (SURVEY.md §1 L4) with both
 variants' routes merged:
 
-* `GET /start`, `GET /stop` — flip `is_exploring`
+* `GET|POST /start`, `GET|POST /stop` — flip `is_exploring`
   (`/root/reference/server/thymio_project/thymio_project/main.py:227-239`);
   stop also forces motors off (pi variant, `pi/src/.../main.py:320-326`).
+  GET stays accepted here — deliberately, unlike /save /load below — for
+  parity with the reference's documented `curl :5000/start` workflow
+  (Flask GET routes): these flip a recoverable flag, while /load
+  irreversibly replaces map state.
 * `GET /status` — JSON connection/exploring/pose (`pi/src/.../main.py:332-341`).
 * `GET /map-image` — latest `/map` as a grayscale PNG, 127 unknown / 255
   free / 0 occupied, flipped to image coords (`server/.../main.py:241-279`).
@@ -185,7 +189,9 @@ class MapApiServer:
         template = [_S.init_state(self.mapper.cfg)
                     for _ in self.mapper.states]
         states, cfg_json = load_checkpoint(fp, template)
-        if cfg_json is not None and cfg_json != self.mapper.cfg.to_json():
+        from jax_mapping.config import configs_equivalent
+        if cfg_json is not None and \
+                not configs_equivalent(cfg_json, self.mapper.cfg.to_json()):
             return 409, "application/json", json.dumps(
                 {"error": "checkpoint config differs from the running "
                           "config; refusing to load"}).encode()

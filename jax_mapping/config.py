@@ -214,6 +214,94 @@ class FrontierConfig:
 
 
 @_frozen
+class VoxelConfig:
+    """3D log-odds voxel grid (BASELINE.json configs[4]: "3D voxel grid
+    (OctoMap-style) from simulated depth cam").
+
+    Generalizes the 2D grid capability (slam_config.yaml:26-27) to 3D with
+    the same dense inverse-sensor-patch idiom (ops/voxel.py). Memory layout
+    is (Z, Y, X) — X on TPU lanes (128-aligned origins), Y on sublanes, Z
+    as the small outer axis — and update patches span the FULL Z extent so
+    patch origins stay 2D (y0, x0), exactly like the 2D grid's.
+    """
+
+    size_x_cells: int = 1024          # grid extent, static shape
+    size_y_cells: int = 1024
+    size_z_cells: int = 64
+    resolution_m: float = 0.05        # same cell size as the 2D grid
+    # Local update patch edge (x == y; z is always full). Must satisfy
+    # patch/2 - align_x/2 >= max_range_m/resolution_m, the same coverage
+    # contract as GridConfig.patch_cells: origin alignment can shift the
+    # patch up to align_x/2 cells off-centre, and returns past the slack
+    # would fall outside the update region and silently vanish (default:
+    # 192 - 64 = 128 cells = 6.4 m >= the 5 m depth-cam range).
+    patch_cells: int = 384
+    max_range_m: float = 5.0          # depth-cam trust horizon
+    align_y: int = 8                  # patch-origin alignment (TPU sublane)
+    align_x: int = 128                # patch-origin alignment (TPU lane)
+    # Log-odds inverse sensor model (same bounded-relaxation semantics as
+    # GridConfig; OctoMap's probHit/probMiss equivalents).
+    logodds_free: float = -0.40
+    logodds_occ: float = 0.85
+    logodds_min: float = -4.0
+    logodds_max: float = 4.0
+    occ_threshold: float = 0.5
+    free_threshold: float = -0.5
+    hit_tolerance_cells: float = 1.0  # half-width of the occupied shell, cells
+
+    @property
+    def extent_m(self) -> Tuple[float, float, float]:
+        return (self.size_x_cells * self.resolution_m,
+                self.size_y_cells * self.resolution_m,
+                self.size_z_cells * self.resolution_m)
+
+    @property
+    def origin_m(self) -> Tuple[float, float, float]:
+        """World coordinate of voxel (0,0,0)'s corner: grid centred on
+        (0,0) in x/y, z starts at 0 (ground plane)."""
+        ex, ey, _ = self.extent_m
+        return (-ex / 2.0, -ey / 2.0, 0.0)
+
+
+@_frozen
+class DepthCamConfig:
+    """Simulated pinhole depth camera.
+
+    The reference has no depth sensor — this is the blueprint's 3D
+    extension (BASELINE.json configs[4]). Pinhole model, optical
+    convention: camera z forward, x right, y down. A reading of exactly 0
+    means "no return" and carves NOTHING (unlike the LD06's zero-as-
+    outlier rule, server/.../main.py:152 — depth cams return 0 for
+    out-of-range or absorptive surfaces, so carving to max range would
+    wrongly clear unknown space).
+    """
+
+    width_px: int = 160
+    height_px: int = 120
+    hfov_rad: float = 1.5010          # ~86 deg (RealSense D435-class)
+    range_min_m: float = 0.2
+    range_max_m: float = 5.0
+    mount_height_m: float = 0.25      # camera z above ground on the robot
+    mount_pitch_rad: float = 0.0      # >0 tilts the optical axis up
+
+    @property
+    def fx(self) -> float:
+        return (self.width_px / 2.0) / math.tan(self.hfov_rad / 2.0)
+
+    @property
+    def fy(self) -> float:
+        return self.fx                # square pixels
+
+    @property
+    def cx(self) -> float:
+        return self.width_px / 2.0 - 0.5
+
+    @property
+    def cy(self) -> float:
+        return self.height_px / 2.0 - 0.5
+
+
+@_frozen
 class FleetConfig:
     """Multi-robot scaling (BASELINE.json configs 4-5: 8-64 simulated Thymios)."""
 
@@ -236,6 +324,8 @@ class SlamConfig:
     # (cluster work at 4096/(4*4) = 256^2).
     frontier: FrontierConfig = FrontierConfig()
     fleet: FleetConfig = FleetConfig()
+    voxel: VoxelConfig = VoxelConfig()
+    depthcam: DepthCamConfig = DepthCamConfig()
     map_publish_period_s: float = 5.0         # slam_config.yaml:25
     tf_publish_period_s: float = 0.1          # slam_config.yaml:24
     # README.md:86 / pi/Dockerfile:3: ROS_DOMAIN_ID=42. Read lazily and
@@ -259,6 +349,8 @@ class SlamConfig:
             loop=LoopClosureConfig(**raw.get("loop", {})),
             frontier=FrontierConfig(**raw.get("frontier", {})),
             fleet=FleetConfig(**raw.get("fleet", {})),
+            voxel=VoxelConfig(**raw.get("voxel", {})),
+            depthcam=DepthCamConfig(**raw.get("depthcam", {})),
             **{k: v for k, v in raw.items()
                if k in ("map_publish_period_s", "tf_publish_period_s", "domain_id")},
         )
@@ -277,7 +369,36 @@ def tiny_config(n_robots: int = 2) -> SlamConfig:
         frontier=FrontierConfig(downsample=2, max_clusters=16,
                                 label_prop_iters=24, bfs_iters=64),
         fleet=FleetConfig(n_robots=n_robots, batch_scans=4),
+        # patch/2 - align/2 = 28 cells = 1.4 m >= the 1.2 m trust horizon.
+        voxel=VoxelConfig(size_x_cells=128, size_y_cells=128,
+                          size_z_cells=16, patch_cells=64, max_range_m=1.2,
+                          align_y=8, align_x=8),
+        depthcam=DepthCamConfig(width_px=40, height_px=30,
+                                range_max_m=1.2),
     )
+
+
+def configs_equivalent(json_a: Optional[str], json_b: Optional[str]) -> bool:
+    """Semantic config-drift comparison for checkpoint/bag guards.
+
+    Parses both sides through `SlamConfig.from_json` — which applies
+    defaults for absent sections and fields — and compares the resulting
+    frozen dataclasses. Plain string comparison would refuse every
+    checkpoint and bag recorded before a config section EXISTED (adding
+    `voxel`/`depthcam` in round 4 would have orphaned all round-3
+    recordings despite zero 2D state drift). Unparseable or genuinely
+    different configs still refuse.
+    """
+    if json_a == json_b:
+        return True
+    if json_a is None or json_b is None:
+        return False
+    try:
+        return SlamConfig.from_json(json_a) == SlamConfig.from_json(json_b)
+    except (TypeError, ValueError, KeyError, AttributeError):
+        # AttributeError: valid JSON that is not an object ('"x"', '[]')
+        # reaches raw.get() — a corrupted config must refuse, not crash.
+        return False
 
 
 def _env_domain_id() -> int:

@@ -110,7 +110,9 @@ def _replay_main(args, cfg) -> int:
               f"expected {sorted(expected)} (missing {missing}) — was the "
               "bag recorded with a different --robots?", file=sys.stderr)
         return 2
-    if rep.config_json is not None and rep.config_json != cfg.to_json():
+    from jax_mapping.config import configs_equivalent
+    if rep.config_json is not None and \
+            not configs_equivalent(rep.config_json, cfg.to_json()):
         print("error: bag was recorded under a different config; pass the "
               "matching --config (the bag stores the recording config)",
               file=sys.stderr)
@@ -220,7 +222,9 @@ def main(argv=None) -> int:
                 print(f"error: cannot resume from {args.resume}: {e}",
                       file=sys.stderr)
                 return 2
-            if ckpt_cfg is not None and ckpt_cfg != cfg.to_json():
+            from jax_mapping.config import configs_equivalent
+            if ckpt_cfg is not None and \
+                    not configs_equivalent(ckpt_cfg, cfg.to_json()):
                 print("error: checkpoint config differs from the running "
                       "config; pass the matching --config", file=sys.stderr)
                 return 2

@@ -1,0 +1,338 @@
+"""3D log-odds voxel grid fused from depth images — OctoMap-style mapping,
+TPU-first (BASELINE.json configs[4]: "3D voxel grid (OctoMap-style) from
+simulated depth cam").
+
+The reference maps in 2D only (slam_toolbox, slam_config.yaml:26-27); this
+module generalizes the framework's dense inverse-sensor-patch idiom
+(ops/grid.py) to 3D. OctoMap's CPU design — per-ray octree traversal with
+pointer chasing — is exactly what a TPU cannot run; instead every voxel of
+a fixed-shape local patch evaluates the inverse sensor model against the
+depth image directly:
+
+    for every voxel v in a (Z, P, P) patch around the camera:
+        c            = R^T (v - cam_pos)          # camera frame, z optical
+        (u, v_px)    = pinhole projection of c    # static-shape math
+        z_img        = depth[v_px, u]             # one gather per voxel
+        v is FREE      if c.z < min(z_img, r_max) - tol  (in frustum, valid)
+        v is OCCUPIED  if |c.z - z_img| <= tol           (valid return)
+        else unchanged
+
+No ray marching, no scatter: each voxel is written exactly once per image,
+so batching over images is a vmap and fleet merging is an add — the same
+deterministic-accumulation property the 2D grid gets (SURVEY.md §7).
+
+Layout: (Z, Y, X), X on TPU lanes (128-aligned patch origins), Y on
+sublanes, Z as the small outer axis. Update patches span the FULL Z extent
+(buildings are shallow; ranges are horizontal-ish), so patch origins stay
+2D (y0, x0) and the global fold is the same aligned dynamic_update_slice
+read-modify-write the 2D grid uses.
+
+Depth-image conventions: pinhole (DepthCamConfig), optical axes (camera z
+forward, x right, y down), depth = z along the OPTICAL AXIS (what real
+depth sensors report), NOT euclidean ray length. A reading of exactly 0
+means "no return" and carves nothing — see DepthCamConfig's docstring for
+why this differs from the LD06 zero-as-outlier rule.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import DepthCamConfig, VoxelConfig
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Camera pose
+# ---------------------------------------------------------------------------
+
+def camera_pose(x_m, y_m, yaw_rad, cam_cfg: DepthCamConfig
+                ) -> Tuple[Array, Array]:
+    """Robot planar pose -> (cam_pos (3,), R_wc (3,3)) world-frame camera.
+
+    The camera sits `mount_height_m` above the ground at the robot's x/y,
+    optical axis along the robot heading tilted by `mount_pitch_rad`
+    (>0 = up). R_wc columns are the camera's (x=right, y=down, z=forward)
+    axes expressed in world coordinates; world points map to camera frame
+    via R_wc^T (w - pos).
+    """
+    x_m = jnp.asarray(x_m, jnp.float32)
+    y_m = jnp.asarray(y_m, jnp.float32)
+    yaw = jnp.asarray(yaw_rad, jnp.float32)
+    p = jnp.float32(cam_cfg.mount_pitch_rad)
+    cy, sy = jnp.cos(yaw), jnp.sin(yaw)
+    cp, sp = jnp.cos(p), jnp.sin(p)
+    fwd = jnp.stack([cp * cy, cp * sy, sp])          # optical axis (cam z)
+    right = jnp.stack([sy, -cy, jnp.zeros_like(sy)])  # cam x
+    down = jnp.cross(fwd, right)                      # cam y (world -z at p=0)
+    pos = jnp.stack([x_m, y_m, jnp.float32(cam_cfg.mount_height_m)])
+    return pos, jnp.stack([right, down, fwd], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Patch origin (2D, full-Z patches) — the ops/grid.py alignment contract
+# ---------------------------------------------------------------------------
+
+def patch_origin(vox: VoxelConfig, cam_pos_xy: Array) -> Array:
+    """Aligned int32 (y0, x0) of the update patch around the camera."""
+    ox, oy, _ = vox.origin_m
+    cx = (cam_pos_xy[0] - ox) / vox.resolution_m
+    cy = (cam_pos_xy[1] - oy) / vox.resolution_m
+    ax, ay = vox.align_x, vox.align_y
+    x0 = jnp.round((cx - vox.patch_cells / 2) / ax).astype(jnp.int32) * ax
+    y0 = jnp.round((cy - vox.patch_cells / 2) / ay).astype(jnp.int32) * ay
+    x0 = jnp.clip(x0, 0, vox.size_x_cells - vox.patch_cells)
+    y0 = jnp.clip(y0, 0, vox.size_y_cells - vox.patch_cells)
+    return jnp.stack([y0, x0])
+
+
+def empty_voxel_grid(vox: VoxelConfig, dtype=jnp.float32) -> Array:
+    """Fresh all-unknown (log-odds 0) voxel grid, (Z, Y, X)."""
+    return jnp.zeros((vox.size_z_cells, vox.size_y_cells, vox.size_x_cells),
+                     dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dense inverse sensor model over an arbitrary (Z, Ny, Nx) region
+# ---------------------------------------------------------------------------
+
+def classify_region(vox: VoxelConfig, cam: DepthCamConfig, depth: Array,
+                    cam_pos: Array, R_wc: Array, y0, x0,
+                    ny: int, nx: int) -> Array:
+    """Log-odds delta for the (Z, ny, nx) voxel region at rows y0, cols x0.
+
+    The one model evaluation both fusion paths share: the patch path calls
+    it at (patch_cells, patch_cells); the sharded path (parallel/
+    voxel_sharded.py) calls it on each device's Y slab directly — the model
+    is pure per-voxel math + one image gather, so GSPMD/shard_map splits it
+    along Y with zero collectives.
+
+    Args:
+      depth: (H, W) float32 metres, 0 = no return (carves nothing).
+      cam_pos: (3,) world camera position; R_wc: (3, 3) from camera_pose.
+      y0, x0: traced int32 region origin (rows, cols).
+    """
+    res = vox.resolution_m
+    ox, oy, oz = vox.origin_m
+    Z = vox.size_z_cells
+    # Voxel centre world coordinates, broadcast to (Z, ny, nx) lazily.
+    xs = (x0 + jnp.arange(nx, dtype=jnp.int32)).astype(jnp.float32)
+    ys = (y0 + jnp.arange(ny, dtype=jnp.int32)).astype(jnp.float32)
+    zs = jnp.arange(Z, dtype=jnp.float32)
+    wx = (xs + 0.5) * res + ox                       # (nx,)
+    wy = (ys + 0.5) * res + oy                       # (ny,)
+    wz = (zs + 0.5) * res + oz                       # (Z,)
+    dx = (wx - cam_pos[0])[None, None, :]            # (1, 1, nx)
+    dy = (wy - cam_pos[1])[None, :, None]            # (1, ny, 1)
+    dz = (wz - cam_pos[2])[:, None, None]            # (Z, 1, 1)
+
+    # Camera-frame coordinates: c = R^T d, expanded per-component so the
+    # (Z, ny, nx) cube is built from broadcasted rank-1 pieces (XLA fuses
+    # these; no (Z*ny*nx, 3) matmul materialisation).
+    cxc = R_wc[0, 0] * dx + R_wc[1, 0] * dy + R_wc[2, 0] * dz   # cam x
+    cyc = R_wc[0, 1] * dx + R_wc[1, 1] * dy + R_wc[2, 1] * dz   # cam y
+    czc = R_wc[0, 2] * dx + R_wc[1, 2] * dy + R_wc[2, 2] * dz   # cam z
+
+    in_front = czc > cam.range_min_m
+    safe_z = jnp.where(in_front, czc, 1.0)
+    u = cam.fx * cxc / safe_z + cam.cx
+    v = cam.fy * cyc / safe_z + cam.cy
+    ui = jnp.round(u).astype(jnp.int32)
+    vi = jnp.round(v).astype(jnp.int32)
+    in_img = ((ui >= 0) & (ui < cam.width_px)
+              & (vi >= 0) & (vi < cam.height_px))
+    frustum = in_front & in_img
+
+    z_img = depth[jnp.clip(vi, 0, cam.height_px - 1),
+                  jnp.clip(ui, 0, cam.width_px - 1)]
+    # Trust horizon is EUCLIDEAN distance (OctoMap's max-range-on-the-ray
+    # semantics), not axial depth: an axial-only bound would let frustum-
+    # corner voxels classify up to max_range/cos(diag half-FOV) ~ 1.4x
+    # max_range away horizontally — outside the patch coverage contract
+    # (_check_patch_coverage), where the patch path would silently drop
+    # them while the sharded full-slab path kept them. The euclidean bound
+    # makes the two paths bit-identical.
+    max_r = jnp.float32(vox.max_range_m)
+    near = (cxc * cxc + cyc * cyc + czc * czc) <= max_r * max_r
+    valid = frustum & near & (z_img > 0.0) & (z_img >= cam.range_min_m)
+
+    tol = vox.hit_tolerance_cells * res
+    carve = jnp.minimum(jnp.where(z_img > 0.0, z_img, 0.0), max_r)
+    free = valid & (czc < carve - tol)
+    occ = valid & (jnp.abs(czc - z_img) <= tol) & (z_img <= max_r)
+
+    delta = jnp.where(occ, vox.logodds_occ,
+                      jnp.where(free, vox.logodds_free, 0.0))
+    return delta.astype(jnp.float32)
+
+
+def classify_patch(vox: VoxelConfig, cam: DepthCamConfig, depth: Array,
+                   cam_pos: Array, R_wc: Array, origin_yx: Array) -> Array:
+    """The (Z, P, P) patch delta for one depth image."""
+    P = vox.patch_cells
+    return classify_region(vox, cam, depth, cam_pos, R_wc,
+                           origin_yx[0], origin_yx[1], P, P)
+
+
+# ---------------------------------------------------------------------------
+# Folding patches into the global voxel grid
+# ---------------------------------------------------------------------------
+
+def apply_patch(vox: VoxelConfig, grid: Array, delta: Array,
+                origin_yx: Array, clamp: bool = True) -> Array:
+    """grid[:, y0:y0+P, x0:x0+P] += delta, clamped to log-odds bounds."""
+    P = vox.patch_cells
+    idx = (jnp.int32(0), origin_yx[0], origin_yx[1])
+    cur = jax.lax.dynamic_slice(grid, idx, (vox.size_z_cells, P, P))
+    new = cur + delta
+    if clamp:
+        new = jnp.clip(new, vox.logodds_min, vox.logodds_max)
+    return jax.lax.dynamic_update_slice(grid, new, idx)
+
+
+# Images classified per fold chunk: a (B, Z, P, P) delta batch at the
+# production shape (64, 384, 384) is B x 37.7 MB of HBM; chunking bounds
+# peak memory the same way grid._FUSE_CHUNK does for 2D scans.
+_FUSE_CHUNK = 8
+
+
+def _check_patch_coverage(vox: VoxelConfig, cam: DepthCamConfig) -> None:
+    """Static (trace-time) guard on the VoxelConfig coverage contract:
+    patch/2 - align_x/2 must reach the trust horizon, or origin alignment
+    can shift the patch far enough that valid returns land outside the
+    update region and silently vanish (the bug code review caught in the
+    first default config)."""
+    slack_m = (vox.patch_cells / 2 - max(vox.align_x, vox.align_y) / 2) \
+        * vox.resolution_m
+    # The horizon is the VOXEL trust radius alone: classify_region bounds
+    # its valid region by euclidean distance <= vox.max_range_m regardless
+    # of the camera's range cap (a caller may feed depth values past the
+    # camera spec; free-carving laterally reaches the voxel radius).
+    horizon = vox.max_range_m
+    if slack_m < horizon:
+        raise ValueError(
+            f"voxel patch coverage violated: patch/2 - align/2 = "
+            f"{slack_m:.2f} m < trust horizon {horizon:.2f} m; raise "
+            f"patch_cells or shrink max_range_m")
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def fuse_depth(vox: VoxelConfig, cam: DepthCamConfig, grid: Array,
+               depth: Array, pose_xyyaw: Array) -> Array:
+    """Fuse ONE depth image taken from a planar robot pose [x, y, yaw]."""
+    _check_patch_coverage(vox, cam)
+    pos, R = camera_pose(pose_xyyaw[0], pose_xyyaw[1], pose_xyyaw[2], cam)
+    origin = patch_origin(vox, pos[:2])
+    delta = classify_patch(vox, cam, depth, pos, R, origin)
+    return apply_patch(vox, grid, delta, origin)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def fuse_depths(vox: VoxelConfig, cam: DepthCamConfig, grid: Array,
+                depths_b: Array, poses_b: Array) -> Array:
+    """Fuse a batch of B depth images, chunked classify -> sequential fold.
+
+    Classification is vmapped (fully parallel); the fold is a sequential
+    scan of aligned read-modify-writes — exact under overlapping patches,
+    no scatter (the 2D fuse_scans design, ops/grid.py).
+
+    Clamp semantics: ONCE per call, not per image (the 2D
+    grid.fuse_scans_window precedent — slam_toolbox's bounded relaxation
+    per map update cycle). This also makes the sharded path
+    (parallel/voxel_sharded.py: sum all slab deltas, clamp once)
+    bit-identical: per-image clamping would diverge on voxels saturating
+    mid-batch under mixed-sign updates.
+
+    Args:
+      depths_b: (B, H, W) metres; poses_b: (B, 3) [x, y, yaw].
+    """
+    _check_patch_coverage(vox, cam)
+    B = depths_b.shape[0]
+    if B == 0:
+        return grid
+
+    def classify_one(depth, pose):
+        pos, R = camera_pose(pose[0], pose[1], pose[2], cam)
+        origin = patch_origin(vox, pos[:2])
+        return classify_patch(vox, cam, depth, pos, R, origin), origin
+
+    def chunk(g, dp):
+        d, p = dp
+        deltas, origins = jax.vmap(classify_one)(d, p)
+
+        def body(gg, do):
+            return apply_patch(vox, gg, do[0], do[1], clamp=False), None
+        out, _ = jax.lax.scan(body, g, (deltas, origins))
+        return out, None
+
+    CB = min(_FUSE_CHUNK, B)
+    nc, rem = B // CB, B % CB
+    out = grid
+    if nc:
+        cut = nc * CB
+        out, _ = jax.lax.scan(
+            chunk, out,
+            (depths_b[:cut].reshape(nc, CB, *depths_b.shape[1:]),
+             poses_b[:cut].reshape(nc, CB, 3)))
+    if rem:
+        out, _ = chunk(out, (depths_b[B - rem:], poses_b[B - rem:]))
+    return jnp.clip(out, vox.logodds_min, vox.logodds_max)
+
+
+# ---------------------------------------------------------------------------
+# Export
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def to_occupancy(vox: VoxelConfig, grid: Array) -> Array:
+    """Log-odds -> int8 {-1 unknown, 0 free, 100 occupied}, the same
+    tri-state contract the 2D grid exports (grid.to_occupancy)."""
+    occ = grid > vox.occ_threshold
+    free = grid < vox.free_threshold
+    return jnp.where(occ, jnp.int8(100),
+                     jnp.where(free, jnp.int8(0), jnp.int8(-1)))
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def height_map(vox: VoxelConfig, grid: Array) -> Array:
+    """(Y, X) float32 metres: top surface of occupied space per column
+    (-1.0 where the column holds no occupied voxel). The 2.5D projection
+    that feeds a 2D planner from the 3D map."""
+    occ = grid > vox.occ_threshold                    # (Z, Y, X)
+    zs = jnp.arange(vox.size_z_cells, dtype=jnp.float32)
+    top = jnp.max(jnp.where(occ, zs[:, None, None], -jnp.inf), axis=0)
+    _, _, oz = vox.origin_m
+    h = (top + 1.0) * vox.resolution_m + oz
+    return jnp.where(jnp.isfinite(top), h, -1.0).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def obstacle_slice(vox: VoxelConfig, grid: Array,
+                   z_min_m: float, z_max_m: float) -> Array:
+    """(Y, X) bool: any occupied voxel in the height band — the 3D map's
+    answer to "which 2D cells block a robot of this height"."""
+    _, _, oz = vox.origin_m
+    zs = (jnp.arange(vox.size_z_cells, dtype=jnp.float32) + 0.5) \
+        * vox.resolution_m + oz
+    band = (zs >= z_min_m) & (zs <= z_max_m)
+    occ = grid > vox.occ_threshold
+    return jnp.any(occ & band[:, None, None], axis=0)
+
+
+def occupied_voxel_centers(vox: VoxelConfig, grid) -> "np.ndarray":  # noqa: F821
+    """Host-side export: (N, 3) world-metre centres of occupied voxels
+    (dynamic N — deliberately not jitted; point-cloud publishing runs on
+    the host like the PNG encoder, bridge/png.py)."""
+    import numpy as np
+    g = np.asarray(grid)
+    zi, yi, xi = np.nonzero(g > vox.occ_threshold)
+    ox, oy, oz = vox.origin_m
+    res = vox.resolution_m
+    return np.stack([(xi + 0.5) * res + ox,
+                     (yi + 0.5) * res + oy,
+                     (zi + 0.5) * res + oz], axis=1).astype(np.float32)

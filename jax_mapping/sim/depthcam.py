@@ -1,0 +1,92 @@
+"""Synthetic pinhole depth camera: device-native rendering against the
+2.5D world (BASELINE.json configs[4]: "simulated depth cam").
+
+The reference has no depth sensor; this renders the same generated worlds
+the LiDAR sim uses (sim/world.py bitmaps) extruded to 3D — walls of
+`wall_height_m` standing on an infinite floor at z = 0. TPU-first like
+sim/lidar.py: no per-ray marching loops. Every pixel samples its ray at S
+fixed euclidean steps (one big gather against the world bitmap + pure
+math for the floor), and the first hit falls out of an argmax over the
+boolean hit profile. vmap over pixels and poses; everything static-shape.
+
+Returned images follow the real-sensor convention ops/voxel.py consumes:
+depth = z along the OPTICAL AXIS (not euclidean ray length), 0 = no
+return (ray left the world or exceeded range_max).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import DepthCamConfig
+from jax_mapping.ops.voxel import camera_pose
+
+Array = jax.Array
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 5))
+def render_depth(cam: DepthCamConfig, world: Array, world_res_m: float,
+                 n_samples: int, pose_xyyaw: Array,
+                 wall_height_m: float = 0.5) -> Array:
+    """One (H, W) float32 depth image from a planar robot pose [x, y, yaw].
+
+    `world` is the (Hw, Ww) boolean obstacle bitmap with centred indexing
+    (the sim/lidar.py convention). Walls span 0 <= z <= wall_height_m;
+    the floor plane z = 0 returns everywhere (a real depth cam sees the
+    floor). Pixels whose ray exits the world sideways or runs past
+    range_max report 0.0 (no return).
+    """
+    Hw, Ww = world.shape
+    H, W = cam.height_px, cam.width_px
+    pos, R = camera_pose(pose_xyyaw[0], pose_xyyaw[1], pose_xyyaw[2], cam)
+
+    # Per-pixel unit ray directions in the camera frame (z optical).
+    us = (jnp.arange(W, dtype=jnp.float32) - cam.cx) / cam.fx
+    vs = (jnp.arange(H, dtype=jnp.float32) - cam.cy) / cam.fy
+    dx_c = jnp.broadcast_to(us[None, :], (H, W))
+    dy_c = jnp.broadcast_to(vs[:, None], (H, W))
+    dz_c = jnp.ones((H, W), jnp.float32)
+    norm = jnp.sqrt(dx_c ** 2 + dy_c ** 2 + dz_c ** 2)
+    d_cam = jnp.stack([dx_c, dy_c, dz_c], axis=-1) / norm[..., None]
+    d_world = jnp.einsum("ij,hwj->hwi", R, d_cam)            # (H, W, 3)
+    # Optical-axis component of the unit ray: converts euclidean sample
+    # distance t to projective depth z = t * cos(angle to axis).
+    cos_axis = d_cam[..., 2]                                  # (H, W)
+
+    # Euclidean sample distances; max stretched so oblique rays can still
+    # reach range_max in projective depth.
+    t_max = cam.range_max_m / jnp.maximum(cos_axis.min(), 0.05)
+    ts = jnp.linspace(cam.range_min_m, t_max, n_samples)      # (S,)
+    # Sample positions: (H, W, S, 3) built lazily by broadcasting.
+    px = pos[0] + d_world[..., 0:1] * ts                      # (H, W, S)
+    py = pos[1] + d_world[..., 1:2] * ts
+    pz = pos[2] + d_world[..., 2:3] * ts
+
+    col = jnp.round(px / world_res_m + Ww / 2 - 0.5).astype(jnp.int32)
+    row = jnp.round(py / world_res_m + Hw / 2 - 0.5).astype(jnp.int32)
+    inb = (row >= 0) & (row < Hw) & (col >= 0) & (col < Ww)
+    wall = world[jnp.clip(row, 0, Hw - 1), jnp.clip(col, 0, Ww - 1)] \
+        & inb & (pz >= 0.0) & (pz <= wall_height_m)
+    floor = pz <= 0.0
+    hit = wall | floor
+
+    any_hit = hit.any(axis=-1)
+    first = jnp.argmax(hit, axis=-1)                          # (H, W)
+    t_hit = ts[first]
+    depth = t_hit * cos_axis                                  # projective z
+    ok = any_hit & (depth >= cam.range_min_m) & (depth <= cam.range_max_m)
+    return jnp.where(ok, depth, 0.0).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 5))
+def render_depths(cam: DepthCamConfig, world: Array, world_res_m: float,
+                  n_samples: int, poses_xyyaw: Array,
+                  wall_height_m: float = 0.5) -> Array:
+    """vmap over a (B, 3) pose batch -> (B, H, W) depth images."""
+    return jax.vmap(
+        lambda p: render_depth(cam, world, world_res_m, n_samples, p,
+                               wall_height_m)
+    )(poses_xyyaw)
