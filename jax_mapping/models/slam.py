@@ -172,16 +172,22 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
         pose = jnp.where(res.accepted, res.pose, pose_odo)
 
         grid = G.fuse_scan(cfg.grid, cfg.scan, st.grid, ranges, pose)
-        k = st.graph.n_poses
-        graph = PG.add_pose(st.graph, pose)
+
+        # Ring full? Halve keyframe density first (PG.thin_keyframes) so
+        # the trajectory keeps extending and loop repair keeps working —
+        # slam_toolbox's unbounded graph, fixed-shape style.
+        graph0, ring0 = jax.lax.cond(
+            st.graph.n_poses >= cfg.loop.max_poses,
+            lambda a: PG.thin_keyframes(*a),
+            lambda a: a, (st.graph, st.scan_ring))
+
+        k = graph0.n_poses
+        graph = PG.add_pose(graph0, pose)
         graph = jax.lax.cond(
             k > 0,
             lambda gr: PG.odometry_edge(gr, jnp.maximum(k - 1, 0), k),
             lambda gr: gr, graph)
-        ring = jnp.where(k < cfg.loop.max_poses,
-                         st.scan_ring.at[jnp.minimum(
-                             k, cfg.loop.max_poses - 1)].set(ranges),
-                         st.scan_ring)
+        ring = ring0.at[jnp.minimum(k, cfg.loop.max_poses - 1)].set(ranges)
 
         # ---- loop closure ------------------------------------------------
         cand, found = PG.loop_candidate(cfg.loop, graph, k)
@@ -204,13 +210,17 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
                 g2 = PG.add_edge(graph, cand, k, rel,
                                  jnp.array([200.0, 200.0, 400.0]))
                 g2 = PG.optimize(cfg.loop, g2)
-                # Map repair: re-fuse every key-scan from optimised poses.
-                grid2 = G.fuse_scans(
-                    cfg.grid, cfg.scan,
-                    G.empty_grid(cfg.grid),
-                    ring,
-                    g2.poses[:cfg.loop.max_poses]
-                    * g2.pose_valid[:cfg.loop.max_poses, None])
+                # Map repair: re-fuse every key-scan from optimised poses,
+                # MASKED on pose validity — unmasked, the ring's never-
+                # written all-zero slots would each carve a phantom free
+                # disc at the origin (a zero range means "outlier, carve
+                # to 10 m", server/.../main.py:152) and erase real walls
+                # there; measured: a 3-scan ring repaired unmasked lost
+                # all 272 occupied cells of its wall.
+                grid2 = G.fuse_scans_masked(
+                    cfg.grid, cfg.scan, G.empty_grid(cfg.grid), ring,
+                    g2.poses[:cfg.loop.max_poses],
+                    g2.pose_valid[:cfg.loop.max_poses])
                 return g2, grid2, jnp.bool_(True)
 
             return jax.lax.cond(good, apply,

@@ -235,10 +235,9 @@ def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
             bool(cfg.loop.enabled) & bool(cfg.loop.cross_robot)
         attempt_any_local = attempt | xattempt
         any_attempt = jax.lax.psum(attempt_any_local.sum(), "fleet") > 0
-        # Ring completeness must agree fleet-wide (see models/fleet
-        # _close_loops on why repair stops after any ring saturates).
-        rings_complete = jax.lax.psum(
-            (graphs.n_poses >= cfg.loop.max_poses).sum(), "fleet") == 0
+        # Rings are complete by construction: a full ring thins before
+        # any append (_update_graphs), uniformly across shards (thinning
+        # depends only on shard-local state) — repair never stops.
 
         def close(args):
             graphs, est = args
@@ -263,7 +262,7 @@ def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
             any_attempt, close, skip, (graphs, est))
         any_closed = jax.lax.psum(closed.sum(), "fleet") > 0
         repair = jax.lax.psum(repair, "fleet")
-        grid = jnp.where(any_closed & rings_complete,
+        grid = jnp.where(any_closed,
                          jnp.clip(repair, cfg.grid.logodds_min,
                                   cfg.grid.logodds_max), grid)
 

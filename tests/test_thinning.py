@@ -1,0 +1,203 @@
+"""Keyframe thinning: unbounded trajectories in the fixed-capacity ring
+(round-3 verdict weak #5 — repair froze forever once a ring saturated) and
+the masked-repair regression (unmasked ring re-fusion phantom-carved free
+space from never-written zero slots, erasing walls near the origin).
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.models import slam as S
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import posegraph as PG
+from jax_mapping.ops.odometry import pose_between
+
+
+def _line_graph(cfg_loop, n, step=0.2):
+    """n poses along +x, odometry chain edges, returns (graph, ring)."""
+    g = PG.empty_graph(cfg_loop)
+    for i in range(n):
+        pose = jnp.asarray([i * step, 0.0, 0.0], jnp.float32)
+        g = PG.add_pose(g, pose)
+        if i:
+            g = PG.odometry_edge(g, i - 1, i)
+    ring = jnp.arange(cfg_loop.max_poses, dtype=jnp.float32)[:, None] \
+        * jnp.ones(8)[None, :]           # row i filled with i: traceable
+    return g, ring
+
+
+def test_thin_structure(tiny_cfg):
+    cap = 16
+    lc = dataclasses.replace(tiny_cfg.loop, max_poses=cap, max_edges=64)
+    g, ring = _line_graph(lc, cap)
+    # Two long-range edges: both-even endpoints (2, 10) and both-odd
+    # (3, 11), with their true relative poses as measurements.
+    for (i, j) in ((2, 10), (3, 11)):
+        meas = pose_between(g.poses[i], g.poses[j])
+        g = PG.add_edge(g, i, j, meas, jnp.asarray([200.0, 200.0, 400.0]))
+
+    g2, ring2 = PG.thin_keyframes(g, ring)
+
+    assert int(g2.n_poses) == cap // 2
+    np.testing.assert_allclose(np.asarray(g2.poses[: cap // 2]),
+                               np.asarray(g.poses[::2]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ring2[: cap // 2]),
+                               np.asarray(ring[::2]), atol=1e-6)
+    valid = np.asarray(g2.pose_valid)
+    assert valid[: cap // 2].all() and not valid[cap // 2:].any()
+
+    # Edges: chain 0..n2-2, then the two surviving loop edges.
+    n2 = cap // 2
+    assert int(g2.n_edges) == (n2 - 1) + 2
+    ij = np.asarray(g2.edge_ij)
+    meas = np.asarray(g2.edge_meas)
+    for e in range(n2 - 1):
+        assert tuple(ij[e]) == (e, e + 1)
+        np.testing.assert_allclose(meas[e], [0.4, 0.0, 0.0], atol=1e-5)
+    # (2,10) -> (1,5) exactly; (3,11) -> (1,5) adjusted by the odometry
+    # hops (all poses collinear, so the adjusted measurement is the true
+    # relative pose of the remapped endpoints).
+    assert tuple(ij[n2 - 1]) == (1, 5)
+    np.testing.assert_allclose(meas[n2 - 1], [1.6, 0.0, 0.0], atol=1e-5)
+    assert tuple(ij[n2]) == (1, 5)
+    np.testing.assert_allclose(meas[n2], [1.6, 0.0, 0.0], atol=1e-4)
+    assert not np.asarray(g2.edge_valid)[int(g2.n_edges):].any()
+
+
+def test_thin_drops_degenerate_remaps(tiny_cfg):
+    """A loop edge whose endpoints collapse to the same kept index (e.g.
+    (4, 5) if it were long-range) must be dropped, not become a
+    self-edge."""
+    cap = 16
+    lc = dataclasses.replace(tiny_cfg.loop, max_poses=cap, max_edges=64)
+    g, ring = _line_graph(lc, cap)
+    meas = pose_between(g.poses[4], g.poses[6])
+    g = PG.add_edge(g, 4, 6, meas, jnp.asarray([200.0, 200.0, 400.0]))
+    # (4, 6) -> (2, 3): survives. Also add (8, 9)-distance-2? (8, 10) ->
+    # (4, 5) survives. A (5, 6)-style j-i==1 edge is chain, rebuilt anyway.
+    g2, _ = PG.thin_keyframes(g, ring)
+    ij = np.asarray(g2.edge_ij)[np.asarray(g2.edge_valid)]
+    assert (ij[:, 1] > ij[:, 0]).all(), "self- or backward edge leaked"
+
+
+def test_thin_preserves_strong_anchor_edges(tiny_cfg):
+    """Gap-1 edges at LOOP weights (the fleet's cross-robot anchors) must
+    survive thinning as strong edges where their endpoints stay distinct,
+    not be downgraded to re-measured odometry."""
+    cap = 16
+    lc = dataclasses.replace(tiny_cfg.loop, max_poses=cap, max_edges=64)
+    g, ring = _line_graph(lc, cap)
+    w_loop = jnp.asarray([200.0, 200.0, 400.0])
+    # Anchor at (5, 6): odd->even, remaps to (2, 3) — must survive strong.
+    g = PG.add_edge(g, 5, 6, pose_between(g.poses[5], g.poses[6]), w_loop)
+    # Anchor at (8, 9): even->odd, collapses to (4, 4) — must drop.
+    g = PG.add_edge(g, 8, 9, pose_between(g.poses[8], g.poses[9]), w_loop)
+
+    g2, _ = PG.thin_keyframes(g, ring)
+    ij = np.asarray(g2.edge_ij)[np.asarray(g2.edge_valid)]
+    w = np.asarray(g2.edge_weight)[np.asarray(g2.edge_valid)]
+    strong = w[:, 2] > 100.0
+    assert strong.sum() == 1, "exactly one anchor should survive"
+    si = int(np.nonzero(strong)[0][0])
+    assert tuple(ij[si]) == (2, 3)
+    # Adjusted to the kept endpoints: new (2, 3) are old poses (4, 6),
+    # 0.4 m apart on the line.
+    np.testing.assert_allclose(
+        np.asarray(g2.edge_meas)[np.asarray(g2.edge_valid)][si],
+        [0.4, 0.0, 0.0], atol=1e-5)
+
+
+def test_thin_then_optimize_stays_consistent(tiny_cfg):
+    """Thinning a consistent graph must leave optimisation a no-op:
+    near-zero residuals before and after."""
+    cap = 32
+    lc = dataclasses.replace(tiny_cfg.loop, max_poses=cap, max_edges=128,
+                             gn_iters=4)
+    # Poses around a circle; chain + one closing edge, all measurements
+    # exact.
+    g = PG.empty_graph(lc)
+    R_c = 2.0
+    for i in range(cap):
+        th = 2 * math.pi * i / cap
+        g = PG.add_pose(g, jnp.asarray(
+            [R_c * math.cos(th), R_c * math.sin(th), th + math.pi / 2]))
+        if i:
+            g = PG.odometry_edge(g, i - 1, i)
+    meas = pose_between(g.poses[0], g.poses[cap - 1])
+    g = PG.add_edge(g, 0, cap - 1, meas, jnp.asarray([200.0, 200.0, 400.0]))
+    assert float(PG.graph_error(g)) < 1e-6
+
+    ring = jnp.zeros((cap, 8), jnp.float32)
+    g2, _ = PG.thin_keyframes(g, ring)
+    assert float(PG.graph_error(g2)) < 1e-4
+    g3 = PG.optimize(lc, g2)
+    a, b = (np.asarray(g3.poses[: cap // 2]),
+            np.asarray(g2.poses[: cap // 2]))
+    np.testing.assert_allclose(a[:, :2], b[:, :2], atol=1e-2)
+    # optimize wraps angles to (-pi, pi]; compare modulo 2*pi.
+    dth = np.abs(np.arctan2(np.sin(a[:, 2] - b[:, 2]),
+                            np.cos(a[:, 2] - b[:, 2])))
+    assert dth.max() < 1e-2
+
+
+def test_slam_step_extends_past_capacity(tiny_cfg):
+    """slam_step keeps accepting key scans beyond max_poses: the ring
+    thins instead of freezing (graph stays under capacity, total key
+    count keeps counting)."""
+    cap = 12
+    cfg = dataclasses.replace(
+        tiny_cfg,
+        loop=dataclasses.replace(tiny_cfg.loop, max_poses=cap,
+                                 max_edges=64, enabled=False))
+    state = S.init_state(cfg)
+    ranges = jnp.zeros(cfg.scan.padded_beams)      # featureless: odometry
+    wl = wr = jnp.float32(4000.0)                  # 0.12 m/step > gate
+    for _ in range(3 * cap):
+        state, diag = S.slam_step(cfg, state, ranges, wl, wr,
+                                  jnp.float32(0.1))
+    assert int(state.n_keyscans) == 3 * cap
+    assert int(state.graph.n_poses) <= cap
+    # The surviving keyframes still form a valid, growing chain.
+    assert bool(state.graph.pose_valid[: int(state.graph.n_poses)].all())
+    # Thinned trajectory still spans the whole drive: the newest pose is
+    # ~3*cap*0.12 m out.
+    x = float(state.graph.poses[int(state.graph.n_poses) - 1, 0])
+    assert x > 0.8 * (3 * cap * 0.12)
+
+
+@pytest.mark.slow
+def test_loop_closure_past_saturation(tiny_cfg):
+    """The round-3 verdict's acceptance test: drive MORE key scans than
+    max_poses, then close the loop — the map must still de-ghost (repair
+    no longer stops at saturation), and the repaired map must keep its
+    walls (the masked-repair regression: unmasked zero slots used to
+    carve the origin region free and erase every occupied cell)."""
+    from tests.test_loop_closure import _drive_loop, loop_cfg
+    base = loop_cfg(tiny_cfg)
+    # Small enough to saturate mid-drive (the drive produces ~70+ key
+    # scans at the 0.3 m gate), big enough to keep loop verification
+    # chains meaningful.
+    cfg = dataclasses.replace(
+        base, loop=dataclasses.replace(base.loop, max_poses=48,
+                                       max_edges=256))
+    state, hist = _drive_loop(cfg, bias_units=1.0)
+
+    assert int(state.n_keyscans) > cfg.loop.max_poses, \
+        "staging failed: drive never saturated the ring"
+    loops = np.array([n for _, _, n in hist])
+    assert loops[-1] >= 1, "no loop closed after saturation"
+    errs = np.array([np.linalg.norm(t[:2] - e[:2]) for t, e, _ in hist])
+    assert errs[-1] < 0.3, f"final error {errs[-1]:.2f} m not repaired"
+
+    # Map quality after the post-saturation repair: the start-corner
+    # walls must be occupied (masked repair), and known-free space must
+    # exist (the map is a real map, not all-unknown).
+    occ = np.asarray(G.to_occupancy(cfg.grid, state.grid))
+    assert (occ == 100).sum() > 30, "repair erased the walls"
+    assert (occ == 0).sum() > 1000, "no free space in the repaired map"
