@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--live-hardware", action="store_true",
                    help="inbound /scan + /odom from real drivers feed the "
                         "mapper; the simulator is not started")
+    p.add_argument("--joy-device", type=str, default=None, metavar="DEV",
+                   help="read a joystick at this evdev node (e.g. "
+                        "/dev/input/event3) and publish /cmd_vel teleop "
+                        "(joystick.yaml semantics: deadman button 0, "
+                        "axes 2/3, autorepeat 20 Hz)")
     p.add_argument("--print-rviz-config", action="store_true",
                    help="print the bundled RViz config path and exit")
     return p
@@ -117,8 +122,26 @@ def main(argv=None) -> int:
     adapter = RclpyAdapter(stack.bus, cfg, tf=stack.tf, inbound=inbound,
                            outbound=outbound, n_robots=n_robots)
     adapter.spin()
+    joy = None
+    if args.joy_device:
+        from jax_mapping.bridge.joydev import attach_joystick
+        try:
+            joy = attach_joystick(stack.bus, args.joy_device)
+            print(f"jax-mapping-ros: joystick at {args.joy_device} -> "
+                  "/cmd_vel (hold button 0 to drive)")
+        except OSError as e:
+            print(f"jax-mapping-ros: cannot open joystick "
+                  f"{args.joy_device}: {e}", file=sys.stderr)
     if not args.live_hardware:
-        stack.brain.start_exploring()
+        # A pad means MANUAL drive: the brain applies /cmd_vel only while
+        # not exploring (brain._manual_targets — the reference's override
+        # semantics), so auto-starting exploration would silently discard
+        # every pad command. The operator flips modes via HTTP /start.
+        if joy is None:
+            stack.brain.start_exploring()
+        else:
+            print("jax-mapping-ros: manual-drive mode (pad attached); "
+                  "start autonomous exploration via HTTP /start")
         print("jax-mapping-ros: sim stack up — /map /map_updates /pose "
               "/poses /scan /odom /tf out, /cmd_vel in")
     else:
@@ -132,6 +155,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if joy is not None:
+            joy.close()
         adapter.shutdown()
         stack.shutdown()
     return 0

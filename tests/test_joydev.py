@@ -1,0 +1,203 @@
+"""JoyDeviceReader: raw evdev byte streams drive the teleop chain.
+
+No /dev/input or uinput exists in this image, so the reader is driven
+with spec-conformant synthetic input_event bytes through a pipe — the
+emulated-device pattern tests/test_native.py uses for the LD06 parser.
+The end-to-end test runs pad bytes -> reader -> TeleopNode -> /cmd_vel
+-> ThymioBrain manual override -> motor targets.
+"""
+
+import os
+import struct
+import time
+
+import pytest
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.driver import (
+    MOTOR_LEFT_TARGET, MOTOR_RIGHT_TARGET, SimulatedThymioDriver,
+)
+from jax_mapping.bridge.joydev import (
+    EV_ABS, EV_KEY, EV_SYN, EVENT, JoyDeviceReader, pack_event,
+)
+from jax_mapping.bridge.teleop import JoystickConfig, TeleopNode
+
+
+BTN_SOUTH = 0x130      # PS4 "X", joystick.yaml enable_button 0
+
+
+def collect(bus, topic="/cmd_vel"):
+    out = []
+    bus.subscribe(topic, callback=out.append)
+    return out
+
+
+def _feed(events: bytes):
+    r, w = os.pipe()
+    os.write(w, events)
+    os.close(w)                      # EOF ends pump()
+    return r
+
+
+def test_event_struct_layout():
+    """24-byte native input_event framing: round-trips type/code/value."""
+    b = pack_event(EV_ABS, 0x05, 255, t=1.5)
+    assert len(b) == EVENT.size
+    sec, usec, etype, code, value = EVENT.unpack(b)
+    assert (sec, usec) == (1, 500000)
+    assert (etype, code, value) == (EV_ABS, 0x05, 255)
+
+
+def test_sample_assembled_only_on_syn(tiny_cfg):
+    bus = Bus()
+    teleop = TeleopNode(bus)
+    seen = []
+    teleop.update = lambda axes, buttons: seen.append((axes, buttons))
+    ev = (pack_event(EV_KEY, BTN_SOUTH, 1)
+          + pack_event(EV_ABS, 0x02, 255)     # right stick hard right
+          + pack_event(EV_ABS, 0x05, 0))      # right stick full forward
+    rd = JoyDeviceReader(_feed(ev), teleop)
+    rd.pump()
+    assert seen == []                          # no SYN yet -> no sample
+
+    ev += pack_event(EV_SYN, 0, 0)
+    rd2 = JoyDeviceReader(_feed(ev), teleop)
+    rd2.pump()
+    assert len(seen) == 1
+    axes, buttons = seen[0]
+    assert buttons[0] == 1
+    # 0..255 normalization: 255 -> +1; axis 5 is vertical -> inverted,
+    # raw 0 (stick pushed forward) -> +1.
+    assert axes[2] == pytest.approx(1.0)
+    assert axes[5] == pytest.approx(1.0)
+    assert rd2.n_samples == 1
+
+
+def test_normalization_center_and_clamp(tiny_cfg):
+    bus = Bus()
+    teleop = TeleopNode(bus)
+    seen = []
+    teleop.update = lambda a, b: seen.append(a)
+    ev = (pack_event(EV_ABS, 0x00, 128) + pack_event(EV_SYN, 0, 0)
+          + pack_event(EV_ABS, 0x00, 300) + pack_event(EV_SYN, 0, 0))
+    rd = JoyDeviceReader(_feed(ev), teleop)
+    rd.pump()
+    assert seen[0][0] == pytest.approx(0.0, abs=0.01)   # centred stick
+    assert seen[1][0] == 1.0                            # out-of-range clamps
+
+
+def test_hat_range_and_custom_override(tiny_cfg):
+    bus = Bus()
+    teleop = TeleopNode(bus)
+    seen = []
+    teleop.update = lambda a, b: seen.append(a)
+    ev = (pack_event(EV_ABS, 0x10, -1)      # hat left
+          + pack_event(EV_ABS, 0x03, 512)   # custom-range axis
+          + pack_event(EV_SYN, 0, 0))
+    rd = JoyDeviceReader(_feed(ev), teleop,
+                         abs_ranges={3: (0.0, 1024.0)})
+    rd.pump()
+    assert seen[0][6] == pytest.approx(-1.0)
+    assert seen[0][3] == pytest.approx(0.0, abs=0.01)
+
+
+def test_pad_drives_brain_override(tiny_cfg):
+    """The verdict's acceptance chain: emulated pad events drive
+    /cmd_vel through the brain's manual override to motor targets."""
+    bus = Bus()
+    out = collect(bus)
+    driver = SimulatedThymioDriver(n_robots=1)
+    from jax_mapping.bridge.brain import ThymioBrain
+    brain = ThymioBrain(tiny_cfg, bus, driver)
+    assert brain.link_up and not brain.is_exploring
+
+    cfg = JoystickConfig()
+    teleop = TeleopNode(bus, cfg)
+    # Full forward on the linear axis (vertical -> raw 0 is forward),
+    # centred angular, deadman held.
+    ev = (pack_event(EV_KEY, BTN_SOUTH, 1)
+          + pack_event(EV_ABS, 0x03, 0)          # axis 3 = linear
+          + pack_event(EV_ABS, 0x02, 128)        # axis 2 = angular ~ 0
+          + pack_event(EV_SYN, 0, 0))
+    rd = JoyDeviceReader(_feed(ev), teleop,
+                         invert_axes=frozenset({1, 3, 5, 7}))
+    rd.pump()
+    teleop._tick()
+
+    assert len(out) == 1
+    assert out[0].linear_x == pytest.approx(cfg.scale_linear, rel=0.02)
+    assert abs(out[0].angular_z) < 0.02
+
+    brain.update_loop()
+    node = driver.first_node()
+    k = tiny_cfg.robot.speed_coeff_m_per_unit_s
+    # 0.20 m/s maps to ~660 wheel units, clamped to the Thymio target
+    # range (+-600, brain.py).
+    expect = min(cfg.scale_linear / k, 600.0)
+    assert driver[node][MOTOR_LEFT_TARGET] == pytest.approx(expect, rel=0.05)
+    assert driver[node][MOTOR_RIGHT_TARGET] == pytest.approx(expect,
+                                                            rel=0.05)
+
+    # Deadman release stops the robot.
+    ev2 = pack_event(EV_KEY, BTN_SOUTH, 0) + pack_event(EV_SYN, 0, 0)
+    rd2 = JoyDeviceReader(_feed(ev2), teleop,
+                          invert_axes=frozenset({1, 3, 5, 7}))
+    rd2.pump()
+    teleop._tick()
+    assert out[-1].linear_x == 0.0
+    brain.update_loop()
+    assert driver[node][MOTOR_LEFT_TARGET] == 0
+
+
+def test_spin_thread_and_close(tiny_cfg):
+    bus = Bus()
+    teleop = TeleopNode(bus)
+    r, w = os.pipe()
+    rd = JoyDeviceReader(r, teleop).spin_thread()
+    os.write(w, pack_event(EV_KEY, BTN_SOUTH, 1) + pack_event(EV_SYN, 0, 0))
+    deadline = time.monotonic() + 2.0
+    while rd.n_samples == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert rd.n_samples == 1
+    os.close(w)
+    rd.close()
+
+
+def test_close_interrupts_quiet_pad(tiny_cfg):
+    """close() must return promptly even when no events ever arrive (a
+    bare blocking read would hang the 2 s join and race fd reuse)."""
+    bus = Bus()
+    teleop = TeleopNode(bus)
+    r, w = os.pipe()
+    rd = JoyDeviceReader(r, teleop).spin_thread()
+    time.sleep(0.05)                       # thread parked in select()
+    t0 = time.monotonic()
+    rd.close()
+    assert time.monotonic() - t0 < 1.0
+    assert not rd._thread.is_alive()
+    os.close(w)
+    os.close(r)
+
+
+def test_attach_joystick_publishes_without_manual_ticks(tiny_cfg):
+    """attach_joystick must own a running executor: pad bytes alone must
+    reach /cmd_vel through the autorepeat timer (the code-review finding:
+    a TeleopNode without an executor never publishes)."""
+    from jax_mapping.bridge.joydev import attach_joystick
+
+    bus = Bus()
+    out = collect(bus)
+    r, w = os.pipe()
+    session = attach_joystick(bus, r)
+    try:
+        os.write(w, pack_event(EV_KEY, BTN_SOUTH, 1)
+                 + pack_event(EV_ABS, 0x03, 0)
+                 + pack_event(EV_SYN, 0, 0))
+        deadline = time.monotonic() + 3.0
+        while not out and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert out, "autorepeat timer never published /cmd_vel"
+        assert out[0].linear_x != 0.0
+    finally:
+        session.close()
+        os.close(w)
