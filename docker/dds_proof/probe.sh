@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Assert the jax-mapping ROS 2 bridge's contract surfaces over REAL DDS.
+# Runs inside a ros:jazzy container next to the stack container
+# (docker-compose.yml); exits non-zero on any missing surface.
+set -u
+. /opt/ros/jazzy/setup.sh
+
+fail() { echo "DDS-PROOF-FAIL: $*" >&2; exit 1; }
+
+echo "== waiting for /map to be advertised (the stack installs jax on"
+echo "   first boot; allow a few minutes) =="
+deadline=$((SECONDS + 240))
+until ros2 topic list 2>/dev/null | grep -qx /map; do
+  [ $SECONDS -ge $deadline ] && fail "/map never advertised"
+  sleep 3
+done
+
+echo "== topic list =="
+ros2 topic list
+
+for t in /map /map_updates /scan /odom /pose /tf /frontiers_markers; do
+  ros2 topic list | grep -qx "$t" || fail "topic $t not advertised"
+done
+
+echo "== /map arrives (latched: transient-local reliable) =="
+timeout 60 ros2 topic echo --once \
+    --qos-durability transient_local --qos-reliability reliable \
+    /map > /tmp/map.msg || fail "/map message never arrived"
+grep -q "resolution: 0.05" /tmp/map.msg || fail "/map resolution wrong"
+
+echo "== /scan flows (Best-Effort sensor QoS) =="
+timeout 30 ros2 topic echo --once --qos-reliability best_effort \
+    /scan > /tmp/scan.msg || fail "/scan message never arrived"
+grep -q "frame_id: base_laser" /tmp/scan.msg || fail "/scan frame wrong"
+
+echo "== /scan rate =="
+timeout 15 ros2 topic hz /scan --window 20 2>&1 | tail -2 || true
+
+echo "== TF chain map -> base_link resolves =="
+timeout 30 ros2 run tf2_ros tf2_echo map base_link 2>&1 | head -6 \
+    > /tmp/tf.txt
+grep -q "Translation" /tmp/tf.txt || fail "tf map->base_link unresolved"
+cat /tmp/tf.txt
+
+echo "== inbound /cmd_vel is subscribed by the stack =="
+info=$(ros2 topic info /cmd_vel 2>/dev/null)
+echo "$info"
+echo "$info" | grep -q "Subscription count: [1-9]" \
+    || fail "stack does not subscribe /cmd_vel"
+
+echo "DDS-PROOF-OK"

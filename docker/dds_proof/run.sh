@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# One-command real-DDS proof (operator machine with Docker + network):
+# boots the stack + probe containers, captures the transcript, exits
+# with the probe's status.
+set -euo pipefail
+cd "$(dirname "$0")"
+docker compose up --abort-on-container-exit --exit-code-from probe \
+    2>&1 | tee transcript.txt
