@@ -1,0 +1,214 @@
+"""Byte transports for the LD06 ingest node: serial, TCP, UDP.
+
+The reference vendors the ldrobot driver with two transport backends —
+UART serial (`pi_hardware.launch.py:17-18`, /dev/ttyUSB0 @ 230400) and a
+TCP/UDP network path (`network_socket_interface_linux.cpp`, SURVEY.md
+§2.3) for lidars behind a serial-to-ethernet bridge. `Ld06IngestNode`
+takes any zero-argument callable returning the freshest bytes; these are
+the concrete implementations for real deployments, stdlib-only:
+
+  * `SerialTransport` — a tty put into raw mode at 230400 baud via
+    termios (no pyserial in this image, none needed: reading a configured
+    tty is just os.read);
+  * `TcpTransport` — client socket to a serial-device server, with
+    bounded-backoff auto-reconnect (the lidar bridge may boot after us);
+  * `UdpTransport` — bound datagram socket (the vendored driver's UDP
+    server mode).
+
+All are non-blocking: they return b"" when nothing is pending, so the
+node's 100 Hz poll timer never stalls the executor, and all are safe to
+`close()` from another thread. Tests drive them with ptys and localhost
+sockets carrying `native.ld06.encode_packets` bytes — the same
+spec-conformant stream real hardware produces.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import socket
+import time
+from typing import Optional
+
+
+class SerialTransport:
+    """Raw-mode tty reader (the reference's UART path)."""
+
+    def __init__(self, path: str, baud: int = 230400):
+        import termios
+        self.path = path
+        self._fd = os.open(path, os.O_RDWR | os.O_NOCTTY | os.O_NONBLOCK)
+        try:
+            attrs = termios.tcgetattr(self._fd)
+            # cfmakeraw semantics: no line discipline mangling the binary
+            # packet stream.
+            attrs[0] = 0                                   # iflag
+            attrs[1] = 0                                   # oflag
+            attrs[2] = termios.CS8 | termios.CREAD | termios.CLOCAL
+            attrs[3] = 0                                   # lflag
+            rate = getattr(termios, f"B{baud}", None)
+            if rate is not None:
+                attrs[4] = attrs[5] = rate                 # ispeed/ospeed
+            termios.tcsetattr(self._fd, termios.TCSANOW, attrs)
+        except termios.error:
+            # Not a real tty (a pty pair or fifo in tests): raw bytes
+            # flow regardless; baud only means something on real UARTs.
+            pass
+
+    def __call__(self) -> bytes:
+        try:
+            return os.read(self._fd, 4096)
+        except BlockingIOError:
+            return b""
+        except OSError:
+            return b""
+
+    def close(self) -> None:
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+
+
+class TcpTransport:
+    """Auto-reconnecting client to a lidar TCP server.
+
+    Fully non-blocking, including the DIAL: connects via connect_ex on a
+    non-blocking socket and completes the handshake across poll calls (a
+    blocking create_connection would stall the shared executor up to its
+    timeout every backoff window while the lidar bridge is down).
+    Counters: `n_connects` counts every established connection;
+    `n_reconnects` only those after a previous one existed (a healthy
+    single-connection session reads 0)."""
+
+    def __init__(self, host: str, port: int,
+                 reconnect_backoff_s: float = 0.5,
+                 max_backoff_s: float = 5.0):
+        self.host, self.port = host, port
+        self._sock: Optional[socket.socket] = None
+        self._pending: Optional[socket.socket] = None
+        self._backoff = reconnect_backoff_s
+        self._backoff0 = reconnect_backoff_s
+        self._max_backoff = max_backoff_s
+        self._next_attempt = 0.0
+        self.n_connects = 0
+        self.n_reconnects = 0
+        self._closed = False
+
+    def _fail_attempt(self) -> None:
+        if self._pending is not None:
+            try:
+                self._pending.close()
+            except OSError:
+                pass
+            self._pending = None
+        self._next_attempt = time.monotonic() + self._backoff
+        self._backoff = min(self._backoff * 2, self._max_backoff)
+
+    def _established(self, s: socket.socket) -> None:
+        if self.n_connects > 0:
+            self.n_reconnects += 1
+        self.n_connects += 1
+        self._sock = s
+        self._pending = None
+        self._backoff = self._backoff0
+
+    def _connect_step(self) -> None:
+        """Advance the non-blocking dial one step; never blocks."""
+        import select
+        now = time.monotonic()
+        if self._closed:
+            return
+        if self._pending is None:
+            if now < self._next_attempt:
+                return
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setblocking(False)
+            rc = s.connect_ex((self.host, self.port))
+            if rc == 0:
+                self._established(s)
+            elif rc in (errno.EINPROGRESS, errno.EWOULDBLOCK,
+                        errno.EAGAIN):
+                self._pending = s
+            else:
+                self._pending = s
+                self._fail_attempt()
+            return
+        # Handshake in flight: writable == resolved (then check SO_ERROR).
+        _, w, _ = select.select([], [self._pending], [], 0)
+        if not w:
+            return
+        err = self._pending.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+        if err == 0:
+            self._established(self._pending)
+        else:
+            self._fail_attempt()
+
+    def __call__(self) -> bytes:
+        s = self._sock                       # snapshot: close() may race
+        if s is None:
+            self._connect_step()
+            s = self._sock
+            if s is None:
+                return b""
+        try:
+            data = s.recv(4096)
+        except BlockingIOError:
+            return b""
+        except OSError:
+            data = b""
+        if not data:
+            # Peer closed (lidar bridge rebooted): drop and re-dial.
+            try:
+                s.close()
+            except OSError:
+                pass
+            if self._sock is s:
+                self._sock = None
+            self._next_attempt = time.monotonic() + self._backoff0
+            return b""
+        return data
+
+    def close(self) -> None:
+        self._closed = True
+        for s in (self._sock, self._pending):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._sock = None
+        self._pending = None
+
+
+class UdpTransport:
+    """Bound datagram receiver (the vendored driver's UDP mode)."""
+
+    def __init__(self, bind_host: str = "0.0.0.0", bind_port: int = 8889):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((bind_host, bind_port))
+        self._sock.setblocking(False)
+        self.port = self._sock.getsockname()[1]
+
+    def __call__(self) -> bytes:
+        out = b""
+        # Drain every pending datagram: packets are 47 bytes and arrive
+        # faster than the poll when the lidar bursts a rotation.
+        while True:
+            try:
+                chunk, _addr = self._sock.recvfrom(4096)
+            except BlockingIOError:
+                break
+            except OSError as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    break
+                return out
+            out += chunk
+        return out
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,160 @@
+"""LD06 transports: serial (pty), TCP (reconnect), UDP — carrying the
+same spec-conformant wire bytes the native parser tests use, end to end
+into published LaserScans.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.ld06_node import Ld06IngestNode
+from jax_mapping.bridge.ld06_transport import (
+    SerialTransport, TcpTransport, UdpTransport,
+)
+from jax_mapping.native import ld06 as N
+
+
+def _rotation_bytes(n_beams=360, r0=2.0):
+    ranges = np.full(n_beams, r0, np.float32)
+    return N.encode_packets(ranges)
+
+
+def _collect_scans(bus, topic="scan"):
+    out = []
+    bus.subscribe(topic, callback=out.append)
+    return out
+
+
+def _drain(node, transport, deadline_s=3.0, want=1):
+    t0 = time.monotonic()
+    while node.n_scans_published < want and \
+            time.monotonic() - t0 < deadline_s:
+        node.poll()
+        time.sleep(0.005)
+
+
+def test_serial_transport_pty_roundtrip(tiny_cfg):
+    """A pty stands in for /dev/ttyUSB0: the reference's UART path."""
+    if not N.native_available():
+        pytest.skip("libld06 not buildable")
+    master, slave = os.openpty()
+    tr = SerialTransport(os.ttyname(slave))
+    bus = Bus()
+    scans = _collect_scans(bus)
+    node = Ld06IngestNode(tiny_cfg.scan, bus, tr, realtime=False)
+
+    # Two rotations: the parser needs the next rotation's start to close
+    # out the previous one.
+    os.write(master, _rotation_bytes(tiny_cfg.scan.n_beams))
+    os.write(master, _rotation_bytes(tiny_cfg.scan.n_beams))
+    _drain(node, tr)
+    assert node.n_scans_published >= 1
+    assert scans and scans[0].ranges.shape == (tiny_cfg.scan.n_beams,)
+    assert scans[0].ranges.max() == pytest.approx(2.0, abs=0.01)
+    tr.close()
+    os.close(master)
+
+
+def test_udp_transport_datagrams(tiny_cfg):
+    if not N.native_available():
+        pytest.skip("libld06 not buildable")
+    tr = UdpTransport(bind_host="127.0.0.1", bind_port=0)
+    bus = Bus()
+    scans = _collect_scans(bus)
+    node = Ld06IngestNode(tiny_cfg.scan, bus, tr, realtime=False)
+
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    data = _rotation_bytes(tiny_cfg.scan.n_beams) \
+        + _rotation_bytes(tiny_cfg.scan.n_beams)
+    # One datagram per packet, like a serial-to-ethernet bridge.
+    for i in range(0, len(data), N.PACKET_BYTES):
+        tx.sendto(data[i:i + N.PACKET_BYTES], ("127.0.0.1", tr.port))
+    _drain(node, tr)
+    assert node.n_scans_published >= 1
+    assert scans[0].ranges.max() == pytest.approx(2.0, abs=0.01)
+    tr.close()
+    tx.close()
+
+
+def test_tcp_transport_reconnects(tiny_cfg):
+    """The lidar bridge boots late and reboots mid-stream: the client
+    transport must dial, deliver, survive the drop, and re-deliver."""
+    if not N.native_available():
+        pytest.skip("libld06 not buildable")
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    tr = TcpTransport("127.0.0.1", port, reconnect_backoff_s=0.05)
+    assert tr() == b""                      # server not listening yet
+    srv.listen(1)
+
+    bus = Bus()
+    scans = _collect_scans(bus)
+    node = Ld06IngestNode(tiny_cfg.scan, bus, tr, realtime=False)
+
+    def serve_once():
+        conn, _ = srv.accept()
+        conn.sendall(_rotation_bytes(tiny_cfg.scan.n_beams))
+        conn.sendall(_rotation_bytes(tiny_cfg.scan.n_beams))
+        time.sleep(0.1)
+        conn.close()                        # mid-stream reboot
+
+    t = threading.Thread(target=serve_once, daemon=True)
+    t.start()
+    _drain(node, tr)
+    assert node.n_scans_published >= 1
+    n_before = node.n_scans_published
+
+    # Second incarnation of the server: the transport re-dials.
+    def serve_again():
+        conn, _ = srv.accept()
+        conn.sendall(_rotation_bytes(tiny_cfg.scan.n_beams, r0=3.0))
+        conn.sendall(_rotation_bytes(tiny_cfg.scan.n_beams, r0=3.0))
+        time.sleep(0.1)
+        conn.close()
+
+    t2 = threading.Thread(target=serve_again, daemon=True)
+    t2.start()
+    # Leftover round-1 bytes can complete an extra rotation BEFORE the
+    # reconnect, so a bare scan count races; wait for the second
+    # incarnation's distinctive 3.0 m rotation instead.
+    t0 = time.monotonic()
+    def got_new():
+        return any(abs(float(s.ranges.max()) - 3.0) < 0.01 for s in scans)
+    while not got_new() and time.monotonic() - t0 < 5.0:
+        node.poll()
+        time.sleep(0.005)
+    assert got_new(), "no scan from the reconnected server"
+    assert node.n_scans_published > n_before
+    # First dial is a connect, not a REconnect (review finding): one
+    # clean session + one recovery == n_connects 2, n_reconnects 1+.
+    assert tr.n_connects >= 2
+    assert tr.n_reconnects >= 1
+    tr.close()
+    srv.close()
+
+
+def test_transports_nonblocking_when_idle(tiny_cfg):
+    """Empty reads return immediately — the poll timer must never stall."""
+    tr = UdpTransport(bind_host="127.0.0.1", bind_port=0)
+    t0 = time.monotonic()
+    for _ in range(100):
+        assert tr() == b""
+    assert time.monotonic() - t0 < 0.5
+    tr.close()
+
+    master, slave = os.openpty()
+    st = SerialTransport(os.ttyname(slave))
+    t0 = time.monotonic()
+    for _ in range(100):
+        assert st() == b""
+    assert time.monotonic() - t0 < 0.5
+    st.close()
+    os.close(master)
