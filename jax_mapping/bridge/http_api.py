@@ -17,6 +17,8 @@ variants' routes merged:
   dead code, `:56-57` vs `:248-249` — SURVEY.md Appendix B); here the cache
   actually works.
 * `GET /frontiers` — JSON frontier targets + assignment (new capability).
+* `GET /voxel-image` — grayscale height-map PNG of the 3D voxel map
+  (BASELINE configs[4]; 404 unless the stack runs with depth_cam).
 * `GET /metrics` — framework counters in Prometheus text format.
 * `POST /save[?name=x]`, `POST /load[?name=x]` — checkpoint / restore the
   live SLAM state (grid, poses, graphs, scan rings) through
@@ -61,10 +63,12 @@ class MapApiServer:
     def __init__(self, bus: Bus, brain=None, host: str = "127.0.0.1",
                  port: int = 5000, png_cache_s: float = 1.0,
                  extra_status: Optional[Callable[[], dict]] = None,
-                 mapper=None, checkpoint_dir: str = "checkpoints"):
+                 mapper=None, checkpoint_dir: str = "checkpoints",
+                 voxel_mapper=None):
         self.bus = bus
         self.brain = brain
         self.mapper = mapper
+        self.voxel_mapper = voxel_mapper
         self.checkpoint_dir = checkpoint_dir
         self.png_cache_s = png_cache_s
         self.extra_status = extra_status
@@ -75,6 +79,9 @@ class MapApiServer:
         self._png: Optional[bytes] = None
         self._png_time = -1e9
         self._png_map_stamp = -1.0
+        self._voxel_png: Optional[bytes] = None
+        self._voxel_png_time = -1e9
+        self._voxel_png_key = -1
         self.n_requests = 0
         self.n_png_cache_hits = 0
 
@@ -149,6 +156,8 @@ class MapApiServer:
             return 200, "application/json", json.dumps(body).encode()
         if route == "/map-image":
             return self._map_image()
+        if route == "/voxel-image":
+            return self._voxel_image()
         if route == "/frontiers":
             return self._frontiers()
         if route == "/metrics":
@@ -221,6 +230,31 @@ class MapApiServer:
             self._png = data
             self._png_time = time.monotonic()
             self._png_map_stamp = msg.header.stamp
+        return 200, "image/png", data
+
+    def _voxel_image(self) -> Tuple[int, str, bytes]:
+        """Grayscale height-map PNG of the 3D voxel map (0 = unmapped
+        column, brighter = taller top surface) — the /map-image analog
+        for the BASELINE configs[4] pipeline, with the same cache policy
+        (keyed on fusion progress: re-encoding an unchanged grid for a
+        polling UI is the exact waste the map-image cache exists for)."""
+        if self.voxel_mapper is None:
+            return 404, "application/json", json.dumps(
+                {"error": "no voxel mapper attached (run the stack with "
+                          "depth_cam enabled)"}).encode()
+        key = self.voxel_mapper.n_images_fused
+        now = time.monotonic()
+        with self._lock:
+            if self._voxel_png is not None \
+                    and now - self._voxel_png_time < self.png_cache_s \
+                    and self._voxel_png_key == key:
+                self.n_png_cache_hits += 1
+                return 200, "image/png", self._voxel_png
+        data = png_codec.encode_gray(self.voxel_mapper.height_map_image())
+        with self._lock:
+            self._voxel_png = data
+            self._voxel_png_time = time.monotonic()
+            self._voxel_png_key = key
         return 200, "image/png", data
 
     def _frontiers(self) -> Tuple[int, str, bytes]:

@@ -47,6 +47,7 @@ class Stack:
     mapper: MapperNode
     api: Optional[MapApiServer]
     executor: Executor
+    voxel_mapper: Optional[object] = None    # VoxelMapperNode when depth_cam
 
     def run_steps(self, n: int) -> None:
         """Faster-than-realtime: drive physics+brain+mapper loops directly,
@@ -55,6 +56,8 @@ class Stack:
             self.sim.step()
             self.brain.update_loop()
             self.mapper.tick()
+            if self.voxel_mapper is not None:
+                self.voxel_mapper.tick()
 
     def shutdown(self) -> None:
         if self.api is not None:
@@ -66,11 +69,14 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
                      world_res_m: Optional[float] = None,
                      n_robots: int = 1, http_port: Optional[int] = None,
                      realtime: bool = False,
-                     drop_prob: float = 0.0, seed: int = 0) -> Stack:
+                     drop_prob: float = 0.0, seed: int = 0,
+                     depth_cam: bool = False) -> Stack:
     """Boot the whole graph. realtime=False leaves timers idle so tests can
     step deterministically via `Stack.run_steps`; realtime=True spins the
     executor thread like the reference's rclpy daemon thread
-    (`server/.../main.py:285-287`). http_port=0 picks a free port."""
+    (`server/.../main.py:285-287`). http_port=0 picks a free port.
+    depth_cam=True adds the 3D pipeline: per-robot simulated depth images
+    fused into a shared voxel grid (BASELINE configs[4])."""
     res = world_res_m if world_res_m is not None else cfg.grid.resolution_m
     bus = Bus(domain_id=cfg.domain_id, drop_prob=drop_prob, seed=seed)
     tf = TfTree()
@@ -83,7 +89,7 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     driver = SimulatedThymioDriver(n_robots=n_robots)
     sim = SimNode(cfg, bus, driver, world, res, tf=tf,
                   rate_hz=cfg.robot.control_rate_hz, seed=seed,
-                  realtime=realtime)
+                  realtime=realtime, depth_cam=depth_cam)
     brain = ThymioBrain(cfg, bus, driver, tf=tf, n_robots=n_robots)
     # Start calibrated: the odom frame origin is the boot pose; expressing
     # boot poses in the map frame up front keeps multi-robot maps aligned
@@ -93,14 +99,22 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     for i, st in enumerate(mapper.states):
         mapper.states[i] = st._replace(pose=jnp.asarray(brain.poses[i]))
 
+    voxel_mapper = None
+    if depth_cam:
+        from jax_mapping.bridge.voxel_mapper import VoxelMapperNode
+        voxel_mapper = VoxelMapperNode(cfg, bus, tf=tf, n_robots=n_robots)
+
     api = None
     if http_port is not None:
         api = MapApiServer(bus, brain=brain, port=http_port,
-                           mapper=mapper)
+                           mapper=mapper, voxel_mapper=voxel_mapper)
         api.serve_thread()
 
-    executor = Executor([sim, brain, mapper])
+    nodes = [sim, brain, mapper] + \
+        ([voxel_mapper] if voxel_mapper is not None else [])
+    executor = Executor(nodes)
     if realtime:
         executor.spin_thread()
     return Stack(cfg=cfg, bus=bus, tf=tf, driver=driver, sim=sim,
-                 brain=brain, mapper=mapper, api=api, executor=executor)
+                 brain=brain, mapper=mapper, api=api, executor=executor,
+                 voxel_mapper=voxel_mapper)

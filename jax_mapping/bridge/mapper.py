@@ -40,6 +40,7 @@ from jax_mapping.bridge.messages import (
     occupancy_from_logodds,
 )
 from jax_mapping.bridge.node import Node
+from jax_mapping.bridge.odom_pairing import OdomPairer
 from jax_mapping.bridge.qos import QoSProfile, qos_map, qos_sensor_data
 from jax_mapping.bridge.tf import TfTree
 from jax_mapping.config import SlamConfig
@@ -70,7 +71,7 @@ class MapperNode(Node):
         self.states = [
             S.init_state(cfg)._replace(grid=self.shared_grid)
             for _ in range(n_robots)]
-        self._odom_hist: List[List[Odometry]] = [[] for _ in range(n_robots)]
+        self._pairer = OdomPairer(n_robots)
         self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
         self._last_odom_pose = [None] * n_robots    # pose used at last fuse
         self._prev_paired: List[Optional[Odometry]] = [None] * n_robots
@@ -197,23 +198,14 @@ class MapperNode(Node):
 
     def _odom_cb(self, i: int, msg: Odometry) -> None:
         with self._state_lock:
-            hist = self._odom_hist[i]
-            hist.append(msg)
-            if len(hist) > 200:
-                del hist[:100]
+            self._pairer.push(i, msg)
 
     # -- pairing + device step ----------------------------------------------
 
     def _pair_odom(self, i: int, stamp: float) -> Optional[Odometry]:
-        """Freshest odometry at or before `stamp` (drop/reorder tolerant)."""
-        best = None
-        for od in self._odom_hist[i]:
-            if od.header.stamp <= stamp and \
-                    (best is None or od.header.stamp > best.header.stamp):
-                best = od
-        if best is None and self._odom_hist[i]:
-            best = self._odom_hist[i][0]            # scan predates odometry
-        return best
+        """Freshest odometry at or before `stamp` (drop/reorder tolerant;
+        shared rule: bridge/odom_pairing.py)."""
+        return self._pairer.pair(i, stamp)
 
     def _pad_ranges(self, scan: LaserScan) -> np.ndarray:
         sc = self.cfg.scan

@@ -99,6 +99,28 @@ class LaserScan:
 
 
 @dataclasses.dataclass
+class DepthImage:
+    """sensor_msgs/Image (32FC1 depth) — the `{ns}depth` payload.
+
+    Depth is metres along the OPTICAL AXIS (what real depth sensors
+    report), 0.0 = no return; intrinsics live in DepthCamConfig, not the
+    message (one camera model per deployment, the reference's
+    one-static-TF-per-sensor convention)."""
+
+    header: Header = dataclasses.field(default_factory=Header)
+    depth: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0, 0), np.float32))
+
+    @property
+    def height(self) -> int:
+        return self.depth.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.depth.shape[1]
+
+
+@dataclasses.dataclass
 class MapMetaData:
     """nav_msgs/MapMetaData: resolution + dimensions + origin pose."""
 

@@ -1,0 +1,47 @@
+"""Drop/reorder-tolerant odometry pairing, shared by the 2D and 3D
+mappers.
+
+Best-Effort sensor delivery (report.pdf §V.A) means scans/depth images
+and odometry arrive dropped and reordered; each sensor sample pairs with
+the FRESHEST odometry at or before its stamp. One implementation so a
+pairing-rule fix cannot silently apply to one mapper and not the other
+(the duplication code review flagged in round 4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from jax_mapping.bridge.messages import Odometry
+
+
+class OdomPairer:
+    """Per-robot bounded odometry history + stamp pairing.
+
+    Not internally locked: both mapper nodes already serialize access
+    under their own state locks (bus callbacks and tick share the node's
+    lock), and locking twice per message would buy nothing.
+    """
+
+    def __init__(self, n_robots: int, max_hist: int = 200):
+        self._hist: List[List[Odometry]] = [[] for _ in range(n_robots)]
+        self._max = max_hist
+
+    def push(self, i: int, od: Odometry) -> None:
+        hist = self._hist[i]
+        hist.append(od)
+        if len(hist) > self._max:
+            del hist[: self._max // 2]
+
+    def pair(self, i: int, stamp: float) -> Optional[Odometry]:
+        """Freshest odometry at or before `stamp`; the oldest sample when
+        the scan predates all odometry (bootstrap); None when no odometry
+        has arrived at all."""
+        best = None
+        for od in self._hist[i]:
+            if od.header.stamp <= stamp and \
+                    (best is None or od.header.stamp > best.header.stamp):
+                best = od
+        if best is None and self._hist[i]:
+            best = self._hist[i][0]
+        return best

@@ -40,7 +40,8 @@ class SimNode(Node):
                  driver: SimulatedThymioDriver, world: np.ndarray,
                  world_res_m: float, tf: Optional[TfTree] = None,
                  rate_hz: float = 10.0, seed: int = 0,
-                 realtime: bool = True):
+                 realtime: bool = True, depth_cam: bool = False,
+                 wall_height_m: float = 0.5):
         super().__init__("sim_node", bus, tf)
         import jax
         import jax.numpy as jnp
@@ -60,6 +61,19 @@ class SimNode(Node):
         self.scan_pubs = [
             self.create_publisher(f"{robot_ns(i, R)}scan", qos_sensor_data)
             for i in range(R)]
+        # Optional simulated depth camera (BASELINE configs[4]): renders a
+        # per-robot depth image each tick for the 3D voxel pipeline.
+        self.depth_cam = depth_cam
+        self.wall_height_m = wall_height_m
+        if depth_cam:
+            from jax_mapping.sim import depthcam
+            self._depthcam = depthcam
+            self.depth_n_samples = max(
+                16, int(cfg.depthcam.range_max_m / (world_res_m * 0.5)))
+            self.depth_pubs = [
+                self.create_publisher(f"{robot_ns(i, R)}depth",
+                                      qos_sensor_data)
+                for i in range(R)]
         self.n_steps = 0
         if realtime:
             self.create_timer(1.0 / rate_hz, self.step)
@@ -96,4 +110,18 @@ class SimNode(Node):
                 range_min=cfg.scan.range_min_m,
                 range_max=cfg.scan.range_max_m,
                 ranges=scans_np[i, :cfg.scan.n_beams].copy()))
+
+        if self.depth_cam:
+            from jax_mapping.bridge.messages import DepthImage
+            depths = self._depthcam.render_depths(
+                cfg.depthcam, self.world, self.world_res_m,
+                self.depth_n_samples, self.sim_state.poses,
+                self.wall_height_m)
+            depths_np = np.asarray(depths)
+            for i, pub in enumerate(self.depth_pubs):
+                pub.publish(DepthImage(
+                    header=Header(stamp=stamp,
+                                  frame_id=f"{robot_ns(i, len(self.depth_pubs))}"
+                                           f"base_camera"),
+                    depth=depths_np[i]))
         self.n_steps += 1

@@ -1,0 +1,150 @@
+"""Voxel mapper node: depth images -> the fleet's shared 3D log-odds map.
+
+The 3D counterpart of `bridge/mapper.py` in the node graph (BASELINE
+configs[4]): subscribes `{ns}depth` (Best-Effort sensor QoS) + `{ns}odom`
+per robot, pairs each depth image with the freshest odometry at or before
+its stamp (the 2D mapper's drop/reorder-tolerant batcher), and fuses
+batches on device through `ops.voxel.fuse_depths` into ONE shared voxel
+grid for the whole fleet — the same single-map memory architecture as the
+2D mapper.
+
+Pose source is odometry, not SLAM: depth fusion rides on the 2D
+pipeline's pose estimates in a full deployment (the mapper's `map->odom`
+correction applies upstream); standalone it maps in the odom frame. The
+camera mount (height, pitch) comes from DepthCamConfig.
+
+Exports mirror the 2D mapper's: `voxel_grid()` (log-odds), plus the 2.5D
+projections a planner or UI consumes — `height_map()` and
+`obstacle_slice()` — and a grayscale height-map image with the /map-image
+color convention's spirit (0 = unknown column, brighter = taller).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from jax_mapping.bridge.brain import robot_ns
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.messages import DepthImage, Odometry
+from jax_mapping.bridge.node import Node
+from jax_mapping.bridge.odom_pairing import OdomPairer
+from jax_mapping.bridge.qos import QoSProfile, qos_sensor_data
+from jax_mapping.bridge.tf import TfTree
+from jax_mapping.config import SlamConfig
+from jax_mapping.utils import global_metrics as M
+
+
+class VoxelMapperNode(Node):
+    """Device-resident 3D mapping behind the topic contract."""
+
+    def __init__(self, cfg: SlamConfig, bus: Bus,
+                 tf: Optional[TfTree] = None, n_robots: int = 1,
+                 tick_period_s: Optional[float] = None):
+        super().__init__("jax_voxel_mapper", bus, tf)
+        import jax.numpy as jnp
+
+        from jax_mapping.ops import voxel as V
+
+        self.cfg = cfg
+        self.n_robots = n_robots
+        self._V, self._jnp = V, jnp
+        V._check_patch_coverage(cfg.voxel, cfg.depthcam)
+
+        self._lock = threading.Lock()
+        self.grid = V.empty_voxel_grid(cfg.voxel)
+        self._depth_q: List[List[DepthImage]] = [[] for _ in range(n_robots)]
+        self._pairer = OdomPairer(n_robots)
+        self.n_images_fused = 0
+        self.n_images_dropped_unpaired = 0
+
+        for i in range(n_robots):
+            ns = robot_ns(i, n_robots)
+            self.create_subscription(
+                f"{ns}depth", functools.partial(self._depth_cb, i),
+                qos_sensor_data)
+            self.create_subscription(
+                f"{ns}odom", functools.partial(self._odom_cb, i),
+                QoSProfile(depth=50))
+
+        period = tick_period_s if tick_period_s is not None \
+            else 1.0 / cfg.robot.control_rate_hz
+        self.create_timer(period, self.tick)
+
+    # -- callbacks ----------------------------------------------------------
+
+    def _depth_cb(self, i: int, msg: DepthImage) -> None:
+        with self._lock:
+            self._depth_q[i].append(msg)
+
+    def _odom_cb(self, i: int, msg: Odometry) -> None:
+        with self._lock:
+            self._pairer.push(i, msg)
+
+    # -- device step --------------------------------------------------------
+
+    def tick(self) -> None:
+        """Drain queues, fuse each robot's batch on device."""
+        jnp = self._jnp
+        cam = self.cfg.depthcam
+        with self._lock:
+            work = []
+            for i in range(self.n_robots):
+                for msg in sorted(self._depth_q[i],
+                                  key=lambda m: m.header.stamp):
+                    od = self._pairer.pair(i, msg.header.stamp)
+                    if od is None:
+                        self.n_images_dropped_unpaired += 1
+                        M.counters.inc("voxel_mapper.images_unpaired")
+                        continue
+                    if msg.depth.shape != (cam.height_px, cam.width_px):
+                        # Shape drift would silently mis-project through
+                        # the pinhole model; refuse loudly in counters.
+                        M.counters.inc("voxel_mapper.images_bad_shape")
+                        continue
+                    work.append((msg.depth, od.pose))
+                self._depth_q[i].clear()
+        if not work:
+            return
+        depths = np.stack([w[0] for w in work]).astype(np.float32)
+        poses = np.asarray([[w[1].x, w[1].y, w[1].theta] for w in work],
+                           np.float32)
+        with M.stages.stage("voxel_mapper.fuse"):
+            with self._lock:
+                grid = self.grid
+            grid = self._V.fuse_depths(self.cfg.voxel, cam, grid,
+                                       jnp.asarray(depths),
+                                       jnp.asarray(poses))
+            with self._lock:
+                self.grid = grid
+        self.n_images_fused += len(work)
+        M.counters.inc("voxel_mapper.images_fused", len(work))
+
+    # -- exports ------------------------------------------------------------
+
+    def voxel_grid(self):
+        with self._lock:
+            return self.grid
+
+    def height_map(self) -> np.ndarray:
+        return np.asarray(self._V.height_map(self.cfg.voxel,
+                                             self.voxel_grid()))
+
+    def obstacle_slice(self, z_min_m: float, z_max_m: float) -> np.ndarray:
+        return np.asarray(self._V.obstacle_slice(
+            self.cfg.voxel, self.voxel_grid(), z_min_m, z_max_m))
+
+    def height_map_image(self) -> np.ndarray:
+        """(Y, X) uint8 grayscale: 0 = no occupied voxel in the column,
+        1..255 scale linearly with top-surface height over the grid's z
+        extent; flipud for image coords (the /map-image convention)."""
+        hm = self.height_map()
+        _, _, ez = self.cfg.voxel.extent_m
+        img = np.zeros(hm.shape, np.uint8)
+        mapped = hm >= 0.0
+        img[mapped] = (1.0 + 254.0 * np.clip(hm[mapped] / ez, 0.0, 1.0)) \
+            .astype(np.uint8)
+        return np.flipud(img)

@@ -58,6 +58,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint")
     p.add_argument("--drop-prob", type=float, default=0.0,
                    help="Best-Effort link loss injection (report.pdf §V.A)")
+    p.add_argument("--depth-cam", action="store_true",
+                   help="also run the 3D pipeline: simulated depth camera "
+                        "per robot fused into a shared voxel grid "
+                        "(BASELINE configs[4]); adds voxel counts to the "
+                        "summary and the /voxel-image HTTP route")
+    p.add_argument("--voxel-out", type=str, default=None, metavar="PNG",
+                   help="write the final 3D height map as a grayscale PNG "
+                        "(requires --depth-cam)")
     p.add_argument("--seed", type=int, default=0)
     return p
 
@@ -174,8 +182,13 @@ def main(argv=None) -> int:
     else:
         cfg = tiny_config(n_robots=args.robots)
 
+    if args.voxel_out and not args.depth_cam:
+        print("error: --voxel-out requires --depth-cam", file=sys.stderr)
+        return 2
+
     if args.replay:
-        clash = [f for f in ("record", "save_final", "resume", "serve")
+        clash = [f for f in ("record", "save_final", "resume", "serve",
+                             "depth_cam")
                  if getattr(args, f)]
         if clash:
             flags = ", ".join("--" + f.replace("_", "-") for f in clash)
@@ -194,7 +207,7 @@ def main(argv=None) -> int:
         0 if args.serve else None)
     stack = launch_sim_stack(cfg, world, n_robots=args.robots,
                              http_port=port, drop_prob=args.drop_prob,
-                             seed=args.seed)
+                             seed=args.seed, depth_cam=args.depth_cam)
     recorder = None
     try:
         if args.record:
@@ -261,6 +274,14 @@ def main(argv=None) -> int:
             "cells_occupied": int((occ == 100).sum()),
             "brain": stack.brain.status(),
         }
+        if args.depth_cam and stack.voxel_mapper is not None:
+            from jax_mapping.ops import voxel as VX
+            occ3 = np.asarray(VX.to_occupancy(
+                cfg.voxel, stack.voxel_mapper.voxel_grid()))
+            summary["voxels_occupied"] = int((occ3 == 100).sum())
+            summary["voxels_free"] = int((occ3 == 0).sum())
+            summary["depth_images_fused"] = int(
+                stack.voxel_mapper.n_images_fused)
         if stack.api is not None:
             summary["http"] = f"http://127.0.0.1:{stack.api.port}"
         print(json.dumps(summary, indent=2))
@@ -273,6 +294,13 @@ def main(argv=None) -> int:
 
         if args.out:
             _write_png(args.out, occ)
+
+        if args.voxel_out and stack.voxel_mapper is not None:
+            from jax_mapping.bridge.png import encode_gray
+            with open(args.voxel_out, "wb") as f:
+                f.write(encode_gray(stack.voxel_mapper.height_map_image()))
+            print(f"voxel height map written to {args.voxel_out}",
+                  file=sys.stderr)
 
         if args.save_final:
             from jax_mapping.io.checkpoint import save_checkpoint

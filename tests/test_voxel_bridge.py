@@ -1,0 +1,93 @@
+"""The 3D pipeline as a runtime citizen: sim depth cam -> VoxelMapperNode
+-> shared voxel grid -> HTTP /voxel-image (BASELINE configs[4] in the
+node graph, not just ops).
+"""
+
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jax_mapping.bridge.launch import launch_sim_stack
+from jax_mapping.bridge.png import decode_gray
+from jax_mapping.ops import voxel as V
+from jax_mapping.sim import world as W
+
+
+@pytest.fixture(scope="module")
+def stack(tiny_cfg):
+    world = W.plank_course(96, tiny_cfg.grid.resolution_m, n_planks=4,
+                           seed=3)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=2, http_port=0,
+                          seed=3, depth_cam=True)
+    st.brain.start_exploring()
+    st.run_steps(30)
+    yield st
+    st.shutdown()
+
+
+def test_depth_images_flow_and_fuse(stack):
+    vm = stack.voxel_mapper
+    assert vm is not None
+    # 2 robots x 30 ticks, modulo any unpaired startup images.
+    assert vm.n_images_fused >= 40
+    grid = np.asarray(vm.voxel_grid())
+    assert np.abs(grid).sum() > 0, "no 3D evidence fused"
+    occ3 = np.asarray(V.to_occupancy(stack.cfg.voxel, grid))
+    assert (occ3 == 0).sum() > 100, "no free space carved in 3D"
+
+
+def test_height_map_and_slice_exports(stack):
+    vm = stack.voxel_mapper
+    hm = vm.height_map()
+    z, y, x = (stack.cfg.voxel.size_z_cells, stack.cfg.voxel.size_y_cells,
+               stack.cfg.voxel.size_x_cells)
+    assert hm.shape == (y, x)
+    blocked = vm.obstacle_slice(0.05, 0.45)
+    assert blocked.shape == (y, x)
+    img = vm.height_map_image()
+    assert img.dtype == np.uint8 and img.shape == (y, x)
+
+
+def test_http_voxel_image(stack):
+    url = f"http://127.0.0.1:{stack.api.port}/voxel-image"
+    body = urllib.request.urlopen(url).read()
+    assert body[:8] == b"\x89PNG\r\n\x1a\n"
+    img = decode_gray(body)
+    assert img.shape == (stack.cfg.voxel.size_y_cells,
+                         stack.cfg.voxel.size_x_cells)
+
+
+def test_http_voxel_image_404_without_depth_cam(tiny_cfg):
+    world = W.empty_arena(96, tiny_cfg.grid.resolution_m)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=1, http_port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{st.api.port}/voxel-image")
+        assert ei.value.code == 404
+    finally:
+        st.shutdown()
+
+
+def test_voxel_mapper_rejects_shape_drift(tiny_cfg):
+    """A depth image whose shape disagrees with DepthCamConfig must be
+    counted out, not mis-projected."""
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.messages import DepthImage, Header, Odometry, \
+        Pose2D
+    from jax_mapping.bridge.voxel_mapper import VoxelMapperNode
+    from jax_mapping.utils import global_metrics as M
+
+    bus = Bus()
+    vm = VoxelMapperNode(tiny_cfg, bus, n_robots=1)
+    od = bus.publisher("odom")
+    dp = bus.publisher("depth")
+    od.publish(Odometry(header=Header(stamp=1.0), pose=Pose2D(0, 0, 0)))
+    before = M.counters.get("voxel_mapper.images_bad_shape")
+    dp.publish(DepthImage(header=Header(stamp=1.1),
+                          depth=np.ones((7, 9), np.float32)))
+    vm.tick()
+    assert vm.n_images_fused == 0
+    assert M.counters.get("voxel_mapper.images_bad_shape") == before + 1
