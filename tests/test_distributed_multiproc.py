@@ -1,8 +1,10 @@
 """True multi-process DCN integration: two OS processes, jax.distributed
-over localhost, running (1) the fleet map-merge psum and (2) the FULL
+over localhost, running (1) the fleet map-merge psum, (2) the FULL
 sharded fleet step — slab-delta psum merge, coarse-mask all_gather,
-matching, fusion, graphs — with the fleet mesh axis genuinely spanning
-the process boundary (Gloo CPU backend).
+matching, fusion, graphs — and (3) the sharded 3D voxel fusion, each
+with the fleet mesh axis genuinely spanning the process boundary (Gloo
+CPU backend). Phase 3 additionally pins exact parity against the
+single-device patch path on every locally-addressable slab.
 
 The reference's distributed operation is two hosts over DDS
 (`/root/reference/README.md:78-86`); this is the XLA-collective
