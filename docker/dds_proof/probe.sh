@@ -18,7 +18,8 @@ done
 echo "== topic list =="
 ros2 topic list
 
-for t in /map /map_updates /scan /odom /pose /tf /frontiers_markers; do
+for t in /map /map_updates /scan /odom /pose /tf /frontiers_markers \
+         /voxel_points; do
   ros2 topic list | grep -qx "$t" || fail "topic $t not advertised"
 done
 

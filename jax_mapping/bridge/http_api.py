@@ -75,13 +75,10 @@ class MapApiServer:
         self._lock = threading.Lock()
         self._latest_map: Optional[OccupancyGrid] = None
         self._latest_frontiers: Optional[FrontierArray] = None
-        # The 1 s PNG cache, implemented for real this time.
-        self._png: Optional[bytes] = None
-        self._png_time = -1e9
-        self._png_map_stamp = -1.0
-        self._voxel_png: Optional[bytes] = None
-        self._voxel_png_time = -1e9
-        self._voxel_png_key = -1
+        # The 1 s PNG cache, implemented for real this time — one policy
+        # for every PNG route (see _cached_png).
+        self._png_cache: Dict[str, tuple] = {}
+        self.png_cache_hits: Dict[str, int] = {}
         self.n_requests = 0
         self.n_png_cache_hits = 0
 
@@ -214,22 +211,13 @@ class MapApiServer:
     def _map_image(self) -> Tuple[int, str, bytes]:
         with self._lock:
             msg = self._latest_map
-            if msg is None:
-                # Reference guard (`server/.../main.py:244-245`).
-                return 404, "application/json", \
-                    json.dumps({"error": "map not yet available"}).encode()
-            now = time.monotonic()
-            if self._png is not None \
-                    and now - self._png_time < self.png_cache_s \
-                    and self._png_map_stamp == msg.header.stamp:
-                self.n_png_cache_hits += 1
-                return 200, "image/png", self._png
-        img = msg.as_image_array()
-        data = png_codec.encode_gray(img)
-        with self._lock:
-            self._png = data
-            self._png_time = time.monotonic()
-            self._png_map_stamp = msg.header.stamp
+        if msg is None:
+            # Reference guard (`server/.../main.py:244-245`).
+            return 404, "application/json", \
+                json.dumps({"error": "map not yet available"}).encode()
+        data = self._cached_png(
+            "map", msg.header.stamp,
+            lambda: png_codec.encode_gray(msg.as_image_array()))
         return 200, "image/png", data
 
     def _voxel_image(self) -> Tuple[int, str, bytes]:
@@ -242,20 +230,33 @@ class MapApiServer:
             return 404, "application/json", json.dumps(
                 {"error": "no voxel mapper attached (run the stack with "
                           "depth_cam enabled)"}).encode()
-        key = self.voxel_mapper.n_images_fused
+        data = self._cached_png(
+            "voxel", self.voxel_mapper.n_images_fused,
+            lambda: png_codec.encode_gray(
+                self.voxel_mapper.height_map_image()))
+        return 200, "image/png", data
+
+    def _cached_png(self, name: str, key, render: Callable[[], bytes]
+                    ) -> bytes:
+        """ONE cache policy for every PNG route (map, voxel): serve the
+        cached bytes while the content key matches within png_cache_s;
+        render outside the lock (a worst-case race costs one redundant
+        encode, never a stale serve — the key check gates every hit).
+        Hits count both per-route (`png_cache_hits` dict) and in the
+        historical total `n_png_cache_hits`."""
         now = time.monotonic()
         with self._lock:
-            if self._voxel_png is not None \
-                    and now - self._voxel_png_time < self.png_cache_s \
-                    and self._voxel_png_key == key:
+            ent = self._png_cache.get(name)
+            if ent is not None and now - ent[1] < self.png_cache_s \
+                    and ent[2] == key:
                 self.n_png_cache_hits += 1
-                return 200, "image/png", self._voxel_png
-        data = png_codec.encode_gray(self.voxel_mapper.height_map_image())
+                self.png_cache_hits[name] = \
+                    self.png_cache_hits.get(name, 0) + 1
+                return ent[0]
+        data = render()
         with self._lock:
-            self._voxel_png = data
-            self._voxel_png_time = time.monotonic()
-            self._voxel_png_key = key
-        return 200, "image/png", data
+            self._png_cache[name] = (data, time.monotonic(), key)
+        return data
 
     def _frontiers(self) -> Tuple[int, str, bytes]:
         with self._lock:

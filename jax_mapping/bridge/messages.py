@@ -121,6 +121,17 @@ class DepthImage:
 
 
 @dataclasses.dataclass
+class VoxelPoints:
+    """Occupied-voxel centres in the map frame — the 3D map's export
+    payload (`/voxel_points`; the rclpy adapter republishes it as
+    sensor_msgs/PointCloud2 for RViz)."""
+
+    header: Header = dataclasses.field(default_factory=Header)
+    points: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0, 3), np.float32))
+
+
+@dataclasses.dataclass
 class MapMetaData:
     """nav_msgs/MapMetaData: resolution + dimensions + origin pose."""
 

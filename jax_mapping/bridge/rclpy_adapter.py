@@ -18,6 +18,9 @@ the reference's exact topic surface onto real DDS:
                           /frontiers_markers (visualization_msgs/
                           MarkerArray of clustered frontier goals — the
                           bundled RViz config's Frontiers display),
+                          /voxel_points (sensor_msgs/PointCloud2 of the
+                          3D voxel map's occupied centres; inert unless
+                          the stack runs a voxel mapper),
                           /tf (tf2_ros broadcaster, main.py:202-215)
   inbound  (ROS -> Bus):  /cmd_vel (geometry_msgs/Twist — Nav2 or
                           teleop_twist_joy, report.pdf §III.A),
@@ -94,7 +97,7 @@ class RclpyAdapter:
     """
 
     OUTBOUND_DEFAULT = ("map", "map_updates", "pose", "scan", "odom",
-                        "frontiers")
+                        "frontiers", "voxel_points")
     INBOUND_DEFAULT = ("cmd_vel", "initialpose", "goal_pose")
 
     def __init__(self, bus: Bus, cfg: SlamConfig,
@@ -176,6 +179,7 @@ class RclpyAdapter:
         "frontiers": "/frontiers", "cmd_vel": "/cmd_vel",
         "initialpose": "/initialpose", "goal_pose": "/goal_pose",
         "scan": "scan", "odom": "odom",
+        "voxel_points": "/voxel_points",
     }
 
     def _wire_outbound(self, topics) -> None:
@@ -215,6 +219,13 @@ class RclpyAdapter:
                                      "/frontiers_markers",
                                      self._ros_qos(depth=1))
             self._bus_to_ros("frontiers", pub, self.frontiers_to_ros_markers)
+        if "voxel_points" in topics:
+            # The 3D voxel map as a point cloud (RViz PointCloud2
+            # display) — published only when a voxel mapper runs; the
+            # subscription is inert otherwise.
+            pub = n.create_publisher(sen.PointCloud2, "/voxel_points",
+                                     self._ros_qos(depth=1))
+            self._bus_to_ros("voxel_points", pub, self.voxel_points_to_ros)
         if "scan" in topics:
             for ns in self._robot_namespaces():
                 bus_t = ns + self.BUS_TOPICS["scan"]
@@ -324,6 +335,32 @@ class RclpyAdapter:
             ranges=np.asarray(m.ranges, np.float32),
             intensities=np.asarray(m.intensities, np.float32),
         )
+
+    def voxel_points_to_ros(self, msg):
+        """VoxelPoints -> sensor_msgs/PointCloud2 (x/y/z float32, packed
+        12-byte points) for the RViz PointCloud2 display."""
+        sen, bi = self._msgs["sen"], self._msgs["bi"]
+        pts = np.ascontiguousarray(np.asarray(msg.points, np.float32))
+        out = sen.PointCloud2()
+        out.header.stamp = _to_ros_time(bi.Time, msg.header.stamp)
+        out.header.frame_id = msg.header.frame_id or "map"
+        out.height = 1
+        out.width = int(pts.shape[0])
+        fields = []
+        for i, name in enumerate(("x", "y", "z")):
+            f = sen.PointField()
+            f.name = name
+            f.offset = 4 * i
+            f.datatype = 7                 # PointField.FLOAT32
+            f.count = 1
+            fields.append(f)
+        out.fields = fields
+        out.is_bigendian = False
+        out.point_step = 12
+        out.row_step = 12 * int(pts.shape[0])
+        out.data = pts.tobytes()
+        out.is_dense = True
+        return out
 
     def occupancy_to_ros(self, msg: OccupancyGrid):
         nav, bi = self._msgs["nav"], self._msgs["bi"]

@@ -70,9 +70,15 @@ class VoxelMapperNode(Node):
                 f"{ns}odom", functools.partial(self._odom_cb, i),
                 QoSProfile(depth=50))
 
+        self.points_pub = self.create_publisher("/voxel_points")
+        #: Point export cap: a fully-mapped production grid can hold
+        #: millions of occupied voxels; RViz chokes long before that.
+        self.max_points = 65536
+
         period = tick_period_s if tick_period_s is not None \
             else 1.0 / cfg.robot.control_rate_hz
         self.create_timer(period, self.tick)
+        self.create_timer(cfg.map_publish_period_s, self.publish_points)
 
     # -- callbacks ----------------------------------------------------------
 
@@ -136,6 +142,19 @@ class VoxelMapperNode(Node):
     def obstacle_slice(self, z_min_m: float, z_max_m: float) -> np.ndarray:
         return np.asarray(self._V.obstacle_slice(
             self.cfg.voxel, self.voxel_grid(), z_min_m, z_max_m))
+
+    def publish_points(self) -> None:
+        """Occupied-voxel centres on `/voxel_points` (uniformly subsampled
+        past `max_points`), the 3D analog of the mapper's /map publish."""
+        from jax_mapping.bridge.messages import Header, VoxelPoints
+        pts = self._V.occupied_voxel_centers(self.cfg.voxel,
+                                             self.voxel_grid())
+        if len(pts) > self.max_points:
+            idx = np.linspace(0, len(pts) - 1, self.max_points) \
+                .round().astype(int)
+            pts = pts[idx]
+        self.points_pub.publish(VoxelPoints(header=Header.now("map"),
+                                            points=pts))
 
     def height_map_image(self) -> np.ndarray:
         """(Y, X) uint8 grayscale: 0 = no occupied voxel in the column,

@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--live-hardware", action="store_true",
                    help="inbound /scan + /odom from real drivers feed the "
                         "mapper; the simulator is not started")
+    p.add_argument("--depth-cam", action="store_true",
+                   help="run the 3D voxel pipeline too: simulated depth "
+                        "cameras feed a shared voxel grid, exported on "
+                        "/voxel_points (RViz PointCloud2) and HTTP "
+                        "/voxel-image")
     p.add_argument("--joy-device", type=str, default=None, metavar="DEV",
                    help="read a joystick at this evdev node (e.g. "
                         "/dev/input/event3) and publish /cmd_vel teleop "
@@ -115,7 +120,7 @@ def main(argv=None) -> int:
                                   seed=args.seed)
         stack = launch_sim_stack(cfg, world, n_robots=n_robots,
                                  http_port=args.http_port, realtime=True,
-                                 seed=args.seed)
+                                 seed=args.seed, depth_cam=args.depth_cam)
         inbound = ("cmd_vel", "initialpose", "goal_pose")
         outbound = RclpyAdapter.OUTBOUND_DEFAULT
 
