@@ -180,14 +180,27 @@ class MapApiServer:
         name = os.path.basename(q.get("name", ["slam_state"])[0]) or \
             "slam_state"
         fp = os.path.join(self.checkpoint_dir, name + ".npz")
+        if name.endswith(".voxel"):
+            # Reserved: checkpoint "x"'s 3D sidecar lives at "x.voxel.npz";
+            # a checkpoint NAMED "x.voxel" would collide with it.
+            return 400, "application/json", json.dumps(
+                {"error": "checkpoint names ending in '.voxel' are "
+                          "reserved for 3D sidecars"}).encode()
         if route == "/save":
             os.makedirs(self.checkpoint_dir, exist_ok=True)
             states = self.mapper.snapshot_states()
             save_checkpoint(fp, states,
                             config_json=self.mapper.cfg.to_json())
-            return 200, "application/json", json.dumps(
-                {"status": "saved", "path": fp,
-                 "robots": len(states)}).encode()
+            body = {"status": "saved", "path": fp, "robots": len(states)}
+            if self.voxel_mapper is not None:
+                from jax_mapping.io.checkpoint import save_voxel_sidecar
+                try:
+                    body["voxel_path"] = save_voxel_sidecar(
+                        fp, self.voxel_mapper.snapshot_grid(),
+                        config_json=self.mapper.cfg.to_json())
+                except ValueError as e:
+                    body["voxel_error"] = str(e)
+            return 200, "application/json", json.dumps(body).encode()
         if not os.path.exists(fp):
             return 404, "application/json", json.dumps(
                 {"error": f"no checkpoint {fp}"}).encode()
@@ -201,12 +214,28 @@ class MapApiServer:
             return 409, "application/json", json.dumps(
                 {"error": "checkpoint config differs from the running "
                           "config; refusing to load"}).encode()
+        # Validate + read the 3D sidecar BEFORE any restore mutates live
+        # state: a bad sidecar must 409 with everything untouched, not
+        # leave the server half-restored.
+        vgrid = None
+        if self.voxel_mapper is not None:
+            from jax_mapping.io.checkpoint import (load_voxel_sidecar,
+                                                   voxel_sidecar_path)
+            try:
+                vgrid = load_voxel_sidecar(
+                    fp, self.voxel_mapper.snapshot_grid(),
+                    running_config_json=self.mapper.cfg.to_json())
+            except ValueError as e:
+                return 409, "application/json", json.dumps(
+                    {"error": f"voxel sidecar: {e}"}).encode()
         # No anchor poses: the /load contract is a server restart with
         # robots holding still, so checkpoint poses are still valid.
         self.mapper.restore_states(states)
-        return 200, "application/json", json.dumps(
-            {"status": "loaded", "path": fp,
-             "robots": len(states)}).encode()
+        body = {"status": "loaded", "path": fp, "robots": len(states)}
+        if vgrid is not None:
+            self.voxel_mapper.restore_grid(vgrid)
+            body["voxel_path"] = voxel_sidecar_path(fp)
+        return 200, "application/json", json.dumps(body).encode()
 
     def _map_image(self) -> Tuple[int, str, bytes]:
         with self._lock:
@@ -231,7 +260,8 @@ class MapApiServer:
                 {"error": "no voxel mapper attached (run the stack with "
                           "depth_cam enabled)"}).encode()
         data = self._cached_png(
-            "voxel", self.voxel_mapper.n_images_fused,
+            "voxel", (self.voxel_mapper.n_images_fused,
+                      self.voxel_mapper.map_revision),
             lambda: png_codec.encode_gray(
                 self.voxel_mapper.height_map_image()))
         return 200, "image/png", data

@@ -60,6 +60,9 @@ class VoxelMapperNode(Node):
         self._pairer = OdomPairer(n_robots)
         self.n_images_fused = 0
         self.n_images_dropped_unpaired = 0
+        #: Bumped on out-of-band grid replacement (restore_grid); cache
+        #: keys combine it with n_images_fused.
+        self.map_revision = 0
 
         for i in range(n_robots):
             ns = robot_ns(i, n_robots)
@@ -142,6 +145,26 @@ class VoxelMapperNode(Node):
     def obstacle_slice(self, z_min_m: float, z_max_m: float) -> np.ndarray:
         return np.asarray(self._V.obstacle_slice(
             self.cfg.voxel, self.voxel_grid(), z_min_m, z_max_m))
+
+    # -- checkpoint surface -------------------------------------------------
+
+    def snapshot_grid(self):
+        """The 3D map state for checkpoints (the grid IS the whole
+        device state; counters are telemetry)."""
+        return self.voxel_grid()
+
+    def restore_grid(self, grid) -> None:
+        g = self._jnp.asarray(grid)
+        want = (self.cfg.voxel.size_z_cells, self.cfg.voxel.size_y_cells,
+                self.cfg.voxel.size_x_cells)
+        if g.shape != want:
+            raise ValueError(
+                f"voxel checkpoint shape {g.shape} != configured {want}")
+        with self._lock:
+            self.grid = g
+            # Content changed without fusing: consumers keying caches on
+            # n_images_fused must see a new revision or serve stale data.
+            self.map_revision += 1
 
     def publish_points(self) -> None:
         """Occupied-voxel centres on `/voxel_points` (uniformly subsampled

@@ -87,7 +87,9 @@ def _write_png(path: str, occ) -> None:
 
 def _replay_main(args, cfg) -> int:
     """Map from a recorded /scan + /odom trace: no sim, no brain — the
-    reference's rosbag workflow (SURVEY.md §7 item 7), mapper only."""
+    reference's rosbag workflow (SURVEY.md §7 item 7), mapper only. Bags
+    carrying depth topics (recorded with --depth-cam) also rebuild the 3D
+    voxel map."""
     import numpy as np
 
     from jax_mapping.bridge.brain import robot_ns
@@ -125,6 +127,17 @@ def _replay_main(args, cfg) -> int:
               "matching --config (the bag stores the recording config)",
               file=sys.stderr)
         return 2
+    # Bags recorded with --depth-cam carry depth topics: rebuild the 3D
+    # voxel map alongside the 2D one.
+    voxel = None
+    if any(t.endswith("depth") for t in bag_topics):
+        from jax_mapping.bridge.voxel_mapper import VoxelMapperNode
+        voxel = VoxelMapperNode(cfg, bus, n_robots=args.robots)
+    elif args.voxel_out:
+        print("error: --voxel-out given but the bag has no depth topics "
+              "(was it recorded without --depth-cam?)", file=sys.stderr)
+        return 2
+
     pubs = {}
     n = 0
     # Interleave publishing with mapper ticks: the odometry pairing
@@ -137,8 +150,12 @@ def _replay_main(args, cfg) -> int:
         n += 1
         if n % 40 == 0:
             mapper.tick()
+            if voxel is not None:
+                voxel.tick()
     for _ in range(4):
         mapper.tick()
+    if voxel is not None:
+        voxel.tick()
 
     occ = np.asarray(G.to_occupancy(cfg.grid, mapper.merged_grid()))
     summary = {
@@ -150,9 +167,21 @@ def _replay_main(args, cfg) -> int:
         "scans_fused": int(mapper.n_scans_fused),
         "scans_dropped_unpaired": int(mapper.n_scans_dropped_unpaired),
     }
+    if voxel is not None:
+        from jax_mapping.ops import voxel as VX
+        occ3 = np.asarray(VX.to_occupancy(cfg.voxel, voxel.voxel_grid()))
+        summary["voxels_occupied"] = int((occ3 == 100).sum())
+        summary["voxels_free"] = int((occ3 == 0).sum())
+        summary["depth_images_fused"] = int(voxel.n_images_fused)
     print(json.dumps(summary, indent=2))
     if args.out:
         _write_png(args.out, occ)
+    if args.voxel_out and voxel is not None:
+        from jax_mapping.bridge.png import encode_gray
+        with open(args.voxel_out, "wb") as f:
+            f.write(encode_gray(voxel.height_map_image()))
+        print(f"voxel height map written to {args.voxel_out}",
+              file=sys.stderr)
     return 0
 
 
@@ -182,8 +211,9 @@ def main(argv=None) -> int:
     else:
         cfg = tiny_config(n_robots=args.robots)
 
-    if args.voxel_out and not args.depth_cam:
-        print("error: --voxel-out requires --depth-cam", file=sys.stderr)
+    if args.voxel_out and not args.depth_cam and not args.replay:
+        print("error: --voxel-out requires --depth-cam (or --replay of a "
+              "bag recorded with it)", file=sys.stderr)
         return 2
 
     if args.replay:
@@ -217,6 +247,8 @@ def main(argv=None) -> int:
             for i in range(args.robots):
                 ns = robot_ns(i, args.robots)
                 topics += [f"{ns}scan", f"{ns}odom"]
+                if args.depth_cam:
+                    topics.append(f"{ns}depth")
             recorder = TraceRecorder(stack.bus, topics)
 
         if args.resume:
@@ -249,6 +281,20 @@ def main(argv=None) -> int:
                                         anchor_poses=stack.brain.poses)
             print(f"resumed {len(states)} robot state(s) from "
                   f"{args.resume}", file=sys.stderr)
+            if stack.voxel_mapper is not None:
+                from jax_mapping.io.checkpoint import load_voxel_sidecar
+                try:
+                    vgrid = load_voxel_sidecar(
+                        args.resume, stack.voxel_mapper.snapshot_grid(),
+                        running_config_json=cfg.to_json())
+                except ValueError as e:
+                    print(f"error: cannot resume 3D map: {e}",
+                          file=sys.stderr)
+                    return 2
+                if vgrid is not None:
+                    stack.voxel_mapper.restore_grid(vgrid)
+                    print("resumed 3D voxel map from the checkpoint "
+                          "sidecar", file=sys.stderr)
 
         stack.brain.start_exploring()
         t0 = time.time()
@@ -308,6 +354,18 @@ def main(argv=None) -> int:
                             config_json=cfg.to_json())
             print(f"checkpoint written to {args.save_final}",
                   file=sys.stderr)
+            if stack.voxel_mapper is not None:
+                from jax_mapping.io.checkpoint import save_voxel_sidecar
+                try:
+                    vp = save_voxel_sidecar(
+                        args.save_final,
+                        stack.voxel_mapper.snapshot_grid(),
+                        config_json=cfg.to_json())
+                    print(f"3D voxel checkpoint written to {vp}",
+                          file=sys.stderr)
+                except ValueError as e:
+                    print(f"error: 3D checkpoint not written: {e}",
+                          file=sys.stderr)
 
         if args.serve and stack.api is not None:
             print(f"serving on http://127.0.0.1:{stack.api.port} — Ctrl-C "

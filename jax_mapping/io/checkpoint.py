@@ -98,6 +98,72 @@ def load_checkpoint(path: str, like: Any
     return jax.tree_util.tree_unflatten(treedef, new_leaves), meta["config"]
 
 
+def voxel_sidecar_path(path: str) -> str:
+    """Sidecar file for the 3D voxel map next to a 2D checkpoint: the
+    grid ships separately so pre-3D checkpoints stay loadable and
+    2D-only stacks never pay the 3D bytes."""
+    root, ext = os.path.splitext(path)
+    return root + ".voxel" + (ext or ".npz")
+
+
+# Sentinel leaf marking a file as a voxel sidecar: checkpoint "x"'s
+# sidecar shares its filename with a hypothetical checkpoint named
+# "x.voxel", and without the marker a save could silently clobber one
+# with the other (code-review r4).
+_VOXEL_SENTINEL = "voxel_sidecar_marker"
+
+
+def save_voxel_sidecar(path: str, grid: Any,
+                       config_json: Optional[str] = None) -> str:
+    """Write the 3D grid as `path`'s sidecar; returns the sidecar path.
+
+    Refuses to overwrite an existing file that is NOT a voxel sidecar
+    (the name-collision case above) — silent 2D-checkpoint data loss is
+    worse than an error."""
+    vp = voxel_sidecar_path(path)
+    if os.path.exists(vp) and not _is_voxel_sidecar(vp):
+        raise ValueError(
+            f"{vp} exists and is not a voxel sidecar (a checkpoint named "
+            f"with the reserved '.voxel' suffix?); refusing to overwrite")
+    save_checkpoint(vp, {"grid": grid, _VOXEL_SENTINEL: np.int8(1)},
+                    config_json=config_json)
+    return vp
+
+
+def load_voxel_sidecar(path: str, template_grid: Any,
+                       running_config_json: Optional[str] = None) -> Any:
+    """Load `path`'s 3D sidecar grid, or None when no sidecar exists.
+
+    Raises ValueError — with a message naming the problem — on a
+    non-sidecar file at the sidecar path, shape drift, or config drift
+    (semantic comparison, config.configs_equivalent). ONE validation
+    path for every consumer (demo --resume, HTTP /load)."""
+    vp = voxel_sidecar_path(path)
+    if not os.path.exists(vp):
+        return None
+    if not _is_voxel_sidecar(vp):
+        raise ValueError(
+            f"{vp} is not a voxel sidecar (name collision with a "
+            f"checkpoint named '.voxel'?); refusing to load")
+    state, cfg_json = load_checkpoint(
+        vp, {"grid": template_grid, _VOXEL_SENTINEL: np.int8(0)})
+    if cfg_json is not None and running_config_json is not None:
+        from jax_mapping.config import configs_equivalent
+        if not configs_equivalent(cfg_json, running_config_json):
+            raise ValueError(
+                "voxel sidecar config differs from the running config")
+    return state["grid"]
+
+
+def _is_voxel_sidecar(vp: str) -> bool:
+    try:
+        with np.load(vp) as z:
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        return _VOXEL_SENTINEL in meta.get("keys", [])
+    except Exception:
+        return False
+
+
 def checkpoint_bytes(state: Any, config_json: Optional[str] = None) -> bytes:
     """In-memory variant (for shipping state over a wire/HTTP)."""
     buf = io.BytesIO()

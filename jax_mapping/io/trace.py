@@ -35,6 +35,7 @@ _TYPES = {
     "OccupancyGrid": M.OccupancyGrid,
     "TransformStamped": M.TransformStamped,
     "FrontierArray": M.FrontierArray,
+    "DepthImage": M.DepthImage,
 }
 
 

@@ -91,3 +91,160 @@ def test_voxel_mapper_rejects_shape_drift(tiny_cfg):
     vm.tick()
     assert vm.n_images_fused == 0
     assert M.counters.get("voxel_mapper.images_bad_shape") == before + 1
+
+
+def test_demo_record_replay_with_depth(tiny_cfg, tmp_path, capsys):
+    """The rosbag workflow covers the 3D pipeline: a bag recorded with
+    --depth-cam replays into both maps, and --voxel-out works off the
+    bag alone."""
+    import json
+
+    from jax_mapping import demo
+
+    bag = str(tmp_path / "depth.bag.npz")
+    rc = demo.main(["--steps", "16", "--robots", "1", "--world", "arena",
+                    "--world-cells", "96", "--depth-cam",
+                    "--record", bag])
+    assert rc == 0
+    capsys.readouterr()
+
+    png = str(tmp_path / "replayed_hm.png")
+    rc = demo.main(["--robots", "1", "--replay", bag, "--voxel-out", png])
+    assert rc == 0
+    raw = capsys.readouterr().out
+    out = json.loads(raw[raw.index("{\n"):])
+    assert out["depth_images_fused"] > 0
+    assert out["voxels_free"] > 0
+    import os
+    assert os.path.exists(png)
+
+
+def test_demo_voxel_checkpoint_sidecar(tiny_cfg, tmp_path, capsys):
+    """--save-final writes the 3D sidecar; --resume restores it."""
+    import json
+
+    from jax_mapping import demo
+    from jax_mapping.io.checkpoint import voxel_sidecar_path
+
+    ckpt = str(tmp_path / "run.npz")
+    rc = demo.main(["--steps", "16", "--robots", "1", "--world", "arena",
+                    "--world-cells", "96", "--depth-cam",
+                    "--save-final", ckpt])
+    assert rc == 0
+    raw = capsys.readouterr().out
+    first = json.loads(raw[raw.index("{\n"):])
+    assert first["voxels_free"] > 0
+    import os
+    assert os.path.exists(voxel_sidecar_path(ckpt))
+
+    rc = demo.main(["--steps", "2", "--robots", "1", "--world", "arena",
+                    "--world-cells", "96", "--depth-cam",
+                    "--resume", ckpt])
+    assert rc == 0
+    raw = capsys.readouterr().out
+    second = json.loads(raw[raw.index("{\n"):])
+    # The resumed 3D map keeps (and extends) the first run's evidence.
+    assert second["voxels_free"] >= first["voxels_free"] * 0.9
+
+
+def test_http_save_load_voxel_sidecar(tiny_cfg, tmp_path):
+    import json as _json
+    import urllib.request
+
+    import jax.numpy as jnp
+
+    world = W.plank_course(96, tiny_cfg.grid.resolution_m, n_planks=4,
+                           seed=3)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=1, http_port=0,
+                          seed=3, depth_cam=True)
+    try:
+        st.api.checkpoint_dir = str(tmp_path)
+        st.brain.start_exploring()
+        st.run_steps(25)
+        url = f"http://127.0.0.1:{st.api.port}"
+        body = _json.loads(urllib.request.urlopen(
+            urllib.request.Request(url + "/save", method="POST")).read())
+        assert "voxel_path" in body
+        g_before = np.asarray(st.voxel_mapper.voxel_grid()).copy()
+        assert np.abs(g_before).sum() > 0
+
+        st.voxel_mapper.restore_grid(
+            jnp.zeros_like(st.voxel_mapper.voxel_grid()))
+        body = _json.loads(urllib.request.urlopen(
+            urllib.request.Request(url + "/load", method="POST")).read())
+        assert "voxel_path" in body
+        np.testing.assert_array_equal(
+            np.asarray(st.voxel_mapper.voxel_grid()), g_before)
+    finally:
+        st.shutdown()
+
+
+def test_sidecar_guards(tiny_cfg, tmp_path):
+    """The name-collision and drift guards: a sidecar never clobbers or
+    masquerades as a 2D checkpoint, and config drift refuses loudly."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from jax_mapping.io.checkpoint import (
+        load_voxel_sidecar, save_checkpoint, save_voxel_sidecar,
+        voxel_sidecar_path,
+    )
+
+    grid = jnp.zeros((4, 8, 8), jnp.float32)
+    ck = str(tmp_path / "x.npz")
+
+    # A (user's) checkpoint occupying the sidecar filename: save refuses.
+    save_checkpoint(voxel_sidecar_path(ck), {"other": np.ones(3)})
+    with _pytest.raises(ValueError, match="not a voxel sidecar"):
+        save_voxel_sidecar(ck, grid)
+    with _pytest.raises(ValueError, match="not a voxel sidecar"):
+        load_voxel_sidecar(ck, grid)
+
+    # Clean path: roundtrip + drift refusal.
+    ck2 = str(tmp_path / "y.npz")
+    save_voxel_sidecar(ck2, grid, config_json=tiny_cfg.to_json())
+    out = load_voxel_sidecar(ck2, grid,
+                             running_config_json=tiny_cfg.to_json())
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(grid))
+    other = dataclasses.replace(
+        tiny_cfg, voxel=dataclasses.replace(tiny_cfg.voxel,
+                                            logodds_occ=0.123))
+    with _pytest.raises(ValueError, match="config differs"):
+        load_voxel_sidecar(ck2, grid,
+                           running_config_json=other.to_json())
+    # No sidecar at all: None, not an error.
+    assert load_voxel_sidecar(str(tmp_path / "none.npz"), grid) is None
+
+
+def test_http_rejects_reserved_voxel_name(tiny_cfg, tmp_path):
+    import urllib.error
+    import urllib.request
+
+    world = W.empty_arena(96, tiny_cfg.grid.resolution_m)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=1, http_port=0)
+    try:
+        st.api.checkpoint_dir = str(tmp_path)
+        url = f"http://127.0.0.1:{st.api.port}/save?name=x.voxel"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                urllib.request.Request(url, method="POST"))
+        assert ei.value.code == 400
+    finally:
+        st.shutdown()
+
+
+def test_replay_voxel_out_without_depth_bag_errors(tiny_cfg, tmp_path,
+                                                   capsys):
+    from jax_mapping import demo
+
+    bag = str(tmp_path / "no_depth.bag.npz")
+    rc = demo.main(["--steps", "8", "--robots", "1", "--world", "arena",
+                    "--world-cells", "96", "--record", bag])
+    assert rc == 0
+    capsys.readouterr()
+    rc = demo.main(["--robots", "1", "--replay", bag,
+                    "--voxel-out", str(tmp_path / "hm.png")])
+    assert rc == 2
+    assert "no depth topics" in capsys.readouterr().err
