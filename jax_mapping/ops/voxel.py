@@ -32,6 +32,19 @@ forward, x right, y down), depth = z along the OPTICAL AXIS (what real
 depth sensors report), NOT euclidean ray length. A reading of exactly 0
 means "no return" and carves nothing — see DepthCamConfig's docstring for
 why this differs from the LD06 zero-as-outlier rule.
+
+Future Pallas kernel note (needs on-chip Mosaic iteration; the tunnel was
+down for all of round 4): the per-voxel `depth[vi, ui]` gather is the
+XLA-TPU hazard here, exactly like the 2D path's `ranges[beam]` was before
+its in-vreg kernel. The exploitable structure at pitch==0: camera-frame
+cxc and czc depend only on (y, x) — NOT z — so for a whole voxel COLUMN
+the pixel u is one per-(y, x) integer and v is LINEAR in z
+(v = fy*(h - wz)/czc + cy). The gather therefore factors into (1) a
+per-(y, x) column pick from the W-wide image — the same table-lookup
+class the 2D kernel solved in vregs with a 512-entry beam table (W=160
+here) — followed by (2) per-z samples at linear positions down one
+120-entry column. Both stages are small-table lookups, not general
+gathers.
 """
 
 from __future__ import annotations
