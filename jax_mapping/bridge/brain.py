@@ -41,7 +41,7 @@ from jax_mapping.bridge.node import Node
 from jax_mapping.bridge.qos import qos_sensor_data
 from jax_mapping.bridge.tf import TfTree
 from jax_mapping.config import SlamConfig, sign_extend_16bit
-from jax_mapping.models.explorer import subsumption_policy
+from jax_mapping.models.explorer import frontier_policy
 from jax_mapping.ops.odometry import rk2_step, wheel_velocities
 
 
@@ -53,12 +53,14 @@ def robot_ns(i: int, n_robots: int) -> str:
 
 @functools.partial(jax.jit, static_argnums=(0,))
 def brain_tick(cfg: SlamConfig, poses, wheel_raw, prox, ranges,
-               exploring, dt):
+               exploring, goals_xy, goal_valid, dt):
     """One fused control tick for R robots.
 
     poses (R,3) float32; wheel_raw (R,2) int32 raw unsigned16 reads;
     prox (R,>=5) int32; ranges (R,B) float32 (zeros when no scan yet);
-    exploring (R,) bool; dt () float32.
+    exploring (R,) bool; goals_xy (R,2) float32 + goal_valid (R,) bool
+    (RViz SetGoal navigation targets; without a valid goal the policy is
+    exactly the reference's reactive navigator); dt () float32.
     Returns (new_poses, odom_twists (R,2)[v,w], targets (R,2) int32,
     leds (R,3) int32, nav_state (R,) int32).
     """
@@ -66,8 +68,11 @@ def brain_tick(cfg: SlamConfig, poses, wheel_raw, prox, ranges,
     new_poses = jax.vmap(
         lambda p, w: rk2_step(cfg.robot, p, w[0], w[1], dt))(poses, wheels)
     v, w = wheel_velocities(cfg.robot, wheels[:, 0], wheels[:, 1])
-    pol = subsumption_policy(cfg.robot, cfg.scan, ranges,
-                             prox[:, :5].astype(jnp.float32), exploring)
+    # frontier_policy with goal_valid=False IS the subsumption policy
+    # (goal seek only engages in the cruise state with a valid goal).
+    pol = frontier_policy(cfg.robot, cfg.scan, poses, goals_xy, goal_valid,
+                          ranges, prox[:, :5].astype(jnp.float32),
+                          exploring)
     return (new_poses, jnp.stack([v, w], -1), pol.targets, pol.led,
             pol.state)
 
@@ -110,6 +115,13 @@ class ThymioBrain(Node):
         # Manual teleop override (bridge/teleop.py). Applies to robot 0 —
         # one pad drives one robot, the rest keep their autonomous policy.
         self.create_subscription("/cmd_vel", self._cmd_vel_cb)
+        # RViz SetGoal (via the rclpy adapter): a navigation goal for
+        # robot 0 — goal-seek with the reactive shield while exploring
+        # (the reference shipped the RViz tool but no consumer; Nav2 was
+        # future work, report.pdf VI.2). Cleared on arrival.
+        self._nav_goal: Optional[tuple] = None
+        self.goal_reached_dist_m = 0.15
+        self.create_subscription("/goal_pose", self._goal_cb)
 
         # Boot connect, offline mode on failure (pi variant semantics).
         self.link_up = connect_with_retries(
@@ -131,6 +143,13 @@ class ThymioBrain(Node):
         with self._state_lock:
             self._last_cmd_vel = msg
             self._last_cmd_vel_t = time.monotonic()
+
+    def _goal_cb(self, msg) -> None:
+        """Any pose-shaped message with .x/.y (the adapter's Pose2D)."""
+        with self._state_lock:
+            self._nav_goal = (float(msg.x), float(msg.y))
+        self._log(f"navigation goal set: ({msg.x:.2f}, {msg.y:.2f}) — "
+                  "engages while exploring")
 
     def _manual_targets(self, now: float):
         """Fresh `/cmd_vel` while not exploring -> (left, right) wheel
@@ -179,6 +198,9 @@ class ThymioBrain(Node):
                      "theta": float(p[2])} for p in self.poses],
                 "ticks": self.n_ticks,
                 "io_errors": self.n_io_errors,
+                "goal": (None if self._nav_goal is None
+                         else {"x": self._nav_goal[0],
+                               "y": self._nav_goal[1]}),
             }
 
     # -- the 10 Hz loop ------------------------------------------------------
@@ -235,10 +257,24 @@ class ThymioBrain(Node):
             with self._state_lock:
                 poses = self.poses.copy()
                 exploring = np.full(R, self.is_exploring)
+                goal = self._nav_goal
             ranges = self._ranges_matrix()
+            goals_xy = np.zeros((R, 2), np.float32)
+            goal_valid = np.zeros(R, bool)
+            if goal is not None:
+                if np.hypot(poses[0, 0] - goal[0],
+                            poses[0, 1] - goal[1]) \
+                        <= self.goal_reached_dist_m:
+                    with self._state_lock:
+                        self._nav_goal = None
+                    self._log("navigation goal reached")
+                else:
+                    goals_xy[0] = goal
+                    goal_valid[0] = True
 
             new_poses, twists, targets, leds, _ = brain_tick(
                 cfg, poses, wheel_raw, prox, ranges, exploring,
+                goals_xy, goal_valid,
                 np.float32(1.0 / cfg.robot.control_rate_hz))
             new_poses = np.asarray(new_poses)
             twists = np.asarray(twists)
