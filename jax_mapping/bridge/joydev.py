@@ -196,9 +196,11 @@ class JoyDeviceReader:
         return n
 
     def spin_thread(self) -> "JoyDeviceReader":
-        self._thread = threading.Thread(target=self.pump, daemon=True,
-                                        name="joydev")
-        self._thread.start()
+        t = threading.Thread(target=self.pump, daemon=True, name="joydev")
+        t.start()
+        # Publish only a STARTED thread: assigning before start() would
+        # make close() join an unstartable thread if start() raises.
+        self._thread = t
         return self
 
     def close(self) -> None:
@@ -250,7 +252,20 @@ def attach_joystick(bus, device_path: str, cfg=None) -> JoystickSession:
     from jax_mapping.bridge.node import Executor
 
     teleop = TeleopNode(bus, cfg)
-    executor = Executor([teleop])
-    executor.spin_thread()
-    reader = JoyDeviceReader(device_path, teleop).spin_thread()
+    # Open the device BEFORE starting the executor: a bad --joy-device
+    # path raises from JoyDeviceReader.__init__, and an already-spinning
+    # executor thread + TeleopNode subscription would leak for the
+    # process lifetime when the caller catches that error.
+    reader = JoyDeviceReader(device_path, teleop)
+    try:
+        executor = Executor([teleop])
+        executor.spin_thread()
+        try:
+            reader.spin_thread()
+        except BaseException:
+            executor.shutdown()
+            raise
+    except BaseException:
+        reader.close()
+        raise
     return JoystickSession(teleop, reader, executor)

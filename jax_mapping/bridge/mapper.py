@@ -71,9 +71,14 @@ class MapperNode(Node):
         self.states = [
             S.init_state(cfg)._replace(grid=self.shared_grid)
             for _ in range(n_robots)]
+        #: Per-robot state generation: bumped whenever a robot's state is
+        #: replaced out-of-band (/initialpose, restore). The shared-grid
+        #: identity check in _finish_step cannot see an /initialpose
+        #: reset (it keeps the same grid object), so in-flight steps also
+        #: compare this counter before installing their result.
+        self._state_gen = [0] * n_robots
         self._pairer = OdomPairer(n_robots)
         self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
-        self._last_odom_pose = [None] * n_robots    # pose used at last fuse
         self._prev_paired: List[Optional[Odometry]] = [None] * n_robots
         self.n_scans_fused = 0
         self.n_scans_dropped_unpaired = 0
@@ -123,8 +128,8 @@ class MapperNode(Node):
             # re-anchoring graph node 0 at the asserted pose. The map is
             # kept: the fresh state aliases the shared grid.
             self.states[0] = fresh._replace(grid=self.shared_grid)
+            self._state_gen[0] += 1
             self._prev_paired[0] = None
-            self._last_odom_pose[0] = None
         M.counters.inc("mapper.initialpose_resets")
 
     # -- checkpoint surface --------------------------------------------------
@@ -187,8 +192,8 @@ class MapperNode(Node):
                     self.states[i] = fresh
                 self.states[i] = self.states[i]._replace(
                     grid=self.shared_grid)
+                self._state_gen[i] += 1
                 self._prev_paired[i] = None
-                self._last_odom_pose[i] = None
 
     # -- topic callbacks -----------------------------------------------------
 
@@ -291,11 +296,16 @@ class MapperNode(Node):
         jnp = self._jnp
         W = len(items)
         ranges_w = np.stack([self._pad_ranges(s) for s, _ in items])
+        # Snapshot generation BEFORE _odom_motion touches _prev_paired: a
+        # restore landing between the two would otherwise pass the
+        # _finish_step guard with _prev_paired holding a pre-restore
+        # sample, and the next step would integrate the frame jump.
+        with self._state_lock:
+            base_grid = self.shared_grid
+            base_gen = self._state_gen[i]
         motion = [self._odom_motion(i, od) for _, od in items]
         wheels_w = np.asarray([[m[0], m[1]] for m in motion], np.float32)
         dts_w = np.asarray([m[2] for m in motion], np.float32)
-        with self._state_lock:
-            base_grid = self.shared_grid
         state = self.states[i]._replace(grid=base_grid)
         with M.stages.stage("mapper.slam_step_window"):
             state, diag = self._S.slam_step_window(
@@ -304,8 +314,10 @@ class MapperNode(Node):
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
             agreement = float(diag.window_agreement)
-        self._finish_step(i, state, items[-1][1], W, matched, closed,
-                          base_grid)
+        installed = self._finish_step(i, state, W, matched, closed,
+                                      base_grid, base_gen)
+        if not installed:
+            return
         self.n_windows_fused += 1
         M.counters.inc("mapper.windows_fused")
         # Surface the leading scans' health (they fuse with no match
@@ -318,9 +330,12 @@ class MapperNode(Node):
     def _step_single(self, i: int, scan: LaserScan, od: Odometry) -> None:
         jnp = self._jnp
         ranges = self._pad_ranges(scan)
-        wl, wr, dt = self._odom_motion(i, od)
+        # Generation snapshot before the _odom_motion side effect — see
+        # _step_window.
         with self._state_lock:
             base_grid = self.shared_grid
+            base_gen = self._state_gen[i]
+        wl, wr, dt = self._odom_motion(i, od)
         state = self.states[i]._replace(grid=base_grid)
         with M.stages.stage("mapper.slam_step"):
             state, diag = self._S.slam_step(
@@ -330,30 +345,46 @@ class MapperNode(Node):
             # so the stage measures the device step, not the enqueue.
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
-        self._finish_step(i, state, od, 1, matched, closed, base_grid)
+        self._finish_step(i, state, 1, matched, closed, base_grid,
+                          base_gen)
 
-    def _finish_step(self, i: int, state, od: Odometry, n_scans: int,
-                     matched: bool, closed: bool, base_grid) -> None:
-        self._last_odom_pose[i] = od.pose
+    def _finish_step(self, i: int, state, n_scans: int,
+                     matched: bool, closed: bool, base_grid,
+                     base_gen: int) -> bool:
+        """Install the step's results; returns False when the step was
+        dropped as stale (callers gate their own telemetry on it)."""
         with self._state_lock:
-            if self.shared_grid is base_grid:
-                # The step's output grid is the fleet's new shared map;
-                # every state keeps aliasing it (arrays are immutable, so
-                # aliasing is free).
-                self.shared_grid = state.grid
-                self.states[i] = state
-                if closed and self.n_robots > 1:
-                    # The closure's in-step repair re-fused only robot
-                    # i's ring; rebuild the shared map from EVERY robot's
-                    # ring so fleet-mates' walls survive
-                    # (models/fleet._close_loops, host-orchestrated).
-                    self.shared_grid = self._refuse_all_rings()
-            # else: another thread replaced the whole fleet state while
-            # this step was in flight (HTTP /load, /initialpose) —
-            # installing ANY of the step's results (grid, state, or a
-            # ring rebuild over the stale ring) would silently revert
-            # that mutation to win one scan's evidence. Drop the step;
-            # the next scan rebuilds from the restored state.
+            if self.shared_grid is not base_grid \
+                    or self._state_gen[i] != base_gen:
+                # Another thread replaced fleet or robot state while this
+                # step was in flight — grid identity catches /load
+                # swapping the shared grid; the generation counter is
+                # defense-in-depth for any mutator the identity check
+                # can't see (bus-delivered /initialpose is serialized
+                # against tick by the node's _cb_lock, but restore_states
+                # runs on the HTTP thread, and correctness here shouldn't
+                # hinge on grid-object-identity subtleties). Installing
+                # ANY of the step's results (grid, state, or a ring
+                # rebuild over the stale ring) would silently revert that
+                # mutation to win one scan's evidence. Drop the step —
+                # including _odom_motion's pairing side effect, so the
+                # next pair bootstraps in the live odom frame instead of
+                # integrating the stale-to-live frame jump — and keep the
+                # fused/matched/closed counters honest.
+                self._prev_paired[i] = None
+                M.counters.inc("mapper.steps_dropped_stale")
+                return False
+            # The step's output grid is the fleet's new shared map;
+            # every state keeps aliasing it (arrays are immutable, so
+            # aliasing is free).
+            self.shared_grid = state.grid
+            self.states[i] = state
+            if closed and self.n_robots > 1:
+                # The closure's in-step repair re-fused only robot
+                # i's ring; rebuild the shared map from EVERY robot's
+                # ring so fleet-mates' walls survive
+                # (models/fleet._close_loops, host-orchestrated).
+                self.shared_grid = self._refuse_all_rings()
             for j in range(self.n_robots):
                 self.states[j] = self.states[j]._replace(
                     grid=self.shared_grid)
@@ -364,6 +395,7 @@ class MapperNode(Node):
         if closed:
             self.n_loops_closed += 1
             M.counters.inc("mapper.loops_closed")
+        return True
 
     def _refuse_all_rings(self):
         """Shared-map repair across the fleet: re-fuse every robot's

@@ -43,5 +43,9 @@ class OdomPairer:
                     (best is None or od.header.stamp > best.header.stamp):
                 best = od
         if best is None and self._hist[i]:
-            best = self._hist[i][0]
+            # Bootstrap: the scan predates all odometry. hist[0] is only
+            # the first-ARRIVED sample; under the reordered delivery this
+            # module exists to tolerate, a later-arriving older sample is
+            # the better anchor — pick by stamp, not arrival order.
+            best = min(self._hist[i], key=lambda od: od.header.stamp)
         return best

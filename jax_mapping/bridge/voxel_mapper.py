@@ -123,11 +123,21 @@ class VoxelMapperNode(Node):
                            np.float32)
         with M.stages.stage("voxel_mapper.fuse"):
             with self._lock:
-                grid = self.grid
-            grid = self._V.fuse_depths(self.cfg.voxel, cam, grid,
+                base_grid = self.grid
+                base_revision = self.map_revision
+            grid = self._V.fuse_depths(self.cfg.voxel, cam, base_grid,
                                        jnp.asarray(depths),
                                        jnp.asarray(poses))
             with self._lock:
+                # Same stale-state guard as mapper._finish_step: a
+                # restore_grid (HTTP /load, demo --resume) landing while
+                # we fused would be silently overwritten by a grid fused
+                # from the pre-restore state. Drop the fused result; the
+                # images are lost, the restored map is not.
+                if self.map_revision != base_revision \
+                        or self.grid is not base_grid:
+                    M.counters.inc("voxel_mapper.fuse_dropped_stale")
+                    return
                 self.grid = grid
         self.n_images_fused += len(work)
         M.counters.inc("voxel_mapper.images_fused", len(work))

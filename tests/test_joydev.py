@@ -201,3 +201,19 @@ def test_attach_joystick_publishes_without_manual_ticks(tiny_cfg):
     finally:
         session.close()
         os.close(w)
+
+
+def test_attach_joystick_bad_device_leaks_nothing(tiny_cfg):
+    """ADVICE r4: a bad --joy-device path must raise WITHOUT leaving a
+    spinning executor thread or a live TeleopNode subscription behind."""
+    import threading
+
+    from jax_mapping.bridge.joydev import attach_joystick
+
+    bus = Bus()
+    before = threading.active_count()
+    with pytest.raises(OSError):
+        attach_joystick(bus, "/nonexistent/input/event99")
+    time.sleep(0.1)
+    assert threading.active_count() == before, \
+        "executor thread leaked after device-open failure"

@@ -248,3 +248,52 @@ def test_replay_voxel_out_without_depth_bag_errors(tiny_cfg, tmp_path,
                     "--voxel-out", str(tmp_path / "hm.png")])
     assert rc == 2
     assert "no depth topics" in capsys.readouterr().err
+
+
+def test_voxel_restore_survives_inflight_fuse(tiny_cfg):
+    """ADVICE r4 (medium): a restore_grid landing while tick() fuses
+    outside the lock must not be overwritten by a grid fused from the
+    pre-restore state. The post-fuse revision check drops the fused
+    result instead."""
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.messages import DepthImage, Header, Odometry, \
+        Pose2D
+    from jax_mapping.bridge.voxel_mapper import VoxelMapperNode
+    from jax_mapping.utils import global_metrics as M
+
+    bus = Bus()
+    vm = VoxelMapperNode(tiny_cfg, bus, n_robots=1)
+    cam = tiny_cfg.depthcam
+    od = bus.publisher("odom")
+    dp = bus.publisher("depth")
+    od.publish(Odometry(header=Header(stamp=1.0), pose=Pose2D(0, 0, 0)))
+    dp.publish(DepthImage(header=Header(stamp=1.1),
+                          depth=np.full((cam.height_px, cam.width_px),
+                                        1.0, np.float32)))
+
+    restored = np.full((tiny_cfg.voxel.size_z_cells,
+                        tiny_cfg.voxel.size_y_cells,
+                        tiny_cfg.voxel.size_x_cells), 0.625, np.float32)
+    real_V = vm._V
+
+    class RacingV:
+        """voxel-ops proxy landing an HTTP /load mid-fuse."""
+
+        def __getattr__(self, name):
+            return getattr(real_V, name)
+
+        def fuse_depths(self, *args):
+            out = real_V.fuse_depths(*args)
+            vm.restore_grid(restored)
+            return out
+
+    vm._V = RacingV()
+    before = M.counters.get("voxel_mapper.fuse_dropped_stale")
+    try:
+        vm.tick()
+    finally:
+        vm._V = real_V
+    assert M.counters.get("voxel_mapper.fuse_dropped_stale") == before + 1
+    np.testing.assert_array_equal(
+        np.asarray(vm.voxel_grid()), restored,
+        err_msg="fuse from pre-restore state overwrote the restored map")
