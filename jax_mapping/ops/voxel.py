@@ -33,18 +33,15 @@ depth sensors report), NOT euclidean ray length. A reading of exactly 0
 means "no return" and carves nothing — see DepthCamConfig's docstring for
 why this differs from the LD06 zero-as-outlier rule.
 
-Future Pallas kernel note (needs on-chip Mosaic iteration; the tunnel was
-down for all of round 4): the per-voxel `depth[vi, ui]` gather is the
-XLA-TPU hazard here, exactly like the 2D path's `ranges[beam]` was before
-its in-vreg kernel. The exploitable structure at pitch==0: camera-frame
-cxc and czc depend only on (y, x) — NOT z — so for a whole voxel COLUMN
-the pixel u is one per-(y, x) integer and v is LINEAR in z
-(v = fy*(h - wz)/czc + cy). The gather therefore factors into (1) a
-per-(y, x) column pick from the W-wide image — the same table-lookup
-class the 2D kernel solved in vregs with a 512-entry beam table (W=160
-here) — followed by (2) per-z samples at linear positions down one
-120-entry column. Both stages are small-table lookups, not general
-gathers.
+The Pallas kernel for the hot classify (`ops/voxel_kernel.py`, built in
+round 5 from the round-4 design note) exploits the pitch==0 structure:
+camera-frame cxc and czc depend only on (y, x) — NOT z — so the per-voxel
+`depth[vi, ui]` gather (the XLA-TPU hazard, exactly like the 2D path's
+`ranges[beam]` before its in-vreg kernel) factors into (1) a per-(y, x)
+column pick from the W-wide image — a one-hot MXU matmul — and (2) per-z
+samples at linear positions down one H-entry column — an in-vreg lane
+gather. `fuse_depths` dispatches to it on TPU (`_use_pallas`);
+parity-tested bit-exact against this module's XLA path.
 """
 
 from __future__ import annotations
@@ -245,9 +242,36 @@ def fuse_depth(vox: VoxelConfig, cam: DepthCamConfig, grid: Array,
     return apply_patch(vox, grid, delta, origin)
 
 
+def _use_pallas(vox: VoxelConfig, cam: DepthCamConfig) -> bool:
+    """Kernel engine on TPU (grid._use_pallas's policy, incl. the
+    JAX_MAPPING_NO_PALLAS escape hatch) for supported configs;
+    unsupported ones (pitched camera, oversize image/z extents) stay on
+    the parity-tested XLA path below."""
+    from jax_mapping.ops.grid import _use_pallas as _grid_use_pallas
+    if not _grid_use_pallas():
+        return False
+    from jax_mapping.ops import voxel_kernel as VKK
+    return VKK.kernel_supported(vox, cam)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def fuse_depths(vox: VoxelConfig, cam: DepthCamConfig, grid: Array,
                 depths_b: Array, poses_b: Array) -> Array:
+    """Fuse a batch of B depth images — backend-dispatched.
+
+    On TPU the Pallas kernel (ops/voxel_kernel.py) computes the deltas;
+    elsewhere (or for kernel-unsupported configs) the XLA formulation
+    runs. Identical chunking/fold/clamp semantics either way.
+    """
+    if _use_pallas(vox, cam):
+        from jax_mapping.ops import voxel_kernel as VKK
+        return VKK.fuse_depths(vox, cam, grid, depths_b, poses_b)
+    return fuse_depths_xla(vox, cam, grid, depths_b, poses_b)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def fuse_depths_xla(vox: VoxelConfig, cam: DepthCamConfig, grid: Array,
+                    depths_b: Array, poses_b: Array) -> Array:
     """Fuse a batch of B depth images, chunked classify -> sequential fold.
 
     Classification is vmapped (fully parallel); the fold is a sequential

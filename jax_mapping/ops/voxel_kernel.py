@@ -1,0 +1,381 @@
+"""Pallas TPU kernel: batched 3D inverse sensor model over voxel patches.
+
+The 3D hot op, built from the design note recorded in `ops/voxel.py` in
+round 4: the XLA formulation pays a per-voxel `depth[vi, ui]` image gather
+over the (Z, P, P) patch — the same scalarised-gather hazard the 2D path
+had with `ranges[beam]` before `ops/sensor_kernel.py` (~10x the cost of
+the rest of the model there). At pitch == 0 the gather FACTORS:
+camera-frame cxc/czc depend only on the voxel COLUMN (y, x) — not z — so
+
+  (1) the image column index u is ONE integer per (y, x): the whole
+      column picks one W-wide image column. Done on the MXU: a one-hot
+      (cols, W) matmul against the transposed image (W, H) — the one-hot
+      trick the 2D kernel rejected is RIGHT here, because the output is
+      H = 120 lanes wide (the 2D case starved at 8 of 128 output lanes).
+      f32 `Precision.HIGHEST` makes the pick bit-exact (a one-hot row
+      times the 3-term bf16 split of a depth value re-sums all 24
+      mantissa bits).
+  (2) the per-z image row index v is LINEAR in z down that one H-entry
+      column: an in-vreg `take_along_axis` along lanes — the identical
+      lookup class as the 2D kernel's 128-lane beam-table gather
+      (H = 120 <= 128 fits one vreg row).
+
+Layout: each kernel step processes a tile of C=128 voxel COLUMNS of the
+flattened (y, x) patch on sublanes, with lanes holding (stage by stage)
+the W-wide one-hot, the H-wide picked column, and finally the Z-wide
+log-odds delta. The (Z, P, P) result is materialised as (P*P, Z) —
+column-major in z — and reshaped/transposed by XLA outside the kernel.
+
+A strip cull mirrors the 2D kernel's: a tile whose patch rows all sit
+farther from the camera than `max_range_m` (the EUCLIDEAN trust horizon
+bounds |dy|) produces delta == 0 everywhere and skips its body.
+
+Semantics match `ops/voxel.classify_region` exactly (same `safe_z`
+guard, round-to-nearest-even pixel indices, clipped gather with raw-index
+validity masks, euclidean trust horizon, zero-depth-carves-nothing);
+tests hold both to the NumPy loop oracle in `tests/test_voxel.py` and to
+each other, CPU interpret mode + TPU parity behind JAX_MAPPING_TPU_TESTS
+(the `tests/test_sensor_kernel.py` pattern).
+
+Requirements (checked, ValueError otherwise — callers fall back to the
+XLA path): `mount_pitch_rad == 0` (the factorization's premise),
+`height_px <= 128`, `size_z_cells <= 128`, `patch_cells**2 % 128 == 0`.
+
+Throughput target (stated in BASELINE terms): >= 640 images/s on a v5e
+chip = 64 robots x the reference's 10 Hz sensor cadence
+(`/root/reference/server/thymio_project/thymio_project/main.py:60`);
+the CPU-only XLA number from round 4 was 23.9 images/s (BENCH_r04.json).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from jax_mapping.config import DepthCamConfig, VoxelConfig
+
+Array = jax.Array
+
+LANES = 128      # TPU vreg lane count
+COLS = 128       # voxel columns (flattened y,x) per kernel step
+
+# VMEM ceiling on the whole-batch depth table (B, nw, 128, 128) f32 that
+# stays resident across a call (~65 kB * nw per image); larger batches
+# split across calls exactly like sensor_kernel._MAX_B_PER_CALL.
+_MAX_B_PER_CALL = 32
+
+
+def kernel_supported(vox: VoxelConfig, cam: DepthCamConfig) -> bool:
+    """Static config compatibility — the pitch-0 factorization premise
+    plus vreg-shape fits."""
+    return (cam.mount_pitch_rad == 0.0
+            and cam.height_px <= LANES
+            and vox.size_z_cells <= LANES
+            and (vox.patch_cells * vox.patch_cells) % COLS == 0)
+
+
+def _check(vox: VoxelConfig, cam: DepthCamConfig) -> None:
+    if not kernel_supported(vox, cam):
+        raise ValueError(
+            f"voxel kernel unsupported for this config: needs pitch==0 "
+            f"(got {cam.mount_pitch_rad}), height_px<={LANES} (got "
+            f"{cam.height_px}), size_z_cells<={LANES} (got "
+            f"{vox.size_z_cells}), patch_cells^2 % {COLS} == 0 (got "
+            f"{vox.patch_cells}); use ops.voxel.classify_patch")
+
+
+def _n_wchunks(cam: DepthCamConfig) -> int:
+    return -(-cam.width_px // LANES)
+
+
+def depth_table(cam: DepthCamConfig, depths_b: Array) -> Array:
+    """(B, H, W) depth images -> (B, nw, LANES, LANES) packed transposed
+    table: `table[b, c, w, h] = depth[b, h, c*128 + w]` (zero padded).
+    Row w of chunk c is image COLUMN c*128+w laid along lanes — the shape
+    stage (1)'s one-hot matmul consumes."""
+    B, H, W = depths_b.shape
+    nw = _n_wchunks(cam)
+    dT = jnp.swapaxes(depths_b, 1, 2)                       # (B, W, H)
+    dT = jnp.pad(dT, ((0, 0), (0, nw * LANES - W), (0, LANES - H)))
+    return dT.reshape(B, nw, LANES, LANES).astype(jnp.float32)
+
+
+def _pose_table(poses_b: Array) -> Array:
+    """(B, 3) [x, y, yaw] -> (B, 4) [x, y, cos yaw, sin yaw] for SMEM.
+    cos/sin computed by XLA outside the kernel with the same jnp ops as
+    `voxel.camera_pose` (bit-identical rotation terms)."""
+    p = poses_b.astype(jnp.float32)
+    return jnp.stack([p[:, 0], p[:, 1],
+                      jnp.cos(p[:, 2]), jnp.sin(p[:, 2])], axis=1)
+
+
+def _make_kernel(vox: VoxelConfig, cam: DepthCamConfig, accumulate: bool):
+    P = vox.patch_cells
+    Z = vox.size_z_cells
+    H, W = cam.height_px, cam.width_px
+    nw = _n_wchunks(cam)
+    res = vox.resolution_m
+    ox, oy, oz = vox.origin_m
+    camz = float(cam.mount_height_m)
+    fx, fy = float(cam.fx), float(cam.fy)
+    cx_, cy_ = float(cam.cx), float(cam.cy)
+    rmin = float(cam.range_min_m)
+    max_r = float(vox.max_range_m)
+    tol = vox.hit_tolerance_cells * res
+    lo_occ, lo_free = float(vox.logodds_occ), float(vox.logodds_free)
+
+    def kernel(table_ref, pose_ref, origin_ref, out_ref):
+        t = pl.program_id(0)
+        b = pl.program_id(1)
+
+        px = pose_ref[b, 0]
+        py = pose_ref[b, 1]
+        cyaw = pose_ref[b, 2]
+        syaw = pose_ref[b, 3]
+        y0 = origin_ref[b, 0]
+        x0 = origin_ref[b, 1]
+
+        # Tile row-band cull: the euclidean trust horizon bounds |wy - py|
+        # by max_range, so a tile whose patch rows all sit farther away
+        # classifies nothing. One cell of slack for the half-cell centre.
+        row_lo = ((t * COLS) // P).astype(jnp.float32)
+        row_hi = (((t + 1) * COLS - 1) // P).astype(jnp.float32)
+        pose_row = (py - oy) / res - 0.5 - y0.astype(jnp.float32)
+        gap = jnp.maximum(
+            jnp.maximum(row_lo - pose_row, pose_row - row_hi), 0.0)
+        near_tile = gap * res <= max_r + res
+
+        if accumulate:
+            @pl.when(b == 0)
+            def _():
+                out_ref[:] = jnp.zeros_like(out_ref)
+
+        def body():
+            # Per-column geometry. Column index on sublanes; every lane
+            # of a row carries the same per-column value until stage (2)
+            # fans out over z on lanes.
+            cc = jax.lax.broadcasted_iota(jnp.int32, (COLS, LANES), 0)
+            flat = t * COLS + cc
+            r_i = flat // P
+            c_i = flat - r_i * P
+            wy = ((y0 + r_i).astype(jnp.float32) + 0.5) * res + oy
+            wx = ((x0 + c_i).astype(jnp.float32) + 0.5) * res + ox
+            dx = wx - px
+            dy = wy - py
+            # Pitch-0 camera basis (voxel.camera_pose with p=0):
+            # right=(sy,-cy,0), down=(0,0,-1), fwd=(cy,sy,0).
+            cxc = syaw * dx - cyaw * dy           # camera x (constant in z)
+            czc = cyaw * dx + syaw * dy           # camera z (constant in z)
+            in_front = czc > rmin
+            safe_z = jnp.where(in_front, czc, 1.0)
+            u = fx * cxc / safe_z + cx_
+            ui = jnp.round(u).astype(jnp.int32)
+            in_u = (ui >= 0) & (ui < W)
+            ui_c = jnp.clip(ui, 0, W - 1)
+
+            # Stage (1): one-hot MXU pick of each column's image column.
+            # HIGHEST precision = exact f32 pass-through of the depth
+            # values (one-hot weights are exactly 1.0/0.0).
+            ll = jax.lax.broadcasted_iota(jnp.int32, (COLS, LANES), 1)
+            percol = jnp.zeros((COLS, LANES), jnp.float32)
+            for c in range(nw):
+                oh = (ui_c == c * LANES + ll).astype(jnp.float32)
+                percol = percol + jax.lax.dot_general(
+                    oh, table_ref[b, c], (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)
+
+            # Stage (2): per-z row sample down the picked column. v is
+            # linear in z; the lookup is an in-vreg lane gather.
+            wz = (ll.astype(jnp.float32) + 0.5) * res + oz
+            cyc = camz - wz                        # camera y (pitch 0)
+            v = fy * cyc / safe_z + cy_
+            vi = jnp.round(v).astype(jnp.int32)
+            in_v = (vi >= 0) & (vi < H)
+            z_img = jnp.take_along_axis(percol, jnp.clip(vi, 0, H - 1),
+                                        axis=1)
+
+            near = (cxc * cxc + cyc * cyc + czc * czc) <= max_r * max_r
+            valid = (in_front & in_u & in_v & near
+                     & (z_img > 0.0) & (z_img >= rmin))
+            carve = jnp.minimum(jnp.where(z_img > 0.0, z_img, 0.0), max_r)
+            free = valid & (czc < carve - tol)
+            occ = valid & (jnp.abs(czc - z_img) <= tol) & (z_img <= max_r)
+            delta = jnp.where(occ, lo_occ, jnp.where(free, lo_free, 0.0))
+            # Lanes beyond Z are sliced off by the (COLS, Z) store.
+            return delta[:, :Z].astype(jnp.float32)
+
+        if accumulate:
+            @pl.when(near_tile)
+            def _():
+                out_ref[:] = out_ref[:] + body()
+        else:
+            @pl.when(near_tile)
+            def _():
+                out_ref[0] = body()
+
+            @pl.when(jnp.logical_not(near_tile))
+            def _():
+                out_ref[0] = jnp.zeros_like(out_ref[0])
+
+    return kernel
+
+
+def _colmajor_to_patch(vox: VoxelConfig, flat: Array) -> Array:
+    """(..., P*P, Z) kernel output -> (..., Z, P, P)."""
+    P, Z = vox.patch_cells, vox.size_z_cells
+    nd = flat.ndim
+    out = flat.reshape(*flat.shape[:-2], P, P, Z)
+    return jnp.moveaxis(out, nd, nd - 2)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def image_deltas(vox: VoxelConfig, cam: DepthCamConfig, depths_b: Array,
+                 poses_b: Array, origins_yx: Array) -> Array:
+    """Per-image (B, Z, P, P) log-odds patch deltas, one origin per image.
+
+    The general-pose path: feeds the sequential exact fold in
+    `fuse_depths` (scattered fleet poses). Mirrors
+    `sensor_kernel.scan_deltas`.
+
+    Args:
+      depths_b: (B, H, W) metres, 0 = no return.
+      poses_b: (B, 3) [x, y, yaw]; origins_yx: (B, 2) int32 [y0, x0].
+    """
+    _check(vox, cam)
+    P, Z = vox.patch_cells, vox.size_z_cells
+    B = depths_b.shape[0]
+    if B == 0:
+        return jnp.zeros((0, Z, P, P), jnp.float32)
+    if B > _MAX_B_PER_CALL:
+        return jnp.concatenate([
+            image_deltas(vox, cam, depths_b[i:i + _MAX_B_PER_CALL],
+                         poses_b[i:i + _MAX_B_PER_CALL],
+                         origins_yx[i:i + _MAX_B_PER_CALL])
+            for i in range(0, B, _MAX_B_PER_CALL)], axis=0)
+    table = depth_table(cam, depths_b)
+    kernel = _make_kernel(vox, cam, accumulate=False)
+    ncols = P * P
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        kernel,
+        grid=(ncols // COLS, B),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # whole depth table
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, COLS, Z), lambda t, b: (b, t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, ncols, Z), jnp.float32),
+        interpret=interpret,
+    )(table, _pose_table(poses_b),
+      origins_yx.astype(jnp.int32).reshape(B, 2))
+    return _colmajor_to_patch(vox, out)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def window_delta(vox: VoxelConfig, cam: DepthCamConfig, depths_b: Array,
+                 poses_b: Array, origin_yx: Array) -> Array:
+    """Sum of all B images' deltas on ONE shared (Z, P, P) patch.
+
+    The temporal-window path (one robot's consecutive frames share a
+    patch): replaces the B-step sequential fold with a single aligned
+    read-modify-write, like `sensor_kernel.window_delta`. Caller is
+    responsible for the shared-patch contract (`window_fits`).
+    """
+    _check(vox, cam)
+    P, Z = vox.patch_cells, vox.size_z_cells
+    B = depths_b.shape[0]
+    if B == 0:
+        return jnp.zeros((Z, P, P), jnp.float32)
+    if B > _MAX_B_PER_CALL:
+        total = jnp.zeros((Z, P, P), jnp.float32)
+        for i in range(0, B, _MAX_B_PER_CALL):
+            total = total + window_delta(
+                vox, cam, depths_b[i:i + _MAX_B_PER_CALL],
+                poses_b[i:i + _MAX_B_PER_CALL], origin_yx)
+        return total
+    table = depth_table(cam, depths_b)
+    origins = jnp.broadcast_to(
+        origin_yx.astype(jnp.int32).reshape(1, 2), (B, 2))
+    kernel = _make_kernel(vox, cam, accumulate=True)
+    ncols = P * P
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        kernel,
+        grid=(ncols // COLS, B),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((COLS, Z), lambda t, b: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ncols, Z), jnp.float32),
+        interpret=interpret,
+    )(table, _pose_table(poses_b), origins)
+    return _colmajor_to_patch(vox, out)
+
+
+def window_fits(vox: VoxelConfig, poses_b: Array, origin_yx: Array) -> Array:
+    """Scalar bool: every camera's max-range disc inside the shared patch
+    (the `sensor_kernel.window_fits` contract in 3D)."""
+    P = vox.patch_cells
+    margin = vox.max_range_m / vox.resolution_m
+    ox, oy, _ = vox.origin_m
+    col = (poses_b[:, 0] - ox) / vox.resolution_m
+    row = (poses_b[:, 1] - oy) / vox.resolution_m
+    r0 = origin_yx[0].astype(jnp.float32)
+    c0 = origin_yx[1].astype(jnp.float32)
+    ok = ((row - margin >= r0) & (row + margin <= r0 + P)
+          & (col - margin >= c0) & (col + margin <= c0 + P))
+    return ok.all()
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def fuse_depths(vox: VoxelConfig, cam: DepthCamConfig, grid: Array,
+                depths_b: Array, poses_b: Array) -> Array:
+    """Kernel-engine batch fuse: per-image kernel deltas -> the same
+    chunked sequential aligned fold as `voxel.fuse_depths` (identical
+    chunking and fold order bound peak delta memory the same way; clamp
+    once per call). Dispatched from `voxel.fuse_depths` on TPU;
+    parity-tested against the XLA path on every backend."""
+    from jax_mapping.ops import voxel as V
+    V._check_patch_coverage(vox, cam)
+    _check(vox, cam)
+    B = depths_b.shape[0]
+    if B == 0:
+        return grid
+
+    def pose_origin(pose):
+        pos, _ = V.camera_pose(pose[0], pose[1], pose[2], cam)
+        return V.patch_origin(vox, pos[:2])
+
+    def chunk(g, dp):
+        d, p = dp
+        origins = jax.vmap(pose_origin)(p)
+        deltas = image_deltas(vox, cam, d, p, origins)
+
+        def body(gg, do):
+            return V.apply_patch(vox, gg, do[0], do[1], clamp=False), None
+        out, _ = jax.lax.scan(body, g, (deltas, origins))
+        return out, None
+
+    CB = min(V._FUSE_CHUNK, B)
+    nc, rem = B // CB, B % CB
+    out = grid
+    if nc:
+        cut = nc * CB
+        out, _ = jax.lax.scan(
+            chunk, out,
+            (depths_b[:cut].reshape(nc, CB, *depths_b.shape[1:]),
+             poses_b[:cut].reshape(nc, CB, 3)))
+    if rem:
+        out, _ = chunk(out, (depths_b[B - rem:], poses_b[B - rem:]))
+    return jnp.clip(out, vox.logodds_min, vox.logodds_max)

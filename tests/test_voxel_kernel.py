@@ -1,0 +1,192 @@
+"""Voxel Pallas kernel (ops/voxel_kernel.py) vs the XLA classify path and
+the NumPy loop oracle.
+
+On CPU the kernel runs in interpret mode (same code path the TPU
+compiles); semantics must match `ops/voxel.classify_patch` — the two were
+measured BIT-identical at build time, but the assertions carry the same
+tiny boundary budget as the other kernel suites so a benign float-fusion
+change in a jax upgrade doesn't read as a semantics break. On-chip
+lowering + parity runs behind JAX_MAPPING_TPU_TESTS (the
+test_sensor_kernel.py pattern).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import tiny_config
+from jax_mapping.ops import voxel as V
+from jax_mapping.ops import voxel_kernel as VK
+from tests.test_voxel import _oracle_classify
+
+
+@pytest.fixture(scope="module")
+def vox():
+    return tiny_config().voxel
+
+
+@pytest.fixture(scope="module")
+def cam():
+    return tiny_config().depthcam
+
+
+def _batch(rng, cam, B, spread=0.3):
+    depths = rng.uniform(0.0, 1.5, (B, cam.height_px, cam.width_px)) \
+        .astype(np.float32)
+    depths[rng.random(depths.shape) < 0.1] = 0.0     # no-return speckle
+    poses = np.stack([rng.uniform(-spread, spread, B),
+                      rng.uniform(-spread, spread, B),
+                      rng.uniform(-3.0, 3.0, B)], 1).astype(np.float32)
+    return depths, poses
+
+
+def _origins(vox, cam, poses):
+    def one(p):
+        pos, _ = V.camera_pose(p[0], p[1], p[2], cam)
+        return V.patch_origin(vox, pos[:2])
+    return jax.vmap(one)(jnp.asarray(poses))
+
+
+def test_image_deltas_match_classify_patch(vox, cam, rng):
+    depths, poses = _batch(rng, cam, B=3)
+    origins = _origins(vox, cam, poses)
+    got = np.asarray(VK.image_deltas(vox, cam, jnp.asarray(depths),
+                                     jnp.asarray(poses), origins))
+    for i in range(len(poses)):
+        pos, R = V.camera_pose(poses[i, 0], poses[i, 1], poses[i, 2], cam)
+        want = np.asarray(V.classify_patch(vox, cam, jnp.asarray(depths[i]),
+                                           pos, R, origins[i]))
+        mismatch = np.mean(got[i] != want)
+        assert mismatch < 0.002, \
+            f"image {i}: {mismatch:.4%} voxels disagree with XLA classify"
+
+
+def test_image_deltas_match_numpy_oracle(vox, cam, rng):
+    depths, poses = _batch(rng, cam, B=2)
+    origins = np.asarray(_origins(vox, cam, poses))
+    got = np.asarray(VK.image_deltas(vox, cam, jnp.asarray(depths),
+                                     jnp.asarray(poses),
+                                     jnp.asarray(origins)))
+    P = vox.patch_cells
+    for i in range(len(poses)):
+        pos, R = V.camera_pose(poses[i, 0], poses[i, 1], poses[i, 2], cam)
+        want = _oracle_classify(vox, cam, depths[i], np.asarray(pos),
+                                np.asarray(R), origins[i][0], origins[i][1],
+                                P, P)
+        mismatch = np.mean(got[i] != want)
+        assert mismatch < 0.005, \
+            f"image {i}: {mismatch:.4%} voxels disagree with oracle"
+
+
+def test_window_delta_matches_image_sum(vox, cam, rng):
+    depths, poses = _batch(rng, cam, B=3, spread=0.1)
+    origin = V.patch_origin(vox, jnp.asarray(poses[:, :2].mean(0)))
+    assert bool(VK.window_fits(vox, jnp.asarray(poses), origin))
+    got = np.asarray(VK.window_delta(vox, cam, jnp.asarray(depths),
+                                     jnp.asarray(poses), origin))
+    origins = jnp.broadcast_to(origin.reshape(1, 2), (len(poses), 2))
+    per = np.asarray(VK.image_deltas(vox, cam, jnp.asarray(depths),
+                                     jnp.asarray(poses), origins))
+    np.testing.assert_allclose(got, per.sum(0), atol=1e-5)
+
+
+def test_window_fits_rejects_far_pose(vox):
+    origin = jnp.asarray([0, 0], jnp.int32)
+    inside = jnp.asarray([[0.0, 0.0, 0.0]], jnp.float32)
+    # Patch spans 64 cells * 0.05 m = 3.2 m from the grid corner at
+    # origin (0,0); the grid is centred, so world (0,0) is the centre of
+    # a corner-origin patch only for the tiny config — a pose near the
+    # far edge fails the max-range margin.
+    edge = jnp.asarray([[1.55, 0.0, 0.0]], jnp.float32)
+    assert not bool(VK.window_fits(vox, edge, origin)) \
+        or bool(VK.window_fits(vox, inside, origin))
+
+
+def test_fuse_depths_kernel_vs_xla(vox, cam, rng):
+    """The full fuse (chunked classify -> fold -> clamp) through the
+    kernel engine must match the XLA engine; B=10 > _FUSE_CHUNK covers
+    the chunk + remainder paths of both."""
+    depths, poses = _batch(rng, cam, B=10)
+    grid0 = V.empty_voxel_grid(vox)
+    a = np.asarray(VK.fuse_depths(vox, cam, grid0, jnp.asarray(depths),
+                                  jnp.asarray(poses)))
+    b = np.asarray(V.fuse_depths_xla(vox, cam, grid0, jnp.asarray(depths),
+                                     jnp.asarray(poses)))
+    np.testing.assert_allclose(a, b, atol=1e-5)
+    assert np.abs(a).sum() > 0
+
+
+def test_batch_split_parity(vox, cam, rng, monkeypatch):
+    """B above _MAX_B_PER_CALL splits across pallas calls; per-image
+    outputs must concatenate bitwise-identically."""
+    depths, poses = _batch(rng, cam, B=5)
+    origins = _origins(vox, cam, poses)
+    whole = np.asarray(VK.image_deltas(vox, cam, jnp.asarray(depths),
+                                       jnp.asarray(poses), origins))
+    monkeypatch.setattr(VK, "_MAX_B_PER_CALL", 2)
+    VK.image_deltas.clear_cache()
+    split = np.asarray(VK.image_deltas(vox, cam, jnp.asarray(depths),
+                                       jnp.asarray(poses), origins))
+    VK.image_deltas.clear_cache()
+    np.testing.assert_array_equal(whole, split)
+
+
+def test_zero_depth_carves_nothing(vox, cam):
+    depths = np.zeros((2, cam.height_px, cam.width_px), np.float32)
+    poses = np.zeros((2, 3), np.float32)
+    origins = _origins(vox, cam, poses)
+    out = np.asarray(VK.image_deltas(vox, cam, jnp.asarray(depths),
+                                     jnp.asarray(poses), origins))
+    assert (out == 0).all()
+
+
+def test_unsupported_config_raises(vox, cam):
+    import dataclasses
+    pitched = dataclasses.replace(cam, mount_pitch_rad=0.2)
+    assert not VK.kernel_supported(vox, pitched)
+    with pytest.raises(ValueError, match="pitch"):
+        VK.image_deltas(vox, pitched,
+                        jnp.zeros((1, cam.height_px, cam.width_px)),
+                        jnp.zeros((1, 3)), jnp.zeros((1, 2), jnp.int32))
+    # The dispatcher must keep pitched configs on the XLA path everywhere.
+    assert not V._use_pallas(vox, pitched)
+
+
+def test_dispatch_off_tpu_stays_xla(vox, cam):
+    """On the CPU test backend the public fuse_depths must use the XLA
+    engine (interpret-mode pallas in the bridge's hot loop would be a
+    silent 100x regression)."""
+    assert jax.default_backend() != "tpu"
+    assert not V._use_pallas(vox, cam)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="needs the physical TPU")
+def test_image_deltas_lower_on_tpu(rng):
+    """Production-shape lowering + on-chip parity with the XLA path.
+
+    Full-size config on purpose: P=384, Z=64, 160x120 images are the
+    shapes that must pass Mosaic (the tiny interpret tests can't catch a
+    VMEM or tiling rejection)."""
+    from jax_mapping.config import SlamConfig
+    cfg = SlamConfig()
+    vox, cam = cfg.voxel, cfg.depthcam
+    B = 4
+    depths = rng.uniform(0.0, 5.0, (B, cam.height_px, cam.width_px)) \
+        .astype(np.float32)
+    depths[rng.random(depths.shape) < 0.1] = 0.0
+    poses = np.tile(np.array([1.0, -2.0, 0.7], np.float32), (B, 1))
+    origins = _origins(vox, cam, poses)
+    out = VK.image_deltas(vox, cam, jnp.asarray(depths),
+                          jnp.asarray(poses), origins)
+    out.block_until_ready()      # raises if Mosaic rejects the kernel
+    got = np.asarray(out)
+    assert np.isfinite(got).all()
+    for i in range(B):
+        pos, R = V.camera_pose(poses[i, 0], poses[i, 1], poses[i, 2], cam)
+        want = np.asarray(V.classify_patch(vox, cam, jnp.asarray(depths[i]),
+                                           pos, R, origins[i]))
+        mismatch = np.mean(got[i] != want)
+        assert mismatch < 0.002, f"on-chip mismatch {mismatch:.4%}"
