@@ -102,7 +102,8 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     voxel_mapper = None
     if depth_cam:
         from jax_mapping.bridge.voxel_mapper import VoxelMapperNode
-        voxel_mapper = VoxelMapperNode(cfg, bus, tf=tf, n_robots=n_robots)
+        voxel_mapper = VoxelMapperNode(cfg, bus, tf=tf, n_robots=n_robots,
+                                       mapper=mapper)
 
     api = None
     if http_port is not None:

@@ -77,6 +77,10 @@ class MapperNode(Node):
         #: reset (it keeps the same grid object), so in-flight steps also
         #: compare this counter before installing their result.
         self._state_gen = [0] * n_robots
+        #: Per-robot (estimated pose, paired odom pose) at the last
+        #: INSTALLED step — the basis of the map->odom correction the 3D
+        #: mapper consumes (depth_anchor); None until a step installs.
+        self._correction = [None] * n_robots
         self._pairer = OdomPairer(n_robots)
         self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
         self._prev_paired: List[Optional[Odometry]] = [None] * n_robots
@@ -130,6 +134,7 @@ class MapperNode(Node):
             self.states[0] = fresh._replace(grid=self.shared_grid)
             self._state_gen[0] += 1
             self._prev_paired[0] = None
+            self._correction[0] = None
         M.counters.inc("mapper.initialpose_resets")
 
     # -- checkpoint surface --------------------------------------------------
@@ -194,6 +199,7 @@ class MapperNode(Node):
                     grid=self.shared_grid)
                 self._state_gen[i] += 1
                 self._prev_paired[i] = None
+                self._correction[i] = None
 
     # -- topic callbacks -----------------------------------------------------
 
@@ -314,8 +320,8 @@ class MapperNode(Node):
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
             agreement = float(diag.window_agreement)
-        installed = self._finish_step(i, state, W, matched, closed,
-                                      base_grid, base_gen)
+        installed = self._finish_step(i, state, items[-1][1], W, matched,
+                                      closed, base_grid, base_gen)
         if not installed:
             return
         self.n_windows_fused += 1
@@ -345,10 +351,10 @@ class MapperNode(Node):
             # so the stage measures the device step, not the enqueue.
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
-        self._finish_step(i, state, 1, matched, closed, base_grid,
+        self._finish_step(i, state, od, 1, matched, closed, base_grid,
                           base_gen)
 
-    def _finish_step(self, i: int, state, n_scans: int,
+    def _finish_step(self, i: int, state, od: Odometry, n_scans: int,
                      matched: bool, closed: bool, base_grid,
                      base_gen: int) -> bool:
         """Install the step's results; returns False when the step was
@@ -388,6 +394,12 @@ class MapperNode(Node):
             for j in range(self.n_robots):
                 self.states[j] = self.states[j]._replace(
                     grid=self.shared_grid)
+            # The installed (estimate, paired odom) pair IS the live
+            # map->odom correction for robot i (depth_anchor consumers).
+            self._correction[i] = (
+                np.asarray(state.pose, np.float32),
+                np.asarray([od.pose.x, od.pose.y, od.pose.theta],
+                           np.float32))
         self.n_scans_fused += n_scans
         M.counters.inc("mapper.scans_fused", n_scans)
         if matched:
@@ -428,6 +440,43 @@ class MapperNode(Node):
             theta=float(est[2] - o.theta)))
 
     # -- exports ------------------------------------------------------------
+
+    # -- 3D-coupling surface (bridge/voxel_mapper.py) ------------------------
+
+    def depth_anchor(self, i: int):
+        """Consistent host-side snapshot the 3D mapper uses to fuse depth
+        at CORRECTED poses and to anchor depth keyframes to this robot's
+        graph: (gen, est_pose, odom_pose, node_idx, node_pose,
+        n_keyscans), or None before the first installed step / while the
+        chain is empty. All values fetched under the state lock so the
+        correction basis and the graph tip belong to the same step."""
+        # Snapshot refs under the lock, fetch device data AFTER releasing
+        # it: states are immutable pytrees, so the snapshot stays
+        # consistent, and a blocking device->host transfer inside the
+        # lock would stall the 2D hot path's _finish_step.
+        with self._state_lock:
+            corr = self._correction[i]
+            if corr is None:
+                return None
+            st = self.states[i]
+            gen = self._state_gen[i]
+        n = int(st.graph.n_poses)
+        if n == 0:
+            return None
+        return (gen, corr[0], corr[1], n - 1,
+                np.asarray(st.graph.poses[n - 1], np.float32),
+                int(st.n_keyscans))
+
+    def graph_snapshot(self, i: int):
+        """(gen, poses (cap, 3) np, pose_valid (cap,) np, n_poses,
+        n_keyscans) for keyframe re-anchoring after a loop closure."""
+        with self._state_lock:      # refs only; transfers after release
+            st = self.states[i]
+            gen = self._state_gen[i]
+        cap = self.cfg.loop.max_poses
+        return (gen, np.asarray(st.graph.poses[:cap], np.float32),
+                np.asarray(st.graph.pose_valid[:cap]),
+                int(st.graph.n_poses), int(st.n_keyscans))
 
     def merged_grid(self):
         """The fleet's shared global map (kept under the historical name:

@@ -8,9 +8,30 @@ batches on device through `ops.voxel.fuse_depths` into ONE shared voxel
 grid for the whole fleet — the same single-map memory architecture as the
 2D mapper.
 
-Pose source is odometry, not SLAM: depth fusion rides on the 2D
-pipeline's pose estimates in a full deployment (the mapper's `map->odom`
-correction applies upstream); standalone it maps in the odom frame. The
+SLAM coupling (round-5; the round-4 node fused at raw odometry and kept
+its drift ghosts forever, unlike slam_toolbox's fully-corrected single
+map, slam_config.yaml:43-48):
+
+* Every image fuses at the CORRECTED pose: the 2D mapper's live map->odom
+  correction (`mapper.depth_anchor`) applied to the image's paired
+  odometry, so the 3D map lives in the map frame, not the odom frame.
+* A bounded depth-keyframe ring (VoxelConfig.keyframe_cap) mirrors the 2D
+  scan ring: a keyframe is stored when the robot has moved past the 2D
+  key-scan gate (matcher.min_travel_m / min_heading_rad), anchored to the
+  robot's current GRAPH node as a relative pose — so optimizing the graph
+  moves the keyframe with its node, exactly slam_toolbox's scan-holding
+  semantics.
+* After a loop closure the voxel grid is RE-FUSED from the keyframe ring
+  at the optimized node poses (`_refuse_from_keyframes`) — the 3D analog
+  of the 2D mapper's ring re-fusion — so 3D walls de-ghost when the 2D
+  map does. Non-keyframe images fused since the last closure contribute
+  only until the next re-fuse, the same lifetime non-key scans have in
+  2D. Graph thinning (ops/posegraph.thin_keyframes) halves node indices;
+  keyframes carry their capture-time thin count and re-anchor through
+  `idx >> (thins_now - thins_then)` — the even-node-at-or-before
+  approximation thinning itself uses for surviving edges.
+
+Standalone (mapper=None) the node still maps in the odom frame.  The
 camera mount (height, pitch) comes from DepthCamConfig.
 
 Exports mirror the 2D mapper's: `voxel_grid()` (log-odds), plus the 2.5D
@@ -22,6 +43,7 @@ color convention's spirit (0 = unknown column, brighter = taller).
 from __future__ import annotations
 
 import functools
+import math
 import threading
 from typing import List, Optional
 
@@ -38,12 +60,70 @@ from jax_mapping.config import SlamConfig
 from jax_mapping.utils import global_metrics as M
 
 
+# Host-side SE(2) mirrors of ops/odometry.pose_compose/pose_between: the
+# per-image correction math runs on 3-vectors where a device round trip
+# per image would dominate the tick.
+
+def _se2_compose(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    ca, sa = math.cos(a[2]), math.sin(a[2])
+    return np.array([a[0] + ca * b[0] - sa * b[1],
+                     a[1] + sa * b[0] + ca * b[1],
+                     a[2] + b[2]], np.float32)
+
+
+def _se2_between(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    ca, sa = math.cos(a[2]), math.sin(a[2])
+    dx, dy = b[0] - a[0], b[1] - a[1]
+    dth = (b[2] - a[2] + math.pi) % (2.0 * math.pi) - math.pi
+    return np.array([ca * dx + sa * dy, -sa * dx + ca * dy, dth],
+                    np.float32)
+
+
+class _Keyframe:
+    """One depth keyframe, anchored to a 2D graph node."""
+
+    __slots__ = ("depth", "rel", "node_idx", "thins", "gen")
+
+    def __init__(self, depth, rel, node_idx, thins, gen):
+        self.depth = depth          # (H, W) np.float32
+        self.rel = rel              # (3,) pose in the anchor node's frame
+        self.node_idx = node_idx    # graph node index at capture
+        self.thins = thins          # graph thin count at capture
+        self.gen = gen              # mapper state generation at capture
+
+
+class _ThinSim:
+    """Per-robot replica of the 2D graph's thinning schedule.
+
+    `models/slam.key_branch` thins exactly when a key add finds the ring
+    full (n >= cap -> n = (cap+1)//2), so the thin count after k key
+    scans is a deterministic function of k; advancing this mirror to the
+    mapper's n_keyscans counter tells the keyframe ring how many times
+    node indices have halved since each capture."""
+
+    __slots__ = ("cap", "k", "n", "t")
+
+    def __init__(self, cap: int):
+        self.cap, self.k, self.n, self.t = cap, 0, 0, 0
+
+    def thins_at(self, k: int) -> int:
+        if k < self.k:              # fresh chain (/initialpose, restore)
+            self.k, self.n, self.t = 0, 0, 0
+        while self.k < k:
+            if self.n >= self.cap:
+                self.n = (self.cap + 1) // 2
+                self.t += 1
+            self.n += 1
+            self.k += 1
+        return self.t
+
+
 class VoxelMapperNode(Node):
     """Device-resident 3D mapping behind the topic contract."""
 
     def __init__(self, cfg: SlamConfig, bus: Bus,
                  tf: Optional[TfTree] = None, n_robots: int = 1,
-                 tick_period_s: Optional[float] = None):
+                 tick_period_s: Optional[float] = None, mapper=None):
         super().__init__("jax_voxel_mapper", bus, tf)
         import jax.numpy as jnp
 
@@ -54,15 +134,29 @@ class VoxelMapperNode(Node):
         self._V, self._jnp = V, jnp
         V._check_patch_coverage(cfg.voxel, cfg.depthcam)
 
+        #: The 2D MapperNode whose corrections/graph this node follows;
+        #: None = standalone odom-frame mapping.
+        self.mapper = mapper
+
         self._lock = threading.Lock()
         self.grid = V.empty_voxel_grid(cfg.voxel)
         self._depth_q: List[List[DepthImage]] = [[] for _ in range(n_robots)]
         self._pairer = OdomPairer(n_robots)
         self.n_images_fused = 0
         self.n_images_dropped_unpaired = 0
-        #: Bumped on out-of-band grid replacement (restore_grid); cache
-        #: keys combine it with n_images_fused.
+        #: Bumped on out-of-band grid replacement (restore_grid, closure
+        #: re-fuse); cache keys combine it with n_images_fused.
         self.map_revision = 0
+
+        # SLAM-coupled state (all under self._lock).
+        self._keyframes: List[List[_Keyframe]] = \
+            [[] for _ in range(n_robots)]
+        self._last_kf_pose: List[Optional[np.ndarray]] = [None] * n_robots
+        self._thin_sim = [_ThinSim(cfg.loop.max_poses)
+                          for _ in range(n_robots)]
+        self._loops_seen = 0
+        self.n_keyframes_stored = 0
+        self.n_refuses = 0
 
         for i in range(n_robots):
             ns = robot_ns(i, n_robots)
@@ -93,10 +187,101 @@ class VoxelMapperNode(Node):
         with self._lock:
             self._pairer.push(i, msg)
 
+    # -- SLAM coupling ------------------------------------------------------
+
+    def _corrected_pose(self, anchor, od_pose: np.ndarray) -> np.ndarray:
+        """Corrected world pose for an image paired with od_pose; anchor
+        from mapper.depth_anchor (None = uncorrected: standalone mode or
+        before the 2D mapper's first step)."""
+        if anchor is None:
+            return od_pose
+        _, est, odom_then, _, _, _ = anchor
+        # T_map_odom = est ∘ odom_then^-1 applied to the capture odom.
+        return _se2_compose(est, _se2_between(odom_then, od_pose))
+
+    def _maybe_keyframe(self, i: int, depth: np.ndarray,
+                        corrected: np.ndarray, anchor) -> None:
+        """Store a depth keyframe when the robot moved past the 2D
+        key-scan gate; caller holds no lock (list append under lock)."""
+        if anchor is None:
+            return
+        m = self.cfg.matcher
+        last = self._last_kf_pose[i]
+        if last is not None:
+            d = math.hypot(corrected[0] - last[0], corrected[1] - last[1])
+            dth = abs((corrected[2] - last[2] + math.pi)
+                      % (2.0 * math.pi) - math.pi)
+            if d <= m.min_travel_m and dth <= m.min_heading_rad:
+                return
+        gen, _, _, node_idx, node_pose, k_then = anchor
+        kf = _Keyframe(depth=np.array(depth, np.float32, copy=True),
+                       rel=_se2_between(node_pose, corrected),
+                       node_idx=node_idx,
+                       thins=self._thin_sim[i].thins_at(k_then),
+                       gen=gen)
+        with self._lock:
+            ring = self._keyframes[i]
+            ring.append(kf)
+            # keyframe_cap is a PER-FLEET memory bound (config.py): each
+            # robot's ring gets an equal share of the slots.
+            if len(ring) > max(1, self.cfg.voxel.keyframe_cap
+                               // self.n_robots):
+                # Ring full: halve keyframe density, even decimation
+                # (the thin_keyframes longevity pattern).
+                self._keyframes[i] = ring[::2]
+                M.counters.inc("voxel_mapper.keyframe_thins")
+        self._last_kf_pose[i] = corrected
+        self.n_keyframes_stored += 1
+        M.counters.inc("voxel_mapper.keyframes")
+
+    def _refuse_from_keyframes(self) -> None:
+        """Rebuild the voxel grid from the keyframe ring at the OPTIMIZED
+        graph poses — the 3D analog of the 2D ring re-fusion after a loop
+        closure. Keyframes from a stale state generation (a chain reset
+        since capture) are dropped; keyframes whose anchor node thinned
+        away re-anchor to the surviving even node at-or-before."""
+        jnp = self._jnp
+        depths, poses = [], []
+        for i in range(self.n_robots):
+            gen, node_poses, node_valid, n_now, k_now = \
+                self.mapper.graph_snapshot(i)
+            t_now = self._thin_sim[i].thins_at(k_now)
+            with self._lock:
+                keep = [kf for kf in self._keyframes[i] if kf.gen == gen]
+                self._keyframes[i] = keep
+                ring = list(keep)
+            for kf in ring:
+                idx = kf.node_idx >> (t_now - kf.thins)
+                if idx >= n_now or not bool(node_valid[idx]):
+                    continue
+                depths.append(kf.depth)
+                poses.append(_se2_compose(node_poses[idx], kf.rel))
+        if not depths:
+            return
+        with self._lock:
+            base_revision = self.map_revision
+        with M.stages.stage("voxel_mapper.refuse"):
+            grid = self._V.fuse_depths(
+                self.cfg.voxel, self.cfg.depthcam,
+                self._V.empty_voxel_grid(self.cfg.voxel),
+                jnp.asarray(np.stack(depths)),
+                jnp.asarray(np.stack(poses, dtype=np.float32)))
+            with self._lock:
+                if self.map_revision != base_revision:
+                    M.counters.inc("voxel_mapper.fuse_dropped_stale")
+                    return
+                self.grid = grid
+                # Content replaced out-of-band of n_images_fused: bump so
+                # PNG caches keyed on (fused, revision) refresh.
+                self.map_revision += 1
+        self.n_refuses += 1
+        M.counters.inc("voxel_mapper.refuses", 1)
+
     # -- device step --------------------------------------------------------
 
     def tick(self) -> None:
-        """Drain queues, fuse each robot's batch on device."""
+        """Drain queues, fuse each robot's batch on device at corrected
+        poses; re-fuse from keyframes when the 2D mapper closed a loop."""
         jnp = self._jnp
         cam = self.cfg.depthcam
         with self._lock:
@@ -114,33 +299,53 @@ class VoxelMapperNode(Node):
                         # the pinhole model; refuse loudly in counters.
                         M.counters.inc("voxel_mapper.images_bad_shape")
                         continue
-                    work.append((msg.depth, od.pose))
+                    work.append((i, msg.depth, od.pose))
                 self._depth_q[i].clear()
-        if not work:
-            return
-        depths = np.stack([w[0] for w in work]).astype(np.float32)
-        poses = np.asarray([[w[1].x, w[1].y, w[1].theta] for w in work],
-                           np.float32)
-        with M.stages.stage("voxel_mapper.fuse"):
-            with self._lock:
-                base_grid = self.grid
-                base_revision = self.map_revision
-            grid = self._V.fuse_depths(self.cfg.voxel, cam, base_grid,
-                                       jnp.asarray(depths),
-                                       jnp.asarray(poses))
-            with self._lock:
-                # Same stale-state guard as mapper._finish_step: a
-                # restore_grid (HTTP /load, demo --resume) landing while
-                # we fused would be silently overwritten by a grid fused
-                # from the pre-restore state. Drop the fused result; the
-                # images are lost, the restored map is not.
-                if self.map_revision != base_revision \
-                        or self.grid is not base_grid:
-                    M.counters.inc("voxel_mapper.fuse_dropped_stale")
-                    return
-                self.grid = grid
-        self.n_images_fused += len(work)
-        M.counters.inc("voxel_mapper.images_fused", len(work))
+        if work:
+            # One anchor snapshot per robot per tick: the correction
+            # basis moves at the 2D mapper's step cadence, not per image.
+            anchors = {}
+            for i in {i for i, _, _ in work}:
+                anchors[i] = self.mapper.depth_anchor(i) \
+                    if self.mapper is not None else None
+            depths, poses = [], []
+            for i, depth, od_pose in work:
+                od_np = np.array([od_pose.x, od_pose.y, od_pose.theta],
+                                 np.float32)
+                corrected = self._corrected_pose(anchors[i], od_np)
+                depths.append(depth)
+                poses.append(corrected)
+                self._maybe_keyframe(i, depth, corrected, anchors[i])
+            depths = np.stack(depths).astype(np.float32)
+            poses = np.stack(poses).astype(np.float32)
+            with M.stages.stage("voxel_mapper.fuse"):
+                with self._lock:
+                    base_grid = self.grid
+                    base_revision = self.map_revision
+                grid = self._V.fuse_depths(self.cfg.voxel, cam, base_grid,
+                                           jnp.asarray(depths),
+                                           jnp.asarray(poses))
+                with self._lock:
+                    # Same stale-state guard as mapper._finish_step: a
+                    # restore_grid (HTTP /load, demo --resume) landing
+                    # while we fused would be silently overwritten by a
+                    # grid fused from the pre-restore state. Drop the
+                    # fused result; the images are lost, the restored
+                    # map is not.
+                    if self.map_revision != base_revision \
+                            or self.grid is not base_grid:
+                        M.counters.inc("voxel_mapper.fuse_dropped_stale")
+                        grid = None
+                    else:
+                        self.grid = grid
+            if grid is not None:
+                self.n_images_fused += len(work)
+                M.counters.inc("voxel_mapper.images_fused", len(work))
+        if self.mapper is not None:
+            loops = self.mapper.n_loops_closed
+            if loops != self._loops_seen:
+                self._loops_seen = loops
+                self._refuse_from_keyframes()
 
     # -- exports ------------------------------------------------------------
 
@@ -175,6 +380,13 @@ class VoxelMapperNode(Node):
             # Content changed without fusing: consumers keying caches on
             # n_images_fused must see a new revision or serve stale data.
             self.map_revision += 1
+            # Checkpointed grids don't carry the keyframe ring; stored
+            # keyframes belong to the pre-restore trajectory and a later
+            # closure re-fuse from them would overwrite the restored map
+            # with stale geometry.
+            for ring in self._keyframes:
+                ring.clear()
+        self._last_kf_pose = [None] * self.n_robots
 
     def publish_points(self) -> None:
         """Occupied-voxel centres on `/voxel_points` (uniformly subsampled

@@ -248,6 +248,14 @@ class VoxelConfig:
     occ_threshold: float = 0.5
     free_threshold: float = -0.5
     hit_tolerance_cells: float = 1.0  # half-width of the occupied shell, cells
+    # Bounded depth-keyframe ring the SLAM-coupled 3D mapper re-fuses
+    # from after loop closures (bridge/voxel_mapper.py) — the 3D analog
+    # of the 2D scan ring. The cap is PER FLEET (each robot's ring gets
+    # cap // n_robots slots) so host memory is sized by this one number:
+    # 256 x 160x120 f32 images = ~20 MB regardless of fleet size. When a
+    # robot's ring fills, keyframe density halves (even decimation), the
+    # thin_keyframes longevity pattern.
+    keyframe_cap: int = 256
 
     @property
     def extent_m(self) -> Tuple[float, float, float]:

@@ -132,7 +132,8 @@ def _replay_main(args, cfg) -> int:
     voxel = None
     if any(t.endswith("depth") for t in bag_topics):
         from jax_mapping.bridge.voxel_mapper import VoxelMapperNode
-        voxel = VoxelMapperNode(cfg, bus, n_robots=args.robots)
+        voxel = VoxelMapperNode(cfg, bus, n_robots=args.robots,
+                                mapper=mapper)
     elif args.voxel_out:
         print("error: --voxel-out given but the bag has no depth topics "
               "(was it recorded without --depth-cam?)", file=sys.stderr)

@@ -93,15 +93,17 @@ def test_window_delta_matches_image_sum(vox, cam, rng):
 
 
 def test_window_fits_rejects_far_pose(vox):
-    origin = jnp.asarray([0, 0], jnp.int32)
+    # Patch of 64 cells at origin (32, 32) spans cells 32..96; world
+    # (0, 0) is cell 64 — dead centre, max-range margin (24 cells) fits.
+    origin = jnp.asarray([32, 32], jnp.int32)
     inside = jnp.asarray([[0.0, 0.0, 0.0]], jnp.float32)
-    # Patch spans 64 cells * 0.05 m = 3.2 m from the grid corner at
-    # origin (0,0); the grid is centred, so world (0,0) is the centre of
-    # a corner-origin patch only for the tiny config — a pose near the
-    # far edge fails the max-range margin.
+    assert bool(VK.window_fits(vox, inside, origin))
+    # A pose whose max-range disc crosses the patch edge must fail.
     edge = jnp.asarray([[1.55, 0.0, 0.0]], jnp.float32)
-    assert not bool(VK.window_fits(vox, edge, origin)) \
-        or bool(VK.window_fits(vox, inside, origin))
+    assert not bool(VK.window_fits(vox, edge, origin))
+    # One bad pose poisons the whole window (it's an all() contract).
+    both = jnp.asarray([[0.0, 0.0, 0.0], [1.55, 0.0, 0.0]], jnp.float32)
+    assert not bool(VK.window_fits(vox, both, origin))
 
 
 def test_fuse_depths_kernel_vs_xla(vox, cam, rng):

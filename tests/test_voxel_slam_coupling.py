@@ -1,0 +1,209 @@
+"""SLAM-coupled 3D map: depth fuses at corrected poses and the voxel grid
+re-fuses from the depth-keyframe ring after a loop closure, de-ghosting 3D
+walls the way the 2D ring re-fusion de-ghosts 2D walls.
+
+Bridge-level version of tests/test_loop_closure.py's acceptance drive: a
+robot with a constant wheel-calibration bias drives a square loop through
+featureless open space (pure dead-reckoning drift), returning to a plank
+it depth-mapped at the start. Pre-closure the plank is ghosted in 3D
+(fused once nearly drift-free, once displaced); the 2D wide loop search
+closes, and the voxel re-fuse at optimized graph poses must collapse the
+ghost.
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.mapper import MapperNode
+from jax_mapping.bridge.messages import (DepthImage, Header, LaserScan,
+                                         Odometry, Pose2D, Twist)
+from jax_mapping.bridge.voxel_mapper import VoxelMapperNode
+from jax_mapping.ops import voxel as V
+from jax_mapping.ops.odometry import twist_to_wheel_units
+from jax_mapping.sim import depthcam as DC
+from jax_mapping.sim import lidar
+from tests.test_loop_closure import loop_cfg
+
+
+def coupled_cfg(tiny_cfg):
+    """The loop-drive config with a voxel grid big enough to hold the
+    12.8 m course (the tiny 6.4 m grid ends before the walls), and a
+    depth camera whose range (2.6 m) meets the plank BEFORE the 2D lidar
+    (3.0 m) can close the loop — otherwise the corrected-pose fusion
+    never ghosts and the re-fuse has nothing to prove. Patch grows to
+    cover the wider trust horizon (coverage contract)."""
+    cfg = loop_cfg(tiny_cfg)
+    return dataclasses.replace(
+        cfg,
+        voxel=dataclasses.replace(cfg.voxel, size_x_cells=256,
+                                  size_y_cells=256, max_range_m=2.6,
+                                  patch_cells=128),
+        depthcam=dataclasses.replace(cfg.depthcam, range_max_m=2.6))
+
+
+def _build_world():
+    """The test_loop_closure world: L-corner + north plank + stub."""
+    world = np.zeros((256, 256), bool)
+
+    def put(r0, r1, c0, c1):
+        world[r0:r1, c0:c1] = True
+    put(30, 32, 30, 70)
+    put(30, 70, 30, 32)
+    put(58, 60, 30, 52)     # the north plank the depth cam ghosts
+    put(86, 89, 30, 37)
+    return world
+
+
+def _plank_band_rows(vox):
+    """Voxel rows of the true plank (world rows 58..60 at 0.05 m,
+    world centred like the voxel grid)."""
+    _, oy, _ = vox.origin_m
+    # world row r -> y = (r - 128) * 0.05; voxel row = (y - oy) / res
+    y0 = (58 - 128) * 0.05
+    y1 = (60 - 128) * 0.05
+    r0 = int((y0 - oy) / vox.resolution_m)
+    r1 = int(math.ceil((y1 - oy) / vox.resolution_m))
+    return r0, r1
+
+
+def _ghost_error(vox, grid, x_lo=-4.9, x_hi=-2.4):
+    """Mean |row offset| (cells) of occupied voxel columns from the true
+    plank rows, within the plank's x extent and a 24-cell neighbourhood —
+    the 3D ghosting metric (0 = every wall voxel on the true plank)."""
+    r0, r1 = _plank_band_rows(vox)
+    occ = np.asarray(V.obstacle_slice(vox, grid, 0.06, 0.45))
+    ox, _, _ = vox.origin_m
+    c0 = int((x_lo - ox) / vox.resolution_m)
+    c1 = int((x_hi - ox) / vox.resolution_m)
+    band = occ[max(r0 - 24, 0):r1 + 24, c0:c1]
+    rows, _ = np.nonzero(band)
+    if len(rows) == 0:
+        return None
+    centre = (r0 + r1) / 2 - max(r0 - 24, 0)
+    return float(np.abs(rows + 0.5 - centre).mean())
+
+
+@pytest.mark.slow
+def test_voxel_map_deghosts_on_loop_closure(tiny_cfg):
+    cfg = coupled_cfg(tiny_cfg)
+    world = _build_world()
+    world_j = jnp.asarray(world)
+    res = cfg.grid.resolution_m
+    n_samples = int(cfg.scan.range_max_m / (res * 0.5))
+
+    bus = Bus()
+    mapper = MapperNode(cfg, bus, n_robots=1)
+    voxel = VoxelMapperNode(cfg, bus, n_robots=1, mapper=mapper)
+    scan_pub = bus.publisher("scan")
+    odom_pub = bus.publisher("odom")
+    depth_pub = bus.publisher("depth")
+
+    start = np.array([-3.8, -3.8, 0.0])
+    mapper.states[0] = mapper.states[0]._replace(
+        pose=jnp.asarray(start, dtype=jnp.float32))
+
+    v, w_turn, dt = 0.35, math.pi / 2, 0.1
+    legs = [("fwd", 5.5), ("turn", 1.0), ("fwd", 5.5), ("turn", 1.0),
+            ("fwd", 5.5), ("turn", 1.0), ("fwd", 4.9)]
+    bias = 1.0
+    k = cfg.robot.speed_coeff_m_per_unit_s
+
+    true_pose = start.copy()
+    odom_pose = start.copy()
+    t = 0.0
+    step = 0
+    err_preclose = None
+    for kind, amount in legs:
+        n = int(round((amount / v if kind == "fwd" else amount) / dt))
+        tv, tw = (v, 0.0) if kind == "fwd" else (0.0, w_turn)
+        wl_t, wr_t = twist_to_wheel_units(cfg.robot, tv, tw)
+        for _ in range(n):
+            def integrate(pose, wl, wr):
+                vl, vr = wl * k, wr * k
+                v_lin = (vl + vr) / 2
+                v_ang = (vr - vl) / cfg.robot.wheel_base_m
+                mid = pose[2] + v_ang * dt / 2
+                return pose + np.array([v_lin * math.cos(mid) * dt,
+                                        v_lin * math.sin(mid) * dt,
+                                        v_ang * dt])
+            true_pose = integrate(true_pose, wl_t, wr_t)
+            # The bridge sees BIASED odometry (left-wheel offset).
+            odom_pose = integrate(odom_pose, wl_t + bias, wr_t)
+            t += dt
+            step += 1
+            scan = np.asarray(lidar.simulate_scans(
+                cfg.scan, world_j, res, n_samples,
+                jnp.asarray(true_pose)[None])[0])
+            odom_pub.publish(Odometry(
+                header=Header(stamp=t, frame_id="odom"),
+                pose=Pose2D(*odom_pose), twist=Twist()))
+            scan_pub.publish(LaserScan(
+                header=Header(stamp=t, frame_id="base_laser"),
+                angle_increment=cfg.scan.angle_increment_rad,
+                ranges=scan[:cfg.scan.n_beams]))
+            if step % 3 == 0:       # depth at a third of the scan rate
+                depth = np.asarray(DC.render_depth(
+                    cfg.depthcam, world_j, res, n_samples,
+                    jnp.asarray(true_pose)))
+                depth_pub.publish(DepthImage(
+                    header=Header(stamp=t, frame_id="base_camera"),
+                    depth=depth))
+            mapper.tick()
+            # Between the 2D closure and the 3D re-fuse (voxel.tick sees
+            # the closure next): the ghosted pre-repair 3D map.
+            if err_preclose is None and mapper.n_loops_closed > 0:
+                err_preclose = _ghost_error(cfg.voxel, voxel.voxel_grid())
+            voxel.tick()
+
+    assert mapper.n_loops_closed >= 1, "staging failed: no loop closed"
+    assert voxel.n_keyframes_stored > 10, "keyframe ring never populated"
+    assert voxel.n_refuses >= 1, "closure never triggered a 3D re-fuse"
+
+    # Pre-closure the plank must actually have ghosted (else the test
+    # proves nothing): the drift at loop end exceeds several cells.
+    assert err_preclose is not None and err_preclose > 3.0, (
+        f"staging failed: pre-closure ghost error {err_preclose} cells "
+        "— drift never displaced the 3D plank")
+    err_post = _ghost_error(cfg.voxel, voxel.voxel_grid())
+    assert err_post is not None, "post-closure 3D map lost the plank"
+    assert err_post < err_preclose / 2, (
+        f"3D wall did not de-ghost: {err_preclose:.1f} -> "
+        f"{err_post:.1f} cells")
+    assert err_post < 3.0, f"post-closure ghost error {err_post:.1f} cells"
+
+
+def test_corrected_pose_math(tiny_cfg):
+    """The map->odom correction applied to a later odom sample equals
+    composing the estimate with the odom-frame motion since the basis."""
+    from jax_mapping.bridge.voxel_mapper import (_se2_between, _se2_compose)
+    est = np.array([2.0, 1.0, 0.7], np.float32)
+    odom_then = np.array([1.5, 0.5, 0.2], np.float32)
+    # Robot moves 0.3 m forward in its own frame after the basis.
+    fwd = np.array([0.3, 0.0, 0.0], np.float32)
+    odom_now = _se2_compose(odom_then, fwd)
+    got = _se2_compose(est, _se2_between(odom_then, odom_now))
+    want = _se2_compose(est, fwd)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_standalone_voxel_mapper_unchanged(tiny_cfg):
+    """mapper=None keeps the round-4 odom-frame behavior: images fuse at
+    raw odometry, no keyframes, no refuses."""
+    bus = Bus()
+    vm = VoxelMapperNode(tiny_cfg, bus, n_robots=1)
+    cam = tiny_cfg.depthcam
+    od = bus.publisher("odom")
+    dp = bus.publisher("depth")
+    od.publish(Odometry(header=Header(stamp=1.0), pose=Pose2D(0, 0, 0)))
+    dp.publish(DepthImage(header=Header(stamp=1.1),
+                          depth=np.full((cam.height_px, cam.width_px), 0.8,
+                                        np.float32)))
+    vm.tick()
+    assert vm.n_images_fused == 1
+    assert vm.n_keyframes_stored == 0 and vm.n_refuses == 0
