@@ -63,11 +63,18 @@ _RESULT = {
     "fleet_tick_p50_ms_8robots": None,
     "fleet_tick_p50_ms_64robots": None,
     "voxel_images_per_sec": None,
+    # Shared-patch window fast path (voxel_kernel.window_delta); TPU only.
+    "voxel_window_images_per_sec": None,
+    # Engine voxel fuse_depths dispatched to (pallas on TPU, else xla).
+    "voxel_path": None,
     "path": None,
     # Engine actually used by the frontier cost fields ("pallas" unless
     # the probe or the production-shape run rejected the kernel).
     "costfield_path": None,
     "sections_completed": [],
+    # Host/toolchain identity: round-over-round comparisons are only
+    # meaningful when the JSON says what produced the number (VERDICT r4).
+    "provenance": None,
 }
 _EMITTED = threading.Event()
 
@@ -154,7 +161,8 @@ def _is_tunnel_failure(e: Exception) -> bool:
         "Couldn't connect"))
 
 
-def _chain_time(make_fn, k1: int, k2: int, reps: int) -> float:
+def _chain_time(make_fn, k1: int, k2: int, reps: int,
+                label: str = None) -> float:
     """Median per-iteration seconds for a chained-loop fn factory.
 
     make_fn() must return f(k) that runs a k-iteration device chain and
@@ -163,6 +171,27 @@ def _chain_time(make_fn, k1: int, k2: int, reps: int) -> float:
     compilation — the per-section compile cost through the remote TPU
     compile tunnel dominated the bench wall clock when every section
     compiled two chain lengths.
+
+    k2 GROWS until the marginal signal t(k2)-t(k1) clears the timing
+    noise (same executable — the trip count is traced, growth is free),
+    and the chosen basis is recorded in provenance.
+
+    This + the chain loop-dependence guards explain the BENCH_r03/r04
+    17x fuse "anomaly" (VERDICT r4 weak #1). Two compounding artifacts,
+    neither hardware, neither the measured code: (1) the old fuse chain
+    was loop-INVARIANT in its classify inputs, so XLA hoisted the whole
+    classification out of the fori_loop and the chain timed only the
+    640^2 patch apply — a ~1.3 ms marginal against a ~1.6 s chain
+    constant (grid materialise + fetch) on a 1-core CPU box; (2) at the
+    old fixed k2=3 that 2.6 ms signal sat inside scheduler jitter, so
+    noise flipped the formula between marginal (r4: 7509 scans/s, idle
+    repro: 17943) and the whole-chain fallback that charges the
+    constant to throughput (r3: 431.6, loaded repro: 437.4) — measured
+    on one box minutes apart. With the dependence guard the honest CPU
+    classify is ~1.2 s/window (~210 scans/s); the TPU headline must be
+    re-measured on-chip (the r3 kernel-stage budget, measured through
+    the already-guarded kernel_chain, puts the kernel alone at
+    5.9 ms/window, so ~43 k scans/s remains the expected order).
     """
     f = make_fn()
     f(k1)  # compile + warm (same executable serves both lengths)
@@ -174,9 +203,32 @@ def _chain_time(make_fn, k1: int, k2: int, reps: int) -> float:
             t0 = time.perf_counter()
             f(k)
             ts.append(time.perf_counter() - t0)
-        return float(np.median(ts))
+        return float(np.median(ts)), float(np.max(ts) - np.min(ts))
 
-    t1, t2 = med(k1), med(k2)
+    t1, spread1 = med(k1)
+    while True:
+        t2, _ = med(k2)
+        signal = t2 - t1
+        if signal > max(4.0 * spread1, 0.05 * t1):
+            break
+        grown = k2 * 3
+        # Growth budget: one more round costs ~reps * t(grown). Project
+        # conservatively by scaling the WHOLE measured chain time (the
+        # constant inflates the estimate) — projecting from `signal`
+        # would under-estimate exactly when growth triggers (noise can
+        # make signal <= 0) and approve rounds that blow the deadline.
+        if grown > 100 or \
+                reps * max(t1, t2) * grown / k2 \
+                > max(_remaining() - 30.0, 0.0):
+            break
+        k2 = grown
+    basis = "marginal" if t2 > t1 else "whole-chain"
+    if label is not None:
+        prov = _RESULT.get("provenance") or {}
+        prov.setdefault("timing", {})[label] = {
+            "t1_s": round(t1, 4), "t2_s": round(t2, 4),
+            "k1": k1, "k2": k2, "basis": basis}
+        _RESULT["provenance"] = prov
     if t2 > t1:
         return (t2 - t1) / (k2 - k1)
     return t2 / k2
@@ -199,6 +251,28 @@ def _run() -> None:
     _RESULT["devices"] = f"{n_dev}x {dev.platform}" + (
         " (tpu tunnel unreachable, virtual-cpu fallback)" if cpu_fallback
         else "")
+    # Provenance (VERDICT r4 weak #1): BENCH_r04's CPU fuse number was 17x
+    # BENCH_r03's with identical measurement AND measured code (diffed) —
+    # builder repro on the r5 image got 437.4 scans/s, agreeing with r3's
+    # 431.6, so r4's 7509.6 came from the driver host, not the repo (a
+    # beefier or idler machine parallelising the window classify; the
+    # conv-bound frontier/match sections barely moved). These fields make
+    # round-over-round artifacts comparable: environment variance is only
+    # diagnosable if the JSON says what hardware produced the number.
+    try:
+        load1 = round(os.getloadavg()[0], 1)
+    except OSError:
+        load1 = None
+    import jaxlib
+    _RESULT["provenance"] = {
+        "cpu_count": os.cpu_count(),
+        "loadavg_1m": load1,
+        "python": ".".join(map(str, sys.version_info[:3])),
+        "jax": jax.__version__,
+        "jaxlib": getattr(jaxlib, "__version__", None),
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
     if cpu_fallback:
         # Virtual-CPU numbers say nothing about the TPU framework; point
         # the reader at the NEWEST builder-measured hardware record.
@@ -288,7 +362,17 @@ def _run() -> None:
     def fuse_chain():
         def run(k):
             def body(_, gr):
-                return G.fuse_scans_window(g, s, gr, ranges_d, poses_d)
+                # Thread the grid into the CLASSIFY inputs (gr[0,0]*0.0
+                # is numerically zero — the grid is clamp-bounded — but
+                # not provably so to XLA): the window delta doesn't
+                # otherwise depend on the loop state, and XLA hoists the
+                # whole classification out of the fori_loop, leaving a
+                # chain that times only the 640^2 patch apply (~1.3 ms vs
+                # the honest ~1.2 s/window classify on a 1-core CPU,
+                # measured with this guard at k2=9) — the invariant-code-
+                # motion hazard the frontier/kernel chains already guard.
+                return G.fuse_scans_window(g, s, gr, ranges_d,
+                                           poses_d + gr[0, 0] * 0.0)
             gr = jax.lax.fori_loop(0, k, body, G.empty_grid(g))
             return gr.sum()
         jitted = jax.jit(run)
@@ -296,7 +380,7 @@ def _run() -> None:
 
     target = 50_000.0 * n_dev / 8.0
     try:
-        dt = _chain_time(fuse_chain, k1, k2, reps)
+        dt = _chain_time(fuse_chain, k1, k2, reps, label="fuse")
         scans_per_sec = B / dt
         _RESULT["value"] = round(scans_per_sec, 1)
         _RESULT["vs_baseline"] = round(scans_per_sec / target, 3)
@@ -320,7 +404,8 @@ def _run() -> None:
                         return d.sum()
                     jitted = jax.jit(run)
                     return lambda k: float(jitted(jnp.int32(k)))
-                kdt = _chain_time(kernel_chain, k1, k2, reps)
+                kdt = _chain_time(kernel_chain, k1, k2, reps,
+                                  label="fuse_kernel")
                 print(f"bench: fuse stage budget — window kernel "
                       f"{kdt * 1e3:.2f} ms, full fuse {dt * 1e3:.2f} ms "
                       f"({B} scans/window)", file=sys.stderr, flush=True)
@@ -346,7 +431,8 @@ def _run() -> None:
                   file=sys.stderr, flush=True)
             os.environ["JAX_MAPPING_NO_PALLAS"] = "1"
             _RESULT["path"] = "xla-fallback"
-            dt = _chain_time(fuse_chain, k1, k2, reps)
+            dt = _chain_time(fuse_chain, k1, k2, reps,
+                             label="fuse_fallback")
             scans_per_sec = B / dt
             _RESULT["value"] = round(scans_per_sec, 1)
             _RESULT["vs_baseline"] = round(scans_per_sec / target, 3)
@@ -447,7 +533,7 @@ def _run() -> None:
             jitted = jax.jit(run_g)
             return lambda k: float(jitted(grid_arr, jnp.int32(k)))
         try:
-            p50 = _chain_time(match_chain, k1, k2, reps)
+            p50 = _chain_time(match_chain, k1, k2, reps, label="match")
             _RESULT["match_p50_ms"] = round(p50 * 1e3, 2)
             _RESULT["sections_completed"].append("match")
         except Exception:
@@ -478,7 +564,8 @@ def _run() -> None:
             jitted = jax.jit(run_g)
             return lambda k: float(jitted(state0, jnp.int32(k)))
         try:
-            p50 = _chain_time(slam_chain, k1, k2, reps)
+            p50 = _chain_time(slam_chain, k1, k2, reps,
+                              label="slam_step")
             _RESULT["slam_step_p50_ms"] = round(p50 * 1e3, 2)
             _RESULT["sections_completed"].append("slam_step")
         except Exception:
@@ -534,7 +621,8 @@ def _run() -> None:
             jitted = jax.jit(run_g)
             return lambda k: float(jitted(fstate0, jnp.int32(k)))
         try:
-            p50 = _chain_time(fleet_chain, 1, 3, min(reps, 3))
+            p50 = _chain_time(fleet_chain, 1, 3, min(reps, 3),
+                              label=f"fleet_tick_{n_robots}")
             _RESULT[key] = round(p50 * 1e3, 2)
             _RESULT["sections_completed"].append(f"fleet_tick_{n_robots}")
         except Exception:
@@ -543,39 +631,87 @@ def _run() -> None:
 
     # ---- 3D voxel fusion throughput (BASELINE configs[4]) ---------------
     # Depth images fused into the production (64, 1024, 1024) 0.05 m
-    # log-odds voxel grid via the patch path (ops/voxel.py). Images are
-    # synthetic (range + speckle) — the sim renderer is not part of the
-    # fusion cost a deployment pays.
+    # log-odds voxel grid. Target (VERDICT r4): >= 640 images/s = 64
+    # robots x the reference's 10 Hz sensor cadence (server main.py:60).
+    # Inputs are REAL renders of the plank-course world through the sim
+    # depth cam (VERDICT r4 weak #6: uniform speckle never exercised the
+    # frustum/occlusion-heavy geometry) — rendered OUTSIDE the timed
+    # region; the renderer is not part of the fusion cost a deployment
+    # pays. `voxel_path` records the engine fuse_depths dispatched to
+    # (the Pallas kernel on TPU, ops/voxel_kernel.py; XLA elsewhere).
     if _remaining() > 90.0:
         from jax_mapping.ops import voxel as VX
+        from jax_mapping.sim import depthcam as DCam
+        from jax_mapping.sim import world as SimW
         vox, cam = cfg.voxel, cfg.depthcam
         VB = 32
-        vdepths = rng.uniform(0.5, cam.range_max_m,
-                              (VB, cam.height_px, cam.width_px)
-                              ).astype(np.float32)
-        vdepths[rng.random(vdepths.shape) < 0.05] = 0.0
+        vworld = SimW.plank_course(512, g.resolution_m, n_planks=10,
+                                   seed=7)
         t2_ = np.linspace(0, 2 * math.pi, VB, endpoint=False)
-        vposes = np.stack([0.4 * np.cos(t2_), 0.4 * np.sin(t2_),
-                           t2_], axis=1).astype(np.float32)
-        vdepths_d = jax.device_put(jnp.asarray(vdepths), dev)
+        vposes = np.stack([3.0 * np.cos(t2_), 3.0 * np.sin(t2_),
+                           t2_ + math.pi / 2], axis=1).astype(np.float32)
+        vdepths_d = jax.device_put(DCam.render_depths(
+            cam, jnp.asarray(vworld), g.resolution_m, 200,
+            jnp.asarray(vposes)), dev)
         vposes_d = jax.device_put(jnp.asarray(vposes), dev)
+        _RESULT["voxel_path"] = ("pallas" if VX._use_pallas(vox, cam)
+                                 else "xla")
 
         def voxel_chain():
             def run(k):
-                def body(_, g):
-                    return VX.fuse_depths(vox, cam, g, vdepths_d, vposes_d)
-                g = jax.lax.fori_loop(0, k, body,
-                                      VX.empty_voxel_grid(vox))
-                return g.sum()
+                def body(_, gr):
+                    # Loop-dependence guard — see fuse_chain.
+                    return VX.fuse_depths(vox, cam, gr, vdepths_d,
+                                          vposes_d + gr[0, 0, 0] * 0.0)
+                gr = jax.lax.fori_loop(0, k, body,
+                                       VX.empty_voxel_grid(vox))
+                return gr.sum()
             jitted = jax.jit(run)
             return lambda k: float(jitted(jnp.int32(k)))
         try:
-            dt = _chain_time(voxel_chain, 1, 3, min(reps, 3))
+            dt = _chain_time(voxel_chain, 1, 3, min(reps, 3),
+                             label="voxel")
             _RESULT["voxel_images_per_sec"] = round(VB / dt, 1)
             _RESULT["sections_completed"].append("voxel")
         except Exception:
             import traceback
             traceback.print_exc(file=sys.stderr)
+
+        # Shared-patch window path (one robot's consecutive frames —
+        # voxel_kernel.window_delta replaces the B-step fold with one
+        # aligned read-modify-write). Kernel engine only: interpret-mode
+        # pallas off-TPU is pathologically slow at production shapes.
+        if VX._use_pallas(vox, cam) and _remaining() > 60.0:
+            from jax_mapping.ops import voxel_kernel as VKK
+            wt = np.linspace(0, 0.5, VB).astype(np.float32)
+            wposes_d = jax.device_put(jnp.asarray(np.stack(
+                [0.2 * np.cos(wt * 2 * np.pi), 0.2 * np.sin(wt * 2 * np.pi),
+                 wt], axis=1).astype(np.float32)), dev)
+            worigin = VX.patch_origin(vox, wposes_d[:, :2].mean(0))
+            assert bool(VKK.window_fits(vox, wposes_d, worigin)), \
+                "bench window trajectory violates the shared-patch contract"
+
+            def vwindow_chain():
+                def run(k):
+                    def body(_, gr):
+                        # Loop-dependence guard — see fuse_chain.
+                        d = VKK.window_delta(vox, cam, vdepths_d,
+                                             wposes_d + gr[0, 0, 0] * 0.0,
+                                             worigin)
+                        return VX.apply_patch(vox, gr, d, worigin)
+                    gr = jax.lax.fori_loop(0, k, body,
+                                           VX.empty_voxel_grid(vox))
+                    return gr.sum()
+                jitted = jax.jit(run)
+                return lambda k: float(jitted(jnp.int32(k)))
+            try:
+                dt = _chain_time(vwindow_chain, 1, 3, min(reps, 3),
+                                 label="voxel_window")
+                _RESULT["voxel_window_images_per_sec"] = round(VB / dt, 1)
+                _RESULT["sections_completed"].append("voxel_window")
+            except Exception:
+                import traceback
+                traceback.print_exc(file=sys.stderr)
     else:
         print(f"bench: skipping voxel ({_remaining():.0f}s left)",
               file=sys.stderr, flush=True)

@@ -278,9 +278,16 @@ def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
         err = jax.lax.psum(err, "fleet") / R
         resp = jax.lax.psum(jnp.sum(res.response), "fleet") / R
         n_loops_total = jax.lax.psum(state2.n_loops.sum(), "fleet")
+        # Thin events THIS step, observed at the trigger condition
+        # (_update_graphs thins exactly when a key add finds the ring
+        # full) — the dry run's proof that thinning fired across the
+        # mesh cannot be inferred from n_poses alone (it is bounded by
+        # capacity whether or not the thin ran).
+        thins = is_key & (state.graphs.n_poses >= cfg.loop.max_poses)
         metrics = {"mean_pose_err_m": err, "mean_match_response": resp,
                    "n_clusters": jnp.sum(fr.sizes > 0),
-                   "n_loops": n_loops_total}
+                   "n_loops": n_loops_total,
+                   "n_thins": jax.lax.psum(thins.sum(), "fleet")}
         return state2, metrics
 
     specs = state_specs(cfg)
@@ -288,6 +295,7 @@ def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
         step, mesh=mesh,
         in_specs=(specs, P(None, None)),
         out_specs=(specs, {"mean_pose_err_m": P(), "mean_match_response": P(),
-                           "n_clusters": P(), "n_loops": P()}),
+                           "n_clusters": P(), "n_loops": P(),
+                           "n_thins": P()}),
         check_vma=False)
     return jax.jit(sharded)

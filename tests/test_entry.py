@@ -25,25 +25,30 @@ def test_entry_compiles_and_runs():
     assert pose_err.shape[0] == 4
 
 
-def test_dryrun_multichip_under_budget():
-    """The whole 8-device dry run (compile + one step) in <= 120 s CPU,
-    exercising the IN-PROCESS branch (conftest pins cpu + 8 host devices
-    and scrubs the axon env, so _cpu_env_ready must hold here)."""
+def test_dryrun_multichip_under_budget(monkeypatch):
+    """The 8-device dry run (compile + short trajectory + voxel fusion)
+    in <= 180 s CPU, exercising the IN-PROCESS branch (conftest pins cpu
+    + 8 host devices and scrubs the axon env, so _cpu_env_ready must hold
+    here). These plumbing tests run the SHORT trajectory
+    (JAX_MAPPING_DRYRUN_STEPS) — the full 16-step gate-crossing run is
+    the driver artifact's job at ~12 s/step on a 1-core virtual mesh."""
+    monkeypatch.setenv("JAX_MAPPING_DRYRUN_STEPS", "4")
     assert E._cpu_env_ready(8), "conftest env contract changed"
     t0 = time.monotonic()
     E.dryrun_multichip(8)
     elapsed = time.monotonic() - t0
-    assert elapsed < 120.0, f"dryrun_multichip(8) took {elapsed:.0f}s"
+    assert elapsed < 180.0, f"dryrun_multichip(8) took {elapsed:.0f}s"
 
 
 def test_dryrun_subprocess_hop_from_poisoned_env(monkeypatch):
     """With the axon marker set, the dry run must detect the poisoned
     process and still succeed via the scrubbed subprocess."""
+    monkeypatch.setenv("JAX_MAPPING_DRYRUN_STEPS", "4")
     monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
     assert not E._cpu_env_ready(8)
     t0 = time.monotonic()
     E.dryrun_multichip(8)
-    assert time.monotonic() - t0 < 180.0
+    assert time.monotonic() - t0 < 240.0
 
 
 def test_scrubbed_env_contents():
