@@ -71,12 +71,10 @@ _MAX_B_PER_CALL = 32
 
 
 def kernel_supported(vox: VoxelConfig, cam: DepthCamConfig) -> bool:
-    """Static config compatibility — the pitch-0 factorization premise
-    plus vreg-shape fits."""
-    return (cam.mount_pitch_rad == 0.0
-            and cam.height_px <= LANES
-            and vox.size_z_cells <= LANES
-            and (vox.patch_cells * vox.patch_cells) % COLS == 0)
+    """Static config compatibility for the PATCH paths — the pitch-0
+    factorization premise plus vreg-shape fits (one predicate,
+    region_supported, so the patch and slab paths cannot drift)."""
+    return region_supported(vox, cam, vox.patch_cells, vox.patch_cells)
 
 
 def _check(vox: VoxelConfig, cam: DepthCamConfig) -> None:
@@ -114,8 +112,13 @@ def _pose_table(poses_b: Array) -> Array:
                       jnp.cos(p[:, 2]), jnp.sin(p[:, 2])], axis=1)
 
 
-def _make_kernel(vox: VoxelConfig, cam: DepthCamConfig, accumulate: bool):
-    P = vox.patch_cells
+def _make_kernel(vox: VoxelConfig, cam: DepthCamConfig, accumulate: bool,
+                 ny: int = None, nx: int = None):
+    """Kernel over a (Z, ny, nx) region (default: the (P, P) patch).
+    The sharded path passes full-width Y slabs (ny=slab_rows,
+    nx=size_x_cells) — same math, different flattening."""
+    ny = vox.patch_cells if ny is None else ny
+    nx = vox.patch_cells if nx is None else nx
     Z = vox.size_z_cells
     H, W = cam.height_px, cam.width_px
     nw = _n_wchunks(cam)
@@ -143,8 +146,8 @@ def _make_kernel(vox: VoxelConfig, cam: DepthCamConfig, accumulate: bool):
         # Tile row-band cull: the euclidean trust horizon bounds |wy - py|
         # by max_range, so a tile whose patch rows all sit farther away
         # classifies nothing. One cell of slack for the half-cell centre.
-        row_lo = ((t * COLS) // P).astype(jnp.float32)
-        row_hi = (((t + 1) * COLS - 1) // P).astype(jnp.float32)
+        row_lo = ((t * COLS) // nx).astype(jnp.float32)
+        row_hi = (((t + 1) * COLS - 1) // nx).astype(jnp.float32)
         pose_row = (py - oy) / res - 0.5 - y0.astype(jnp.float32)
         gap = jnp.maximum(
             jnp.maximum(row_lo - pose_row, pose_row - row_hi), 0.0)
@@ -161,8 +164,8 @@ def _make_kernel(vox: VoxelConfig, cam: DepthCamConfig, accumulate: bool):
             # fans out over z on lanes.
             cc = jax.lax.broadcasted_iota(jnp.int32, (COLS, LANES), 0)
             flat = t * COLS + cc
-            r_i = flat // P
-            c_i = flat - r_i * P
+            r_i = flat // nx
+            c_i = flat - r_i * nx
             wy = ((y0 + r_i).astype(jnp.float32) + 0.5) * res + oy
             wx = ((x0 + c_i).astype(jnp.float32) + 0.5) * res + ox
             dx = wx - px
@@ -226,12 +229,18 @@ def _make_kernel(vox: VoxelConfig, cam: DepthCamConfig, accumulate: bool):
     return kernel
 
 
+def _colmajor_to_region(vox: VoxelConfig, flat: Array,
+                        ny: int, nx: int) -> Array:
+    """(..., ny*nx, Z) kernel output -> (..., Z, ny, nx)."""
+    Z = vox.size_z_cells
+    nd = flat.ndim
+    out = flat.reshape(*flat.shape[:-2], ny, nx, Z)
+    return jnp.moveaxis(out, nd, nd - 2)
+
+
 def _colmajor_to_patch(vox: VoxelConfig, flat: Array) -> Array:
     """(..., P*P, Z) kernel output -> (..., Z, P, P)."""
-    P, Z = vox.patch_cells, vox.size_z_cells
-    nd = flat.ndim
-    out = flat.reshape(*flat.shape[:-2], P, P, Z)
-    return jnp.moveaxis(out, nd, nd - 2)
+    return _colmajor_to_region(vox, flat, vox.patch_cells, vox.patch_cells)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
@@ -290,22 +299,34 @@ def window_delta(vox: VoxelConfig, cam: DepthCamConfig, depths_b: Array,
     responsible for the shared-patch contract (`window_fits`).
     """
     _check(vox, cam)
-    P, Z = vox.patch_cells, vox.size_z_cells
+    P = vox.patch_cells
+    B = depths_b.shape[0]
+    origins = jnp.broadcast_to(
+        origin_yx.astype(jnp.int32).reshape(1, 2), (max(B, 1), 2))
+    return _summed_delta(vox, cam, depths_b, poses_b, origins, P, P)
+
+
+def _summed_delta(vox: VoxelConfig, cam: DepthCamConfig, depths_b: Array,
+                  poses_b: Array, origins_b: Array, ny: int,
+                  nx: int) -> Array:
+    """Shared accumulate-mode body of window_delta and region_delta: the
+    batch-summed (Z, ny, nx) delta at per-image origins (one pallas_call
+    per <=_MAX_B_PER_CALL chunk so the two public paths cannot drift)."""
+    Z = vox.size_z_cells
     B = depths_b.shape[0]
     if B == 0:
-        return jnp.zeros((Z, P, P), jnp.float32)
+        return jnp.zeros((Z, ny, nx), jnp.float32)
     if B > _MAX_B_PER_CALL:
-        total = jnp.zeros((Z, P, P), jnp.float32)
+        total = jnp.zeros((Z, ny, nx), jnp.float32)
         for i in range(0, B, _MAX_B_PER_CALL):
-            total = total + window_delta(
+            total = total + _summed_delta(
                 vox, cam, depths_b[i:i + _MAX_B_PER_CALL],
-                poses_b[i:i + _MAX_B_PER_CALL], origin_yx)
+                poses_b[i:i + _MAX_B_PER_CALL],
+                origins_b[i:i + _MAX_B_PER_CALL], ny, nx)
         return total
     table = depth_table(cam, depths_b)
-    origins = jnp.broadcast_to(
-        origin_yx.astype(jnp.int32).reshape(1, 2), (B, 2))
-    kernel = _make_kernel(vox, cam, accumulate=True)
-    ncols = P * P
+    kernel = _make_kernel(vox, cam, accumulate=True, ny=ny, nx=nx)
+    ncols = ny * nx
     interpret = jax.default_backend() != "tpu"
     out = pl.pallas_call(
         kernel,
@@ -319,8 +340,42 @@ def window_delta(vox: VoxelConfig, cam: DepthCamConfig, depths_b: Array,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((ncols, Z), jnp.float32),
         interpret=interpret,
-    )(table, _pose_table(poses_b), origins)
-    return _colmajor_to_patch(vox, out)
+    )(table, _pose_table(poses_b), origins_b)
+    return _colmajor_to_region(vox, out, ny, nx)
+
+
+def region_supported(vox: VoxelConfig, cam: DepthCamConfig,
+                     ny: int, nx: int) -> bool:
+    """Static support check for arbitrary (ny, nx) regions (the sharded
+    Y-slab path): the patch shape constraint generalises to the region's
+    flattened column count."""
+    return (cam.mount_pitch_rad == 0.0
+            and cam.height_px <= LANES
+            and vox.size_z_cells <= LANES
+            and (ny * nx) % COLS == 0)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 5, 6))
+def region_delta(vox: VoxelConfig, cam: DepthCamConfig, depths_b: Array,
+                 poses_b: Array, y0, ny: int, nx: int) -> Array:
+    """Summed (Z, ny, nx) log-odds delta of B images over the region at
+    rows y0.., cols 0.. — the kernel twin of summing
+    `voxel.classify_region` over the batch. The sharded Y-slab fuse
+    (`parallel/voxel_sharded.py`) calls it per device with its own
+    traced y0; there is no coverage contract here (the slab keeps every
+    in-trust-radius update, unlike patches).
+    """
+    Z = vox.size_z_cells
+    if not region_supported(vox, cam, ny, nx):
+        raise ValueError(
+            f"voxel region kernel unsupported: pitch="
+            f"{cam.mount_pitch_rad}, H={cam.height_px}, Z={Z}, "
+            f"ny*nx={ny * nx} % {COLS}")
+    B = depths_b.shape[0]
+    origins = jnp.stack(
+        [jnp.broadcast_to(jnp.asarray(y0, jnp.int32), (max(B, 1),)),
+         jnp.zeros((max(B, 1),), jnp.int32)], axis=1)
+    return _summed_delta(vox, cam, depths_b, poses_b, origins, ny, nx)
 
 
 def window_fits(vox: VoxelConfig, poses_b: Array, origin_yx: Array) -> Array:

@@ -72,26 +72,48 @@ def make_voxel_fuse_step(vox: VoxelConfig, cam: DepthCamConfig,
     n_space = mesh.shape["space"]
     slab_rows = vox.size_y_cells // n_space
 
+    # Engine choice (static, at trace time): on TPU the Pallas region
+    # kernel (ops/voxel_kernel.region_delta — the patch kernel's
+    # factorized gather over this device's whole Y slab); elsewhere the
+    # parity-tested XLA classify. Same per-image delta either way, so
+    # the 'space'-collective-free property is engine-independent.
+    # Gate = platform policy + the SLAB's own support predicate — NOT
+    # voxel._use_pallas, whose patch-shape constraint is irrelevant here
+    # and would silently fall back for slab-supported configs.
+    from jax_mapping.ops.grid import _use_pallas as _grid_use_pallas
+    use_kernel = _grid_use_pallas()
+    if use_kernel:
+        from jax_mapping.ops import voxel_kernel as VKK
+        use_kernel = VKK.region_supported(vox, cam, slab_rows,
+                                          vox.size_x_cells)
+
     def _local(grid_slab: Array, depths: Array, poses: Array) -> Array:
         # Which rows this device owns.
         y0 = jax.lax.axis_index("space").astype(jnp.int32) * slab_rows
 
-        def one(depth, pose):
-            pos, R = V.camera_pose(pose[0], pose[1], pose[2], cam)
-            return V.classify_region(vox, cam, depth, pos, R,
-                                     y0, jnp.int32(0),
+        if use_kernel:
+            # Accumulates over the LOCAL (fleet-sharded) batch; already
+            # fleet-varying (derived from the sharded depths), so the
+            # psum below merges batch shards exactly like the XLA scan.
+            delta = VKK.region_delta(vox, cam, depths, poses, y0,
                                      slab_rows, vox.size_x_cells)
+        else:
+            def one(depth, pose):
+                pos, R = V.camera_pose(pose[0], pose[1], pose[2], cam)
+                return V.classify_region(vox, cam, depth, pos, R,
+                                         y0, jnp.int32(0),
+                                         slab_rows, vox.size_x_cells)
 
-        def body(acc, dp):
-            return acc + one(*dp), None
-        # The accumulator varies over 'fleet' (it sums fleet-sharded
-        # images); the grid slab does not — mark the init accordingly or
-        # shard_map rejects the scan carry. Unconditional (a size-1
-        # 'fleet' axis still tags in_specs values as fleet-varying), and
-        # the matching psum is a no-op at size 1.
-        init = jax.lax.pcast(jnp.zeros_like(grid_slab), ("fleet",),
-                             to="varying")
-        delta, _ = jax.lax.scan(body, init, (depths, poses))
+            def body(acc, dp):
+                return acc + one(*dp), None
+            # The accumulator varies over 'fleet' (it sums fleet-sharded
+            # images); the grid slab does not — mark the init accordingly
+            # or shard_map rejects the scan carry. Unconditional (a
+            # size-1 'fleet' axis still tags in_specs values as
+            # fleet-varying), and the matching psum is a no-op at size 1.
+            init = jax.lax.pcast(jnp.zeros_like(grid_slab), ("fleet",),
+                                 to="varying")
+            delta, _ = jax.lax.scan(body, init, (depths, poses))
         delta = jax.lax.psum(delta, "fleet")
         return jnp.clip(grid_slab + delta, vox.logodds_min, vox.logodds_max)
 
