@@ -180,12 +180,14 @@ class MapApiServer:
         name = os.path.basename(q.get("name", ["slam_state"])[0]) or \
             "slam_state"
         fp = os.path.join(self.checkpoint_dir, name + ".npz")
-        if name.endswith(".voxel"):
-            # Reserved: checkpoint "x"'s 3D sidecar lives at "x.voxel.npz";
-            # a checkpoint NAMED "x.voxel" would collide with it.
+        if name.endswith((".voxel", ".voxelkf")):
+            # Reserved: checkpoint "x"'s 3D sidecars live at
+            # "x.voxel.npz" / "x.voxelkf.npz"; a checkpoint NAMED with
+            # either suffix would collide with them.
             return 400, "application/json", json.dumps(
-                {"error": "checkpoint names ending in '.voxel' are "
-                          "reserved for 3D sidecars"}).encode()
+                {"error": "checkpoint names ending in '.voxel' or "
+                          "'.voxelkf' are reserved for 3D sidecars"}
+            ).encode()
         if route == "/save":
             os.makedirs(self.checkpoint_dir, exist_ok=True)
             states = self.mapper.snapshot_states()
@@ -193,10 +195,17 @@ class MapApiServer:
                             config_json=self.mapper.cfg.to_json())
             body = {"status": "saved", "path": fp, "robots": len(states)}
             if self.voxel_mapper is not None:
-                from jax_mapping.io.checkpoint import save_voxel_sidecar
+                from jax_mapping.io.checkpoint import (
+                    save_keyframe_sidecar, save_voxel_sidecar)
                 try:
                     body["voxel_path"] = save_voxel_sidecar(
                         fp, self.voxel_mapper.snapshot_grid(),
+                        config_json=self.mapper.cfg.to_json())
+                    # Keyframe ring alongside, so post-/load closures can
+                    # still repair the 3D map (the 2D scan ring's
+                    # persistence, in 3D).
+                    body["keyframe_path"] = save_keyframe_sidecar(
+                        fp, self.voxel_mapper.snapshot_keyframes(),
                         config_json=self.mapper.cfg.to_json())
                 except ValueError as e:
                     body["voxel_error"] = str(e)
@@ -218,13 +227,19 @@ class MapApiServer:
         # state: a bad sidecar must 409 with everything untouched, not
         # leave the server half-restored.
         vgrid = None
+        vkf = None
         if self.voxel_mapper is not None:
-            from jax_mapping.io.checkpoint import (load_voxel_sidecar,
+            from jax_mapping.io.checkpoint import (load_keyframe_sidecar,
+                                                   load_voxel_sidecar,
                                                    voxel_sidecar_path)
             try:
                 vgrid = load_voxel_sidecar(
                     fp, self.voxel_mapper.snapshot_grid(),
                     running_config_json=self.mapper.cfg.to_json())
+                vkf = load_keyframe_sidecar(
+                    fp, running_config_json=self.mapper.cfg.to_json())
+                if vkf is not None:
+                    self.voxel_mapper.validate_keyframes(vkf)
             except ValueError as e:
                 return 409, "application/json", json.dumps(
                     {"error": f"voxel sidecar: {e}"}).encode()
@@ -235,6 +250,12 @@ class MapApiServer:
         if vgrid is not None:
             self.voxel_mapper.restore_grid(vgrid)
             body["voxel_path"] = voxel_sidecar_path(fp)
+            if vkf is not None:
+                # AFTER restore_grid (which clears the ring) and AFTER
+                # restore_states (generations bumped): the graphs these
+                # keyframes anchor to are exactly the restored ones.
+                self.voxel_mapper.restore_keyframes(vkf)
+                body["keyframes_restored"] = int(len(vkf["robot"]))
         return 200, "application/json", json.dumps(body).encode()
 
     def _map_image(self) -> Tuple[int, str, bytes]:

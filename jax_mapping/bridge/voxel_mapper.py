@@ -368,6 +368,76 @@ class VoxelMapperNode(Node):
         device state; counters are telemetry)."""
         return self.voxel_grid()
 
+    def snapshot_keyframes(self) -> dict:
+        """The depth-keyframe ring as flat arrays for the .voxelkf
+        checkpoint sidecar (io/checkpoint.save_keyframe_sidecar). State
+        generations are process-local and deliberately NOT serialized —
+        restore_keyframes re-tags with the live generation."""
+        with self._lock:
+            kfs = [(i, kf) for i in range(self.n_robots)
+                   for kf in self._keyframes[i]]
+        H, W = self.cfg.depthcam.height_px, self.cfg.depthcam.width_px
+        return {
+            "depths": (np.stack([kf.depth for _, kf in kfs])
+                       if kfs else np.zeros((0, H, W), np.float32)),
+            "rels": np.asarray([kf.rel for _, kf in kfs],
+                               np.float32).reshape(len(kfs), 3),
+            "node_idx": np.asarray([kf.node_idx for _, kf in kfs],
+                                   np.int32),
+            "thins": np.asarray([kf.thins for _, kf in kfs], np.int32),
+            "robot": np.asarray([i for i, _ in kfs], np.int32),
+        }
+
+    def validate_keyframes(self, kf: dict) -> None:
+        """Raise ValueError if a keyframe sidecar cannot be restored into
+        THIS node (shape/robot-range drift). Split out so /load can
+        validate BEFORE any restore mutates live state (the handler's
+        409-with-everything-untouched contract)."""
+        H, W = self.cfg.depthcam.height_px, self.cfg.depthcam.width_px
+        depths = np.asarray(kf["depths"], np.float32)
+        if depths.ndim != 3 or depths.shape[1:] != (H, W):
+            raise ValueError(
+                f"keyframe depths shape {depths.shape} != (K, {H}, {W})")
+        robots = np.asarray(kf["robot"], np.int32)
+        if len(robots) != len(depths):
+            raise ValueError(
+                f"keyframe arrays disagree: {len(robots)} robot ids vs "
+                f"{len(depths)} depths")
+        if len(robots) and (robots.min() < 0
+                            or robots.max() >= self.n_robots):
+            raise ValueError(
+                f"keyframe robot ids outside 0..{self.n_robots - 1}")
+
+    def restore_keyframes(self, kf: dict) -> None:
+        """Repopulate the ring from a keyframe sidecar — valid ONLY
+        alongside a graph-preserving state restore (HTTP /load): the
+        node anchors refer to the checkpointed graphs. Re-anchored
+        resumes (demo --resume with fresh chains) must NOT call this;
+        their rings stay empty (restore_grid clears them). Keyframes are
+        tagged with each robot's LIVE state generation so later
+        /initialpose resets still invalidate them."""
+        self.validate_keyframes(kf)
+        depths = np.asarray(kf["depths"], np.float32)
+        robots = np.asarray(kf["robot"], np.int32)
+        gens = [self.mapper.graph_snapshot(i)[0] if self.mapper is not None
+                else 0 for i in range(self.n_robots)]
+        rings: List[List[_Keyframe]] = [[] for _ in range(self.n_robots)]
+        for k in range(len(robots)):
+            i = int(robots[k])
+            rings[i].append(_Keyframe(
+                depth=depths[k],
+                rel=np.asarray(kf["rels"][k], np.float32),
+                node_idx=int(kf["node_idx"][k]),
+                thins=int(kf["thins"][k]),
+                gen=gens[i]))
+        with self._lock:
+            self._keyframes = rings
+        # Fresh gating + thin replicas: thins_at() re-simulates from the
+        # restored n_keyscans deterministically on next use.
+        self._last_kf_pose = [None] * self.n_robots
+        self._thin_sim = [_ThinSim(self.cfg.loop.max_poses)
+                          for _ in range(self.n_robots)]
+
     def restore_grid(self, grid) -> None:
         g = self._jnp.asarray(grid)
         want = (self.cfg.voxel.size_z_cells, self.cfg.voxel.size_y_cells,

@@ -356,11 +356,19 @@ def main(argv=None) -> int:
             print(f"checkpoint written to {args.save_final}",
                   file=sys.stderr)
             if stack.voxel_mapper is not None:
-                from jax_mapping.io.checkpoint import save_voxel_sidecar
+                from jax_mapping.io.checkpoint import (
+                    save_keyframe_sidecar, save_voxel_sidecar)
                 try:
                     vp = save_voxel_sidecar(
                         args.save_final,
                         stack.voxel_mapper.snapshot_grid(),
+                        config_json=cfg.to_json())
+                    # Keyframe ring too: demo --resume re-anchors (fresh
+                    # chains) and ignores it, but HTTP /load of the same
+                    # file restores post-load closure repair from it.
+                    save_keyframe_sidecar(
+                        args.save_final,
+                        stack.voxel_mapper.snapshot_keyframes(),
                         config_json=cfg.to_json())
                     print(f"3D voxel checkpoint written to {vp}",
                           file=sys.stderr)

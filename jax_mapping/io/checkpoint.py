@@ -155,6 +155,91 @@ def load_voxel_sidecar(path: str, template_grid: Any,
     return state["grid"]
 
 
+def keyframe_sidecar_path(path: str) -> str:
+    """Sidecar for the 3D depth-keyframe ring next to a 2D checkpoint.
+
+    Ships separately from the voxel-grid sidecar because its arrays are
+    VARIABLE-length (K keyframes) — the template-checked checkpoint
+    format pins leaf counts and shapes, and folding the ring into the
+    grid sidecar would refuse every pre-round-5 sidecar on load."""
+    root, ext = os.path.splitext(path)
+    return root + ".voxelkf" + (ext or ".npz")
+
+
+_KF_KEYS = ("depths", "rels", "node_idx", "thins", "robot")
+
+
+def save_keyframe_sidecar(path: str, kf: dict,
+                          config_json: Optional[str] = None) -> str:
+    """Write the keyframe ring (`voxel_mapper.snapshot_keyframes()`
+    dict) as `path`'s .voxelkf sidecar; returns the sidecar path."""
+    missing = [k for k in _KF_KEYS if k not in kf]
+    if missing:
+        raise ValueError(f"keyframe snapshot missing keys {missing}")
+    kp = keyframe_sidecar_path(path)
+    if os.path.exists(kp) and not _is_keyframe_sidecar(kp):
+        # Same refuse-to-clobber class as save_voxel_sidecar: a
+        # checkpoint NAMED "x.voxelkf" collides with checkpoint "x"'s
+        # keyframe sidecar, and silent data loss is worse than an error.
+        raise ValueError(
+            f"{kp} exists and is not a keyframe sidecar (a checkpoint "
+            f"named with the reserved '.voxelkf' suffix?); refusing to "
+            f"overwrite")
+    meta = {"config": config_json, "version": 1, "kind": "voxel_keyframes"}
+    arrays = {k: np.asarray(kf[k]) for k in _KF_KEYS}
+    arrays[_META_KEY] = np.frombuffer(json.dumps(meta).encode(), np.uint8)
+    tmp = kp + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    os.replace(tmp, kp)
+    return kp
+
+
+def load_keyframe_sidecar(path: str,
+                          running_config_json: Optional[str] = None):
+    """Load `path`'s keyframe ring, or None when no sidecar exists
+    (pre-round-5 checkpoints: the ring simply starts empty, exactly the
+    pre-persistence behavior). Raises ValueError on a wrong-kind file or
+    config drift."""
+    kp = keyframe_sidecar_path(path)
+    if not os.path.exists(kp):
+        return None
+    with np.load(kp) as z:
+        try:
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        except Exception:
+            meta = {}
+        if meta.get("kind") != "voxel_keyframes":
+            raise ValueError(
+                f"{kp} is not a voxel keyframe sidecar; refusing to load")
+        if running_config_json is not None and \
+                meta.get("config") is not None:
+            from jax_mapping.config import configs_equivalent
+            if not configs_equivalent(meta["config"], running_config_json):
+                raise ValueError(
+                    "keyframe sidecar config differs from the running "
+                    "config")
+        absent = [k for k in _KF_KEYS if k not in z.files]
+        if absent:
+            raise ValueError(
+                f"keyframe sidecar {kp} missing arrays {absent}")
+        out = {k: z[k] for k in _KF_KEYS}
+    lens = {k: len(out[k]) for k in _KF_KEYS}
+    if len(set(lens.values())) != 1:
+        raise ValueError(
+            f"keyframe sidecar arrays disagree on length: {lens}")
+    return out
+
+
+def _is_keyframe_sidecar(kp: str) -> bool:
+    try:
+        with np.load(kp) as z:
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        return meta.get("kind") == "voxel_keyframes"
+    except Exception:
+        return False
+
+
 def _is_voxel_sidecar(vp: str) -> bool:
     try:
         with np.load(vp) as z:

@@ -159,3 +159,48 @@ def test_trace_message_fidelity(tmp_path):
     assert msgs["b"].twist.angular_z == pytest.approx(-0.5)
     assert msgs["c"].info.origin.x == pytest.approx(-1)
     np.testing.assert_array_equal(msgs["c"].data, grid.data)
+
+
+def test_keyframe_sidecar_guards(tmp_path):
+    """Review r5: the .voxelkf saver refuses to clobber a non-sidecar
+    file at the colliding name, and the loader turns structural damage
+    (missing arrays, mismatched lengths) into ValueError — the type the
+    HTTP /load handler maps to 409."""
+    import numpy as np
+    import pytest
+
+    from jax_mapping.io.checkpoint import (keyframe_sidecar_path,
+                                           load_keyframe_sidecar,
+                                           save_checkpoint,
+                                           save_keyframe_sidecar)
+
+    base = str(tmp_path / "ck.npz")
+    kf = {"depths": np.zeros((2, 4, 5), np.float32),
+          "rels": np.zeros((2, 3), np.float32),
+          "node_idx": np.zeros(2, np.int32),
+          "thins": np.zeros(2, np.int32),
+          "robot": np.zeros(2, np.int32)}
+
+    # A REAL checkpoint parked at the sidecar's path must not be
+    # silently overwritten.
+    save_checkpoint(keyframe_sidecar_path(base), {"grid": np.ones(3)})
+    with pytest.raises(ValueError, match="refusing to overwrite"):
+        save_keyframe_sidecar(base, kf)
+    import os
+    os.remove(keyframe_sidecar_path(base))
+
+    save_keyframe_sidecar(base, kf)
+    got = load_keyframe_sidecar(base)
+    np.testing.assert_array_equal(got["depths"], kf["depths"])
+
+    # Wrong-kind file at the sidecar path -> ValueError, not KeyError.
+    save_checkpoint(keyframe_sidecar_path(base), {"grid": np.ones(3)})
+    with pytest.raises(ValueError, match="not a voxel keyframe"):
+        load_keyframe_sidecar(base)
+
+    # Length disagreement -> ValueError.
+    bad = dict(kf, robot=np.zeros(3, np.int32))
+    os.remove(keyframe_sidecar_path(base))
+    save_keyframe_sidecar(base, bad)
+    with pytest.raises(ValueError, match="disagree on length"):
+        load_keyframe_sidecar(base)
