@@ -148,6 +148,18 @@ class MapApiServer:
                 json.dumps({"status": "exploration stopped"}).encode()
         if route == "/status":
             body = self.brain.status() if self.brain is not None else {}
+            if self.mapper is not None:
+                # Mapping-pipeline health alongside the brain's motion
+                # fields — from the attached nodes directly, so every
+                # stack with a mapper (sim, ros, rosbag-replay) gets the
+                # operator's one-glance health check.
+                body["n_scans_fused"] = self.mapper.n_scans_fused
+                body["n_loops_closed"] = self.mapper.n_loops_closed
+            if self.voxel_mapper is not None:
+                body["n_images_fused"] = self.voxel_mapper.n_images_fused
+                body["n_depth_keyframes"] = \
+                    self.voxel_mapper.n_keyframes_stored
+                body["n_voxel_refuses"] = self.voxel_mapper.n_refuses
             if self.extra_status is not None:
                 body.update(self.extra_status())
             return 200, "application/json", json.dumps(body).encode()

@@ -421,3 +421,30 @@ def test_goal_behind_wall_shield_wins(tiny_cfg):
             "unreachable goal reported reached"
     finally:
         st.shutdown()
+
+
+def test_status_exposes_mapping_health(tiny_cfg):
+    """/status carries the mapping pipeline's counters (scans fused,
+    loops closed, 3D images/keyframes/refuses) alongside the brain's
+    motion fields — the operator's one-glance health check."""
+    import json as _json
+    import urllib.request
+
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    world = W.plank_course(96, tiny_cfg.grid.resolution_m, n_planks=4,
+                           seed=4)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=1, http_port=0,
+                          seed=4, depth_cam=True)
+    try:
+        st.brain.start_exploring()
+        st.run_steps(8)
+        body = _json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{st.api.port}/status").read())
+        assert body["n_scans_fused"] == st.mapper.n_scans_fused > 0
+        assert body["n_loops_closed"] == st.mapper.n_loops_closed
+        assert body["n_images_fused"] == st.voxel_mapper.n_images_fused > 0
+        assert "n_depth_keyframes" in body and "n_voxel_refuses" in body
+    finally:
+        st.shutdown()
