@@ -296,3 +296,93 @@ def test_old_checkpoints_without_keyframe_sidecar_load(tiny_cfg, tmp_path):
         assert sum(len(r) for r in st.voxel_mapper._keyframes) == 0
     finally:
         st.shutdown()
+
+
+def _tiny_ring_cfg(tiny_cfg, cap=8):
+    """Tiny 8-slot ring + key-every-step gating: thinning fires within a
+    short straight drive (shared by the thin-replica and remap tests so
+    both exercise the SAME schedule)."""
+    return dataclasses.replace(
+        tiny_cfg,
+        loop=dataclasses.replace(tiny_cfg.loop, max_poses=cap,
+                                 max_edges=64),
+        matcher=dataclasses.replace(tiny_cfg.matcher, min_travel_m=0.01,
+                                    min_heading_rad=3.0))
+
+
+def _drive_straight_step(cfg, pubs, step):
+    """Publish one tick of the straight drive (odom + scan, optionally a
+    flat-wall depth image at 0.6 m)."""
+    t = 0.1 * step
+    odom_pub, scan_pub, depth_pub = pubs
+    odom_pub.publish(Odometry(header=Header(stamp=t, frame_id="odom"),
+                              pose=Pose2D(0.02 * step, 0.0, 0.0),
+                              twist=Twist()))
+    scan_pub.publish(LaserScan(
+        header=Header(stamp=t, frame_id="base_laser"),
+        angle_increment=cfg.scan.angle_increment_rad,
+        ranges=np.full(cfg.scan.n_beams, 1.0, np.float32)))
+    if depth_pub is not None:
+        cam = cfg.depthcam
+        depth_pub.publish(DepthImage(
+            header=Header(stamp=t, frame_id="base_camera"),
+            depth=np.full((cam.height_px, cam.width_px), 0.6,
+                          np.float32)))
+
+
+def test_thin_replica_tracks_real_graph(tiny_cfg):
+    """_ThinSim must reproduce the REAL graph's node count after every
+    key add — the invariant the keyframe remap (idx >> dthins) rests on.
+    Drive enough keys through a tiny 8-slot ring that thinning fires
+    repeatedly and check the replica never diverges."""
+    from jax_mapping.bridge.voxel_mapper import _ThinSim
+
+    cap = 8
+    cfg = _tiny_ring_cfg(tiny_cfg, cap)
+    bus = Bus()
+    mapper = MapperNode(cfg, bus, n_robots=1)
+    pubs = (bus.publisher("odom"), bus.publisher("scan"), None)
+    sim = _ThinSim(cap)
+    for step in range(1, 25):
+        _drive_straight_step(cfg, pubs, step)
+        mapper.tick()
+        st = mapper.states[0]
+        k = int(st.n_keyscans)
+        sim.thins_at(k)      # advance the replica to the real counter
+        assert sim.n == int(st.graph.n_poses), (
+            f"replica diverged at step {step}: sim n={sim.n} vs graph "
+            f"n_poses={int(st.graph.n_poses)} (k={k})")
+    assert int(mapper.states[0].n_keyscans) > cap, \
+        "staging: ring never saturated"
+    assert sim.t >= 1, "staging: no thin ever fired"
+
+
+def test_keyframes_survive_graph_thinning(tiny_cfg):
+    """Keyframes captured BEFORE a graph thin must re-anchor to the
+    surviving even node (idx >> dthins) and still rebuild the 3D map on
+    re-fuse — not dangle or vanish. Same drive schedule as the replica
+    test (shared helpers)."""
+    cap = 8
+    cfg = _tiny_ring_cfg(tiny_cfg, cap)
+    bus = Bus()
+    mapper = MapperNode(cfg, bus, n_robots=1)
+    voxel = VoxelMapperNode(cfg, bus, n_robots=1, mapper=mapper)
+    pubs = (bus.publisher("odom"), bus.publisher("scan"),
+            bus.publisher("depth"))
+    kfs_before_thin = 0
+    for step in range(1, 25):
+        _drive_straight_step(cfg, pubs, step)
+        mapper.tick()
+        voxel.tick()
+        if int(mapper.states[0].n_keyscans) == cap:
+            kfs_before_thin = sum(len(x) for x in voxel._keyframes)
+    assert int(mapper.states[0].n_keyscans) > cap, "ring never saturated"
+    assert kfs_before_thin > 0, "no keyframes captured before the thin"
+    n_kf = sum(len(x) for x in voxel._keyframes)
+    voxel._refuse_from_keyframes()
+    assert voxel.n_refuses == 1
+    # Every keyframe remapped onto a live node: none dropped for a
+    # dangling index, and the rebuilt map carries wall evidence.
+    assert sum(len(x) for x in voxel._keyframes) == n_kf
+    g = np.asarray(voxel.voxel_grid())
+    assert (g > 0).sum() > 0, "re-fuse after thinning lost the wall"
