@@ -15,9 +15,12 @@ driver budget no matter what the toolchain does. Three guards:
 
   1. Backend probe in a BOUNDED SUBPROCESS before anything compiles — the
      TPU tunnel in this image can hang backend init indefinitely (even
-     `jax.devices()`), which no in-process deadline can interrupt. If the
-     probe fails, re-exec once onto scrubbed virtual-CPU before burning
-     any compile time, and say so in the JSON ("platform" field).
+     `jax.devices()`), which no in-process deadline can interrupt; round 5
+     also saw a half-wedged state where enumeration answers in ~1 s but
+     every compile RPC blocks, so the probe jit-compiles a scalar too
+     (utils/backend_guard.py). If the probe fails, re-exec once onto
+     scrubbed virtual-CPU before burning any compile time, and say so in
+     the JSON ("platform" field).
   2. A watchdog thread with a hard deadline (JAX_MAPPING_BENCH_DEADLINE_S,
      default 540 s) that prints whatever sections completed and exits —
      partial data over rc 124.
@@ -113,7 +116,8 @@ def _probe_backend() -> bool:
 def main() -> None:
     if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") != "1" \
             and not _probe_backend():
-        print(f"bench: backend init did not finish in {PROBE_TIMEOUT_S:.0f}s "
+        print("bench: backend init/compile probe did not finish in "
+              f"{PROBE_TIMEOUT_S:.0f}s "
               "(wedged TPU tunnel?); falling back to virtual CPU",
               file=sys.stderr, flush=True)
         env = _scrub_cpu_env()

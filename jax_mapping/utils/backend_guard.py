@@ -13,7 +13,9 @@ copies of the same defence.
 This module is that defence, shared (VERDICT r3 item 2: "shared helper,
 not a third copy"):
 
-  1. `backend_probe_ok()` — run `jax.devices()` in a BOUNDED subprocess.
+  1. `backend_probe_ok()` — run `jax.devices()` + one tiny jit compile
+     in a BOUNDED subprocess (the compile matters: a half-wedged tunnel
+     can enumerate devices instantly yet hang every compile RPC).
   2. `scrubbed_cpu_env()` — the ambient env minus every axon/TPU hook,
      pinned to the virtual CPU backend.
   3. `ensure_responsive_backend()` — probe, and if the backend cannot
@@ -43,6 +45,15 @@ def probe_timeout_s() -> float:
     return float(os.environ.get("JAX_MAPPING_PROBE_S", "120"))
 
 
+# Child code for backend_probe_ok, module-level so tests can pin the
+# actual probe contents (not prose around them): must both enumerate
+# devices AND compile+fetch through the backend.
+_PROBE_CODE = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+               "v = jax.jit(lambda x: x + 1)(jnp.float32(1)); "
+               "v.block_until_ready(); "
+               "print(d[0].platform, len(d), float(v), flush=True)")
+
+
 def backend_env_suspect() -> bool:
     """Is the wedge-capable plugin active in this environment at all?
 
@@ -59,16 +70,22 @@ def backend_env_suspect() -> bool:
 
 
 def backend_probe_ok(timeout_s: float | None = None) -> bool:
-    """Can this environment's default jax backend initialise promptly?
+    """Can this environment's default jax backend initialise AND compile
+    promptly?
 
-    Runs `jax.devices()` in a bounded subprocess — the wedged tunnel
-    hangs backend init in ways no in-process deadline can interrupt.
+    Runs `jax.devices()` plus one trivial jit compile in a bounded
+    subprocess — the wedged tunnel hangs in ways no in-process deadline
+    can interrupt. The compile step is load-bearing: round 5 observed a
+    half-wedged tunnel state where device enumeration returns in ~1 s but
+    every compile RPC (even a scalar add) blocks >5 min — an
+    enumeration-only probe passes and the entry point then hangs at its
+    first jit. A healthy remote tunnel compiles the scalar probe in
+    seconds, well inside the default 120 s budget.
     """
-    code = ("import jax; d = jax.devices(); "
-            "print(d[0].platform, len(d), flush=True)")
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
+            [sys.executable, "-c", _PROBE_CODE], capture_output=True,
+            text=True,
             timeout=timeout_s if timeout_s is not None else probe_timeout_s())
     except subprocess.TimeoutExpired:
         return False
@@ -117,7 +134,7 @@ def ensure_responsive_backend(entry: str,
         return
     if backend_probe_ok():
         return
-    print(f"{entry}: jax backend init did not finish in "
+    print(f"{entry}: jax backend init/compile probe did not finish in "
           f"{probe_timeout_s():.0f}s (wedged TPU tunnel?); restarting on "
           "virtual CPU", file=sys.stderr, flush=True)
     cmd = [sys.executable] + (argv if argv is not None else sys.argv)

@@ -92,6 +92,19 @@ def test_ensure_proceeds_on_healthy_probe():
 
 
 def test_probe_ok_real_subprocess():
-    """The probe really runs jax.devices() in a child; on this test env
-    (scrubbed CPU) it must succeed well inside the timeout."""
+    """The probe really runs jax.devices() + a jit compile in a child; on
+    this test env (scrubbed CPU) it must succeed well inside the timeout."""
     assert BG.backend_probe_ok(timeout_s=120)
+
+
+def test_probe_compiles_not_just_enumerates():
+    """Round-5 regression pin: a half-wedged tunnel answers jax.devices()
+    in ~1 s but blocks every compile RPC >5 min, so an enumeration-only
+    probe waves the entry point through to a hang at its first jit. The
+    probe's child code must therefore jit-compile and block on a result,
+    not merely enumerate. Pinned on the actual child source
+    (BG._PROBE_CODE, what subprocess.run executes — not prose around it)
+    alongside the behavioral CPU run above."""
+    assert "jax.jit" in BG._PROBE_CODE
+    assert "block_until_ready" in BG._PROBE_CODE
+    assert "jax.devices()" in BG._PROBE_CODE
