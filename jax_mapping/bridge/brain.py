@@ -122,6 +122,13 @@ class ThymioBrain(Node):
         self._nav_goal: Optional[tuple] = None
         self.goal_reached_dist_m = 0.15
         self.create_subscription("/goal_pose", self._goal_cb)
+        # Planner waypoint (bridge/planner.py): while fresh, reachable,
+        # and computed FOR the current goal, the brain steers at this
+        # instead of the raw goal — map-aware navigation around walls.
+        # Stale/absent waypoint (planner not launched, goal unreachable)
+        # keeps the round-4 straight-line seek under the shield.
+        self._waypoint = None
+        self.create_subscription("/goal_waypoint", self._waypoint_cb)
 
         # Boot connect, offline mode on failure (pi variant semantics).
         self.link_up = connect_with_retries(
@@ -150,6 +157,44 @@ class ThymioBrain(Node):
             self._nav_goal = (float(msg.x), float(msg.y))
         self._log(f"navigation goal set: ({msg.x:.2f}, {msg.y:.2f}) — "
                   "engages while exploring")
+
+    def _waypoint_cb(self, msg) -> None:
+        with self._state_lock:
+            self._waypoint = (msg, self.n_ticks)
+
+    def nav_goal(self) -> Optional[tuple]:
+        """Current navigation goal (planner reads the brain's copy so a
+        reached-and-cleared goal stops replanning)."""
+        with self._state_lock:
+            return self._nav_goal
+
+    def robot_pose(self, i: int) -> np.ndarray:
+        with self._state_lock:
+            return self.poses[i].copy()
+
+    def _steer_target(self, goal: tuple) -> tuple:
+        """The point robot 0 steers at for `goal`: the planner's lookahead
+        waypoint when fresh + reachable + computed for THIS goal, else the
+        goal itself. Freshness is measured in CONTROL TICKS, not wall
+        time: faster-than-realtime stacks (Stack.run_steps, demo) replan
+        every period_s of simulated control time, and a wall-clock TTL
+        would silently expire every waypoint on a host where a replan
+        window of sim steps takes longer than the TTL to execute —
+        host-speed-dependent trajectories in the deterministic path."""
+        with self._state_lock:
+            entry = self._waypoint
+        if entry is None:
+            return goal
+        wp, at_tick = entry
+        if not wp.reachable:
+            return goal
+        ttl_ticks = (self.cfg.planner.waypoint_ttl_s
+                     * self.cfg.robot.control_rate_hz)
+        if self.n_ticks - at_tick > ttl_ticks:
+            return goal
+        if np.hypot(wp.goal_x - goal[0], wp.goal_y - goal[1]) > 1e-3:
+            return goal                      # plan for a superseded goal
+        return (wp.x, wp.y)
 
     def _manual_targets(self, now: float):
         """Fresh `/cmd_vel` while not exploring -> (left, right) wheel
@@ -269,7 +314,7 @@ class ThymioBrain(Node):
                         self._nav_goal = None
                     self._log("navigation goal reached")
                 else:
-                    goals_xy[0] = goal
+                    goals_xy[0] = self._steer_target(goal)
                     goal_valid[0] = True
 
             new_poses, twists, targets, leds, _ = brain_tick(

@@ -64,11 +64,12 @@ class MapApiServer:
                  port: int = 5000, png_cache_s: float = 1.0,
                  extra_status: Optional[Callable[[], dict]] = None,
                  mapper=None, checkpoint_dir: str = "checkpoints",
-                 voxel_mapper=None):
+                 voxel_mapper=None, planner=None):
         self.bus = bus
         self.brain = brain
         self.mapper = mapper
         self.voxel_mapper = voxel_mapper
+        self.planner = planner
         self.checkpoint_dir = checkpoint_dir
         self.png_cache_s = png_cache_s
         self.extra_status = extra_status
@@ -160,6 +161,9 @@ class MapApiServer:
                 body["n_depth_keyframes"] = \
                     self.voxel_mapper.n_keyframes_stored
                 body["n_voxel_refuses"] = self.voxel_mapper.n_refuses
+            if self.planner is not None:
+                body["n_plans"] = self.planner.n_plans
+                body["plan_reachable"] = self.planner.last_reachable
             if self.extra_status is not None:
                 body.update(self.extra_status())
             return 200, "application/json", json.dumps(body).encode()

@@ -48,16 +48,27 @@ class Stack:
     api: Optional[MapApiServer]
     executor: Executor
     voxel_mapper: Optional[object] = None    # VoxelMapperNode when depth_cam
+    planner: Optional[object] = None         # PlannerNode when cfg.planner.enabled
+    _steps_run: int = 0
 
     def run_steps(self, n: int) -> None:
         """Faster-than-realtime: drive physics+brain+mapper loops directly,
-        n sensor ticks (realtime=False stacks only)."""
+        n sensor ticks (realtime=False stacks only). The planner keeps its
+        real cadence RATIO (one plan per period_s of simulated control
+        time), not wall time — deterministic stepping must replan exactly
+        as often as the realtime executor would."""
+        steps_per_plan = max(1, round(self.cfg.planner.period_s
+                                      * self.cfg.robot.control_rate_hz))
         for _ in range(n):
             self.sim.step()
             self.brain.update_loop()
             self.mapper.tick()
             if self.voxel_mapper is not None:
                 self.voxel_mapper.tick()
+            self._steps_run += 1
+            if self.planner is not None \
+                    and self._steps_run % steps_per_plan == 0:
+                self.planner.tick()
 
     def shutdown(self) -> None:
         if self.api is not None:
@@ -105,17 +116,24 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
         voxel_mapper = VoxelMapperNode(cfg, bus, tf=tf, n_robots=n_robots,
                                        mapper=mapper)
 
+    planner = None
+    if cfg.planner.enabled:
+        from jax_mapping.bridge.planner import PlannerNode
+        planner = PlannerNode(cfg, bus, mapper=mapper, brain=brain)
+
     api = None
     if http_port is not None:
         api = MapApiServer(bus, brain=brain, port=http_port,
-                           mapper=mapper, voxel_mapper=voxel_mapper)
+                           mapper=mapper, voxel_mapper=voxel_mapper,
+                           planner=planner)
         api.serve_thread()
 
     nodes = [sim, brain, mapper] + \
-        ([voxel_mapper] if voxel_mapper is not None else [])
+        ([voxel_mapper] if voxel_mapper is not None else []) + \
+        ([planner] if planner is not None else [])
     executor = Executor(nodes)
     if realtime:
         executor.spin_thread()
     return Stack(cfg=cfg, bus=bus, tf=tf, driver=driver, sim=sim,
                  brain=brain, mapper=mapper, api=api, executor=executor,
-                 voxel_mapper=voxel_mapper)
+                 voxel_mapper=voxel_mapper, planner=planner)

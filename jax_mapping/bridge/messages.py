@@ -251,6 +251,36 @@ class FrontierArray:
         default_factory=lambda: np.zeros(0, np.int32))
 
 
+@dataclasses.dataclass
+class Path:
+    """`/plan` payload: the global planner's world-frame waypoint list
+    (nav_msgs/Path at the rclpy boundary — the topic Nav2's planners
+    publish; the reference's SetGoal tool had no planner behind it,
+    `server/rviz_config.rviz:193-198`). Empty poses_xy = no plan (goal
+    unreachable or already reached)."""
+
+    header: Header = dataclasses.field(default_factory=Header)
+    poses_xy: np.ndarray = dataclasses.field(            # (L, 2) metres
+        default_factory=lambda: np.zeros((0, 2), np.float32))
+
+
+@dataclasses.dataclass
+class Waypoint:
+    """`/goal_waypoint` payload: the planner's lookahead steering target.
+
+    The brain steers toward (x, y) instead of the raw goal while the
+    message is fresher than PlannerConfig.waypoint_ttl_s and `reachable`;
+    goal_x/goal_y echo the goal the plan was computed FOR, so a steering
+    target from a superseded goal is never applied to a new one."""
+
+    header: Header = dataclasses.field(default_factory=Header)
+    x: float = 0.0
+    y: float = 0.0
+    reachable: bool = False
+    goal_x: float = 0.0
+    goal_y: float = 0.0
+
+
 def occupancy_from_logodds(logodds: np.ndarray, occ_threshold: float,
                            free_threshold: float, resolution: float,
                            origin_xy: Tuple[float, float],

@@ -1,0 +1,124 @@
+"""Planner node: RViz SetGoal -> `/plan` path + steering waypoint.
+
+Closes the navigation loop the reference left open: RViz's SetGoal tool
+published `/goal_pose` with no consumer (Nav2 was future work, report.pdf
+§VI.2; `server/rviz_config.rviz:193-198`). The brain's round-4 goal seek
+steers STRAIGHT at the goal under the reactive shield, so a goal behind a
+wall was only "not crashed into", never reached. This node is the
+Nav2-shaped global planner over the framework's own map:
+
+* On a timer (PlannerConfig.period_s) while a navigation goal is set:
+  snapshot the mapper's shared grid + the robot's SLAM-corrected pose,
+  run `ops.planner.plan_to_goal` (goal-seeded obstacle-aware cost-to-go
+  + greedy descent, one jit), and publish
+    - `/plan`          Path: the world-frame waypoint list (RViz Path
+                       display; nav_msgs/Path at the rclpy boundary),
+    - `/goal_waypoint` Pose2D + reachable flag: the lookahead steering
+                       target the brain prefers over the raw goal while
+                       fresh (PlannerConfig.waypoint_ttl_s).
+* Unreachable goals publish an EMPTY plan with reachable=False — the
+  brain keeps round-4 straight-line-seek-under-shield behavior, and the
+  operator sees the empty path in RViz.
+
+Frames: planning runs in the map frame (the grid's frame). The brain
+steers from its odometry pose toward a map-frame waypoint — the same
+map~odom approximation its round-4 straight-line seek already makes; the
+SLAM correction enters through the planned PATH being anchored to the
+corrected map.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.messages import Header, Path, Waypoint
+from jax_mapping.bridge.node import Node
+from jax_mapping.config import SlamConfig
+from jax_mapping.utils.profiling import global_metrics as M
+
+
+class PlannerNode(Node):
+    """Global planner for the fleet's goal robot (robot 0, the one RViz's
+    SetGoal drives — brain._goal_cb's convention)."""
+
+    def __init__(self, cfg: SlamConfig, bus: Bus, mapper, brain=None,
+                 robot_idx: int = 0):
+        super().__init__("planner", bus)
+        self.cfg = cfg
+        self.mapper = mapper
+        self.brain = brain
+        self.robot_idx = robot_idx
+        self.plan_pub = self.create_publisher("/plan")
+        self.wp_pub = self.create_publisher("/goal_waypoint")
+        # Standalone (no brain reference): track the goal from the topic.
+        # With a brain, the brain owns the goal (set by /goal_pose, cleared
+        # on arrival) and this node reads it, so a reached goal stops
+        # replanning without a second arrival bookkeeper.
+        self._goal: Optional[tuple] = None
+        if brain is None:
+            self.create_subscription("/goal_pose", self._goal_cb)
+        self.n_plans = 0
+        self.last_reachable: Optional[bool] = None
+        self.create_timer(cfg.planner.period_s, self.tick)
+
+    def _goal_cb(self, msg) -> None:
+        self._goal = (float(msg.x), float(msg.y))
+
+    def _current_goal(self) -> Optional[tuple]:
+        if self.brain is not None:
+            return self.brain.nav_goal()
+        return self._goal
+
+    def _robot_pose_xy(self) -> Optional[np.ndarray]:
+        """SLAM-corrected pose when the mapper has stepped; the brain's
+        odometry pose before that (map == odom until the first
+        correction)."""
+        anchor = self.mapper.depth_anchor(self.robot_idx)
+        if anchor is not None:
+            return np.asarray(anchor[1], np.float32)[:2]
+        if self.brain is not None:
+            return self.brain.robot_pose(self.robot_idx)[:2]
+        return None
+
+    def tick(self) -> None:
+        goal = self._current_goal()
+        if goal is None:
+            return
+        pose_xy = self._robot_pose_xy()
+        if pose_xy is None:
+            return
+        import jax.numpy as jnp
+        from jax_mapping.ops import planner as P
+        with M.stages.stage("planner.tick"):
+            r = P.plan_to_goal(self.cfg.planner, self.cfg.frontier,
+                               self.cfg.grid, self.mapper.merged_grid(),
+                               jnp.asarray(np.asarray(goal, np.float32)),
+                               jnp.asarray(pose_xy))
+            valid = np.asarray(r.path_valid)
+            path = np.asarray(r.path_xy)[valid]
+            reachable = bool(r.reachable)
+            wp = np.asarray(r.waypoint_xy, np.float32)
+        if self.brain is None and bool(r.arrived):
+            # Standalone arrival bookkeeping: with a brain the brain
+            # clears the goal (and this node reads its copy); without one
+            # the planner must stop itself or it replans forever.
+            self._goal = None
+            return
+        hdr = Header.now("map")
+        self.plan_pub.publish(Path(header=hdr, poses_xy=path))
+        self.wp_pub.publish(Waypoint(header=hdr, x=float(wp[0]),
+                                     y=float(wp[1]), reachable=reachable,
+                                     goal_x=float(goal[0]),
+                                     goal_y=float(goal[1])))
+        self.n_plans += 1
+        self.last_reachable = reachable
+        M.counters.inc("planner.plans")
+
+    def status(self) -> dict:
+        return {"n_plans": self.n_plans,
+                "last_reachable": self.last_reachable,
+                "goal": self._current_goal()}

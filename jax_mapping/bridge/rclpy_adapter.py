@@ -97,7 +97,7 @@ class RclpyAdapter:
     """
 
     OUTBOUND_DEFAULT = ("map", "map_updates", "pose", "scan", "odom",
-                        "frontiers", "voxel_points")
+                        "frontiers", "voxel_points", "plan")
     INBOUND_DEFAULT = ("cmd_vel", "initialpose", "goal_pose")
 
     def __init__(self, bus: Bus, cfg: SlamConfig,
@@ -180,6 +180,7 @@ class RclpyAdapter:
         "initialpose": "/initialpose", "goal_pose": "/goal_pose",
         "scan": "scan", "odom": "odom",
         "voxel_points": "/voxel_points",
+        "plan": "/plan",
     }
 
     def _wire_outbound(self, topics) -> None:
@@ -219,6 +220,12 @@ class RclpyAdapter:
                                      "/frontiers_markers",
                                      self._ros_qos(depth=1))
             self._bus_to_ros("frontiers", pub, self.frontiers_to_ros_markers)
+        if "plan" in topics:
+            # The global planner's path (bridge/planner.py) on the topic
+            # Nav2's planners use; RViz's Path display reads it.
+            pub = n.create_publisher(nav.Path, "/plan",
+                                     self._ros_qos(depth=1))
+            self._bus_to_ros("plan", pub, self.path_to_ros)
         if "voxel_points" in topics:
             # The 3D voxel map as a point cloud (RViz PointCloud2
             # display) — published only when a voxel mapper runs; the
@@ -335,6 +342,26 @@ class RclpyAdapter:
             ranges=np.asarray(m.ranges, np.float32),
             intensities=np.asarray(m.intensities, np.float32),
         )
+
+    def path_to_ros(self, msg):
+        """Path -> nav_msgs/Path (PoseStamped per waypoint, identity
+        orientation — the plan carries positions; heading comes from the
+        brain's steering, not the path)."""
+        nav, geo, bi = (self._msgs["nav"], self._msgs["geo"],
+                        self._msgs["bi"])
+        out = nav.Path()
+        out.header.stamp = _to_ros_time(bi.Time, msg.header.stamp)
+        out.header.frame_id = msg.header.frame_id or "map"
+        poses = []
+        for x, y in np.asarray(msg.poses_xy, np.float32):
+            ps = geo.PoseStamped()
+            ps.header = out.header
+            ps.pose.position.x = float(x)
+            ps.pose.position.y = float(y)
+            ps.pose.orientation.w = 1.0
+            poses.append(ps)
+        out.poses = poses
+        return out
 
     def voxel_points_to_ros(self, msg):
         """VoxelPoints -> sensor_msgs/PointCloud2 (x/y/z float32, packed

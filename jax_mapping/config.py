@@ -214,6 +214,41 @@ class FrontierConfig:
 
 
 @_frozen
+class PlannerConfig:
+    """Map-aware global path planning for RViz SetGoal navigation.
+
+    The reference shipped the SetGoal tool publishing `/goal_pose` with no
+    consumer (Nav2 was listed as future work, report.pdf §VI.2;
+    `server/rviz_config.rviz:193-198`). Round 4 gave the brain straight-line
+    goal seeking with the reactive shield; this section adds the Nav2-shaped
+    capability behind that same topic: a goal-seeded obstacle-aware
+    cost-to-go field over the live map (ops/planner.py, reusing the frontier
+    machinery's coarsen + min-plus BFS), greedy-descent path extraction, a
+    published `/plan` for RViz, and a lookahead waypoint the brain steers to
+    instead of the raw goal — so a goal behind a wall is navigated around,
+    not just shielded against.
+    """
+
+    enabled: bool = True
+    period_s: float = 1.0             # replan cadence (map moves slowly)
+    # Descent bound, in first-level coarse cells (size/frontier.downsample);
+    # also the static /plan length.
+    max_path_len: int = 256
+    # Waypoint distance along the path, coarse cells. Far enough that the
+    # reactive shield's swerves don't orbit it; near enough that steering
+    # straight at it cannot cut a corner by more than the conservative
+    # coarsening's ~1-cell wall inflation (the shield covers the rest).
+    lookahead_cells: int = 4
+    # Brain falls back to straight-line seek when the freshest waypoint is
+    # older than this (planner dead / not launched — round-4 behavior).
+    waypoint_ttl_s: float = 3.0
+    # Goal-seeded BFS bound, in first-level coarse cells. The field must
+    # reach the robot for the goal to be declared reachable; each bound
+    # unit is one doubled min-plus sweep (radius 2 cells).
+    bfs_iters: int = 512
+
+
+@_frozen
 class VoxelConfig:
     """3D log-odds voxel grid (BASELINE.json configs[4]: "3D voxel grid
     (OctoMap-style) from simulated depth cam").
@@ -332,6 +367,7 @@ class SlamConfig:
     # (cluster work at 4096/(4*4) = 256^2).
     frontier: FrontierConfig = FrontierConfig()
     fleet: FleetConfig = FleetConfig()
+    planner: PlannerConfig = PlannerConfig()
     voxel: VoxelConfig = VoxelConfig()
     depthcam: DepthCamConfig = DepthCamConfig()
     map_publish_period_s: float = 5.0         # slam_config.yaml:25
@@ -357,6 +393,7 @@ class SlamConfig:
             loop=LoopClosureConfig(**raw.get("loop", {})),
             frontier=FrontierConfig(**raw.get("frontier", {})),
             fleet=FleetConfig(**raw.get("fleet", {})),
+            planner=PlannerConfig(**raw.get("planner", {})),
             voxel=VoxelConfig(**raw.get("voxel", {})),
             depthcam=DepthCamConfig(**raw.get("depthcam", {})),
             **{k: v for k, v in raw.items()

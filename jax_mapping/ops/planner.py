@@ -1,0 +1,149 @@
+"""Goal-seeded global path planning on the occupancy grid.
+
+The Nav2-shaped capability behind the reference's unconsumed RViz SetGoal
+tool (`server/rviz_config.rviz:193-198`; Nav2 itself was "future work",
+report.pdf §VI.2): given the live log-odds map and a `/goal_pose`, produce
+a path the robot can follow AROUND obstacles, where the round-4 brain
+could only steer straight at the goal under the reactive shield.
+
+TPU-first design — everything is fixed-shape and jit-compiled:
+
+* The distance field is the frontier machinery's obstacle-aware min-plus
+  BFS (`ops/frontier.cost_to_go`) seeded at the GOAL cell instead of the
+  robot, over the same conservative coarsened passability the frontier
+  costs use (free | frontier | unknown — a planner that refuses to cross
+  unknown space could never reach an exploration target).
+* Path extraction is greedy descent on that field: from the robot's cell,
+  `lax.scan` over a static step bound, each step moving to the argmin of
+  the 3x3 neighbourhood. Min-plus fields are monotone along shortest
+  paths, so descent terminates at the goal (the unique local minimum of
+  its connected component) without any data-dependent control flow.
+* Outputs are static-shape: an (L, 2) world-frame path with a validity
+  mask (the `/plan` message), a single lookahead waypoint for the brain's
+  steering target, and a reachability flag.
+
+The descent runs on the first-level coarse grid (size/downsample, default
+1024^2 at 0.2 m) — planning does not need the 0.05 m rasterization detail,
+and the coarse field is what already fits the <5 ms frontier budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import FrontierConfig, GridConfig, PlannerConfig
+from jax_mapping.ops import frontier as F
+
+Array = jax.Array
+
+
+class PlanResult(NamedTuple):
+    path_xy: Array       # (max_path_len, 2) f32 world coords, goal-padded
+    path_valid: Array    # (max_path_len,) bool — prefix mask of real cells
+    n_steps: Array       # () i32 — valid prefix length
+    reachable: Array     # () bool — the field reached the robot's cell
+    waypoint_xy: Array   # (2,) f32 — lookahead steering target
+    arrived: Array       # () bool — robot's cell IS the goal cell
+
+
+def _world_to_cell(grid_cfg: GridConfig, res: float, xy: Array,
+                   n: int) -> Array:
+    """World (x, y) -> coarse (row, col), clipped into the grid."""
+    ox, oy = grid_cfg.origin_m
+    rc = jnp.stack([(xy[1] - oy) / res, (xy[0] - ox) / res])
+    return jnp.clip(rc.astype(jnp.int32), 0, n - 1)
+
+
+def _cell_to_world(grid_cfg: GridConfig, res: float, rc: Array) -> Array:
+    """Coarse (row, col) cell centre -> world (x, y)."""
+    ox, oy = grid_cfg.origin_m
+    return jnp.stack([(rc[..., 1].astype(jnp.float32) + 0.5) * res + ox,
+                      (rc[..., 0].astype(jnp.float32) + 0.5) * res + oy],
+                     axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def plan_to_goal(pcfg: PlannerConfig, fcfg: FrontierConfig,
+                 grid_cfg: GridConfig, logodds: Array, goal_xy: Array,
+                 start_xy: Array) -> PlanResult:
+    """Plan a coarse-grid path from `start_xy` to `goal_xy` on the map.
+
+    One fused jit: coarsen -> goal-seeded cost-to-go -> greedy descent.
+    Unreachable goals (sealed off, or beyond the bfs_iters radius) come
+    back `reachable=False` with an empty path; the caller keeps round-4
+    behavior (straight-line seek under the shield) in that case.
+    """
+    free, _occ, unknown = F.coarsen(fcfg, grid_cfg, logodds)
+    mask = F.frontier_mask(free, unknown)
+    # Same passability stance as the frontier costs (compute_frontiers_
+    # from_masks): robots may push into unknown space.
+    passable = free | mask | unknown
+    n = passable.shape[0]
+    res = grid_cfg.resolution_m * fcfg.downsample
+
+    goal_rc = _world_to_cell(grid_cfg, res, goal_xy, n)
+    start_rc = _world_to_cell(grid_cfg, res, start_xy, n)
+
+    # Field FROM the goal: dist[r, c] = coarse cells to reach the goal.
+    # cost_to_go unblocks its seed, so a goal in a conservatively-occupied
+    # coarse cell (hugging a wall) still radiates.
+    bfs_cfg = dataclasses.replace(fcfg, bfs_iters=pcfg.bfs_iters)
+    dist = F.cost_to_go(bfs_cfg, passable, goal_rc[None, :],
+                        jnp.array([True]))
+
+    big = jnp.float32(F._BIG)
+    padded = jnp.pad(dist, 1, constant_values=F._BIG)
+
+    # The robot itself can sit in a conservatively-blocked coarse cell;
+    # judge reachability (and take the first step) from the best cell of
+    # its 3x3 neighbourhood, exactly the seed-unblocking concession
+    # cost_to_go makes for frontier seeds.
+    def patch_at(rc):
+        return jax.lax.dynamic_slice(padded, (rc[0], rc[1]), (3, 3))
+
+    start_patch = patch_at(start_rc)
+    reachable = jnp.min(start_patch) < big
+    arrived = jnp.all(start_rc == goal_rc)
+
+    d8 = jnp.array([[-1, -1], [-1, 0], [-1, 1],
+                    [0, -1], [0, 0], [0, 1],
+                    [1, -1], [1, 0], [1, 1]], jnp.int32)
+
+    def step(rc, _):
+        patch = patch_at(rc)
+        nxt = jnp.clip(rc + d8[jnp.argmin(patch)], 0, n - 1)
+        # Once at the goal (field == 0, the component's unique minimum)
+        # argmin holds the centre cell and the path self-pads.
+        return nxt, nxt
+
+    _, cells = jax.lax.scan(step, start_rc, None,
+                            length=pcfg.max_path_len)
+    at_goal = jnp.all(cells == goal_rc[None, :], axis=1)
+    # Valid prefix: every cell up to and including the FIRST goal arrival
+    # (the descent self-pads at the goal afterwards). A goal beyond the
+    # descent horizon keeps the whole prefix — a partial path toward a far
+    # goal still steers the robot the right way until the next replan.
+    reached_by = jnp.cumsum(at_goal.astype(jnp.int32)) > 0
+    prev_reached = jnp.concatenate([jnp.zeros(1, bool), reached_by[:-1]])
+    valid = (jnp.logical_not(prev_reached) & reachable
+             & jnp.logical_not(arrived))
+    n_steps = valid.sum().astype(jnp.int32)
+
+    path_xy = _cell_to_world(grid_cfg, res, cells)
+    goal_f = goal_xy.astype(jnp.float32)
+    path_xy = jnp.where(valid[:, None], path_xy, goal_f[None, :])
+
+    # Lookahead waypoint: the path cell lookahead_cells along (or the last
+    # valid cell when the goal is nearer than the lookahead).
+    wp_idx = jnp.clip(jnp.minimum(pcfg.lookahead_cells, n_steps) - 1,
+                      0, pcfg.max_path_len - 1)
+    waypoint = jnp.where(reachable & (n_steps > 0), path_xy[wp_idx], goal_f)
+
+    return PlanResult(path_xy=path_xy, path_valid=valid, n_steps=n_steps,
+                      reachable=reachable, waypoint_xy=waypoint,
+                      arrived=arrived)

@@ -342,15 +342,19 @@ def test_http_load_refuses_config_drift(tiny_cfg, tmp_path):
         stack.shutdown()
 
 
-def _goal_stack(tiny_cfg, world):
+def _goal_stack(tiny_cfg, world, planner: bool = False):
     """Sim stack tuned for goal-seek drives: faster cruise so a metre of
-    travel fits a CPU test budget."""
+    travel fits a CPU test budget. planner=False pins the round-4
+    straight-line-seek behavior these tests target (the map-aware planner
+    has its own suite, tests/test_planner.py)."""
     import dataclasses
 
     from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.config import PlannerConfig
     cfg = dataclasses.replace(
         tiny_cfg, robot=dataclasses.replace(tiny_cfg.robot,
-                                            cruise_speed_units=300))
+                                            cruise_speed_units=300),
+        planner=dataclasses.replace(tiny_cfg.planner, enabled=planner))
     return launch_sim_stack(cfg, world, n_robots=1, http_port=0, seed=2)
 
 
@@ -391,9 +395,12 @@ def test_goal_seek_reaches_and_clears(tiny_cfg):
 
 def test_goal_behind_wall_shield_wins(tiny_cfg):
     """Goal-seek must not defeat the reactive shield: with the goal
-    straight behind a wall, the robot keeps avoiding (IR pivot / LiDAR
-    swerve outrank goal steering in the subsumption stack) and never
-    drives into the wall; the unreachable goal stays set."""
+    straight behind a wall and NO planner (round-4 behavior, pinned via
+    _goal_stack(planner=False)), the robot keeps avoiding (IR pivot /
+    LiDAR swerve outrank goal steering in the subsumption stack) and never
+    drives into the wall; the straight-line-unreachable goal stays set.
+    With the planner the same scenario is navigated around —
+    tests/test_planner.py::test_planner_reaches_goal_behind_wall."""
     import numpy as np
 
     from jax_mapping.sim import world as W

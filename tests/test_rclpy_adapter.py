@@ -106,6 +106,7 @@ def stub_ros(monkeypatch):
     nav = types.ModuleType("nav_msgs.msg")
     nav.OccupancyGrid = _msg("OccupancyGrid")
     nav.Odometry = _msg("Odometry")
+    nav.Path = _msg("Path")
     geo = types.ModuleType("geometry_msgs.msg")
     geo.Twist = _msg("Twist")
     geo.PoseWithCovarianceStamped = _msg("PoseWithCovarianceStamped")
@@ -502,6 +503,27 @@ def test_outbound_voxel_points_reach_ros(tiny_cfg, stub_ros):
     vals = struct.unpack("<6f", m.data)
     assert vals == pytest.approx((1.0, 2.0, 0.25, -0.5, 0.0, 0.1))
     assert m.header.frame_id == "map"
+
+
+def test_outbound_plan_reaches_ros(tiny_cfg, stub_ros):
+    """Path on the bus -> nav_msgs/Path on /plan (PoseStamped per
+    waypoint, identity orientation — the RViz Path display contract)."""
+    from jax_mapping.bridge.messages import Header, Path
+
+    bus, _tf, ad = _adapter(tiny_cfg, stub_ros)
+    pts = np.asarray([[0.5, 0.0], [0.6, 0.1], [0.7, 0.2]], np.float32)
+    bus.publisher("/plan").publish(
+        Path(header=Header(stamp=2.5, frame_id="map"), poses_xy=pts))
+
+    pub = ad.node.pubs["/plan"]
+    assert len(pub.published) == 1
+    m = pub.published[0]
+    assert m.header.frame_id == "map"
+    assert len(m.poses) == 3
+    got = np.asarray([(p.pose.position.x, p.pose.position.y)
+                      for p in m.poses])
+    assert np.allclose(got, pts, atol=1e-6)
+    assert all(p.pose.orientation.w == 1.0 for p in m.poses)
 
 
 def test_voxel_mapper_publishes_points(tiny_cfg):
