@@ -183,6 +183,13 @@ class MapApiServer:
                     {"error": f"{route} requires POST "
                               f"(curl -X POST ...{route})"}).encode()
             return self._checkpoint(route, path)
+        if route == "/save-map":
+            # Writes to disk -> POST-only, same stance as /save.
+            if method != "POST":
+                return 405, "application/json", json.dumps(
+                    {"error": "/save-map requires POST "
+                              "(curl -X POST .../save-map)"}).encode()
+            return self._save_rosmap(path)
         return 404, "application/json", \
             json.dumps({"error": f"no route {route}"}).encode()
 
@@ -273,6 +280,32 @@ class MapApiServer:
                 self.voxel_mapper.restore_keyframes(vkf)
                 body["keyframes_restored"] = int(len(vkf["robot"]))
         return 200, "application/json", json.dumps(body).encode()
+
+    def _save_rosmap(self, path: str) -> Tuple[int, str, bytes]:
+        """POST /save-map?name=x -> checkpoint_dir/x.pgm + x.yaml in the
+        ROS map_server format (the `map_saver_cli` artifact; the
+        reference ecosystem's portable map interchange). Unlike /save,
+        this is the LOSSY export every external consumer reads — npz
+        checkpoints remain the lossless resume path."""
+        if self.mapper is None:
+            return 404, "application/json", json.dumps(
+                {"error": "no mapper attached"}).encode()
+        from jax_mapping.io import rosmap
+        q = parse_qs(urlparse(path).query)
+        name = os.path.basename(q.get("name", ["map"])[0]) or "map"
+        g = self.mapper.cfg.grid
+        # Threshold directly: the export edge's {-1, 0, 100} trichotomy
+        # (occupancy_from_logodds semantics) without a message detour.
+        lo = np.asarray(self.mapper.merged_grid())
+        occ = np.full(lo.shape, -1, np.int8)
+        occ[lo <= g.free_threshold] = 0
+        occ[lo >= g.occ_threshold] = 100
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        pgm, yaml = rosmap.save_map(
+            os.path.join(self.checkpoint_dir, name),
+            occ, g.resolution_m, g.origin_m)
+        return 200, "application/json", json.dumps(
+            {"status": "saved", "pgm": pgm, "yaml": yaml}).encode()
 
     def _map_image(self) -> Tuple[int, str, bytes]:
         with self._lock:

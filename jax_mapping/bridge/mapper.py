@@ -201,6 +201,32 @@ class MapperNode(Node):
                 self._prev_paired[i] = None
                 self._correction[i] = None
 
+    def seed_map_prior(self, prior_logodds) -> None:
+        """Install an imported map (io/rosmap.load_map -> logodds_prior)
+        as the fleet's shared grid — localization-on-a-known-map
+        bootstrapping (slam_toolbox's map-start / map_server role).
+
+        The prior REPLACES the grid through a fresh array, so
+        _finish_step's shared-grid identity check drops any in-flight
+        step fused from the pre-seed grid; per-robot generations bump for
+        the /initialpose-style guards. Graphs and poses are untouched:
+        robots keep localizing, now against the imported walls.
+        """
+        jnp = self._jnp
+        g = self.cfg.grid
+        prior = jnp.asarray(prior_logodds, dtype="float32")
+        if prior.shape != (g.size_cells, g.size_cells):
+            raise ValueError(
+                f"map prior shape {prior.shape} != grid "
+                f"({g.size_cells}, {g.size_cells}); resample the import "
+                "to the running config first (io/rosmap.embed_in_grid)")
+        with self._state_lock:
+            self.shared_grid = prior
+            for i in range(len(self.states)):
+                self.states[i] = self.states[i]._replace(
+                    grid=self.shared_grid)
+                self._state_gen[i] += 1
+
     # -- topic callbacks -----------------------------------------------------
 
     def _scan_cb(self, i: int, msg: LaserScan) -> None:

@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", type=str, default=None, metavar="CKPT",
                    help="resume the SLAM state from a checkpoint written "
                         "by --save-final or the HTTP /save endpoint")
+    p.add_argument("--map-prior", type=str, default=None, metavar="YAML",
+                   help="seed the mapper with a ROS map_server map "
+                        "(map.yaml + map.pgm, e.g. a map_saver_cli or "
+                        "POST /save-map artifact) before stepping — "
+                        "localization-on-a-known-map bootstrapping")
     p.add_argument("--save-final", type=str, default=None, metavar="CKPT",
                    help="write the final SLAM state as a resumable "
                         "checkpoint")
@@ -251,6 +256,21 @@ def main(argv=None) -> int:
                 if args.depth_cam:
                     topics.append(f"{ns}depth")
             recorder = TraceRecorder(stack.bus, topics)
+
+        if args.map_prior:
+            if args.resume:
+                # restore_states would install the checkpoint's grid over
+                # the just-seeded prior — refusing beats silently telling
+                # the user the prior is active when it is not.
+                print("demo: --map-prior and --resume both set a map; "
+                      "pick one (a checkpoint already contains its grid)")
+                return 2
+            from jax_mapping.io import rosmap
+            occ, res, origin = rosmap.load_map(args.map_prior)
+            occ = rosmap.embed_in_grid(occ, res, origin, cfg.grid)
+            stack.mapper.seed_map_prior(rosmap.logodds_prior(occ))
+            print(f"demo: seeded map prior from {args.map_prior} "
+                  f"({int((occ == 100).sum())} occupied cells)")
 
         if args.resume:
             from jax_mapping.io.checkpoint import load_checkpoint

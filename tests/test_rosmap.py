@@ -1,0 +1,196 @@
+"""ROS map_server map interchange (io/rosmap.py + /save-map + prior seed).
+
+The reference ecosystem's portable map artifact: map_saver_cli writes
+`map.pgm` + `map.yaml`, map_server/Nav2/localization consume it. The
+reference itself never saved a map (restart lost it, SURVEY.md §5); the
+framework's npz checkpoints are lossless but private. These tests pin the
+format (trinary pixel values, row flip, YAML sidecar), the HTTP export,
+and the localization-bootstrapping import path.
+"""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jax_mapping.config import tiny_config
+from jax_mapping.io import rosmap
+
+
+def _trinary(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.choice(np.array([-1, 0, 100], np.int8), size=shape)
+
+
+def test_roundtrip_bitwise(tmp_path):
+    occ = _trinary((48, 64))
+    pgm, yaml = rosmap.save_map(str(tmp_path / "m"), occ, 0.05,
+                                (-1.6, -1.2))
+    occ2, res, origin = rosmap.load_map(yaml)
+    assert res == 0.05 and origin == (-1.6, -1.2)
+    assert occ2.dtype == np.int8 and (occ2 == occ).all()
+
+
+def test_pgm_format_pinned(tmp_path):
+    """The bytes a foreign map_server reads: P5 header, 255 maxval, and
+    the trinary pixel values with grid row 0 (min-y) at the image
+    BOTTOM."""
+    occ = np.full((4, 3), -1, np.int8)
+    occ[0, 0] = 100                          # min-y corner occupied
+    occ[3, 2] = 0                            # max-y corner free
+    pgm, _ = rosmap.save_map(str(tmp_path / "m"), occ, 0.05, (0.0, 0.0))
+    raw = open(pgm, "rb").read()
+    assert raw.startswith(b"P5\n3 4\n255\n")
+    px = np.frombuffer(raw[len(b"P5\n3 4\n255\n"):], np.uint8).reshape(4, 3)
+    assert px[3, 0] == 0                     # occupied, image bottom-left
+    assert px[0, 2] == 254                   # free, image top-right
+    assert px[1, 1] == 205                   # unknown elsewhere
+
+
+def test_load_foreign_negate_and_thresholds(tmp_path):
+    """Imports honour the sidecar's negate/threshold fields, not just the
+    values this module writes."""
+    px = np.array([[0, 128, 255]], np.uint8)
+    with open(tmp_path / "f.pgm", "wb") as f:
+        f.write(b"P5\n3 1\n255\n" + px.tobytes())
+    (tmp_path / "f.yaml").write_text(
+        "image: f.pgm\nresolution: 0.1\norigin: [0.0, 0.0, 0.0]\n"
+        "negate: 1\noccupied_thresh: 0.9\nfree_thresh: 0.1\n")
+    occ, res, origin = rosmap.load_map(str(tmp_path / "f.yaml"))
+    # negate=1: p_occ = px/255 -> 0.0, 0.502, 1.0
+    assert occ[0, 0] == 0 and occ[0, 1] == -1 and occ[0, 2] == 100
+
+
+def test_embed_offsets_and_clip():
+    cfg = tiny_config()
+    g = cfg.grid
+    occ = np.full((10, 10), 0, np.int8)
+    occ[5, 5] = 100
+    # Origin one metre inside the grid's min corner.
+    ox, oy = g.origin_m
+    out = rosmap.embed_in_grid(occ, g.resolution_m, (ox + 1.0, oy + 1.0), g)
+    k = round(1.0 / g.resolution_m)
+    assert out[k + 5, k + 5] == 100
+    assert out[k, k] == 0
+    assert out[0, 0] == -1                   # outside the import: unknown
+    with pytest.raises(ValueError):
+        rosmap.embed_in_grid(occ, g.resolution_m * 2, (0, 0), g)
+
+
+def test_load_rejects_rotated_origin(tmp_path):
+    """origin yaw != 0 is legal ROS but the axis-aligned embed would put
+    every wall in the wrong place — must refuse loudly."""
+    px = np.full((2, 2), 254, np.uint8)
+    with open(tmp_path / "r.pgm", "wb") as f:
+        f.write(b"P5\n2 2\n255\n" + px.tobytes())
+    (tmp_path / "r.yaml").write_text(
+        "image: r.pgm\nresolution: 0.05\norigin: [0.0, 0.0, 1.57]\n"
+        "negate: 0\n")
+    with pytest.raises(ValueError, match="yaw"):
+        rosmap.load_map(str(tmp_path / "r.yaml"))
+
+
+def test_logodds_prior_values():
+    occ = np.array([[-1, 0, 100]], np.int8)
+    lo = rosmap.logodds_prior(occ)
+    assert lo[0, 0] == 0.0 and lo[0, 1] == -2.0 and lo[0, 2] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP export + localization-bootstrap import, end to end
+# ---------------------------------------------------------------------------
+
+def _stack(tiny_cfg, tmp_path, seed=0):
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    cfg = dataclasses.replace(
+        tiny_cfg, planner=dataclasses.replace(tiny_cfg.planner,
+                                              enabled=False))
+    world = W.empty_arena(96, cfg.grid.resolution_m)
+    st = launch_sim_stack(cfg, world, n_robots=1, http_port=0, seed=seed)
+    st.api.checkpoint_dir = str(tmp_path)
+    return st
+
+
+def test_http_save_map_and_reimport(tiny_cfg, tmp_path):
+    """Drive: explore a bit -> POST /save-map -> artifact loads back to
+    exactly the occupancy the live /map exports; GET is rejected; a
+    FRESH mapper seeded with the import serves the imported walls."""
+    st = _stack(tiny_cfg, tmp_path)
+    try:
+        st.brain.start_exploring()
+        st.run_steps(30)
+        url = f"http://127.0.0.1:{st.api.port}/save-map?name=arena"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url)     # GET must not write
+        assert ei.value.code == 405
+        with urllib.request.urlopen(
+                urllib.request.Request(url, method="POST")) as r:
+            body = json.loads(r.read())
+        assert body["status"] == "saved"
+        occ, res, origin = rosmap.load_map(body["yaml"])
+        g = st.cfg.grid
+        assert res == g.resolution_m and origin == g.origin_m
+        from jax_mapping.bridge.messages import occupancy_from_logodds
+        live = occupancy_from_logodds(
+            np.asarray(st.mapper.merged_grid()), g.occ_threshold,
+            g.free_threshold, g.resolution_m, g.origin_m)
+        live_occ = np.asarray(live.data, np.int8).reshape(
+            live.info.height, live.info.width)
+        assert (occ == live_occ).all()
+        assert (occ == 100).sum() > 0, "nothing mapped in 30 steps?"
+    finally:
+        st.shutdown()
+
+    # Fresh stack, seeded from the artifact: the walls are served on
+    # /map-image terms without a single scan fused.
+    st2 = _stack(tiny_cfg, tmp_path, seed=1)
+    try:
+        occ2 = rosmap.embed_in_grid(occ, res, origin, st2.cfg.grid)
+        st2.mapper.seed_map_prior(rosmap.logodds_prior(occ2))
+        g = st2.cfg.grid
+        from jax_mapping.bridge.messages import occupancy_from_logodds
+        seeded = occupancy_from_logodds(
+            np.asarray(st2.mapper.merged_grid()), g.occ_threshold,
+            g.free_threshold, g.resolution_m, g.origin_m)
+        s_occ = np.asarray(seeded.data, np.int8).reshape(
+            seeded.info.height, seeded.info.width)
+        assert ((s_occ == 100) == (occ == 100)).all()
+        assert ((s_occ == 0) == (occ == 0)).all()
+    finally:
+        st2.shutdown()
+
+
+def test_seed_prior_shape_guard(tiny_cfg, tmp_path):
+    st = _stack(tiny_cfg, tmp_path)
+    try:
+        with pytest.raises(ValueError):
+            st.mapper.seed_map_prior(np.zeros((8, 8), np.float32))
+    finally:
+        st.shutdown()
+
+
+def test_demo_map_prior_cli(tmp_path, capsys):
+    """The operator surface: a map_server artifact boots a demo run via
+    --map-prior and the seed is reported."""
+    from jax_mapping import demo
+
+    occ = np.full((32, 32), 0, np.int8)
+    occ[0, :] = 100
+    _pgm, yaml = rosmap.save_map(str(tmp_path / "prior"), occ, 0.05,
+                                 (-0.8, -0.8))
+    rc = demo.main(["--steps", "2", "--robots", "1", "--world", "arena",
+                    "--world-cells", "96", "--map-prior", yaml])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "seeded map prior" in out
+    # --map-prior + --resume would let restore_states silently overwrite
+    # the prior; the demo refuses the combination instead.
+    rc = demo.main(["--steps", "1", "--world", "arena", "--world-cells",
+                    "96", "--map-prior", yaml, "--resume", "nope.npz"])
+    assert rc == 2
+    assert "pick one" in capsys.readouterr().out
