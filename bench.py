@@ -65,6 +65,10 @@ _RESULT = {
     "slam_step_p50_ms": None,
     "fleet_tick_p50_ms_8robots": None,
     "fleet_tick_p50_ms_64robots": None,
+    # Global replan latency at production scale (ops/planner.plan_to_goal:
+    # 4096^2 map -> coarse goal-seeded BFS + descent). Budget: one replan
+    # per PlannerConfig.period_s (1000 ms) per goal robot.
+    "plan_p50_ms": None,
     "voxel_images_per_sec": None,
     # Shared-patch window fast path (voxel_kernel.window_delta); TPU only.
     "voxel_window_images_per_sec": None,
@@ -718,6 +722,57 @@ def _run() -> None:
                 traceback.print_exc(file=sys.stderr)
     else:
         print(f"bench: skipping voxel ({_remaining():.0f}s left)",
+              file=sys.stderr, flush=True)
+
+    # ---- global planner: replan latency at production scale --------------
+    # The round-5 navigation capability (ops/planner.py): goal-seeded
+    # obstacle-aware cost-to-go over the coarse 1024^2 field + greedy
+    # descent, one jit. Budget: PlannerConfig.period_s (= 1 s) per replan;
+    # the p50 must sit far under it for the planner to ride the mapper's
+    # cadence without stealing the hot path's device time.
+    if _remaining() > 150.0:
+        from jax_mapping.ops import planner as PL
+        pcfg = cfg.planner
+        nlo = g.size_cells
+        plan_lo = np.full((nlo, nlo), -1.0, np.float32)
+        prng = np.random.default_rng(5)
+        for _ in range(40):                  # random axis-aligned walls
+            wr = int(prng.integers(0, nlo - 200))
+            wc = int(prng.integers(0, nlo - 200))
+            if prng.random() < 0.5:
+                plan_lo[wr:wr + 200, wc:wc + 4] = 3.0
+            else:
+                plan_lo[wr:wr + 4, wc:wc + 200] = 3.0
+        plan_lo_d = jax.device_put(jnp.asarray(plan_lo), dev)
+        ox, oy = g.origin_m
+        span = nlo * g.resolution_m
+        start_xy = jnp.asarray([ox + 0.25 * span, oy + 0.25 * span],
+                               jnp.float32)
+        goal_xy = jnp.asarray([ox + 0.65 * span, oy + 0.65 * span],
+                              jnp.float32)
+
+        def plan_chain():
+            def run(k):
+                def body(_, s):
+                    # Loop-dependence guard (see fuse_chain): the carry IS
+                    # the iteration's waypoint sum and feeds the next
+                    # start via `* 0.0` — value-neutral, but XLA cannot
+                    # fold x*0 (NaN/Inf) so the plans stay serialized.
+                    r = PL.plan_to_goal(pcfg, cfg.frontier, g, plan_lo_d,
+                                        goal_xy, start_xy + s * 0.0)
+                    return r.waypoint_xy.sum()
+                return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+            jitted = jax.jit(run)
+            return lambda k: float(jitted(jnp.int32(k)))
+        try:
+            dt = _chain_time(plan_chain, 1, 3, min(reps, 3), label="plan")
+            _RESULT["plan_p50_ms"] = round(dt * 1000.0, 2)
+            _RESULT["sections_completed"].append("plan")
+        except Exception:
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+    else:
+        print(f"bench: skipping plan ({_remaining():.0f}s left)",
               file=sys.stderr, flush=True)
 
 

@@ -81,6 +81,11 @@ class MapperNode(Node):
         #: INSTALLED step — the basis of the map->odom correction the 3D
         #: mapper consumes (depth_anchor); None until a step installs.
         self._correction = [None] * n_robots
+        #: Imported map prior (seed_map_prior). Kept so loop-closure ring
+        #: re-fusions — which rebuild from an EMPTY grid — can backfill
+        #: the cells no live key scan covers; without this the first
+        #: closure silently erases the imported map.
+        self._map_prior = None
         self._pairer = OdomPairer(n_robots)
         self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
         self._prev_paired: List[Optional[Odometry]] = [None] * n_robots
@@ -222,6 +227,7 @@ class MapperNode(Node):
                 "to the running config first (io/rosmap.embed_in_grid)")
         with self._state_lock:
             self.shared_grid = prior
+            self._map_prior = prior
             for i in range(len(self.states)):
                 self.states[i] = self.states[i]._replace(
                     grid=self.shared_grid)
@@ -417,6 +423,17 @@ class MapperNode(Node):
                 # ring so fleet-mates' walls survive
                 # (models/fleet._close_loops, host-orchestrated).
                 self.shared_grid = self._refuse_all_rings()
+            if closed and self._map_prior is not None:
+                # Ring re-fusions rebuild from an empty grid, so every
+                # cell without live key-scan evidence (log-odds exactly
+                # 0) reverts to unknown — which would silently erase an
+                # imported map prior at the first closure. Backfill:
+                # live evidence wins wherever any exists; the prior
+                # keeps the unobserved remainder of the known map.
+                jnp_ = self._jnp
+                self.shared_grid = jnp_.where(self.shared_grid == 0.0,
+                                              self._map_prior,
+                                              self.shared_grid)
             for j in range(self.n_robots):
                 self.states[j] = self.states[j]._replace(
                     grid=self.shared_grid)

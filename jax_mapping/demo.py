@@ -266,9 +266,16 @@ def main(argv=None) -> int:
                       "pick one (a checkpoint already contains its grid)")
                 return 2
             from jax_mapping.io import rosmap
-            occ, res, origin = rosmap.load_map(args.map_prior)
-            occ = rosmap.embed_in_grid(occ, res, origin, cfg.grid)
-            stack.mapper.seed_map_prior(rosmap.logodds_prior(occ))
+            try:
+                occ, res, origin = rosmap.load_map(args.map_prior)
+                occ = rosmap.embed_in_grid(occ, res, origin, cfg.grid)
+                stack.mapper.seed_map_prior(rosmap.logodds_prior(occ))
+            except (OSError, ValueError, KeyError) as e:
+                # Same polite-refusal contract as --resume: bad input is
+                # an rc=2 message, not a traceback.
+                print(f"demo: cannot seed --map-prior "
+                      f"{args.map_prior}: {e}")
+                return 2
             print(f"demo: seeded map prior from {args.map_prior} "
                   f"({int((occ == 100).sum())} occupied cells)")
 

@@ -165,6 +165,55 @@ def test_http_save_map_and_reimport(tiny_cfg, tmp_path):
         st2.shutdown()
 
 
+def test_prior_survives_closure_refusion(tiny_cfg, tmp_path):
+    """Loop-closure ring re-fusions rebuild the shared grid from EMPTY +
+    key scans, which would silently erase an imported prior at the first
+    closure. _finish_step must backfill: live evidence wins wherever any
+    exists, the prior keeps the unobserved map."""
+    import jax.numpy as jnp
+
+    from jax_mapping.bridge.messages import Header, Odometry, Pose2D
+
+    st = _stack(tiny_cfg, tmp_path)
+    try:
+        m = st.mapper
+        n = st.cfg.grid.size_cells
+        prior = np.zeros((n, n), np.float32)
+        prior[10:20, 10:20] = 2.0            # imported wall A
+        prior[30:40, 30:40] = -2.0           # imported free space
+        m.seed_map_prior(prior)
+        # A closure's in-step repair output: empty except live evidence —
+        # wall B, plus fresh FREE evidence overlapping imported wall A's
+        # corner (live must win there).
+        refused = np.zeros((n, n), np.float32)
+        refused[60:70, 60:70] = 3.0          # live wall B
+        refused[10:12, 10:12] = -0.4         # live free over prior wall A
+        base_grid = m.merged_grid()
+        state = m.states[0]._replace(grid=jnp.asarray(refused))
+        od = Odometry(header=Header(stamp=1.0), pose=Pose2D(0, 0, 0))
+        assert m._finish_step(0, state, od, 1, matched=True, closed=True,
+                              base_grid=base_grid, base_gen=m._state_gen[0])
+        out = np.asarray(m.merged_grid())
+        assert (out[60:70, 60:70] == 3.0).all()      # live wall kept
+        assert (out[10:12, 10:12] == -0.4).all()     # live free wins
+        assert (out[12:20, 12:20] == 2.0).all()      # prior wall backfilled
+        assert (out[30:40, 30:40] == -2.0).all()     # prior free backfilled
+        assert (out[0, 0] == 0.0)                    # unknown stays unknown
+    finally:
+        st.shutdown()
+
+
+def test_demo_map_prior_bad_input_polite(tmp_path, capsys):
+    """--map-prior input failures follow the --resume contract: polite
+    message + rc=2, not a traceback."""
+    from jax_mapping import demo
+
+    rc = demo.main(["--steps", "1", "--world", "arena", "--world-cells",
+                    "96", "--map-prior", str(tmp_path / "nope.yaml")])
+    assert rc == 2
+    assert "cannot seed --map-prior" in capsys.readouterr().out
+
+
 def test_seed_prior_shape_guard(tiny_cfg, tmp_path):
     st = _stack(tiny_cfg, tmp_path)
     try:
