@@ -129,6 +129,14 @@ class ThymioBrain(Node):
         # keeps the round-4 straight-line seek under the shield.
         self._waypoint = None
         self.create_subscription("/goal_waypoint", self._waypoint_cb)
+        # Assigned-frontier exploration (FrontierConfig.seek_assigned):
+        # the mapper's /frontiers assignments become goal-seek targets
+        # for exploring robots without a manual nav goal — the map-based
+        # explorer the reference's report defers to future work
+        # (report.pdf VI.2), driving the actual robots instead of only
+        # the RViz markers.
+        self._frontiers = None
+        self.create_subscription("/frontiers", self._frontiers_cb)
 
         # Boot connect, offline mode on failure (pi variant semantics).
         self.link_up = connect_with_retries(
@@ -161,6 +169,37 @@ class ThymioBrain(Node):
     def _waypoint_cb(self, msg) -> None:
         with self._state_lock:
             self._waypoint = (msg, self.n_ticks)
+
+    def _frontiers_cb(self, msg) -> None:
+        with self._state_lock:
+            self._frontiers = (msg, self.n_ticks)
+
+    def _apply_frontier_goals(self, goals_xy: np.ndarray,
+                              goal_valid: np.ndarray) -> None:
+        """Fill unset goal rows from the freshest /frontiers assignment.
+
+        Manual nav goals (already-valid rows) win; robots whose
+        assignment is -1 (no reachable frontier) keep the blind cruise
+        fallback. Staleness is measured in control ticks like the
+        planner waypoint, and for the same reason."""
+        if not self.cfg.frontier.seek_assigned:
+            return
+        with self._state_lock:
+            entry = self._frontiers
+        if entry is None:
+            return
+        msg, at_tick = entry
+        ttl_ticks = (self.cfg.frontier.seek_ttl_s
+                     * self.cfg.robot.control_rate_hz)
+        if self.n_ticks - at_tick > ttl_ticks:
+            return
+        targets = np.asarray(msg.targets_xy, np.float32)
+        assign = np.asarray(msg.assignment)
+        for i in range(min(self.n_robots, len(assign))):
+            a = int(assign[i])
+            if not goal_valid[i] and 0 <= a < len(targets):
+                goals_xy[i] = targets[a]
+                goal_valid[i] = True
 
     def nav_goal(self) -> Optional[tuple]:
         """Current navigation goal (planner reads the brain's copy so a
@@ -316,6 +355,7 @@ class ThymioBrain(Node):
                 else:
                     goals_xy[0] = self._steer_target(goal)
                     goal_valid[0] = True
+            self._apply_frontier_goals(goals_xy, goal_valid)
 
             new_poses, twists, targets, leds, _ = brain_tick(
                 cfg, poses, wheel_raw, prox, ranges, exploring,

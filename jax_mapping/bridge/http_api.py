@@ -203,20 +203,25 @@ class MapApiServer:
         name = os.path.basename(q.get("name", ["slam_state"])[0]) or \
             "slam_state"
         fp = os.path.join(self.checkpoint_dir, name + ".npz")
-        if name.endswith((".voxel", ".voxelkf")):
-            # Reserved: checkpoint "x"'s 3D sidecars live at
-            # "x.voxel.npz" / "x.voxelkf.npz"; a checkpoint NAMED with
-            # either suffix would collide with them.
+        if name.endswith((".voxel", ".voxelkf", ".prior")):
+            # Reserved: checkpoint "x"'s sidecars live at "x.voxel.npz" /
+            # "x.voxelkf.npz" / "x.prior.npz"; a checkpoint NAMED with
+            # any of those suffixes would collide with them.
             return 400, "application/json", json.dumps(
-                {"error": "checkpoint names ending in '.voxel' or "
-                          "'.voxelkf' are reserved for 3D sidecars"}
-            ).encode()
+                {"error": "checkpoint names ending in '.voxel', "
+                          "'.voxelkf' or '.prior' are reserved for "
+                          "sidecars"}).encode()
         if route == "/save":
             os.makedirs(self.checkpoint_dir, exist_ok=True)
             states = self.mapper.snapshot_states()
             save_checkpoint(fp, states,
                             config_json=self.mapper.cfg.to_json())
             body = {"status": "saved", "path": fp, "robots": len(states)}
+            prior = self.mapper.map_prior()
+            if prior is not None:
+                from jax_mapping.io.checkpoint import save_prior_sidecar
+                body["prior_path"] = save_prior_sidecar(
+                    fp, prior, config_json=self.mapper.cfg.to_json())
             if self.voxel_mapper is not None:
                 from jax_mapping.io.checkpoint import (
                     save_keyframe_sidecar, save_voxel_sidecar)
@@ -266,10 +271,23 @@ class MapApiServer:
             except ValueError as e:
                 return 409, "application/json", json.dumps(
                     {"error": f"voxel sidecar: {e}"}).encode()
+        from jax_mapping.io.checkpoint import load_prior_sidecar
+        try:
+            prior = load_prior_sidecar(
+                fp, self._G_empty(),
+                running_config_json=self.mapper.cfg.to_json())
+        except ValueError as e:
+            return 409, "application/json", json.dumps(
+                {"error": f"prior sidecar: {e}"}).encode()
         # No anchor poses: the /load contract is a server restart with
         # robots holding still, so checkpoint poses are still valid.
-        self.mapper.restore_states(states)
+        # map_prior=None CLEARS a live prior — the checkpoint is the
+        # source of truth now.
+        self.mapper.restore_states(states, map_prior=prior)
         body = {"status": "loaded", "path": fp, "robots": len(states)}
+        if prior is not None:
+            from jax_mapping.io.checkpoint import prior_sidecar_path
+            body["prior_path"] = prior_sidecar_path(fp)
         if vgrid is not None:
             self.voxel_mapper.restore_grid(vgrid)
             body["voxel_path"] = voxel_sidecar_path(fp)
@@ -280,6 +298,11 @@ class MapApiServer:
                 self.voxel_mapper.restore_keyframes(vkf)
                 body["keyframes_restored"] = int(len(vkf["robot"]))
         return 200, "application/json", json.dumps(body).encode()
+
+    def _G_empty(self):
+        """Template grid for the prior sidecar's shape/dtype check."""
+        from jax_mapping.ops import grid as G
+        return G.empty_grid(self.mapper.cfg.grid)
 
     def _save_rosmap(self, path: str) -> Tuple[int, str, bytes]:
         """POST /save-map?name=x -> checkpoint_dir/x.pgm + x.yaml in the

@@ -163,8 +163,15 @@ class MapperNode(Node):
         return [states[0]._replace(grid=shared)] + \
             [st._replace(grid=zero) for st in states[1:]]
 
-    def restore_states(self, states, anchor_poses=None) -> None:
+    def restore_states(self, states, anchor_poses=None,
+                       map_prior=None) -> None:
         """Swap in checkpointed SLAM states and reset odometry pairing.
+
+        map_prior: the checkpoint's imported-map prior (its .prior
+        sidecar), or None — which CLEARS any live prior: the checkpoint
+        is now the source of truth, and a stale prior from the previous
+        session would backfill a different environment's walls at the
+        next loop closure.
 
         Both resume paths (HTTP /load, demo --resume) go through here so
         the pairing reset can't be forgotten at one call site: without it
@@ -186,6 +193,9 @@ class MapperNode(Node):
                 f"runs {len(self.states)}")
         jnp = self._jnp
         with self._state_lock:
+            self._map_prior = (None if map_prior is None
+                               else jnp.asarray(map_prior,
+                                                dtype="float32"))
             self.states = list(states)
             # Rebuild the shared grid from the checkpoint: states saved by
             # this design all alias one grid (max-merge is then a no-op);
@@ -205,6 +215,12 @@ class MapperNode(Node):
                 self._state_gen[i] += 1
                 self._prev_paired[i] = None
                 self._correction[i] = None
+
+    def map_prior(self):
+        """The live imported-map prior (for checkpoint sidecars), or
+        None."""
+        with self._state_lock:
+            return self._map_prior
 
     def seed_map_prior(self, prior_logodds) -> None:
         """Install an imported map (io/rosmap.load_map -> logodds_prior)

@@ -211,6 +211,15 @@ class FrontierConfig:
     exact_bfs: bool = False
     mg_levels: int = 3                # multigrid resolutions
     mg_refine_iters: int = 8          # doubled sweeps per refinement level
+    # Bridge-brain consumption of the published assignments: exploring
+    # robots without a manual nav goal steer at their assigned frontier
+    # (map-based exploration, report.pdf §VI.2) instead of blind cruise;
+    # the reactive shield still outranks. False = the reference's pure
+    # subsumption wander.
+    seek_assigned: bool = True
+    # Assignments older than this (in control-loop time) are ignored —
+    # a dead mapper must not leave robots chasing stale frontiers.
+    seek_ttl_s: float = 5.0
 
 
 @_frozen

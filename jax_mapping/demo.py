@@ -270,7 +270,8 @@ def main(argv=None) -> int:
                 occ, res, origin = rosmap.load_map(args.map_prior)
                 occ = rosmap.embed_in_grid(occ, res, origin, cfg.grid)
                 stack.mapper.seed_map_prior(rosmap.logodds_prior(occ))
-            except (OSError, ValueError, KeyError) as e:
+            except (OSError, ValueError, KeyError, TypeError,
+                    IndexError) as e:
                 # Same polite-refusal contract as --resume: bad input is
                 # an rc=2 message, not a traceback.
                 print(f"demo: cannot seed --map-prior "
@@ -301,12 +302,23 @@ def main(argv=None) -> int:
                 print("error: checkpoint config differs from the running "
                       "config; pass the matching --config", file=sys.stderr)
                 return 2
+            from jax_mapping.io.checkpoint import load_prior_sidecar
+            from jax_mapping.ops import grid as _G
+            try:
+                ckpt_prior = load_prior_sidecar(
+                    args.resume, _G.empty_grid(cfg.grid),
+                    running_config_json=cfg.to_json())
+            except ValueError as e:
+                print(f"error: cannot resume map prior: {e}",
+                      file=sys.stderr)
+                return 2
             # Anchor at the relaunched sim's ACTUAL spawn poses: the map
             # is inherited, but robots respawned — fusing at the stale
             # checkpoint poses would draw the spawn surroundings into the
             # wrong part of the map (mapper.restore_states docstring).
             stack.mapper.restore_states(states,
-                                        anchor_poses=stack.brain.poses)
+                                        anchor_poses=stack.brain.poses,
+                                        map_prior=ckpt_prior)
             print(f"resumed {len(states)} robot state(s) from "
                   f"{args.resume}", file=sys.stderr)
             if stack.voxel_mapper is not None:
@@ -382,6 +394,13 @@ def main(argv=None) -> int:
                             config_json=cfg.to_json())
             print(f"checkpoint written to {args.save_final}",
                   file=sys.stderr)
+            prior = stack.mapper.map_prior()
+            if prior is not None:
+                from jax_mapping.io.checkpoint import save_prior_sidecar
+                pp = save_prior_sidecar(args.save_final, prior,
+                                        config_json=cfg.to_json())
+                print(f"map-prior sidecar written to {pp}",
+                      file=sys.stderr)
             if stack.voxel_mapper is not None:
                 from jax_mapping.io.checkpoint import (
                     save_keyframe_sidecar, save_voxel_sidecar)

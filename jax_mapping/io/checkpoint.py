@@ -155,6 +155,66 @@ def load_voxel_sidecar(path: str, template_grid: Any,
     return state["grid"]
 
 
+def prior_sidecar_path(path: str) -> str:
+    """Sidecar for an imported map prior (mapper.seed_map_prior) next to
+    a 2D checkpoint. Ships separately so checkpoints without a prior
+    stay byte-identical to before, and because the prior must survive a
+    resume: closure re-fusions rebuild the grid from empty + rings, and
+    a restored session without its prior would erase the imported map at
+    the first closure — the exact bug the backfill exists to fix,
+    resurfacing across a restart."""
+    root, ext = os.path.splitext(path)
+    return root + ".prior" + (ext or ".npz")
+
+
+_PRIOR_SENTINEL = "prior_sidecar_marker"
+
+
+def save_prior_sidecar(path: str, prior: Any,
+                       config_json: Optional[str] = None) -> str:
+    """Write the map prior as `path`'s .prior sidecar; returns the path.
+    Same clobber guard as the voxel sidecar."""
+    pp = prior_sidecar_path(path)
+    if os.path.exists(pp) and not _is_prior_sidecar(pp):
+        raise ValueError(
+            f"{pp} exists and is not a prior sidecar (a checkpoint named "
+            f"with the reserved '.prior' suffix?); refusing to overwrite")
+    save_checkpoint(pp, {"prior": prior, _PRIOR_SENTINEL: np.int8(1)},
+                    config_json=config_json)
+    return pp
+
+
+def load_prior_sidecar(path: str, template_grid: Any,
+                       running_config_json: Optional[str] = None) -> Any:
+    """Load `path`'s prior sidecar, or None when no sidecar exists.
+    ValueError on non-sidecar collision, shape drift, or config drift —
+    one validation path for demo --resume and HTTP /load."""
+    pp = prior_sidecar_path(path)
+    if not os.path.exists(pp):
+        return None
+    if not _is_prior_sidecar(pp):
+        raise ValueError(
+            f"{pp} is not a prior sidecar (name collision with a "
+            f"checkpoint named '.prior'?); refusing to load")
+    state, cfg_json = load_checkpoint(
+        pp, {"prior": template_grid, _PRIOR_SENTINEL: np.int8(0)})
+    if cfg_json is not None and running_config_json is not None:
+        from jax_mapping.config import configs_equivalent
+        if not configs_equivalent(cfg_json, running_config_json):
+            raise ValueError(
+                "prior sidecar config differs from the running config")
+    return state["prior"]
+
+
+def _is_prior_sidecar(pp: str) -> bool:
+    try:
+        with np.load(pp) as z:
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        return _PRIOR_SENTINEL in meta.get("keys", [])
+    except Exception:
+        return False
+
+
 def keyframe_sidecar_path(path: str) -> str:
     """Sidecar for the 3D depth-keyframe ring next to a 2D checkpoint.
 

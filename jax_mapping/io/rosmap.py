@@ -99,6 +99,9 @@ def load_map(yaml_path: str) -> Tuple[np.ndarray, float,
     when negate=0)."""
     with open(yaml_path) as f:
         meta = _parse_yaml(f.read())
+    if "image" not in meta or "resolution" not in meta:
+        raise ValueError(
+            f"{yaml_path}: missing 'image' or 'resolution' key")
     img_path = os.path.join(os.path.dirname(os.path.abspath(yaml_path)),
                             str(meta["image"]))
     with open(img_path, "rb") as f:
@@ -109,9 +112,15 @@ def load_map(yaml_path: str) -> Tuple[np.ndarray, float,
         dims = f.readline().split()
         while dims and dims[0].startswith(b"#"):     # comment lines
             dims = f.readline().split()
-        w, h = int(dims[0]), int(dims[1])
-        maxval = int(f.readline().strip())
-        px = np.frombuffer(f.read(w * h), np.uint8).reshape(h, w)
+        try:
+            w, h = int(dims[0]), int(dims[1])
+            maxval = int(f.readline().strip())
+            px = np.frombuffer(f.read(w * h), np.uint8).reshape(h, w)
+        except (IndexError, ValueError) as e:
+            # Truncated/malformed header or short pixel payload — the
+            # hand-rolled parser must surface one exception type so
+            # callers' polite-refusal contracts hold.
+            raise ValueError(f"malformed PGM {img_path}: {e}") from e
     if maxval != 255:
         raise ValueError(f"unsupported PGM maxval {maxval}")
     negate = int(meta.get("negate", 0))
@@ -124,6 +133,9 @@ def load_map(yaml_path: str) -> Tuple[np.ndarray, float,
     occ[p_occ < free_t] = 0
     occ = np.flipud(occ)                     # image bottom -> grid min-y
     origin = meta.get("origin", [0.0, 0.0, 0.0])
+    if not isinstance(origin, list) or len(origin) < 2:
+        raise ValueError(f"malformed origin {origin!r} "
+                         "(expected [x, y, yaw])")
     if len(origin) > 2 and abs(float(origin[2])) > 1e-9:
         # Legal in ROS, but embedding is axis-aligned (same stance as the
         # same-resolution-only rule): importing a rotated map unrotated

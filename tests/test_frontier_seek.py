@@ -1,0 +1,139 @@
+"""Assigned-frontier exploration through the bridge brain.
+
+The mapper has always PUBLISHED /frontiers (targets + per-robot
+assignment); until round 5 nothing drove the robots with it — the bridge
+stack explored reactively (blind cruise + shield) while the assignments
+only fed RViz markers. FrontierConfig.seek_assigned wires them into the
+brain's goal-seek: the map-based explorer the reference's report defers
+to future work (report.pdf §VI.2), actually steering the fleet.
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+
+from jax_mapping.bridge.messages import FrontierArray, Header
+
+
+def _bare_brain(tiny_cfg, seek=True, n_robots=1):
+    from jax_mapping.bridge.brain import ThymioBrain
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.driver import SimulatedThymioDriver
+
+    cfg = dataclasses.replace(
+        tiny_cfg,
+        robot=dataclasses.replace(tiny_cfg.robot, cruise_speed_units=300),
+        frontier=dataclasses.replace(tiny_cfg.frontier,
+                                     seek_assigned=seek))
+    bus = Bus()
+    brain = ThymioBrain(cfg, bus, SimulatedThymioDriver(n_robots=n_robots),
+                        n_robots=n_robots)
+    return bus, brain
+
+
+def _publish_frontiers(bus, targets, assignment):
+    bus.publisher("/frontiers").publish(FrontierArray(
+        header=Header.now("map"),
+        targets_xy=np.asarray(targets, np.float32),
+        sizes=np.full(len(targets), 10, np.int32),
+        assignment=np.asarray(assignment, np.int32)))
+
+
+def test_brain_steers_to_assigned_frontier(tiny_cfg):
+    """A frontier BEHIND the robot: with seek the robot turns around and
+    closes distance; without it the blind cruise drives away. The bare
+    brain + simulated driver is a pure kinematic rig (no LiDAR walls, no
+    mapper interference)."""
+    results = {}
+    for seek in (True, False):
+        bus, brain = _bare_brain(tiny_cfg, seek=seek)
+        try:
+            brain.start_exploring()
+            target = (-1.0, 0.0)             # robot starts at 0,0 facing +x
+            d0 = math.hypot(*target)
+            for _ in range(120):
+                _publish_frontiers(bus, [target], [0])
+                brain.update_loop()
+                # Perfect-response physics: written targets become the
+                # measured speeds the next tick reads (the sim node's
+                # ingest_state role, minus the lag model).
+                brain.driver.ingest_state(brain.driver.targets(),
+                                          np.zeros((1, 7), np.int32))
+            p = brain.robot_pose(0)
+            results[seek] = math.hypot(p[0] - target[0], p[1] - target[1])
+        finally:
+            brain.destroy()
+    assert results[True] < d0 * 0.6, (
+        f"seek never closed on the frontier (d={results[True]:.2f})")
+    assert results[False] > d0, (
+        "blind cruise unexpectedly approached the rear frontier — the "
+        "control rig no longer distinguishes the modes")
+
+
+def test_manual_goal_outranks_frontier(tiny_cfg):
+    """Robot 0's RViz nav goal wins over its frontier assignment; other
+    robots still take theirs."""
+    bus, brain = _bare_brain(tiny_cfg, n_robots=2)
+    try:
+        brain.start_exploring()
+        goals = np.zeros((2, 2), np.float32)
+        valid = np.zeros(2, bool)
+        goals[0] = (2.0, 2.0)                # manual goal, robot 0
+        valid[0] = True
+        _publish_frontiers(bus, [(-1.0, 0.0), (0.0, -1.0)], [0, 1])
+        brain._apply_frontier_goals(goals, valid)
+        assert valid.all()
+        assert tuple(goals[0]) == (2.0, 2.0)           # manual goal kept
+        assert tuple(goals[1]) == (0.0, -1.0)          # assignment applied
+    finally:
+        brain.destroy()
+
+
+def test_unassigned_and_stale_frontiers_ignored(tiny_cfg):
+    bus, brain = _bare_brain(tiny_cfg)
+    try:
+        goals = np.zeros((1, 2), np.float32)
+        valid = np.zeros(1, bool)
+        _publish_frontiers(bus, [(1.0, 1.0)], [-1])    # no reachable one
+        brain._apply_frontier_goals(goals, valid)
+        assert not valid.any()
+        _publish_frontiers(bus, [(1.0, 1.0)], [0])
+        brain.n_ticks += int(brain.cfg.frontier.seek_ttl_s
+                             * brain.cfg.robot.control_rate_hz) + 1
+        brain._apply_frontier_goals(goals, valid)      # stale: mapper dead
+        assert not valid.any()
+    finally:
+        brain.destroy()
+
+
+def test_stack_explores_toward_frontiers(tiny_cfg):
+    """Full stack: with seek the robot leaves its corner of a rooms world
+    through the live mapper's assignments and fuses more of the map than
+    the blind cruiser over the same budget."""
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    coverage = {}
+    for seek in (True, False):
+        cfg = dataclasses.replace(
+            tiny_cfg,
+            robot=dataclasses.replace(tiny_cfg.robot,
+                                      cruise_speed_units=600),
+            planner=dataclasses.replace(tiny_cfg.planner, enabled=False),
+            frontier=dataclasses.replace(tiny_cfg.frontier,
+                                         seek_assigned=seek))
+        world = W.rooms_world(128, cfg.grid.resolution_m, seed=5)
+        st = launch_sim_stack(cfg, world, n_robots=1, http_port=None,
+                              seed=6)
+        try:
+            st.brain.start_exploring()
+            st.run_steps(250)
+            lo = np.asarray(st.mapper.merged_grid())
+            coverage[seek] = int((np.abs(lo) > 0.3).sum())
+        finally:
+            st.shutdown()
+    # Frontier seek must not map LESS than blind wander (it usually maps
+    # substantially more; equality-ish can happen in tiny worlds, so the
+    # bound is conservative).
+    assert coverage[True] >= coverage[False] * 0.8, coverage

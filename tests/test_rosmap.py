@@ -203,6 +203,51 @@ def test_prior_survives_closure_refusion(tiny_cfg, tmp_path):
         st.shutdown()
 
 
+def test_prior_lifecycle_across_save_load(tiny_cfg, tmp_path):
+    """The prior persists through /save + /load (a .prior sidecar) — a
+    resumed session's first closure must still backfill the imported map
+    — and a /load of a PRIOR-LESS checkpoint CLEARS a live prior, so a
+    stale prior can't paint another environment's walls."""
+    import json as _json
+    import urllib.request
+
+    st = _stack(tiny_cfg, tmp_path)
+    try:
+        n = st.cfg.grid.size_cells
+        prior = np.zeros((n, n), np.float32)
+        prior[10:20, 10:20] = 2.0
+        st.mapper.seed_map_prior(prior)
+        base = f"http://127.0.0.1:{st.api.port}"
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/save?name=withprior", method="POST")) as r:
+            body = _json.loads(r.read())
+        assert body["prior_path"].endswith(".prior.npz")
+
+        # Clear the live prior, then /load: it must come back.
+        st.mapper.restore_states(st.mapper.snapshot_states())
+        assert st.mapper.map_prior() is None
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/load?name=withprior", method="POST")) as r:
+            body = _json.loads(r.read())
+        assert "prior_path" in body
+        restored = np.asarray(st.mapper.map_prior())
+        assert (restored[10:20, 10:20] == 2.0).all()
+
+        # Save WITHOUT a prior, re-seed one live, /load the prior-less
+        # checkpoint: the stale prior must clear.
+        st.mapper.restore_states(st.mapper.snapshot_states())
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/save?name=noprior", method="POST")) as r:
+            assert "prior_path" not in _json.loads(r.read())
+        st.mapper.seed_map_prior(prior)
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/load?name=noprior", method="POST")) as r:
+            _json.loads(r.read())
+        assert st.mapper.map_prior() is None
+    finally:
+        st.shutdown()
+
+
 def test_demo_map_prior_bad_input_polite(tmp_path, capsys):
     """--map-prior input failures follow the --resume contract: polite
     message + rc=2, not a traceback."""
