@@ -137,6 +137,12 @@ class ThymioBrain(Node):
         # the RViz markers.
         self._frontiers = None
         self.create_subscription("/frontiers", self._frontiers_cb)
+        # Per-robot planned waypoints toward assignments
+        # (bridge/planner.py frontier waypoints): preferred over the raw
+        # target when fresh, reachable, and planned for the SAME target.
+        self._frontier_wps: dict = {}
+        self.create_subscription("/frontier_waypoints",
+                                 self._frontier_wp_cb)
 
         # Boot connect, offline mode on failure (pi variant semantics).
         self.link_up = connect_with_retries(
@@ -174,6 +180,11 @@ class ThymioBrain(Node):
         with self._state_lock:
             self._frontiers = (msg, self.n_ticks)
 
+    def _frontier_wp_cb(self, msg) -> None:
+        with self._state_lock:
+            self._frontier_wps[int(getattr(msg, "robot", 0))] = \
+                (msg, self.n_ticks)
+
     def _apply_frontier_goals(self, goals_xy: np.ndarray,
                               goal_valid: np.ndarray) -> None:
         """Fill unset goal rows from the freshest /frontiers assignment.
@@ -186,6 +197,7 @@ class ThymioBrain(Node):
             return
         with self._state_lock:
             entry = self._frontiers
+            fwps = dict(self._frontier_wps)
         if entry is None:
             return
         msg, at_tick = entry
@@ -195,11 +207,27 @@ class ThymioBrain(Node):
             return
         targets = np.asarray(msg.targets_xy, np.float32)
         assign = np.asarray(msg.assignment)
+        ttl_wp = (self.cfg.planner.waypoint_ttl_s
+                  * self.cfg.robot.control_rate_hz)
+        # A planned waypoint must have been computed for (about) THIS
+        # target — clusters drift between publishes, so the echo match
+        # is per-coarse-cell, not exact.
+        tol = (self.cfg.grid.resolution_m * self.cfg.frontier.downsample
+               * 2.0)
         for i in range(min(self.n_robots, len(assign))):
             a = int(assign[i])
-            if not goal_valid[i] and 0 <= a < len(targets):
-                goals_xy[i] = targets[a]
-                goal_valid[i] = True
+            if goal_valid[i] or not 0 <= a < len(targets):
+                continue
+            goals_xy[i] = targets[a]
+            goal_valid[i] = True
+            wp_entry = fwps.get(i)
+            if wp_entry is None:
+                continue
+            wp, wp_tick = wp_entry
+            if (wp.reachable and self.n_ticks - wp_tick <= ttl_wp
+                    and np.hypot(wp.goal_x - targets[a][0],
+                                 wp.goal_y - targets[a][1]) <= tol):
+                goals_xy[i] = (wp.x, wp.y)
 
     def nav_goal(self) -> Optional[tuple]:
         """Current navigation goal (planner reads the brain's copy so a

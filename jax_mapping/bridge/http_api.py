@@ -218,10 +218,23 @@ class MapApiServer:
                             config_json=self.mapper.cfg.to_json())
             body = {"status": "saved", "path": fp, "robots": len(states)}
             prior = self.mapper.map_prior()
+            from jax_mapping.io.checkpoint import (prior_sidecar_path,
+                                                   save_prior_sidecar)
             if prior is not None:
-                from jax_mapping.io.checkpoint import save_prior_sidecar
-                body["prior_path"] = save_prior_sidecar(
-                    fp, prior, config_json=self.mapper.cfg.to_json())
+                try:
+                    body["prior_path"] = save_prior_sidecar(
+                        fp, prior, config_json=self.mapper.cfg.to_json())
+                except ValueError as e:
+                    # Same contract as the voxel sidecar: the main
+                    # checkpoint IS saved; report the sidecar problem.
+                    body["prior_error"] = str(e)
+            else:
+                # A stale sidecar from an earlier save under this name
+                # would resurrect the OLD environment's prior on /load —
+                # exactly what restore_states' clear contract prevents.
+                pp = prior_sidecar_path(fp)
+                if os.path.exists(pp):
+                    os.unlink(pp)
             if self.voxel_mapper is not None:
                 from jax_mapping.io.checkpoint import (
                     save_keyframe_sidecar, save_voxel_sidecar)

@@ -271,7 +271,9 @@ class Waypoint:
     The brain steers toward (x, y) instead of the raw goal while the
     message is fresher than PlannerConfig.waypoint_ttl_s and `reachable`;
     goal_x/goal_y echo the goal the plan was computed FOR, so a steering
-    target from a superseded goal is never applied to a new one."""
+    target from a superseded goal is never applied to a new one. `robot`
+    addresses the fleet member (frontier waypoints are per-robot; the
+    manual nav goal is robot 0's, brain._goal_cb's convention)."""
 
     header: Header = dataclasses.field(default_factory=Header)
     x: float = 0.0
@@ -279,6 +281,7 @@ class Waypoint:
     reachable: bool = False
     goal_x: float = 0.0
     goal_y: float = 0.0
+    robot: int = 0
 
 
 def occupancy_from_logodds(logodds: np.ndarray, occ_threshold: float,

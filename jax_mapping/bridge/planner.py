@@ -61,53 +61,77 @@ class PlannerNode(Node):
         self._goal: Optional[tuple] = None
         if brain is None:
             self.create_subscription("/goal_pose", self._goal_cb)
+        # Frontier waypoints (PlannerConfig.frontier_waypoints): per-robot
+        # planned steering targets toward /frontiers assignments, so fleet
+        # exploration navigates around walls instead of straight-line
+        # seeking into them. The brain matches each waypoint to its
+        # robot's CURRENT assignment via the goal echo.
+        self._frontiers = None
+        self.create_subscription("/frontiers", self._frontiers_cb)
+        self.fwp_pub = self.create_publisher("/frontier_waypoints")
         self.n_plans = 0
+        self.n_frontier_plans = 0
         self.last_reachable: Optional[bool] = None
         self.create_timer(cfg.planner.period_s, self.tick)
 
     def _goal_cb(self, msg) -> None:
         self._goal = (float(msg.x), float(msg.y))
 
+    def _frontiers_cb(self, msg) -> None:
+        self._frontiers = msg
+
     def _current_goal(self) -> Optional[tuple]:
         if self.brain is not None:
             return self.brain.nav_goal()
         return self._goal
 
-    def _robot_pose_xy(self) -> Optional[np.ndarray]:
+    def _robot_pose_xy(self, i: Optional[int] = None
+                       ) -> Optional[np.ndarray]:
         """SLAM-corrected pose when the mapper has stepped; the brain's
         odometry pose before that (map == odom until the first
         correction)."""
-        anchor = self.mapper.depth_anchor(self.robot_idx)
+        if i is None:
+            i = self.robot_idx
+        anchor = self.mapper.depth_anchor(i)
         if anchor is not None:
             return np.asarray(anchor[1], np.float32)[:2]
         if self.brain is not None:
-            return self.brain.robot_pose(self.robot_idx)[:2]
+            return self.brain.robot_pose(i)[:2]
         return None
 
-    def tick(self) -> None:
-        goal = self._current_goal()
-        if goal is None:
-            return
-        pose_xy = self._robot_pose_xy()
-        if pose_xy is None:
-            return
+    def _plan(self, goal, pose_xy):
+        """One jitted plan; returns (path, reachable, waypoint, arrived)."""
         import jax.numpy as jnp
         from jax_mapping.ops import planner as P
+        r = P.plan_to_goal(self.cfg.planner, self.cfg.frontier,
+                           self.cfg.grid, self.mapper.merged_grid(),
+                           jnp.asarray(np.asarray(goal, np.float32)),
+                           jnp.asarray(pose_xy))
+        return (np.asarray(r.path_xy)[np.asarray(r.path_valid)],
+                bool(r.reachable), np.asarray(r.waypoint_xy, np.float32),
+                bool(r.arrived))
+
+    def tick(self) -> None:
         with M.stages.stage("planner.tick"):
-            r = P.plan_to_goal(self.cfg.planner, self.cfg.frontier,
-                               self.cfg.grid, self.mapper.merged_grid(),
-                               jnp.asarray(np.asarray(goal, np.float32)),
-                               jnp.asarray(pose_xy))
-            valid = np.asarray(r.path_valid)
-            path = np.asarray(r.path_xy)[valid]
-            reachable = bool(r.reachable)
-            wp = np.asarray(r.waypoint_xy, np.float32)
-        if self.brain is None and bool(r.arrived):
+            manual = self._tick_manual_goal()
+            if self.cfg.planner.frontier_waypoints:
+                self._tick_frontier_waypoints(manual_active=manual)
+
+    def _tick_manual_goal(self) -> bool:
+        """Plan for the RViz nav goal; returns whether one is active."""
+        goal = self._current_goal()
+        if goal is None:
+            return False
+        pose_xy = self._robot_pose_xy()
+        if pose_xy is None:
+            return True
+        path, reachable, wp, arrived = self._plan(goal, pose_xy)
+        if self.brain is None and arrived:
             # Standalone arrival bookkeeping: with a brain the brain
             # clears the goal (and this node reads its copy); without one
             # the planner must stop itself or it replans forever.
             self._goal = None
-            return
+            return False
         hdr = Header.now("map")
         self.plan_pub.publish(Path(header=hdr, poses_xy=path))
         self.wp_pub.publish(Waypoint(header=hdr, x=float(wp[0]),
@@ -117,8 +141,51 @@ class PlannerNode(Node):
         self.n_plans += 1
         self.last_reachable = reachable
         M.counters.inc("planner.plans")
+        return True
+
+    def _tick_frontier_waypoints(self, manual_active: bool) -> None:
+        """Plan per exploring robot toward its /frontiers assignment and
+        publish per-robot waypoints (+ robot 0's plan for RViz when no
+        manual goal claims /plan)."""
+        fr = self._frontiers
+        if fr is None:
+            return
+        if self.brain is not None and not self.brain.is_exploring:
+            return                           # /stop: nothing to steer
+        # A dead mapper must not keep the planner burning a BFS per robot
+        # per period toward frozen assignments (the brain's seek_ttl_s
+        # gate would discard the waypoints anyway). Wall-clock age is the
+        # right clock here: in deterministic stepping the mapper runs in
+        # the same loop and cannot silently die between steps.
+        if (time.monotonic() - fr.header.stamp
+                > self.cfg.frontier.seek_ttl_s):
+            return
+        targets = np.asarray(fr.targets_xy, np.float32)
+        assign = np.asarray(fr.assignment)
+        hdr = Header.now("map")
+        for i in range(min(self.mapper.n_robots, len(assign))):
+            if manual_active and i == self.robot_idx:
+                continue                     # the nav goal owns robot 0
+            a = int(assign[i])
+            if not 0 <= a < len(targets):
+                continue
+            pose_xy = self._robot_pose_xy(i)
+            if pose_xy is None:
+                continue
+            target = targets[a]
+            path, reachable, wp, _arrived = self._plan(tuple(target),
+                                                       pose_xy)
+            self.fwp_pub.publish(Waypoint(
+                header=hdr, x=float(wp[0]), y=float(wp[1]),
+                reachable=reachable, goal_x=float(target[0]),
+                goal_y=float(target[1]), robot=i))
+            self.n_frontier_plans += 1
+            M.counters.inc("planner.frontier_plans")
+            if i == self.robot_idx and not manual_active:
+                self.plan_pub.publish(Path(header=hdr, poses_xy=path))
 
     def status(self) -> dict:
         return {"n_plans": self.n_plans,
+                "n_frontier_plans": self.n_frontier_plans,
                 "last_reachable": self.last_reachable,
                 "goal": self._current_goal()}

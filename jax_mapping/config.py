@@ -255,6 +255,12 @@ class PlannerConfig:
     # reach the robot for the goal to be declared reachable; each bound
     # unit is one doubled min-plus sweep (radius 2 cells).
     bfs_iters: int = 512
+    # Plan for assigned FRONTIERS too (not just the manual nav goal):
+    # each replan period the planner computes a path per exploring robot
+    # to its /frontiers assignment and publishes per-robot waypoints the
+    # brain steers at — frontier exploration that navigates around walls
+    # instead of straight-line seeking into them.
+    frontier_waypoints: bool = True
 
 
 @_frozen

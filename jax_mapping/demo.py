@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -395,12 +396,19 @@ def main(argv=None) -> int:
             print(f"checkpoint written to {args.save_final}",
                   file=sys.stderr)
             prior = stack.mapper.map_prior()
+            from jax_mapping.io.checkpoint import (prior_sidecar_path,
+                                                   save_prior_sidecar)
             if prior is not None:
-                from jax_mapping.io.checkpoint import save_prior_sidecar
                 pp = save_prior_sidecar(args.save_final, prior,
                                         config_json=cfg.to_json())
                 print(f"map-prior sidecar written to {pp}",
                       file=sys.stderr)
+            else:
+                # Remove a stale sidecar from an earlier save under this
+                # name — it would resurrect the old prior on resume.
+                pp = prior_sidecar_path(args.save_final)
+                if os.path.exists(pp):
+                    os.unlink(pp)
             if stack.voxel_mapper is not None:
                 from jax_mapping.io.checkpoint import (
                     save_keyframe_sidecar, save_voxel_sidecar)

@@ -107,6 +107,86 @@ def test_unassigned_and_stale_frontiers_ignored(tiny_cfg):
         brain.destroy()
 
 
+def test_frontier_waypoint_preferred_when_matching(tiny_cfg):
+    """The brain steers at the planner's per-robot frontier waypoint when
+    it is fresh, reachable, and planned for (about) the robot's CURRENT
+    assignment — raw target otherwise."""
+    from jax_mapping.bridge.messages import Waypoint
+
+    bus, brain = _bare_brain(tiny_cfg, n_robots=2)
+    try:
+        target = (2.0, 0.0)
+        tol = (tiny_cfg.grid.resolution_m * tiny_cfg.frontier.downsample
+               * 2.0)
+
+        def wp(robot, goal, reachable=True):
+            return Waypoint(header=Header.now("map"), x=0.5, y=0.5,
+                            reachable=reachable, goal_x=goal[0],
+                            goal_y=goal[1], robot=robot)
+
+        goals = np.zeros((2, 2), np.float32)
+        valid = np.zeros(2, bool)
+        _publish_frontiers(bus, [target], [0, 0])
+        bus.publisher("/frontier_waypoints").publish(wp(0, target))
+        brain._apply_frontier_goals(goals, valid)
+        assert tuple(goals[0]) == (0.5, 0.5)           # planned waypoint
+        assert tuple(goals[1]) == target               # no waypoint: raw
+
+        # Waypoint for a DIFFERENT target (cluster moved): raw target.
+        bus.publisher("/frontier_waypoints").publish(
+            wp(0, (target[0] + 3 * tol, target[1])))
+        goals[:] = 0
+        valid[:] = False
+        brain._apply_frontier_goals(goals, valid)
+        assert tuple(goals[0]) == target
+
+        # Unreachable plan: raw target (blind seek under the shield).
+        bus.publisher("/frontier_waypoints").publish(
+            wp(0, target, reachable=False))
+        goals[:] = 0
+        valid[:] = False
+        brain._apply_frontier_goals(goals, valid)
+        assert tuple(goals[0]) == target
+    finally:
+        brain.destroy()
+
+
+def test_planner_publishes_frontier_waypoints(tiny_cfg):
+    """Full stack: with no manual goal, the planner plans toward the live
+    mapper's assignments and publishes per-robot /frontier_waypoints."""
+    import dataclasses as _dc
+
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    cfg = _dc.replace(
+        tiny_cfg,
+        robot=_dc.replace(tiny_cfg.robot, cruise_speed_units=600),
+        planner=_dc.replace(tiny_cfg.planner, lookahead_cells=3,
+                            bfs_iters=128))
+    world = W.empty_arena(96, cfg.grid.resolution_m)
+    st = launch_sim_stack(cfg, world, n_robots=2, http_port=None, seed=7)
+    try:
+        wps = []
+        st.bus.subscribe("/frontier_waypoints", callback=wps.append)
+        st.brain.start_exploring()
+        # Frontier clusters need some explored area before assignments
+        # become valid; step until the planner has planned one (bounded).
+        for _ in range(30):
+            st.run_steps(round(cfg.planner.period_s
+                               * cfg.robot.control_rate_hz))
+            if st.planner.n_frontier_plans > 0:
+                break
+        assert st.planner.n_frontier_plans > 0
+        assert wps, "no frontier waypoint ever published"
+        robots = {w.robot for w in wps}
+        assert robots <= {0, 1} and len(robots) >= 1
+        for w in wps:
+            assert np.isfinite([w.x, w.y]).all()
+    finally:
+        st.shutdown()
+
+
 def test_stack_explores_toward_frontiers(tiny_cfg):
     """Full stack: with seek the robot leaves its corner of a rooms world
     through the live mapper's assignments and fuses more of the map than

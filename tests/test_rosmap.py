@@ -244,6 +244,20 @@ def test_prior_lifecycle_across_save_load(tiny_cfg, tmp_path):
                 f"{base}/load?name=noprior", method="POST")) as r:
             _json.loads(r.read())
         assert st.mapper.map_prior() is None
+
+        # Overwrite the SAME name without a live prior: the earlier
+        # save's .prior sidecar must be deleted, or the old environment's
+        # prior resurrects on the next /load of that name.
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/save?name=withprior", method="POST")) as r:
+            assert "prior_path" not in _json.loads(r.read())
+        import os as _os
+        assert not _os.path.exists(
+            str(tmp_path / "withprior.prior.npz"))
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/load?name=withprior", method="POST")) as r:
+            _json.loads(r.read())
+        assert st.mapper.map_prior() is None
     finally:
         st.shutdown()
 
