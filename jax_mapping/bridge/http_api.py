@@ -218,7 +218,7 @@ class MapApiServer:
                             config_json=self.mapper.cfg.to_json())
             body = {"status": "saved", "path": fp, "robots": len(states)}
             prior = self.mapper.map_prior()
-            from jax_mapping.io.checkpoint import (prior_sidecar_path,
+            from jax_mapping.io.checkpoint import (clear_prior_sidecar,
                                                    save_prior_sidecar)
             if prior is not None:
                 try:
@@ -232,9 +232,8 @@ class MapApiServer:
                 # A stale sidecar from an earlier save under this name
                 # would resurrect the OLD environment's prior on /load —
                 # exactly what restore_states' clear contract prevents.
-                pp = prior_sidecar_path(fp)
-                if os.path.exists(pp):
-                    os.unlink(pp)
+                # (Sentinel-checked: never deletes a non-sidecar file.)
+                clear_prior_sidecar(fp)
             if self.voxel_mapper is not None:
                 from jax_mapping.io.checkpoint import (
                     save_keyframe_sidecar, save_voxel_sidecar)

@@ -29,7 +29,6 @@ corrected map.
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 import numpy as np
@@ -71,14 +70,20 @@ class PlannerNode(Node):
         self.fwp_pub = self.create_publisher("/frontier_waypoints")
         self.n_plans = 0
         self.n_frontier_plans = 0
+        self.n_goal_fields = 0
         self.last_reachable: Optional[bool] = None
+        #: Planner tick counter — the staleness clock for /frontiers.
+        #: The repo's TTL doctrine (brain._steer_target): freshness in
+        #: the DETERMINISTIC time base, never wall time, or slow hosts
+        #: silently change trajectories.
+        self._n_ticks = 0
         self.create_timer(cfg.planner.period_s, self.tick)
 
     def _goal_cb(self, msg) -> None:
         self._goal = (float(msg.x), float(msg.y))
 
     def _frontiers_cb(self, msg) -> None:
-        self._frontiers = msg
+        self._frontiers = (msg, self._n_ticks)
 
     def _current_goal(self) -> Optional[tuple]:
         if self.brain is not None:
@@ -112,6 +117,7 @@ class PlannerNode(Node):
                 bool(r.arrived))
 
     def tick(self) -> None:
+        self._n_ticks += 1
         with M.stages.stage("planner.tick"):
             manual = self._tick_manual_goal()
             if self.cfg.planner.frontier_waypoints:
@@ -147,22 +153,31 @@ class PlannerNode(Node):
         """Plan per exploring robot toward its /frontiers assignment and
         publish per-robot waypoints (+ robot 0's plan for RViz when no
         manual goal claims /plan)."""
-        fr = self._frontiers
-        if fr is None:
+        entry = self._frontiers
+        if entry is None:
             return
+        fr, at_tick = entry
         if self.brain is not None and not self.brain.is_exploring:
             return                           # /stop: nothing to steer
-        # A dead mapper must not keep the planner burning a BFS per robot
-        # per period toward frozen assignments (the brain's seek_ttl_s
-        # gate would discard the waypoints anyway). Wall-clock age is the
-        # right clock here: in deterministic stepping the mapper runs in
-        # the same loop and cannot silently die between steps.
-        if (time.monotonic() - fr.header.stamp
-                > self.cfg.frontier.seek_ttl_s):
+        # A dead mapper must not keep the planner burning a BFS per
+        # target per period toward frozen assignments (the brain's
+        # seek_ttl_s gate would discard the waypoints anyway). Staleness
+        # in PLANNER TICKS — the deterministic time base — per the TTL
+        # doctrine above.
+        ttl_ticks = max(1, round(self.cfg.frontier.seek_ttl_s
+                                 / self.cfg.planner.period_s))
+        if self._n_ticks - at_tick > ttl_ticks:
             return
         targets = np.asarray(fr.targets_xy, np.float32)
         assign = np.asarray(fr.assignment)
         hdr = Header.now("map")
+        # The goal-seeded field is the dominant cost and depends only on
+        # the target; the frontier auction SHARES clusters when robots
+        # outnumber frontiers (assign_frontiers), so compute one field
+        # per unique assigned target and descend it per robot.
+        import jax.numpy as jnp
+        from jax_mapping.ops import planner as P
+        fields: dict = {}
         for i in range(min(self.mapper.n_robots, len(assign))):
             if manual_active and i == self.robot_idx:
                 continue                     # the nav goal owns robot 0
@@ -173,8 +188,19 @@ class PlannerNode(Node):
             if pose_xy is None:
                 continue
             target = targets[a]
-            path, reachable, wp, _arrived = self._plan(tuple(target),
-                                                       pose_xy)
+            if a not in fields:
+                fields[a] = P.goal_field(
+                    self.cfg.planner, self.cfg.frontier, self.cfg.grid,
+                    self.mapper.merged_grid(),
+                    jnp.asarray(np.asarray(target, np.float32)))
+                self.n_goal_fields += 1
+            r = P.descend_field(self.cfg.planner, self.cfg.frontier,
+                                self.cfg.grid, fields[a],
+                                jnp.asarray(np.asarray(target,
+                                                       np.float32)),
+                                jnp.asarray(pose_xy))
+            reachable = bool(r.reachable)
+            wp = np.asarray(r.waypoint_xy, np.float32)
             self.fwp_pub.publish(Waypoint(
                 header=hdr, x=float(wp[0]), y=float(wp[1]),
                 reachable=reachable, goal_x=float(target[0]),
@@ -182,6 +208,7 @@ class PlannerNode(Node):
             self.n_frontier_plans += 1
             M.counters.inc("planner.frontier_plans")
             if i == self.robot_idx and not manual_active:
+                path = np.asarray(r.path_xy)[np.asarray(r.path_valid)]
                 self.plan_pub.publish(Path(header=hdr, poses_xy=path))
 
     def status(self) -> dict:

@@ -217,6 +217,14 @@ class FrontierConfig:
     # the reactive shield still outranks. False = the reference's pure
     # subsumption wander.
     seek_assigned: bool = True
+    # On-device planned steering for the fleet model: steer at a
+    # waypoint descended from a TARGET-seeded cost field instead of
+    # straight at the assigned target (frontier.assigned_waypoints).
+    # Roughly doubles the obstacle-aware frontier cost (a second
+    # cost_fields pass), so it defaults off — the <5 ms p50 @ 64 robots
+    # budget was set without it.
+    planned_goals: bool = False
+    waypoint_lookahead: int = 2       # descent steps, clustering cells
     # Assignments older than this (in control-loop time) are ignored —
     # a dead mapper must not leave robots chasing stale frontiers.
     seek_ttl_s: float = 5.0

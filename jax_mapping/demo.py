@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 import time
 
@@ -396,7 +395,7 @@ def main(argv=None) -> int:
             print(f"checkpoint written to {args.save_final}",
                   file=sys.stderr)
             prior = stack.mapper.map_prior()
-            from jax_mapping.io.checkpoint import (prior_sidecar_path,
+            from jax_mapping.io.checkpoint import (clear_prior_sidecar,
                                                    save_prior_sidecar)
             if prior is not None:
                 pp = save_prior_sidecar(args.save_final, prior,
@@ -406,9 +405,8 @@ def main(argv=None) -> int:
             else:
                 # Remove a stale sidecar from an earlier save under this
                 # name — it would resurrect the old prior on resume.
-                pp = prior_sidecar_path(args.save_final)
-                if os.path.exists(pp):
-                    os.unlink(pp)
+                # (Sentinel-checked: never deletes a non-sidecar file.)
+                clear_prior_sidecar(args.save_final)
             if stack.voxel_mapper is not None:
                 from jax_mapping.io.checkpoint import (
                     save_keyframe_sidecar, save_voxel_sidecar)

@@ -206,6 +206,19 @@ def load_prior_sidecar(path: str, template_grid: Any,
     return state["prior"]
 
 
+def clear_prior_sidecar(path: str) -> bool:
+    """Remove checkpoint `path`'s .prior sidecar if one exists; returns
+    whether a file was removed. SENTINEL-CHECKED: a non-sidecar file at
+    the sidecar path (a user checkpoint named '.prior' — the collision
+    the save/load guards refuse with ValueError) is left alone, because
+    a cleanup helper must not bypass the clobber guard."""
+    pp = prior_sidecar_path(path)
+    if os.path.exists(pp) and _is_prior_sidecar(pp):
+        os.unlink(pp)
+        return True
+    return False
+
+
 def _is_prior_sidecar(pp: str) -> bool:
     try:
         with np.load(pp) as z:

@@ -278,6 +278,14 @@ def fleet_step(cfg: SlamConfig, state: FleetState, world_res_m: float,
                              state.est_poses)
     goals = fr.targets[jnp.clip(fr.assignment, 0)]
     goal_valid = fr.assignment >= 0
+    if cfg.frontier.planned_goals:
+        # Planned steering: a waypoint along the min-plus shortest path
+        # to the assigned target (frontier.assigned_waypoints) replaces
+        # the straight-line bearing wherever a plan exists.
+        wps, wvalid = F.assigned_waypoints(cfg.frontier, cfg.grid,
+                                           state.grid, state.est_poses,
+                                           fr.targets, fr.assignment)
+        goals = jnp.where(wvalid[:, None], wps, goals)
     pol = frontier_policy(cfg.robot, cfg.scan, state.est_poses, goals,
                           goal_valid, scans, prox, state.exploring)
 

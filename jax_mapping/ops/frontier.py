@@ -473,6 +473,31 @@ def compute_frontiers(cfg: FrontierConfig, grid_cfg: GridConfig,
                                         robot_poses)
 
 
+def bfs_passability(cfg: FrontierConfig, grid_cfg: GridConfig,
+                    free: Array, unknown: Array, mask: Array
+                    ) -> tuple[Array, float]:
+    """(bfs_passable, bfs_res): the passability grid and cell size the
+    obstacle-aware BFS runs at. ONE definition shared by the assignment
+    costs (compute_frontiers_from_masks) and the planned-steering
+    waypoints (assigned_waypoints_from_masks): a waypoint descent is only
+    correct while its passability matches what the assignment considered
+    traversable.
+
+    At cluster_downsample > 1, passability pools CONSERVATIVELY (a
+    coarse cell is blocked if ANY child is blocked — same stance as
+    coarsen()'s occupancy): pooling with any() instead would erase walls
+    thinner than c cells and let obstacle-aware costs tunnel straight
+    through them. Frontier cells stay traversable so targets in
+    wall-adjacent blocks remain reachable (and seeds are unblocked
+    inside cost_to_go / cost_fields)."""
+    passable = free | mask | unknown   # robots may push into unknown space
+    res = grid_cfg.resolution_m * cfg.downsample
+    c = cfg.cluster_downsample
+    if c == 1:
+        return passable, res
+    return ~_pool_any(~passable, c) | _pool_any(mask, c), res * c
+
+
 def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
                                  free: Array, unknown: Array,
                                  robot_poses: Array) -> FrontierResult:
@@ -483,8 +508,11 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
     d = cfg.downsample
     res = grid_cfg.resolution_m * d
     ox, oy = grid_cfg.origin_m
-    passable = free | mask | unknown   # robots may push into unknown space
-
+    # BFS runs at the clustering resolution (shared definition:
+    # bfs_passability); costs reported in first-level coarse cells for
+    # unit consistency with c == 1.
+    bfs_passable, bfs_res = bfs_passability(cfg, grid_cfg, free, unknown,
+                                            mask)
     if c == 1:
         labels = label_components(cfg, mask)
         centroids, targets, sizes, slots = summarize_clusters(cfg, grid_cfg,
@@ -493,21 +521,12 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
                          0, free.shape[0] - 1)
         tgt_c = jnp.clip(((targets[:, 0] - ox) / res).astype(jnp.int32),
                          0, free.shape[0] - 1)
-        bfs_passable, bfs_res, bfs_scale = passable, res, 1.0
+        bfs_scale = 1.0
     else:
         labels, slots, centroids, targets, sizes, rep_rc, _mask2 = \
             _cluster_hierarchical(cfg, grid_cfg, mask)
         tgt_r, tgt_c = rep_rc[:, 0], rep_rc[:, 1]
-        # BFS runs at the clustering resolution; costs reported in
-        # first-level coarse cells for unit consistency with c == 1.
-        # Passability pools CONSERVATIVELY (a coarse cell is blocked if ANY
-        # child is blocked — same stance as coarsen()'s occupancy): pooling
-        # with any() instead would erase walls thinner than c cells and let
-        # obstacle-aware costs tunnel straight through them. Frontier cells
-        # stay traversable so targets in wall-adjacent blocks remain
-        # reachable (and robot seeds are unblocked inside cost_to_go).
-        bfs_passable = ~_pool_any(~passable, c) | _pool_any(mask, c)
-        bfs_res, bfs_scale = res * c, float(c)
+        bfs_scale = float(c)
 
     if cfg.obstacle_aware:
         if cfg.exact_bfs:
@@ -548,3 +567,92 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
     return FrontierResult(mask=mask, labels=labels, slots=slots,
                           centroids=centroids, targets=targets, sizes=sizes,
                           assignment=assignment, costs=costs)
+
+
+# ---------------------------------------------------------------------------
+# Planned steering waypoints (FrontierConfig.planned_goals)
+# ---------------------------------------------------------------------------
+
+def assigned_waypoints_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
+                                  free: Array, unknown: Array,
+                                  robot_poses: Array, targets: Array,
+                                  assignment: Array
+                                  ) -> tuple[Array, Array]:
+    """Per-robot planned steering waypoints toward assigned targets.
+
+    The straight-line seek (`models/explorer.frontier_policy`) drives
+    INTO walls between a robot and its frontier and leaves escape to the
+    reactive shield; this computes, per robot, a multigrid cost field
+    seeded at the robot's ASSIGNED target cell (`ops/costfield` — the
+    same engine the assignment costs use, seeded at targets instead of
+    robots) and descends it greedily from the robot's cell for
+    `cfg.waypoint_lookahead` coarse steps: the waypoint leads around
+    obstacles along the min-plus shortest path.
+
+    Cost: one more `cost_fields` pass, roughly DOUBLING the
+    obstacle-aware frontier cost — which is why `planned_goals` defaults
+    off (the <5 ms p50 @ 64 robots budget was set without it).
+
+    Returns (waypoints_xy (R, 2) f32, valid (R,) bool); callers keep the
+    raw target where invalid (unassigned, unreachable, or already inside
+    the target cell).
+    """
+    from jax_mapping.ops import costfield as CF
+
+    mask = frontier_mask(free, unknown)
+    ox, oy = grid_cfg.origin_m
+    # The SAME passability the assignment costs used (shared helper —
+    # a waypoint must never route through a cell the assignment
+    # considered blocked, or vice versa).
+    bfs_passable, bfs_res = bfs_passability(cfg, grid_cfg, free, unknown,
+                                            mask)
+    n2 = bfs_passable.shape[0]
+
+    def to_rc(xy):
+        return jnp.stack(
+            [jnp.clip(((xy[:, 1] - oy) / bfs_res).astype(jnp.int32),
+                      0, n2 - 1),
+             jnp.clip(((xy[:, 0] - ox) / bfs_res).astype(jnp.int32),
+                      0, n2 - 1)], axis=1)
+
+    t_xy = targets[jnp.clip(assignment, 0)]
+    seeds_rc = to_rc(t_xy)
+    robot_rc = to_rc(robot_poses[:, :2])
+
+    fields = CF.cost_fields(~bfs_passable, seeds_rc, cfg.mg_levels,
+                            cfg.mg_refine_iters)        # (R, n2, n2)
+    padded = jnp.pad(fields, ((0, 0), (1, 1), (1, 1)),
+                     constant_values=_BIG)
+
+    d8 = jnp.array([[-1, -1], [-1, 0], [-1, 1],
+                    [0, -1], [0, 0], [0, 1],
+                    [1, -1], [1, 0], [1, 1]], jnp.int32)
+
+    def descend(field_pad, rc0):
+        def body(_, rc):
+            patch = jax.lax.dynamic_slice(field_pad, (rc[0], rc[1]),
+                                          (3, 3))
+            return jnp.clip(rc + d8[jnp.argmin(patch)], 0, n2 - 1)
+        rc = jax.lax.fori_loop(0, cfg.waypoint_lookahead, body, rc0)
+        start_min = jnp.min(jax.lax.dynamic_slice(
+            field_pad, (rc0[0], rc0[1]), (3, 3)))
+        return rc, start_min
+
+    rc2, start_min = jax.vmap(descend)(padded, robot_rc)
+    wp_xy = jnp.stack(
+        [(rc2[:, 1].astype(jnp.float32) + 0.5) * bfs_res + ox,
+         (rc2[:, 0].astype(jnp.float32) + 0.5) * bfs_res + oy], axis=1)
+    moved = jnp.any(rc2 != robot_rc, axis=1)
+    valid = (assignment >= 0) & (start_min < _BIG) & moved
+    return wp_xy, valid
+
+
+def assigned_waypoints(cfg: FrontierConfig, grid_cfg: GridConfig,
+                       logodds: Array, robot_poses: Array, targets: Array,
+                       assignment: Array) -> tuple[Array, Array]:
+    """`assigned_waypoints_from_masks` from a raw log-odds grid (the
+    unsharded fleet model's entry; XLA CSEs the repeated coarsen with
+    compute_frontiers' inside one jit)."""
+    free, _occ, unknown = coarsen(cfg, grid_cfg, logodds)
+    return assigned_waypoints_from_masks(cfg, grid_cfg, free, unknown,
+                                         robot_poses, targets, assignment)

@@ -68,16 +68,14 @@ def _cell_to_world(grid_cfg: GridConfig, res: float, rc: Array) -> Array:
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def plan_to_goal(pcfg: PlannerConfig, fcfg: FrontierConfig,
-                 grid_cfg: GridConfig, logodds: Array, goal_xy: Array,
-                 start_xy: Array) -> PlanResult:
-    """Plan a coarse-grid path from `start_xy` to `goal_xy` on the map.
-
-    One fused jit: coarsen -> goal-seeded cost-to-go -> greedy descent.
-    Unreachable goals (sealed off, or beyond the bfs_iters radius) come
-    back `reachable=False` with an empty path; the caller keeps round-4
-    behavior (straight-line seek under the shield) in that case.
-    """
+def goal_field(pcfg: PlannerConfig, fcfg: FrontierConfig,
+               grid_cfg: GridConfig, logodds: Array,
+               goal_xy: Array) -> Array:
+    """The goal-seeded cost-to-go field alone (coarse cells to reach the
+    goal). Separated from the descent so a caller planning for MANY
+    robots that share one goal (frontier auction sharing, ops/frontier
+    assign_frontiers) computes the field — the dominant cost — once per
+    goal and descends per robot."""
     free, _occ, unknown = F.coarsen(fcfg, grid_cfg, logodds)
     mask = F.frontier_mask(free, unknown)
     # Same passability stance as the frontier costs (compute_frontiers_
@@ -85,16 +83,24 @@ def plan_to_goal(pcfg: PlannerConfig, fcfg: FrontierConfig,
     passable = free | mask | unknown
     n = passable.shape[0]
     res = grid_cfg.resolution_m * fcfg.downsample
-
     goal_rc = _world_to_cell(grid_cfg, res, goal_xy, n)
-    start_rc = _world_to_cell(grid_cfg, res, start_xy, n)
-
-    # Field FROM the goal: dist[r, c] = coarse cells to reach the goal.
     # cost_to_go unblocks its seed, so a goal in a conservatively-occupied
     # coarse cell (hugging a wall) still radiates.
     bfs_cfg = dataclasses.replace(fcfg, bfs_iters=pcfg.bfs_iters)
-    dist = F.cost_to_go(bfs_cfg, passable, goal_rc[None, :],
+    return F.cost_to_go(bfs_cfg, passable, goal_rc[None, :],
                         jnp.array([True]))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def descend_field(pcfg: PlannerConfig, fcfg: FrontierConfig,
+                  grid_cfg: GridConfig, dist: Array, goal_xy: Array,
+                  start_xy: Array) -> PlanResult:
+    """Greedy descent of a `goal_field` from `start_xy` (see
+    plan_to_goal, which fuses both for the single-robot case)."""
+    n = dist.shape[0]
+    res = grid_cfg.resolution_m * fcfg.downsample
+    goal_rc = _world_to_cell(grid_cfg, res, goal_xy, n)
+    start_rc = _world_to_cell(grid_cfg, res, start_xy, n)
 
     big = jnp.float32(F._BIG)
     padded = jnp.pad(dist, 1, constant_values=F._BIG)
@@ -147,3 +153,19 @@ def plan_to_goal(pcfg: PlannerConfig, fcfg: FrontierConfig,
     return PlanResult(path_xy=path_xy, path_valid=valid, n_steps=n_steps,
                       reachable=reachable, waypoint_xy=waypoint,
                       arrived=arrived)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def plan_to_goal(pcfg: PlannerConfig, fcfg: FrontierConfig,
+                 grid_cfg: GridConfig, logodds: Array, goal_xy: Array,
+                 start_xy: Array) -> PlanResult:
+    """Plan a coarse-grid path from `start_xy` to `goal_xy` on the map.
+
+    One fused jit: coarsen -> goal-seeded cost-to-go -> greedy descent
+    (goal_field + descend_field inlined together). Unreachable goals
+    (sealed off, or beyond the bfs_iters radius) come back
+    `reachable=False` with an empty path; the caller keeps round-4
+    behavior (straight-line seek under the shield) in that case.
+    """
+    dist = goal_field(pcfg, fcfg, grid_cfg, logodds, goal_xy)
+    return descend_field(pcfg, fcfg, grid_cfg, dist, goal_xy, start_xy)

@@ -185,6 +185,13 @@ def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
                                                   R // n_fleet)
         goals = fr.targets[jnp.clip(assignment, 0)]
         goal_valid = assignment >= 0
+        if cfg.frontier.planned_goals:
+            # Planned steering from the SAME gathered coarse masks the
+            # assignment used — local robots only, no extra collectives.
+            wps, wvalid = F.assigned_waypoints_from_masks(
+                cfg.frontier, cfg.grid, free, unk, state.est_poses,
+                fr.targets, assignment)
+            goals = jnp.where(wvalid[:, None], wps, goals)
 
         # 3. Policy (local).
         pol = frontier_policy(cfg.robot, cfg.scan, state.est_poses, goals,

@@ -183,6 +183,10 @@ def test_planner_publishes_frontier_waypoints(tiny_cfg):
         assert robots <= {0, 1} and len(robots) >= 1
         for w in wps:
             assert np.isfinite([w.x, w.y]).all()
+        # Field dedup: the goal-seeded field is computed once per UNIQUE
+        # assigned target, never more than once per plan (robots sharing
+        # a cluster share the field).
+        assert 0 < st.planner.n_goal_fields <= st.planner.n_frontier_plans
     finally:
         st.shutdown()
 

@@ -262,6 +262,28 @@ def test_prior_lifecycle_across_save_load(tiny_cfg, tmp_path):
         st.shutdown()
 
 
+def test_clear_prior_sidecar_is_sentinel_checked(tmp_path):
+    """The stale-sidecar cleanup must never delete a NON-sidecar file at
+    the sidecar path (a user checkpoint literally named '.prior' — the
+    collision the save/load guards refuse); only real sidecars go."""
+    from jax_mapping.io.checkpoint import (clear_prior_sidecar,
+                                           prior_sidecar_path,
+                                           save_checkpoint,
+                                           save_prior_sidecar)
+
+    ckpt = str(tmp_path / "x.npz")
+    # A real sidecar: removed.
+    save_prior_sidecar(ckpt, np.zeros((4, 4), np.float32))
+    assert clear_prior_sidecar(ckpt)
+    import os as _os
+    assert not _os.path.exists(prior_sidecar_path(ckpt))
+    # A user checkpoint at the sidecar path: left alone.
+    save_checkpoint(prior_sidecar_path(ckpt),
+                    {"grid": np.zeros((4, 4), np.float32)})
+    assert not clear_prior_sidecar(ckpt)
+    assert _os.path.exists(prior_sidecar_path(ckpt))
+
+
 def test_demo_map_prior_bad_input_polite(tmp_path, capsys):
     """--map-prior input failures follow the --resume contract: polite
     message + rc=2, not a traceback."""

@@ -168,3 +168,32 @@ def test_label_prop_pallas_full_size_on_chip(cfg):
     b = set(np.unique(ln[100, 120:180]).tolist())
     assert len(a) == 1 and len(b) == 1 and a != b
     assert (ln[~mask] == -1).all()
+
+
+def test_plan_to_goal_full_size_on_chip(cfg):
+    """The global planner lowers and runs at the production shape
+    (4096^2 map -> coarse 1024^2 goal-seeded BFS + 256-step descent).
+    Staged for hardware validation like the kernels above; the latency
+    target lives in bench.py (plan_p50_ms under PlannerConfig.period_s)."""
+    from jax_mapping.ops import planner as P
+    g = cfg.grid
+    lo = np.full((g.size_cells, g.size_cells), -1.0, np.float32)
+    lo[:, 2048:2052] = 3.0                    # wall splitting the map
+    lo[3600:3800, 2048:2052] = -1.0           # gap
+    lo_j = jnp.asarray(lo)
+    ox, oy = g.origin_m
+    span = g.size_cells * g.resolution_m
+    start = jnp.asarray([ox + 0.3 * span, oy + 0.3 * span], jnp.float32)
+    goal = jnp.asarray([ox + 0.7 * span, oy + 0.3 * span], jnp.float32)
+
+    r = P.plan_to_goal(cfg.planner, cfg.frontier, g, lo_j, goal, start)
+    jax.block_until_ready(r)                  # warm compile
+    t0 = time.perf_counter()
+    r = P.plan_to_goal(cfg.planner, cfg.frontier, g,
+                       lo_j + jnp.float32(0.0), goal, start)
+    reachable = bool(r.reachable)
+    dt = time.perf_counter() - t0
+    assert reachable, "goal through the gap must be reachable"
+    path = np.asarray(r.path_xy)[np.asarray(r.path_valid)]
+    assert len(path) > 0 and np.isfinite(path).all()
+    assert dt < 10.0, f"full-size plan took {dt:.1f}s"
