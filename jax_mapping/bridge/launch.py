@@ -119,7 +119,8 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     planner = None
     if cfg.planner.enabled:
         from jax_mapping.bridge.planner import PlannerNode
-        planner = PlannerNode(cfg, bus, mapper=mapper, brain=brain)
+        planner = PlannerNode(cfg, bus, mapper=mapper, brain=brain,
+                              voxel_mapper=voxel_mapper)
 
     api = None
     if http_port is not None:

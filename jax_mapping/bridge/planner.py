@@ -45,12 +45,17 @@ class PlannerNode(Node):
     SetGoal drives — brain._goal_cb's convention)."""
 
     def __init__(self, cfg: SlamConfig, bus: Bus, mapper, brain=None,
-                 robot_idx: int = 0):
+                 robot_idx: int = 0, voxel_mapper=None):
         super().__init__("planner", bus)
         self.cfg = cfg
         self.mapper = mapper
         self.brain = brain
         self.robot_idx = robot_idx
+        # 3D-aware planning (PlannerConfig.use_voxel_obstacles): with a
+        # voxel mapper attached, plans search the 2D grid overlaid with
+        # the 3D map's obstacle slice — depth-camera obstacles the LiDAR
+        # plane misses block paths.
+        self.voxel_mapper = voxel_mapper
         self.plan_pub = self.create_publisher("/plan")
         self.wp_pub = self.create_publisher("/goal_waypoint")
         # Standalone (no brain reference): track the goal from the topic.
@@ -66,6 +71,7 @@ class PlannerNode(Node):
         # seeking into them. The brain matches each waypoint to its
         # robot's CURRENT assignment via the goal echo.
         self._frontiers = None
+        self._lo_cache = None
         self.create_subscription("/frontiers", self._frontiers_cb)
         self.fwp_pub = self.create_publisher("/frontier_waypoints")
         self.n_plans = 0
@@ -104,12 +110,37 @@ class PlannerNode(Node):
             return self.brain.robot_pose(i)[:2]
         return None
 
+    def _planning_grid(self):
+        """The log-odds grid plans search: the shared 2D map, overlaid
+        with the 3D obstacle slice when a voxel mapper is attached.
+        Memoized on the INPUT ARRAY IDENTITIES (immutable device
+        arrays): the manual-goal plan and every frontier field in a tick
+        share one basis, the overlay (a full obstacle_slice reduction
+        over the voxel grid) reruns only when either map actually
+        changed, and a mid-tick restore invalidates naturally."""
+        lo = self.mapper.merged_grid()
+        overlay = (self.voxel_mapper is not None
+                   and self.cfg.planner.use_voxel_obstacles)
+        vg = self.voxel_mapper.voxel_grid() if overlay else None
+        # The cache HOLDS the keyed arrays (not bare id()s, whose values
+        # can be reused after garbage collection), so `is` is sound.
+        if self._lo_cache is not None \
+                and self._lo_cache[0] is lo and self._lo_cache[1] is vg:
+            return self._lo_cache[2]
+        out = lo
+        if overlay:
+            from jax_mapping.ops import planner as P
+            out = P.overlay_voxel_obstacles(
+                self.cfg.planner, self.cfg.grid, self.cfg.voxel, lo, vg)
+        self._lo_cache = (lo, vg, out)
+        return out
+
     def _plan(self, goal, pose_xy):
         """One jitted plan; returns (path, reachable, waypoint, arrived)."""
         import jax.numpy as jnp
         from jax_mapping.ops import planner as P
         r = P.plan_to_goal(self.cfg.planner, self.cfg.frontier,
-                           self.cfg.grid, self.mapper.merged_grid(),
+                           self.cfg.grid, self._planning_grid(),
                            jnp.asarray(np.asarray(goal, np.float32)),
                            jnp.asarray(pose_xy))
         return (np.asarray(r.path_xy)[np.asarray(r.path_valid)],
@@ -178,6 +209,7 @@ class PlannerNode(Node):
         import jax.numpy as jnp
         from jax_mapping.ops import planner as P
         fields: dict = {}
+        plan_lo = None                       # fetched once, on first use
         for i in range(min(self.mapper.n_robots, len(assign))):
             if manual_active and i == self.robot_idx:
                 continue                     # the nav goal owns robot 0
@@ -189,9 +221,11 @@ class PlannerNode(Node):
                 continue
             target = targets[a]
             if a not in fields:
+                if plan_lo is None:
+                    plan_lo = self._planning_grid()
                 fields[a] = P.goal_field(
                     self.cfg.planner, self.cfg.frontier, self.cfg.grid,
-                    self.mapper.merged_grid(),
+                    plan_lo,
                     jnp.asarray(np.asarray(target, np.float32)))
                 self.n_goal_fields += 1
             r = P.descend_field(self.cfg.planner, self.cfg.frontier,

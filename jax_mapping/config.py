@@ -269,6 +269,16 @@ class PlannerConfig:
     # brain steers at — frontier exploration that navigates around walls
     # instead of straight-line seeking into them.
     frontier_waypoints: bool = True
+    # 3D-aware planning: overlay the voxel map's obstacle slice (any
+    # occupied voxel in the robot's height band) as occupied cells in
+    # the grid the planner searches — obstacles the 2D LiDAR plane
+    # misses (overhangs, low clutter under the scan plane) block plans
+    # when a depth camera maps them. Needs the 3D pipeline (depth_cam).
+    use_voxel_obstacles: bool = True
+    # The height band a robot must clear, metres above the floor. Floor
+    # returns stay out of the band (z_min above the ground plane).
+    voxel_z_min_m: float = 0.05
+    voxel_z_max_m: float = 0.30
 
 
 @_frozen

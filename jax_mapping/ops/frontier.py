@@ -473,6 +473,25 @@ def compute_frontiers(cfg: FrontierConfig, grid_cfg: GridConfig,
                                         robot_poses)
 
 
+#: 3x3 neighbourhood offsets (row-major) for greedy field descent —
+#: index k of a 3x3 patch argmin maps to this displacement.
+D8 = jnp.array([[-1, -1], [-1, 0], [-1, 1],
+                [0, -1], [0, 0], [0, 1],
+                [1, -1], [1, 0], [1, 1]], jnp.int32)
+
+
+def descent_step(padded_field: Array, rc: Array, n: int) -> Array:
+    """One greedy min-plus descent step: move to the argmin of the 3x3
+    neighbourhood of `rc` in a field padded by 1 with _BIG. ONE
+    definition shared by the host planner's path extraction
+    (ops/planner.descend_field) and the fleet model's waypoint descent
+    (assigned_waypoints_from_masks) — tie-breaking and clipping must
+    never drift between them. At the field's minimum (the goal) the
+    centre cell wins and the descent self-pads."""
+    patch = jax.lax.dynamic_slice(padded_field, (rc[0], rc[1]), (3, 3))
+    return jnp.clip(rc + D8[jnp.argmin(patch)], 0, n - 1)
+
+
 def bfs_passability(cfg: FrontierConfig, grid_cfg: GridConfig,
                     free: Array, unknown: Array, mask: Array
                     ) -> tuple[Array, float]:
@@ -624,16 +643,10 @@ def assigned_waypoints_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
     padded = jnp.pad(fields, ((0, 0), (1, 1), (1, 1)),
                      constant_values=_BIG)
 
-    d8 = jnp.array([[-1, -1], [-1, 0], [-1, 1],
-                    [0, -1], [0, 0], [0, 1],
-                    [1, -1], [1, 0], [1, 1]], jnp.int32)
-
     def descend(field_pad, rc0):
-        def body(_, rc):
-            patch = jax.lax.dynamic_slice(field_pad, (rc[0], rc[1]),
-                                          (3, 3))
-            return jnp.clip(rc + d8[jnp.argmin(patch)], 0, n2 - 1)
-        rc = jax.lax.fori_loop(0, cfg.waypoint_lookahead, body, rc0)
+        rc = jax.lax.fori_loop(
+            0, cfg.waypoint_lookahead,
+            lambda _, rc: descent_step(field_pad, rc, n2), rc0)
         start_min = jnp.min(jax.lax.dynamic_slice(
             field_pad, (rc0[0], rc0[1]), (3, 3)))
         return rc, start_min

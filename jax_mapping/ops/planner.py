@@ -116,15 +116,11 @@ def descend_field(pcfg: PlannerConfig, fcfg: FrontierConfig,
     reachable = jnp.min(start_patch) < big
     arrived = jnp.all(start_rc == goal_rc)
 
-    d8 = jnp.array([[-1, -1], [-1, 0], [-1, 1],
-                    [0, -1], [0, 0], [0, 1],
-                    [1, -1], [1, 0], [1, 1]], jnp.int32)
-
     def step(rc, _):
-        patch = patch_at(rc)
-        nxt = jnp.clip(rc + d8[jnp.argmin(patch)], 0, n - 1)
-        # Once at the goal (field == 0, the component's unique minimum)
-        # argmin holds the centre cell and the path self-pads.
+        # Shared step (frontier.descent_step): once at the goal (field
+        # == 0, the component's unique minimum) argmin holds the centre
+        # cell and the path self-pads.
+        nxt = F.descent_step(padded, rc, n)
         return nxt, nxt
 
     _, cells = jax.lax.scan(step, start_rc, None,
@@ -153,6 +149,50 @@ def descend_field(pcfg: PlannerConfig, fcfg: FrontierConfig,
     return PlanResult(path_xy=path_xy, path_valid=valid, n_steps=n_steps,
                       reachable=reachable, waypoint_xy=waypoint,
                       arrived=arrived)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def overlay_voxel_obstacles(pcfg: PlannerConfig, grid_cfg: GridConfig,
+                            vox_cfg, logodds: Array,
+                            voxel_grid: Array) -> Array:
+    """The 2D log-odds grid with the 3D map's obstacle slice stamped in
+    as occupied — what the planner should search when a depth camera
+    maps obstacles the LiDAR plane misses (overhangs, low clutter).
+
+    The slice (`ops.voxel.obstacle_slice`, any occupied voxel in
+    [voxel_z_min_m, voxel_z_max_m]) embeds at the static cell offset the
+    two grids' origins imply; same-resolution only, like the rosmap
+    embed. Occupied cells take max(current, occ_threshold + 1) so a 3D
+    obstacle always blocks the coarsened passability without erasing
+    stronger 2D evidence; everything else is untouched (the overlay is
+    for PLANNING — the published /map stays pure 2D).
+    """
+    from jax_mapping.ops import voxel as VX
+
+    if abs(vox_cfg.resolution_m - grid_cfg.resolution_m) > 1e-9:
+        raise ValueError(
+            f"voxel resolution {vox_cfg.resolution_m} != grid "
+            f"{grid_cfg.resolution_m}; 3D-aware planning requires equal "
+            "cell sizes")
+    obs = VX.obstacle_slice(vox_cfg, voxel_grid, pcfg.voxel_z_min_m,
+                            pcfg.voxel_z_max_m)          # (Y, X) bool
+    vox_o = vox_cfg.origin_m
+    res = grid_cfg.resolution_m
+    r0 = int(round((vox_o[1] - grid_cfg.origin_m[1]) / res))
+    c0 = int(round((vox_o[0] - grid_cfg.origin_m[0]) / res))
+    n = grid_cfg.size_cells
+    ny, nx = obs.shape
+    # Clip the voxel extent into the grid (static slices — offsets are
+    # config-derived Python ints).
+    gr0, gc0 = max(0, r0), max(0, c0)
+    gr1, gc1 = min(n, r0 + ny), min(n, c0 + nx)
+    if gr1 <= gr0 or gc1 <= gc0:
+        return logodds                       # disjoint extents
+    sub = obs[gr0 - r0:gr1 - r0, gc0 - c0:gc1 - c0]
+    region = logodds[gr0:gr1, gc0:gc1]
+    occ_lo = jnp.float32(grid_cfg.occ_threshold + 1.0)
+    region2 = jnp.where(sub, jnp.maximum(region, occ_lo), region)
+    return logodds.at[gr0:gr1, gc0:gc1].set(region2)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))

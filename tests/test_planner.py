@@ -115,6 +115,93 @@ def test_waypoint_within_lookahead(walled):
 
 
 # ---------------------------------------------------------------------------
+# 3D-aware planning (voxel obstacle overlay)
+# ---------------------------------------------------------------------------
+
+def _voxel_band_indices(vox, pcfg):
+    oz = vox.origin_m[2]
+    zs = (np.arange(vox.size_z_cells) + 0.5) * vox.resolution_m + oz
+    return np.nonzero((zs >= pcfg.voxel_z_min_m)
+                      & (zs <= pcfg.voxel_z_max_m))[0]
+
+
+def test_overlay_voxel_obstacles_embeds_band(tiny_cfg):
+    """Occupied voxels in the robot's height band stamp the matching 2D
+    cells occupied; voxels outside the band (overhead clearance) don't."""
+    import jax.numpy as jnp
+
+    from jax_mapping.ops import planner as P
+
+    g, vox, pcfg = tiny_cfg.grid, tiny_cfg.voxel, tiny_cfg.planner
+    lo = jnp.full((g.size_cells, g.size_cells), -2.0)   # known free
+    vg = np.zeros((vox.size_z_cells, vox.size_y_cells,
+                   vox.size_x_cells), np.float32)
+    band = _voxel_band_indices(vox, pcfg)
+    assert len(band) > 0
+    vg[band[0], 20, 30] = 3.0                # in-band obstacle
+    above = band[-1] + 1
+    vg[above, 40, 50] = 3.0                  # above the robot: ignored
+    out = np.asarray(P.overlay_voxel_obstacles(
+        pcfg, g, vox, lo, jnp.asarray(vg)))
+    res = g.resolution_m
+    r0 = round((vox.origin_m[1] - g.origin_m[1]) / res)
+    c0 = round((vox.origin_m[0] - g.origin_m[0]) / res)
+    assert out[r0 + 20, c0 + 30] >= g.occ_threshold
+    assert out[r0 + 40, c0 + 50] == -2.0     # overhead: untouched
+    assert out[r0 + 21, c0 + 30] == -2.0     # neighbours untouched
+    # Resolution mismatch refuses.
+    import dataclasses as _dc
+    bad = _dc.replace(vox, resolution_m=vox.resolution_m * 2)
+    with pytest.raises(ValueError, match="resolution"):
+        P.overlay_voxel_obstacles(pcfg, g, bad, lo, jnp.asarray(vg))
+
+
+def test_plan_blocked_by_3d_obstacle(tiny_cfg, tmp_path):
+    """A goal ringed by depth-camera obstacles the 2D map knows nothing
+    about: reachable on the bare 2D grid, unreachable once the planner
+    sees the voxel overlay — the capability 2D-only planning cannot
+    have."""
+    import dataclasses as _dc
+
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    cfg = _dc.replace(
+        tiny_cfg, planner=_dc.replace(tiny_cfg.planner, bfs_iters=128))
+    world = W.empty_arena(96, cfg.grid.resolution_m)
+    st = launch_sim_stack(cfg, world, n_robots=1, http_port=None,
+                          seed=8, depth_cam=True)
+    try:
+        n = cfg.grid.size_cells
+        st.mapper.seed_map_prior(np.full((n, n), -2.0, np.float32))
+        goal = (1.5, 1.5)
+        pose = np.zeros(2, np.float32)
+        _p, reachable, _w, _a = st.planner._plan(goal, pose)
+        assert reachable, "free 2D map must reach the goal"
+        # Ring of in-band voxels around the goal (2D map unchanged).
+        vox = cfg.voxel
+        vg = np.zeros((vox.size_z_cells, vox.size_y_cells,
+                       vox.size_x_cells), np.float32)
+        band = _voxel_band_indices(vox, cfg.planner)
+        res = vox.resolution_m
+        gy = round((goal[1] - vox.origin_m[1]) / res)
+        gx = round((goal[0] - vox.origin_m[0]) / res)
+        r = 12
+        for z in band:
+            vg[z, gy - r:gy + r, gx - r:gx - r + 3] = 3.0
+            vg[z, gy - r:gy + r, gx + r:gx + r + 3] = 3.0
+            vg[z, gy - r:gy - r + 3, gx - r:gx + r] = 3.0
+            vg[z, gy + r:gy + r + 3, gx - r:gx + r + 3] = 3.0
+        st.voxel_mapper.restore_grid(vg)
+        _p, reachable, _w, _a = st.planner._plan(goal, pose)
+        assert not reachable, (
+            "3D ring did not block the plan — the overlay never reached "
+            "the planner")
+    finally:
+        st.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # Brain waypoint preference (unit)
 # ---------------------------------------------------------------------------
 
