@@ -121,6 +121,11 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
         from jax_mapping.bridge.planner import PlannerNode
         planner = PlannerNode(cfg, bus, mapper=mapper, brain=brain,
                               voxel_mapper=voxel_mapper)
+        if planner.voxel_mapper is not None:
+            # ONE map for assignment and planning: the auction must not
+            # assign frontiers whose corridors only the 3D overlay knows
+            # are blocked (see mapper.publish_frontiers).
+            mapper.frontier_grid_provider = planner._planning_grid
 
     api = None
     if http_port is not None:

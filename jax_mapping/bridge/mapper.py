@@ -86,6 +86,10 @@ class MapperNode(Node):
         #: the cells no live key scan covers; without this the first
         #: closure silently erases the imported map.
         self._map_prior = None
+        #: Optional callable returning the log-odds grid FRONTIER
+        #: ASSIGNMENT should run on (launch wires the planner's
+        #: voxel-overlaid planning basis); None = the shared 2D map.
+        self.frontier_grid_provider = None
         self._pairer = OdomPairer(n_robots)
         self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
         self._prev_paired: List[Optional[Odometry]] = [None] * n_robots
@@ -521,7 +525,14 @@ class MapperNode(Node):
             gen = self._state_gen[i]
         n = int(st.graph.n_poses)
         if n == 0:
-            return None
+            # A correction without a graph: localization mode tracks the
+            # pose against a frozen map and never grows the graph. The
+            # 3D mapper must still fuse at CORRECTED poses (or the voxel
+            # map shears off the frozen 2D map under odometry drift);
+            # node_idx = -1 says "no node to anchor keyframes to" — and
+            # with no closures possible, nothing would re-fuse them.
+            return (gen, corr[0], corr[1], -1, corr[0],
+                    int(st.n_keyscans))
         return (gen, corr[0], corr[1], n - 1,
                 np.asarray(st.graph.poses[n - 1], np.float32),
                 int(st.n_keyscans))
@@ -557,9 +568,22 @@ class MapperNode(Node):
     def publish_frontiers(self) -> None:
         with self._state_lock:
             poses = np.stack([np.asarray(st.pose) for st in self.states])
+        # Frontier assignment runs on the PLANNING grid when a provider
+        # is wired (launch: the planner's voxel-overlaid basis) — the
+        # auction and the waypoint descent must see the same map, or a
+        # frontier whose only corridor is blocked by depth-only
+        # obstacles gets assigned forever while every plan to it fails.
+        lo = self.merged_grid()
+        if self.frontier_grid_provider is not None:
+            try:
+                lo = self.frontier_grid_provider()
+            except Exception:                # noqa: BLE001
+                # Provider trouble must not take down frontier publishing;
+                # the bare 2D map is the round-4 behavior.
+                import traceback
+                traceback.print_exc()
         fr = self._F.compute_frontiers(self.cfg.frontier, self.cfg.grid,
-                                       self.merged_grid(),
-                                       self._jnp.asarray(poses))
+                                       lo, self._jnp.asarray(poses))
         hdr = Header.now("map")    # one stamp for the whole publish cycle
         self.frontiers_pub.publish(FrontierArray(
             header=hdr,

@@ -54,8 +54,19 @@ class PlannerNode(Node):
         # 3D-aware planning (PlannerConfig.use_voxel_obstacles): with a
         # voxel mapper attached, plans search the 2D grid overlaid with
         # the 3D map's obstacle slice — depth-camera obstacles the LiDAR
-        # plane misses block paths.
+        # plane misses block paths. The overlay needs equal cell sizes;
+        # validated HERE (once, loudly) rather than per tick, where the
+        # node's guarded callbacks would swallow the error and silently
+        # kill every plan. A mismatched config degrades to 2D-only.
         self.voxel_mapper = voxel_mapper
+        if (voxel_mapper is not None and cfg.planner.use_voxel_obstacles
+                and abs(cfg.voxel.resolution_m - cfg.grid.resolution_m)
+                > 1e-9):
+            print("[planner] voxel resolution "
+                  f"{cfg.voxel.resolution_m} != grid "
+                  f"{cfg.grid.resolution_m}; 3D obstacle overlay "
+                  "DISABLED — plans search the 2D map only", flush=True)
+            self.voxel_mapper = None
         self.plan_pub = self.create_publisher("/plan")
         self.wp_pub = self.create_publisher("/goal_waypoint")
         # Standalone (no brain reference): track the goal from the topic.

@@ -203,7 +203,9 @@ class VoxelMapperNode(Node):
                         corrected: np.ndarray, anchor) -> None:
         """Store a depth keyframe when the robot moved past the 2D
         key-scan gate; caller holds no lock (list append under lock)."""
-        if anchor is None:
+        if anchor is None or anchor[3] < 0:
+            # No graph node to anchor to (localization mode: frozen map,
+            # no graph, no closures — keyframes would never re-fuse).
             return
         m = self.cfg.matcher
         last = self._last_kf_pose[i]

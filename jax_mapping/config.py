@@ -403,6 +403,13 @@ class SlamConfig:
     planner: PlannerConfig = PlannerConfig()
     voxel: VoxelConfig = VoxelConfig()
     depthcam: DepthCamConfig = DepthCamConfig()
+    # slam_toolbox's operating mode (slam_config.yaml:20: "mapping" —
+    # the file's comment offers localization as the alternative).
+    # "localization" freezes the map: key scans MATCH against it for
+    # pose tracking but never fuse, the pose graph never grows, and
+    # loop closure never fires — localize-on-a-known-map, the partner
+    # of an imported prior (--map-prior / seed_map_prior).
+    mode: str = "mapping"
     map_publish_period_s: float = 5.0         # slam_config.yaml:25
     tf_publish_period_s: float = 0.1          # slam_config.yaml:24
     # README.md:86 / pi/Dockerfile:3: ROS_DOMAIN_ID=42. Read lazily and
@@ -430,7 +437,8 @@ class SlamConfig:
             voxel=VoxelConfig(**raw.get("voxel", {})),
             depthcam=DepthCamConfig(**raw.get("depthcam", {})),
             **{k: v for k, v in raw.items()
-               if k in ("map_publish_period_s", "tf_publish_period_s", "domain_id")},
+               if k in ("mode", "map_publish_period_s",
+                        "tf_publish_period_s", "domain_id")},
         )
 
 
@@ -472,7 +480,13 @@ def configs_equivalent(json_a: Optional[str], json_b: Optional[str]) -> bool:
     if json_a is None or json_b is None:
         return False
     try:
-        return SlamConfig.from_json(json_a) == SlamConfig.from_json(json_b)
+        a = SlamConfig.from_json(json_a)
+        b = SlamConfig.from_json(json_b)
+        # `mode` is an OPERATING mode, not a state-shape parameter: a
+        # checkpoint mapped in "mapping" and resumed under
+        # "localization" (map a site, then localize on it) is the
+        # feature's core flow, not drift.
+        return a.replace(mode="mapping") == b.replace(mode="mapping")
     except (TypeError, ValueError, KeyError, AttributeError):
         # AttributeError: valid JSON that is not an object ('"x"', '[]')
         # reaches raw.get() — a corrupted config must refuse, not crash.

@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", type=str, default=None, metavar="CKPT",
                    help="resume the SLAM state from a checkpoint written "
                         "by --save-final or the HTTP /save endpoint")
+    p.add_argument("--localization", action="store_true",
+                   help="freeze the map (SlamConfig.mode=localization, "
+                        "slam_config.yaml:20's other mode): scans match "
+                        "for pose tracking but never fuse — localize on "
+                        "a known map, usually with --map-prior")
     p.add_argument("--map-prior", type=str, default=None, metavar="YAML",
                    help="seed the mapper with a ROS map_server map "
                         "(map.yaml + map.pgm, e.g. a map_saver_cli or "
@@ -216,6 +221,8 @@ def main(argv=None) -> int:
             cfg = SlamConfig.from_json(f.read())
     else:
         cfg = tiny_config(n_robots=args.robots)
+    if args.localization:
+        cfg = cfg.replace(mode="localization")
 
     if args.voxel_out and not args.depth_cam and not args.replay:
         print("error: --voxel-out requires --depth-cam (or --replay of a "

@@ -156,6 +156,9 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
               wheel_left: Array, wheel_right: Array,
               dt: Array) -> tuple[SlamState, SlamDiag]:
     """One control-period update: odometry, gated match+fuse, loop closure."""
+    if cfg.mode not in ("mapping", "localization"):
+        raise ValueError(f"unknown SlamConfig.mode {cfg.mode!r} "
+                         "(mapping | localization)")
     m = cfg.matcher
     pose_odo = rk2_step(cfg.robot, state.pose, wheel_left, wheel_right, dt)
 
@@ -170,6 +173,21 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
         # degraded-mode semantics, SURVEY.md §5 failure detection).
         res = M.match(cfg.grid, cfg.scan, m, st.grid, ranges, pose_odo)
         pose = jnp.where(res.accepted, res.pose, pose_odo)
+
+        if cfg.mode == "localization":
+            # slam_toolbox's other mode (slam_config.yaml:20 selects
+            # mapping vs localization): track the pose against a FROZEN
+            # map — no fusion, no graph growth, no loop closures. Pairs
+            # with an imported map (mapper.seed_map_prior / --map-prior):
+            # the robot localizes on the known environment without
+            # redrawing it. Static config -> this branch is compiled
+            # out entirely in mapping mode.
+            st2 = st._replace(pose=pose, last_key_pose=pose)
+            diag = SlamDiag(matched=res.accepted, response=res.response,
+                            key_added=jnp.bool_(False),
+                            loop_closed=jnp.bool_(False),
+                            window_agreement=jnp.float32(1.0))
+            return st2, diag
 
         grid = G.fuse_scan(cfg.grid, cfg.scan, st.grid, ranges, pose)
 
@@ -300,8 +318,13 @@ def slam_step_window(cfg: SlamConfig, state: SlamState, ranges_w: Array,
 
     agreement = _window_agreement(cfg, state.grid, ranges_w[:-1],
                                   poses_w[:-1])
-    grid = G.fuse_scans_window_checked(cfg.grid, cfg.scan, state.grid,
-                                       ranges_w[:-1], poses_w[:-1])
+    if cfg.mode == "localization":
+        # Frozen map: the window's leading scans contribute telemetry
+        # (agreement) but no evidence; only the last scan's match runs.
+        grid = state.grid
+    else:
+        grid = G.fuse_scans_window_checked(cfg.grid, cfg.scan, state.grid,
+                                           ranges_w[:-1], poses_w[:-1])
     # The last scan runs the full pipeline; starting it from the W-2th pose
     # makes its internal odometry land exactly on poses_w[-1].
     st = state._replace(grid=grid, pose=poses_w[-2])

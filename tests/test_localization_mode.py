@@ -1,0 +1,157 @@
+"""SlamConfig.mode = "localization": the frozen-map operating mode.
+
+slam_toolbox's config file selects mapping vs localization
+(`slam_config.yaml:20` ships "mapping"); the reference only ever mapped.
+This framework's localization mode freezes the map — key scans MATCH for
+pose tracking, nothing fuses, the graph never grows, closures never fire
+— pairing with an imported prior (--map-prior) for
+localize-on-a-known-map.
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from jax_mapping.config import configs_equivalent, tiny_config
+from jax_mapping.models import slam as S
+from jax_mapping.sim import lidar
+
+
+def _loc_cfg(tiny_cfg):
+    return dataclasses.replace(tiny_cfg, mode="localization")
+
+
+def test_unknown_mode_refused(tiny_cfg):
+    bad = dataclasses.replace(tiny_cfg, mode="slam")
+    st = S.init_state(bad)
+    with pytest.raises(ValueError, match="mode"):
+        S.slam_step(bad, st, jnp.zeros(bad.scan.padded_beams),
+                    jnp.float32(0), jnp.float32(0), jnp.float32(0.1))
+
+
+def test_mode_not_config_drift(tiny_cfg):
+    """A checkpoint mapped in mapping mode must load under localization:
+    map a site, then localize on it is the feature's core flow."""
+    a = tiny_cfg.to_json()
+    b = _loc_cfg(tiny_cfg).to_json()
+    assert configs_equivalent(a, b)
+    # Real drift still refuses.
+    c = dataclasses.replace(
+        tiny_cfg, grid=dataclasses.replace(tiny_cfg.grid,
+                                           size_cells=128)).to_json()
+    assert not configs_equivalent(a, c)
+
+
+def test_localization_freezes_map_and_tracks(tiny_cfg):
+    """Drive a robot with biased odometry over a PRIOR map: the grid
+    stays bitwise frozen (no fusion, no graph growth, no closures) while
+    the matcher keeps the pose estimate near truth — the mapping-mode
+    estimate without corrections would drift away."""
+    from jax_mapping.sim import world as W
+
+    cfg = _loc_cfg(tiny_cfg)
+    res = cfg.grid.resolution_m
+    world = np.asarray(W.rooms_world(128, res, seed=4), bool)
+    world_j = jnp.asarray(world)
+    n = cfg.grid.size_cells
+
+    # The prior: the true world rasterized as log-odds (what --map-prior
+    # seeding produces after a good mapping session).
+    prior = np.zeros((n, n), np.float32)
+    c0 = (n - 128) // 2
+    prior[c0:c0 + 128, c0:c0 + 128] = np.where(world, 2.0, -2.0)
+    st = S.init_state(cfg)._replace(grid=jnp.asarray(prior))
+    grid0 = st.grid
+
+    n_samples = int(cfg.scan.range_max_m / (res * 0.5))
+    v, dt = 0.25, 0.1
+    from jax_mapping.ops.odometry import twist_to_wheel_units
+    wl, wr = twist_to_wheel_units(cfg.robot, v, 0.0)
+    true_pose = np.array([0.0, 0.0, 0.0])
+    bias = 6.0                                 # wheel-units bias
+    k = cfg.robot.speed_coeff_m_per_unit_s
+    for _ in range(60):
+        vl, vr = wl * k, wr * k
+        v_lin = (vl + vr) / 2
+        v_ang = (vr - vl) / cfg.robot.wheel_base_m
+        mid = true_pose[2] + v_ang * dt / 2
+        true_pose = true_pose + np.array(
+            [v_lin * math.cos(mid) * dt, v_lin * math.sin(mid) * dt,
+             v_ang * dt])
+        scan = lidar.simulate_scans(cfg.scan, world_j, res, n_samples,
+                                    jnp.asarray(true_pose)[None])[0]
+        st, diag = S.slam_step(cfg, st, scan, jnp.float32(wl + bias),
+                               jnp.float32(wr), jnp.float32(dt))
+
+    assert st.grid is grid0 or bool((st.grid == grid0).all()), \
+        "localization mode mutated the frozen map"
+    assert int(st.graph.n_poses) == 0, "graph grew in localization mode"
+    assert int(st.n_loops) == 0
+    err = np.linalg.norm(np.asarray(st.pose)[:2] - true_pose[:2])
+    assert err < 0.15, f"localized pose drifted {err:.2f} m from truth"
+    # The same biased drive with matching disabled drifts further —
+    # proof the matcher (not luck) kept the estimate close.
+    odo = np.array([0.0, 0.0, 0.0])
+    tp = np.array([0.0, 0.0, 0.0])
+    for _ in range(60):
+        for pose, (l, r) in ((odo, (wl + bias, wr)), (tp, (wl, wr))):
+            vl, vr = l * k, r * k
+            v_lin = (vl + vr) / 2
+            v_ang = (vr - vl) / cfg.robot.wheel_base_m
+            mid = pose[2] + v_ang * dt / 2
+            pose += np.array([v_lin * math.cos(mid) * dt,
+                              v_lin * math.sin(mid) * dt, v_ang * dt])
+    odo_err = np.linalg.norm(odo[:2] - tp[:2])
+    assert err < odo_err * 0.7, (
+        f"matcher did not beat raw odometry ({err:.3f} vs {odo_err:.3f})")
+
+
+def test_localization_depth_anchor_still_corrects(tiny_cfg):
+    """Localization + depth cam: the graph never grows, but
+    depth_anchor must still hand the 3D mapper the live map->odom
+    correction (node_idx -1, keyframes skipped) — or the voxel map
+    would shear off the frozen 2D map at raw odometry."""
+    import dataclasses as _dc
+
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    cfg = _dc.replace(tiny_cfg, mode="localization")
+    world = W.empty_arena(96, cfg.grid.resolution_m)
+    st = launch_sim_stack(cfg, world, n_robots=1, http_port=None,
+                          seed=14, depth_cam=True)
+    try:
+        n = cfg.grid.size_cells
+        st.mapper.seed_map_prior(np.full((n, n), -2.0, np.float32))
+        st.brain.start_exploring()
+        st.run_steps(25)
+        anchor = st.mapper.depth_anchor(0)
+        assert anchor is not None, \
+            "no correction anchor in localization mode"
+        assert anchor[3] == -1                   # no node to anchor to
+        assert st.voxel_mapper.n_images_fused > 0
+        assert st.voxel_mapper.n_keyframes_stored == 0, \
+            "keyframes stored with no graph to anchor them"
+    finally:
+        st.shutdown()
+
+
+def test_demo_localization_cli(tmp_path, capsys):
+    """Operator flow: --localization + --map-prior boots, runs, and the
+    saved checkpoint still carries the (frozen) map."""
+    from jax_mapping import demo
+    from jax_mapping.io import rosmap
+
+    occ = np.full((32, 32), 0, np.int8)
+    occ[0, :] = 100
+    _pgm, yaml = rosmap.save_map(str(tmp_path / "prior"), occ, 0.05,
+                                 (-0.8, -0.8))
+    rc = demo.main(["--steps", "4", "--robots", "1", "--world", "arena",
+                    "--world-cells", "96", "--localization",
+                    "--map-prior", yaml])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "seeded map prior" in out

@@ -156,6 +156,73 @@ def test_overlay_voxel_obstacles_embeds_band(tiny_cfg):
         P.overlay_voxel_obstacles(pcfg, g, bad, lo, jnp.asarray(vg))
 
 
+def test_resolution_mismatch_degrades_to_2d(tiny_cfg, tmp_path, capsys):
+    """A coarser voxel map than the 2D grid disables the overlay at
+    CONSTRUCTION (loudly) instead of raising inside the guarded tick and
+    silently killing every plan."""
+    import dataclasses as _dc
+
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    cfg = _dc.replace(
+        tiny_cfg, voxel=_dc.replace(tiny_cfg.voxel,
+                                    resolution_m=tiny_cfg.grid.resolution_m
+                                    * 2))
+    world = W.empty_arena(96, cfg.grid.resolution_m)
+    st = launch_sim_stack(cfg, world, n_robots=1, http_port=None,
+                          seed=9, depth_cam=True)
+    try:
+        assert st.planner.voxel_mapper is None       # overlay disabled
+        assert st.mapper.frontier_grid_provider is None
+        assert "DISABLED" in capsys.readouterr().out
+        # Planning still works on the bare 2D map.
+        n = cfg.grid.size_cells
+        st.mapper.seed_map_prior(np.full((n, n), -2.0, np.float32))
+        _p, reachable, _w, _a = st.planner._plan((1.0, 1.0),
+                                                 np.zeros(2, np.float32))
+        assert reachable
+    finally:
+        st.shutdown()
+
+
+def test_frontier_assignment_sees_voxel_obstacles(tiny_cfg):
+    """The auction and the waypoint descent run on the SAME map: with
+    the overlay wired, frontier assignment uses the planning grid, so a
+    corridor only the 3D map knows is blocked raises the cluster's cost
+    rather than assigning it forever against failing plans."""
+    import dataclasses as _dc
+
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    cfg = _dc.replace(
+        tiny_cfg, planner=_dc.replace(tiny_cfg.planner, bfs_iters=64))
+    world = W.empty_arena(96, cfg.grid.resolution_m)
+    st = launch_sim_stack(cfg, world, n_robots=1, http_port=None,
+                          seed=10, depth_cam=True)
+    try:
+        assert st.mapper.frontier_grid_provider is not None
+        # The provider returns the overlaid basis: stamp a 3D obstacle,
+        # confirm the grid the mapper's frontier pass reads is blocked
+        # there while the published /map basis is not.
+        vox, pcfg = cfg.voxel, cfg.planner
+        vg = np.zeros((vox.size_z_cells, vox.size_y_cells,
+                       vox.size_x_cells), np.float32)
+        band = _voxel_band_indices(vox, pcfg)
+        vg[band[0], 30, 30] = 3.0
+        st.voxel_mapper.restore_grid(vg)
+        lo = np.asarray(st.mapper.frontier_grid_provider())
+        res = cfg.grid.resolution_m
+        r0 = round((vox.origin_m[1] - cfg.grid.origin_m[1]) / res)
+        c0 = round((vox.origin_m[0] - cfg.grid.origin_m[0]) / res)
+        assert lo[r0 + 30, c0 + 30] >= cfg.grid.occ_threshold
+        assert np.asarray(st.mapper.merged_grid())[r0 + 30, c0 + 30] \
+            < cfg.grid.occ_threshold
+    finally:
+        st.shutdown()
+
+
 def test_plan_blocked_by_3d_obstacle(tiny_cfg, tmp_path):
     """A goal ringed by depth-camera obstacles the 2D map knows nothing
     about: reachable on the bare 2D grid, unreachable once the planner
