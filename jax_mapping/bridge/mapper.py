@@ -91,6 +91,10 @@ class MapperNode(Node):
         #: voxel-overlaid planning basis); None = the shared 2D map.
         self.frontier_grid_provider = None
         self._pairer = OdomPairer(n_robots)
+        #: Per-robot covariance diag of the last ACCEPTED match
+        #: (models.slam SlamDiag.cov) — published with /pose, the
+        #: PoseWithCovariance slam_toolbox serves. None until a match.
+        self._last_cov = [None] * n_robots
         self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
         self._prev_paired: List[Optional[Odometry]] = [None] * n_robots
         self.n_scans_fused = 0
@@ -372,6 +376,8 @@ class MapperNode(Node):
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
             agreement = float(diag.window_agreement)
+            if matched:
+                self._last_cov[i] = np.asarray(diag.cov, np.float32)
         installed = self._finish_step(i, state, items[-1][1], W, matched,
                                       closed, base_grid, base_gen)
         if not installed:
@@ -403,6 +409,8 @@ class MapperNode(Node):
             # so the stage measures the device step, not the enqueue.
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
+            if matched:
+                self._last_cov[i] = np.asarray(diag.cov, np.float32)
         self._finish_step(i, state, od, 1, matched, closed, base_grid,
                           base_gen)
 
@@ -592,5 +600,7 @@ class MapperNode(Node):
             assignment=np.asarray(fr.assignment)))
         self.pose_pub.publish([
             {"x": float(p[0]), "y": float(p[1]), "theta": float(p[2]),
-             "stamp": hdr.stamp}
-            for p in poses])
+             "stamp": hdr.stamp,
+             "cov": (None if self._last_cov[i] is None
+                     else self._last_cov[i].tolist())}
+            for i, p in enumerate(poses)])

@@ -135,9 +135,15 @@ class PlannerNode(Node):
         vg = self.voxel_mapper.voxel_grid() if overlay else None
         # The cache HOLDS the keyed arrays (not bare id()s, whose values
         # can be reused after garbage collection), so `is` is sound.
-        if self._lo_cache is not None \
-                and self._lo_cache[0] is lo and self._lo_cache[1] is vg:
-            return self._lo_cache[2]
+        # SNAPSHOT the tuple once: this runs from two executor threads
+        # (the planner's own tick AND the mapper's publish_frontiers via
+        # frontier_grid_provider — node callbacks serialize per NODE),
+        # so re-reading self._lo_cache between check and return could
+        # mix two generations. Tuple assignment is atomic; the worst
+        # interleaving now is one redundant overlay computation.
+        cache = self._lo_cache
+        if cache is not None and cache[0] is lo and cache[1] is vg:
+            return cache[2]
         out = lo
         if overlay:
             from jax_mapping.ops import planner as P

@@ -470,6 +470,15 @@ class RclpyAdapter:
         out.pose.pose.position.y = float(p["y"])
         out.pose.pose.orientation.z = math.sin(p["theta"] / 2.0)
         out.pose.pose.orientation.w = math.cos(p["theta"] / 2.0)
+        cov = p.get("cov")
+        if cov is not None:
+            # Row-major 6x6 (x y z r p y): the correlative matcher's
+            # surface covariance (ops/scan_match MatchResult.cov) on the
+            # x/x, y/y and yaw/yaw diagonals — what slam_toolbox's
+            # PoseWithCovariance carries.
+            c = [0.0] * 36
+            c[0], c[7], c[35] = float(cov[0]), float(cov[1]), float(cov[2])
+            out.pose.covariance = c
         return out
 
     def pose_list_to_ros_array(self, poses):

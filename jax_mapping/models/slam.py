@@ -53,6 +53,10 @@ class SlamDiag(NamedTuple):
     # window of garbage scans must not be invisible in the diag. 1.0 for
     # the single-scan path (no leading scans to disagree).
     window_agreement: Array  # () float in [0, 1]
+    # Correlative-match covariance diag (MatchResult.cov) from this
+    # step's match; zeros when no match ran (non-key step). The bridge
+    # publishes it with /pose (slam_toolbox's PoseWithCovariance).
+    cov: Array           # (3,) [var_x m^2, var_y m^2, var_th rad^2]
 
 
 def init_state(cfg: SlamConfig, pose0=None) -> SlamState:
@@ -186,7 +190,8 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
             diag = SlamDiag(matched=res.accepted, response=res.response,
                             key_added=jnp.bool_(False),
                             loop_closed=jnp.bool_(False),
-                            window_agreement=jnp.float32(1.0))
+                            window_agreement=jnp.float32(1.0),
+                            cov=res.cov)
             return st2, diag
 
         grid = G.fuse_scan(cfg.grid, cfg.scan, st.grid, ranges, pose)
@@ -260,7 +265,7 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
                         n_keyscans=st.n_keyscans + 1)
         diag = SlamDiag(matched=res.accepted, response=res.response,
                         key_added=jnp.bool_(True), loop_closed=closed,
-                        window_agreement=jnp.float32(1.0))
+                        window_agreement=jnp.float32(1.0), cov=res.cov)
         return st2, diag
 
     def skip_branch(st: SlamState):
@@ -268,7 +273,8 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
         diag = SlamDiag(matched=jnp.bool_(False), response=jnp.float32(0),
                         key_added=jnp.bool_(False),
                         loop_closed=jnp.bool_(False),
-                        window_agreement=jnp.float32(1.0))
+                        window_agreement=jnp.float32(1.0),
+                        cov=jnp.zeros(3, jnp.float32))
         return st2, diag
 
     return jax.lax.cond(is_key, key_branch, skip_branch, state)

@@ -42,10 +42,22 @@ Array = jax.Array
 
 
 class MatchResult(NamedTuple):
+    """pose + acceptance + response + covariance of one correlative match.
+
+    `cov` is the diagonal (var_x m^2, var_y m^2, var_theta rad^2) from
+    softmax-weighted second moments of the COARSE response surface —
+    the only stage that spans the whole search window, so a corridor's
+    metres-long ridge registers (the fine surface covers just +-1 coarse
+    step). It is the correlation-surface covariance Karto/slam_toolbox
+    publish with their poses (Olson 2009's formulation): a sharp single
+    peak reports tight variance (floored at the coarse quantisation), a
+    ridge reports wide variance along the ridge axis.
+    """
     pose: Array          # (3,) refined [x, y, yaw]
     response: Array      # () fine-stage response in [0, 1]
     coarse_response: Array  # () coarse-stage response in [0, 1]
     accepted: Array      # () bool: response >= matcher.min_response
+    cov: Array           # (3,) diag [var_x m^2, var_y m^2, var_th rad^2]
 
 
 # ---------------------------------------------------------------------------
@@ -294,9 +306,40 @@ def match(grid_cfg: GridConfig, scan_cfg: ScanConfig, m_cfg: MatcherConfig,
         guess_pose[1] + shift1[1] + deltas[si, 1],
         guess_pose[2] + dth1,
     ])
+
+    # --- correlation-surface covariance (MatchResult.cov docstring) -----
+    # Computed over the COARSE surface: it spans the whole search window
+    # (the fine surface covers only +-1 coarse step, far too narrow to
+    # see a corridor's metres-long ridge). Softmax weights; temperature
+    # in response units — small enough that only the peak's basin
+    # contributes, large enough that a flat ridge keeps mass spread.
+    T = jnp.float32(0.05)
+    surf = resp_c[ai_c].astype(jnp.float32)  # (2n+1, 2n+1) xy, step_m
+    w_t = jnp.exp((surf - surf.max()) / T)
+    wx = w_t.sum(axis=0)                     # collapse y -> x axis
+    wy = w_t.sum(axis=1)
+    mx = (wx * offs).sum() / wx.sum()
+    my = (wy * offs).sum() / wy.sum()
+    var_x = (wx * (offs - mx) ** 2).sum() / wx.sum()
+    var_y = (wy * (offs - my) ** 2).sum() / wy.sum()
+    resp_a = resp_c.max(axis=(1, 2)).astype(jnp.float32)  # per coarse angle
+    w_a = jnp.exp((resp_a - resp_a.max()) / T)
+    ma = (w_a * dth_c).sum() / w_a.sum()
+    var_th = (w_a * (dth_c - ma) ** 2).sum() / w_a.sum()
+    # Never report tighter than the stage's own quantisation — and the
+    # stage HERE is the coarse one for all three axes (the theta surface
+    # is sampled at coarse_angle_step_rad; flooring it at the fine step
+    # would publish ~100x overconfident yaw variance).
+    cov = jnp.stack([
+        jnp.maximum(var_x, (step_m / 2) ** 2 / 3),
+        jnp.maximum(var_y, (step_m / 2) ** 2 / 3),
+        jnp.maximum(var_th,
+                    (m_cfg.coarse_angle_step_rad / 2) ** 2 / 3)])
+
     return MatchResult(pose=pose, response=fine_resp,
                        coarse_response=coarse_resp,
-                       accepted=fine_resp >= m_cfg.min_response)
+                       accepted=fine_resp >= m_cfg.min_response,
+                       cov=cov)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))

@@ -155,3 +155,29 @@ def test_demo_localization_cli(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "seeded map prior" in out
+
+
+def test_stack_publishes_pose_covariance(tiny_cfg):
+    """After real matches, the mapper's /pose dicts carry the last
+    accepted match's covariance diag (finite, positive)."""
+    import dataclasses as _dc
+
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    cfg = _dc.replace(
+        tiny_cfg, planner=_dc.replace(tiny_cfg.planner, enabled=False))
+    world = W.empty_arena(96, cfg.grid.resolution_m)
+    st = launch_sim_stack(cfg, world, n_robots=1, http_port=None, seed=15)
+    try:
+        poses_msgs = []
+        st.bus.subscribe("/pose", callback=poses_msgs.append)
+        st.brain.start_exploring()
+        st.run_steps(40)
+        with_cov = [m for m in poses_msgs if m and m[0].get("cov")]
+        assert with_cov, "no /pose ever carried a covariance"
+        cov = with_cov[-1][0]["cov"]
+        assert len(cov) == 3
+        assert all(np.isfinite(c) and c > 0 for c in cov)
+    finally:
+        st.shutdown()

@@ -505,6 +505,28 @@ def test_outbound_voxel_points_reach_ros(tiny_cfg, stub_ros):
     assert m.header.frame_id == "map"
 
 
+def test_pose_covariance_reaches_ros(tiny_cfg, stub_ros):
+    """/pose carries the correlative matcher's surface covariance on the
+    x/x, y/y, yaw/yaw diagonals of the 6x6 (slam_toolbox's
+    PoseWithCovariance contract); poses without a match yet omit it."""
+    bus, _tf, ad = _adapter(tiny_cfg, stub_ros)
+    bus.publisher("/pose").publish([
+        {"x": 1.0, "y": 2.0, "theta": 0.5, "stamp": 1.0,
+         "cov": [0.01, 0.04, 0.002]}])
+    m = ad.node.pubs["/pose"].published[-1]
+    c = m.pose.covariance
+    assert c[0] == pytest.approx(0.01)
+    assert c[7] == pytest.approx(0.04)
+    assert c[35] == pytest.approx(0.002)
+    assert sum(abs(v) for v in c) == pytest.approx(0.052)
+    bus.publisher("/pose").publish([
+        {"x": 1.0, "y": 2.0, "theta": 0.5, "stamp": 1.0, "cov": None}])
+    m2 = ad.node.pubs["/pose"].published[-1]
+    # Stub Obj auto-creates attributes; covariance must simply not have
+    # been assigned a list.
+    assert not isinstance(getattr(m2.pose, "covariance", None), list)
+
+
 def test_outbound_plan_reaches_ros(tiny_cfg, stub_ros):
     """Path on the bus -> nav_msgs/Path on /plan (PoseStamped per
     waypoint, identity orientation — the RViz Path display contract)."""

@@ -124,3 +124,41 @@ def test_conv_scores_bf16_parity(tiny_cfg, rng):
     np.testing.assert_allclose(np.asarray(bf16), np.asarray(f32),
                                rtol=2e-2, atol=1e-2)
     assert int(jnp.argmax(bf16)) == int(jnp.argmax(f32))
+
+
+def test_match_covariance_sharp_room_vs_corridor(tiny_cfg, room_map):
+    """Correlation-surface covariance (MatchResult.cov): a structured
+    room pins all three axes tightly; an infinite corridor (two parallel
+    walls along x) leaves x unconstrained — its variance must blow up
+    relative to the constrained y while the room's stays tight."""
+    g, s, m = tiny_cfg.grid, tiny_cfg.scan, tiny_cfg.matcher
+    true_pose = np.array([0.0, 0.0, 0.0], np.float32)
+    scan = room_scan(s, true_pose)
+    res_room = M.match(g, s, m, room_map, jnp.asarray(scan),
+                       jnp.asarray(true_pose))
+    cov_room = np.asarray(res_room.cov)
+    assert (cov_room >= 0).all() and np.isfinite(cov_room).all()
+    # Tight: stddev within a few map cells / the fine angle step's scale.
+    assert cov_room[0] < (4 * g.resolution_m) ** 2
+    assert cov_room[1] < (4 * g.resolution_m) ** 2
+
+    # Corridor along x: walls at y = +-0.8 m spanning the whole grid.
+    n = g.size_cells
+    corridor = np.zeros((n, n), np.float32)
+    half = n // 2
+    wall = int(round(0.8 / g.resolution_m))
+    corridor[half - wall - 1:half - wall + 1, :] = 3.0
+    corridor[half + wall - 1:half + wall + 1, :] = 3.0
+    # A corridor scan: beams hit the walls, nothing bounds x.
+    rr = np.zeros(s.padded_beams, np.float32)
+    angles = np.linspace(0, 2 * math.pi, s.n_beams, endpoint=False)
+    sin = np.sin(angles)
+    with np.errstate(divide="ignore"):
+        d = np.where(np.abs(sin) > 1e-6, 0.8 / np.abs(sin), 0.0)
+    rr[:s.n_beams] = np.where((d > 0) & (d <= s.range_max_m), d, 0.0)
+    res_cor = M.match(g, s, m, jnp.asarray(corridor), jnp.asarray(rr),
+                      jnp.asarray(true_pose))
+    cov_cor = np.asarray(res_cor.cov)
+    assert cov_cor[0] > cov_cor[1] * 4, (
+        f"corridor did not widen x variance: {cov_cor}")
+    assert cov_cor[1] < (4 * g.resolution_m) ** 2
