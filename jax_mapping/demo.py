@@ -274,18 +274,16 @@ def main(argv=None) -> int:
                 return 2
             from jax_mapping.io import rosmap
             try:
-                occ, res, origin = rosmap.load_map(args.map_prior)
-                occ = rosmap.embed_in_grid(occ, res, origin, cfg.grid)
-                stack.mapper.seed_map_prior(rosmap.logodds_prior(occ))
-            except (OSError, ValueError, KeyError, TypeError,
-                    IndexError) as e:
+                n_occ = rosmap.seed_mapper(stack.mapper, args.map_prior,
+                                           cfg.grid)
+            except rosmap.SEED_ERRORS as e:
                 # Same polite-refusal contract as --resume: bad input is
                 # an rc=2 message, not a traceback.
                 print(f"demo: cannot seed --map-prior "
                       f"{args.map_prior}: {e}")
                 return 2
             print(f"demo: seeded map prior from {args.map_prior} "
-                  f"({int((occ == 100).sum())} occupied cells)")
+                  f"({n_occ} occupied cells)")
 
         if args.resume:
             from jax_mapping.io.checkpoint import load_checkpoint

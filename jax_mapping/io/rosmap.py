@@ -172,6 +172,24 @@ def embed_in_grid(occupancy: np.ndarray, resolution_m: float,
     return out
 
 
+#: Exceptions a malformed/missing map-prior import can raise; entry
+#: points catch exactly this for their polite-refusal (rc=2) contract —
+#: ONE definition so demo and ros_launch cannot drift.
+SEED_ERRORS = (OSError, ValueError, KeyError, TypeError, IndexError)
+
+
+def seed_mapper(mapper, yaml_path: str, grid_cfg) -> int:
+    """Load a map_server artifact and seed `mapper` with it as a
+    log-odds prior (the full --map-prior pipeline: load -> same-res
+    embed -> prior -> mapper.seed_map_prior). Returns the occupied-cell
+    count for operator logging; raises one of SEED_ERRORS on bad
+    input."""
+    occ, res, origin = load_map(yaml_path)
+    occ = embed_in_grid(occ, res, origin, grid_cfg)
+    mapper.seed_map_prior(logodds_prior(occ))
+    return int((occ == 100).sum())
+
+
 def logodds_prior(occupancy: np.ndarray, occ_logodds: float = 2.0,
                   free_logodds: float = -2.0) -> np.ndarray:
     """An int8 occupancy grid as a log-odds PRIOR for seeding a mapper:

@@ -52,6 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "/dev/input/event3) and publish /cmd_vel teleop "
                         "(joystick.yaml semantics: deadman button 0, "
                         "axes 2/3, autorepeat 20 Hz)")
+    p.add_argument("--map-prior", type=str, default=None, metavar="YAML",
+                   help="seed the mapper with a ROS map_server map "
+                        "(map.yaml + map.pgm) before mapping")
+    p.add_argument("--localization", action="store_true",
+                   help="freeze the map (SlamConfig.mode=localization): "
+                        "scans match for pose tracking only; pair with "
+                        "--map-prior")
     p.add_argument("--print-rviz-config", action="store_true",
                    help="print the bundled RViz config path and exit")
     return p
@@ -94,6 +101,8 @@ def main(argv=None) -> int:
             cfg = SlamConfig.from_json(f.read())
     else:
         cfg = tiny_config(n_robots=n_robots)
+    if args.localization:
+        cfg = cfg.replace(mode="localization")
 
     if args.live_hardware:
         # Live mode = the reference's PC-server role alone
@@ -108,8 +117,9 @@ def main(argv=None) -> int:
                                    n_robots=n_robots)
         inbound = ("cmd_vel", "scan", "odom", "initialpose", "goal_pose")
         # No scan/odom echo (see above), but the live mapper still
-        # publishes /frontiers — keep the RViz marker display fed.
-        outbound = ("map", "map_updates", "pose", "frontiers")
+        # publishes /frontiers and the standalone planner /plan — keep
+        # the RViz marker + Path displays fed.
+        outbound = ("map", "map_updates", "pose", "frontiers", "plan")
     else:
         from jax_mapping.bridge.launch import launch_sim_stack
         from jax_mapping.sim import world as W
@@ -123,6 +133,19 @@ def main(argv=None) -> int:
                                  seed=args.seed, depth_cam=args.depth_cam)
         inbound = ("cmd_vel", "initialpose", "goal_pose")
         outbound = RclpyAdapter.OUTBOUND_DEFAULT
+
+    if args.map_prior:
+        from jax_mapping.io import rosmap
+        try:
+            n_occ = rosmap.seed_mapper(stack.mapper, args.map_prior,
+                                       cfg.grid)
+        except rosmap.SEED_ERRORS as e:
+            print(f"jax-mapping-ros: cannot seed --map-prior "
+                  f"{args.map_prior}: {e}", file=sys.stderr)
+            stack.shutdown()
+            return 2
+        print(f"jax-mapping-ros: seeded map prior from {args.map_prior} "
+              f"({n_occ} occupied cells)")
 
     adapter = RclpyAdapter(stack.bus, cfg, tf=stack.tf, inbound=inbound,
                            outbound=outbound, n_robots=n_robots)
@@ -168,7 +191,13 @@ def main(argv=None) -> int:
 
 
 def _launch_live_stack(cfg, http_port=None, n_robots: int = 1):
-    """Mapper + API + TF only, fed by real inbound /scan + /odom."""
+    """Mapper + planner + API + TF, fed by real inbound /scan + /odom.
+
+    The planner runs STANDALONE (brain=None): RViz SetGoal publishes
+    /goal_pose over DDS, the planner answers with /plan — the operator
+    sees the route on the live map and an external follower (Nav2-style)
+    can consume it; there is no brain to steer in live mode (the robot
+    side runs its own controller on real hardware)."""
     import dataclasses as _dc
 
     from jax_mapping.bridge.bus import Bus
@@ -177,6 +206,7 @@ def _launch_live_stack(cfg, http_port=None, n_robots: int = 1):
     from jax_mapping.bridge.mapper import MapperNode
     from jax_mapping.bridge.messages import Header, TransformStamped
     from jax_mapping.bridge.node import Executor
+    from jax_mapping.bridge.planner import PlannerNode
     from jax_mapping.bridge.tf import TfTree
 
     bus = Bus(domain_id=cfg.domain_id)
@@ -185,12 +215,15 @@ def _launch_live_stack(cfg, http_port=None, n_robots: int = 1):
         header=Header(frame_id="base_link"), child_frame_id="base_laser",
         z=LASER_MOUNT_Z_M))
     mapper = MapperNode(cfg, bus, tf=tf, n_robots=n_robots)
+    planner = None
+    if cfg.planner.enabled:
+        planner = PlannerNode(cfg, bus, mapper=mapper, brain=None)
     api = None
     if http_port is not None:
         api = MapApiServer(bus, brain=None, port=http_port,
-                           mapper=mapper)
+                           mapper=mapper, planner=planner)
         api.serve_thread()
-    executor = Executor([mapper])
+    executor = Executor([mapper] + ([planner] if planner else []))
     executor.spin_thread()
 
     @_dc.dataclass
@@ -200,6 +233,7 @@ def _launch_live_stack(cfg, http_port=None, n_robots: int = 1):
         mapper: object
         api: object
         executor: object
+        planner: object = None
 
         def shutdown(self):
             if self.api is not None:
@@ -207,7 +241,7 @@ def _launch_live_stack(cfg, http_port=None, n_robots: int = 1):
             self.executor.shutdown()
 
     return LiveStack(bus=bus, tf=tf, mapper=mapper, api=api,
-                     executor=executor)
+                     executor=executor, planner=planner)
 
 
 if __name__ == "__main__":

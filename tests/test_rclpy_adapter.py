@@ -347,6 +347,32 @@ def test_live_hardware_mode_no_sim_no_echo(tiny_cfg, stub_ros, capsys):
     assert "live stack up" in out
 
 
+def test_ros_launch_map_prior_and_localization(tiny_cfg, stub_ros,
+                                               tmp_path, capsys):
+    """The ROS entry point mirrors the demo's operator surface: a
+    map_server artifact seeds the mapper, --localization freezes it, and
+    bad input follows the polite rc=2 contract."""
+    import numpy as np
+
+    from jax_mapping import ros_launch
+    from jax_mapping.io import rosmap
+
+    occ = np.full((32, 32), 0, np.int8)
+    occ[0, :] = 100
+    _pgm, yaml = rosmap.save_map(str(tmp_path / "prior"), occ, 0.05,
+                                 (-0.8, -0.8))
+    rc = ros_launch.main(["--world", "arena", "--world-cells", "96",
+                          "--duration-s", "0.3", "--localization",
+                          "--map-prior", yaml])
+    assert rc == 0
+    assert "seeded map prior" in capsys.readouterr().out
+    rc = ros_launch.main(["--world", "arena", "--world-cells", "96",
+                          "--duration-s", "0.2",
+                          "--map-prior", str(tmp_path / "nope.yaml")])
+    assert rc == 2
+    assert "cannot seed --map-prior" in capsys.readouterr().err
+
+
 def test_inbound_initialpose_relocalizes_mapper(tiny_cfg, stub_ros):
     """RViz SetInitialPose -> adapter -> bus -> mapper pose reset."""
     import math as _m
