@@ -283,12 +283,23 @@ def _run() -> None:
     }
     if cpu_fallback:
         # Virtual-CPU numbers say nothing about the TPU framework; point
-        # the reader at the NEWEST builder-measured hardware record.
+        # the reader at the NEWEST builder-measured HARDWARE record —
+        # skipping CPU-fallback records, which would make the pointer a
+        # self-referential loop when the newest local artifact is itself
+        # a wedged-tunnel fallback.
         import glob
+        import json as _json
         recs = sorted(glob.glob(os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "BENCH_LOCAL_r*.json")))
-        if recs:
-            _RESULT["tpu_numbers_recorded_in"] = os.path.basename(recs[-1])
+        for rec in reversed(recs):
+            try:
+                with open(rec) as f:
+                    devices = _json.load(f).get("devices", "")
+            except (OSError, ValueError):
+                continue
+            if "tpu" in devices and "unreachable" not in devices:
+                _RESULT["tpu_numbers_recorded_in"] = os.path.basename(rec)
+                break
 
     # ---- engine choice: probe the Pallas kernel once on tiny shapes ------
     # A Mosaic/toolchain rejection must cost seconds, not the round: fall

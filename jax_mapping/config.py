@@ -493,6 +493,17 @@ def configs_equivalent(json_a: Optional[str], json_b: Optional[str]) -> bool:
         return False
 
 
+def ensure_valid_mode(cfg: "SlamConfig") -> None:
+    """ONE definition of the operating-mode guard for every step entry
+    (models/slam.slam_step, models/fleet.fleet_step,
+    parallel/fleet_sharded.make_fleet_step): an unknown mode must refuse
+    loudly in ALL of them — a missed copy would silently fall through to
+    the mapping branch."""
+    if cfg.mode not in ("mapping", "localization"):
+        raise ValueError(f"unknown SlamConfig.mode {cfg.mode!r} "
+                         "(mapping | localization)")
+
+
 def _env_domain_id() -> int:
     try:
         return int(os.environ.get("ROS_DOMAIN_ID", "42"))

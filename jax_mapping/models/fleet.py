@@ -32,7 +32,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from jax_mapping.config import SlamConfig
+from jax_mapping.config import SlamConfig, ensure_valid_mode
 from jax_mapping.models.explorer import PolicyOut, frontier_policy
 from jax_mapping.models.slam import _verify_loop
 from jax_mapping.ops import frontier as F
@@ -265,6 +265,7 @@ def _close_loops(cfg: SlamConfig, graphs: PG.PoseGraph, grid: Array,
 def fleet_step(cfg: SlamConfig, state: FleetState, world_res_m: float,
                world: Array) -> tuple[FleetState, FleetDiag]:
     """One synchronous fleet tick (the reference's 10 Hz loop, batched)."""
+    ensure_valid_mode(cfg)
     dt = 1.0 / cfg.robot.control_rate_hz
     n_samples = int(cfg.scan.range_max_m / (world_res_m * 0.5))
 
@@ -309,32 +310,44 @@ def fleet_step(cfg: SlamConfig, state: FleetState, world_res_m: float,
                         scans, est)
     est = jnp.where((is_key & res.accepted)[:, None], res.pose, est)
 
-    # 7. Fuse this tick's key scans (masked batched fold, exact under
-    # overlap; sub-gate robots add nothing).
-    grid = G.fuse_scans_masked(cfg.grid, cfg.scan, state.grid, scans, est,
-                               is_key)
+    if cfg.mode == "localization":
+        # Frozen-map mode (models/slam.slam_step's key_branch analog for
+        # the batch path): the matcher's corrections stand, nothing
+        # fuses, graphs never grow, closures never fire. Static config
+        # -> the mapping machinery below is compiled out entirely.
+        grid = state.grid
+        graphs, rings = state.graphs, state.scan_rings
+        closed = jnp.zeros_like(is_key)
+    else:
+        # 7. Fuse this tick's key scans (masked batched fold, exact under
+        # overlap; sub-gate robots add nothing).
+        grid = G.fuse_scans_masked(cfg.grid, cfg.scan, state.grid, scans,
+                                   est, is_key)
 
-    # 8. Pose graphs + loop closure.
-    graphs, rings, k_idx = _update_graphs(cfg, state.graphs, est, is_key,
-                                          scans, state.scan_rings)
-    cand, cand_found = jax.vmap(
-        lambda g, q: PG.loop_candidate(cfg.loop, g, q))(graphs, k_idx)
-    attempt = is_key & cand_found & bool(cfg.loop.enabled)
-    # Cross-robot closure for key robots without an own candidate, gated
-    # on the robot being LOST: its narrow-window match against the shared
-    # map was rejected. A robot matching happily is already coupled to the
-    # fleet through the shared grid; cross-verification is the wide-window
-    # relocalization against a fleet-mate's chain for the drifted one.
-    xrobot, xcand, xfound = _cross_candidates(cfg, graphs, est)
-    xattempt = is_key & ~res.accepted & xfound & ~attempt & \
-        bool(cfg.loop.enabled) & bool(cfg.loop.cross_robot)
+        # 8. Pose graphs + loop closure.
+        graphs, rings, k_idx = _update_graphs(cfg, state.graphs, est,
+                                              is_key, scans,
+                                              state.scan_rings)
+        cand, cand_found = jax.vmap(
+            lambda g, q: PG.loop_candidate(cfg.loop, g, q))(graphs, k_idx)
+        attempt = is_key & cand_found & bool(cfg.loop.enabled)
+        # Cross-robot closure for key robots without an own candidate,
+        # gated on the robot being LOST: its narrow-window match against
+        # the shared map was rejected. A robot matching happily is
+        # already coupled to the fleet through the shared grid;
+        # cross-verification is the wide-window relocalization against a
+        # fleet-mate's chain for the drifted one.
+        xrobot, xcand, xfound = _cross_candidates(cfg, graphs, est)
+        xattempt = is_key & ~res.accepted & xfound & ~attempt & \
+            bool(cfg.loop.enabled) & bool(cfg.loop.cross_robot)
 
-    graphs, grid, est, closed = jax.lax.cond(
-        (attempt | xattempt).any(),
-        lambda args: _close_loops(cfg, *args),
-        lambda args: (args[0], args[1], args[3], jnp.zeros_like(attempt)),
-        (graphs, grid, rings, est, scans, k_idx, cand, attempt,
-         xrobot, xcand, xattempt))
+        graphs, grid, est, closed = jax.lax.cond(
+            (attempt | xattempt).any(),
+            lambda args: _close_loops(cfg, *args),
+            lambda args: (args[0], args[1], args[3],
+                          jnp.zeros_like(attempt)),
+            (graphs, grid, rings, est, scans, k_idx, cand, attempt,
+             xrobot, xcand, xattempt))
 
     last_key = jnp.where(is_key[:, None], est, state.last_key_poses)
     state2 = FleetState(sim=sim2, est_poses=est, grid=grid,

@@ -24,7 +24,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from jax_mapping.config import SlamConfig
+from jax_mapping.config import SlamConfig, ensure_valid_mode
 from jax_mapping.ops import grid as G
 from jax_mapping.ops import posegraph as PG
 from jax_mapping.ops import scan_match as M
@@ -160,9 +160,7 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
               wheel_left: Array, wheel_right: Array,
               dt: Array) -> tuple[SlamState, SlamDiag]:
     """One control-period update: odometry, gated match+fuse, loop closure."""
-    if cfg.mode not in ("mapping", "localization"):
-        raise ValueError(f"unknown SlamConfig.mode {cfg.mode!r} "
-                         "(mapping | localization)")
+    ensure_valid_mode(cfg)
     m = cfg.matcher
     pose_odo = rk2_step(cfg.robot, state.pose, wheel_left, wheel_right, dt)
 

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax_mapping.config import SlamConfig
+from jax_mapping.config import SlamConfig, ensure_valid_mode
 from jax_mapping.models.explorer import frontier_policy
 from jax_mapping.models.fleet import (_cross_candidates, _update_graphs,
                                       _verify_and_optimize)
@@ -148,6 +148,7 @@ def _slab_delta(cfg: SlamConfig, scans: Array, poses: Array,
 
 def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
     """Build the jitted sharded step: (state, world) -> (state, metrics)."""
+    ensure_valid_mode(cfg)
     n_space = mesh.shape["space"]
     n_fleet = mesh.shape["fleet"]
     N = cfg.grid.size_cells
@@ -216,62 +217,79 @@ def make_fleet_step(cfg: SlamConfig, mesh: Mesh, world_res_m: float):
                             scans, est)
         est = jnp.where((is_key & res.accepted)[:, None], res.pose, est)
 
-        # 6. Fuse: local KEY robots' slab contributions, psum over 'fleet'.
-        delta = _slab_delta(cfg, scans, est, slab_row0, slab_rows,
-                            mask=is_key)
-        delta = jax.lax.psum(delta, "fleet")
-        grid = jnp.clip(state.grid + delta, cfg.grid.logodds_min,
-                        cfg.grid.logodds_max)
+        if cfg.mode == "localization":
+            # Frozen-map mode (models/fleet.fleet_step's gate, sharded):
+            # corrections stand, nothing fuses, graphs never grow,
+            # closures never fire — and the skipped sections' psums
+            # vanish uniformly across the mesh (static config), so no
+            # shard waits on a collective another shard compiled out.
+            grid = state.grid
+            graphs, rings = state.graphs, state.scan_rings
+            closed = jnp.zeros_like(is_key)
+        else:
+            # 6. Fuse: local KEY robots' slab contributions, psum over
+            # 'fleet'.
+            delta = _slab_delta(cfg, scans, est, slab_row0, slab_rows,
+                                mask=is_key)
+            delta = jax.lax.psum(delta, "fleet")
+            grid = jnp.clip(state.grid + delta, cfg.grid.logodds_min,
+                            cfg.grid.logodds_max)
 
-        # 7. Pose graphs (local robots) + loop closure. The heavy
-        # verification runs under ONE cond whose predicate is psum'd so it
-        # is uniform across the mesh; the branch itself contains NO
-        # collectives (psums happen outside), so the cond cannot deadlock.
-        graphs, rings, k_idx = _update_graphs(cfg, state.graphs, est,
-                                              is_key, scans,
-                                              state.scan_rings)
-        cand, found = jax.vmap(
-            lambda g, q: PG.loop_candidate(cfg.loop, g, q))(graphs, k_idx)
-        attempt = is_key & found & bool(cfg.loop.enabled)
-        # Cross-robot relocalization stays SHARD-LOCAL: candidates come
-        # from this shard's graphs only (a fleet-wide search would drag
-        # every shard's rings through collectives; locality is the trade
-        # the fleet axis buys — see models/fleet._cross_candidates).
-        xrobot, xcand, xfound = _cross_candidates(cfg, graphs, est)
-        xattempt = is_key & ~res.accepted & xfound & ~attempt & \
-            bool(cfg.loop.enabled) & bool(cfg.loop.cross_robot)
-        attempt_any_local = attempt | xattempt
-        any_attempt = jax.lax.psum(attempt_any_local.sum(), "fleet") > 0
-        # Rings are complete by construction: a full ring thins before
-        # any append (_update_graphs), uniformly across shards (thinning
-        # depends only on shard-local state) — repair never stops.
+            # 7. Pose graphs (local robots) + loop closure. The heavy
+            # verification runs under ONE cond whose predicate is psum'd
+            # so it is uniform across the mesh; the branch itself
+            # contains NO collectives (psums happen outside), so the
+            # cond cannot deadlock.
+            graphs, rings, k_idx = _update_graphs(cfg, state.graphs, est,
+                                                  is_key, scans,
+                                                  state.scan_rings)
+            cand, found = jax.vmap(
+                lambda g, q: PG.loop_candidate(cfg.loop, g, q))(graphs,
+                                                                k_idx)
+            attempt = is_key & found & bool(cfg.loop.enabled)
+            # Cross-robot relocalization stays SHARD-LOCAL: candidates
+            # come from this shard's graphs only (a fleet-wide search
+            # would drag every shard's rings through collectives;
+            # locality is the trade the fleet axis buys — see
+            # models/fleet._cross_candidates).
+            xrobot, xcand, xfound = _cross_candidates(cfg, graphs, est)
+            xattempt = is_key & ~res.accepted & xfound & ~attempt & \
+                bool(cfg.loop.enabled) & bool(cfg.loop.cross_robot)
+            attempt_any_local = attempt | xattempt
+            any_attempt = jax.lax.psum(attempt_any_local.sum(),
+                                       "fleet") > 0
+            # Rings are complete by construction: a full ring thins
+            # before any append (_update_graphs), uniformly across
+            # shards (thinning depends only on shard-local state) —
+            # repair never stops.
 
-        def close(args):
-            graphs, est = args
-            graphs3, est2, closed = _verify_and_optimize(
-                cfg, graphs, rings, est, scans, k_idx, cand, attempt,
-                xrobot, xcand, xattempt)
-            # Local repair slab from this shard's rings (psum'd OUTSIDE —
-            # the cond branches stay collective-free).
-            Rl, cap, beams = rings.shape
-            repair = _slab_delta(
-                cfg, rings.reshape(Rl * cap, beams),
-                graphs3.poses[:, :cap].reshape(Rl * cap, 3), slab_row0,
-                slab_rows, mask=graphs3.pose_valid[:, :cap].reshape(-1))
-            return graphs3, est2, closed, repair
+            def close(args):
+                graphs, est = args
+                graphs3, est2, closed = _verify_and_optimize(
+                    cfg, graphs, rings, est, scans, k_idx, cand, attempt,
+                    xrobot, xcand, xattempt)
+                # Local repair slab from this shard's rings (psum'd
+                # OUTSIDE — the cond branches stay collective-free).
+                Rl, cap, beams = rings.shape
+                repair = _slab_delta(
+                    cfg, rings.reshape(Rl * cap, beams),
+                    graphs3.poses[:, :cap].reshape(Rl * cap, 3),
+                    slab_row0, slab_rows,
+                    mask=graphs3.pose_valid[:, :cap].reshape(-1))
+                return graphs3, est2, closed, repair
 
-        def skip(args):
-            graphs, est = args
-            zero = jnp.zeros((slab_rows, N), jnp.float32)
-            return graphs, est, jnp.zeros_like(attempt), zero
+            def skip(args):
+                graphs, est = args
+                zero = jnp.zeros((slab_rows, N), jnp.float32)
+                return graphs, est, jnp.zeros_like(attempt), zero
 
-        graphs, est, closed, repair = jax.lax.cond(
-            any_attempt, close, skip, (graphs, est))
-        any_closed = jax.lax.psum(closed.sum(), "fleet") > 0
-        repair = jax.lax.psum(repair, "fleet")
-        grid = jnp.where(any_closed,
-                         jnp.clip(repair, cfg.grid.logodds_min,
-                                  cfg.grid.logodds_max), grid)
+            graphs, est, closed, repair = jax.lax.cond(
+                any_attempt, close, skip, (graphs, est))
+            any_closed = jax.lax.psum(closed.sum(), "fleet") > 0
+            repair = jax.lax.psum(repair, "fleet")
+            grid = jnp.where(any_closed,
+                             jnp.clip(repair, cfg.grid.logodds_min,
+                                      cfg.grid.logodds_max), grid)
 
         last_key = jnp.where(is_key[:, None], est, state.last_key_poses)
         state2 = ShardedFleetState(

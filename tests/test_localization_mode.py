@@ -181,3 +181,57 @@ def test_stack_publishes_pose_covariance(tiny_cfg):
         assert all(np.isfinite(c) and c > 0 for c in cov)
     finally:
         st.shutdown()
+
+
+def test_fleet_step_localization_freezes_map(tiny_cfg):
+    """The batch fleet model honours the mode too: matched corrections
+    stand, the shared grid stays bitwise frozen, graphs never grow."""
+    import jax
+
+    from jax_mapping.models import fleet as FM
+    from jax_mapping.sim import world as W
+
+    cfg = dataclasses.replace(
+        _loc_cfg(tiny_cfg),
+        fleet=dataclasses.replace(tiny_cfg.fleet, n_robots=4))
+    world = jnp.asarray(W.empty_arena(96, cfg.grid.resolution_m))
+    state = FM.init_fleet_state(cfg, jax.random.PRNGKey(2))
+    prior = jnp.where(world, 2.0, -2.0)
+    n = cfg.grid.size_cells
+    c0 = (n - 96) // 2
+    full = jnp.zeros((n, n)).at[c0:c0 + 96, c0:c0 + 96].set(prior)
+    state = state._replace(grid=full)
+    grid0 = state.grid
+    for _ in range(5):
+        state, diag = FM.fleet_step(cfg, state, cfg.grid.resolution_m,
+                                    world)
+    assert bool((state.grid == grid0).all()), "fleet grid mutated"
+    assert int(np.asarray(state.graphs.n_poses).sum()) == 0
+    assert int(np.asarray(state.n_loops).sum()) == 0
+    assert np.isfinite(np.asarray(diag.pose_err)).all()
+
+
+def test_sharded_fleet_step_localization(tiny_cfg):
+    """The sharded twin compiles and runs frozen across the virtual
+    8-device mesh (the skipped fuse/closure psums vanish uniformly)."""
+    import jax
+
+    from jax_mapping.parallel import fleet_sharded as FS
+    from jax_mapping.parallel import mesh as MESH
+    from jax_mapping.sim import world as W
+
+    cfg = dataclasses.replace(
+        _loc_cfg(tiny_cfg),
+        fleet=dataclasses.replace(tiny_cfg.fleet, n_robots=8))
+    assert len(jax.devices()) == 8
+    mesh = MESH.make_mesh(n_fleet=4, n_space=2)
+    world = jnp.asarray(W.empty_arena(96, cfg.grid.resolution_m))
+    state = FS.init_sharded_state(cfg, mesh)
+    grid0 = np.asarray(jax.device_get(state.grid)).copy()
+    step = FS.make_fleet_step(cfg, mesh, cfg.grid.resolution_m)
+    for _ in range(3):
+        state, metrics = step(state, world)
+    assert int(state.t) == 3
+    assert (np.asarray(jax.device_get(state.grid)) == grid0).all(), \
+        "sharded grid mutated in localization mode"
+    assert np.isfinite(float(metrics["mean_pose_err_m"]))
