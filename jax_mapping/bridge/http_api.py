@@ -156,6 +156,11 @@ class MapApiServer:
                 # operator's one-glance health check.
                 body["n_scans_fused"] = self.mapper.n_scans_fused
                 body["n_loops_closed"] = self.mapper.n_loops_closed
+                calib = self.mapper.calibration()
+                if calib is not None:
+                    # Live odometry-scale re-measurement of the
+                    # hand-calibrated SPEED_COEFF (report.pdf §III.D).
+                    body["odom_calibration"] = calib
             if self.voxel_mapper is not None:
                 body["n_images_fused"] = self.voxel_mapper.n_images_fused
                 body["n_depth_keyframes"] = \

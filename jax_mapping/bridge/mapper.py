@@ -95,6 +95,21 @@ class MapperNode(Node):
         #: (models.slam SlamDiag.cov) — published with /pose, the
         #: PoseWithCovariance slam_toolbox serves. None until a match.
         self._last_cov = [None] * n_robots
+        #: Odometry-scale calibration accumulators (see _finish_step):
+        #: per-robot EWMA sums of matched straight-motion SLAM vs
+        #: odometry displacement. Decayed so the estimate TRACKS the
+        #: battery/slip drift it exists to measure (a lifetime average
+        #: would report the coeff of an hour ago); effective window
+        #: ~1/(1-decay) samples.
+        self._calib_decay = 0.995
+        self._calib_odo = [0.0] * n_robots
+        self._calib_slam = [0.0] * n_robots
+        self._calib_n = [0] * n_robots       # lifetime sample count
+        #: Previous installed step's matched flag, per robot: a
+        #: re-convergence snap after a dead-reckoned stretch lands the
+        #: ACCUMULATED correction in one step's d_slam — only
+        #: matched-after-matched steps are clean samples.
+        self._prev_matched = [False] * n_robots
         self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
         self._prev_paired: List[Optional[Odometry]] = [None] * n_robots
         self.n_scans_fused = 0
@@ -147,6 +162,7 @@ class MapperNode(Node):
             self.states[0] = fresh._replace(grid=self.shared_grid)
             self._state_gen[0] += 1
             self._prev_paired[0] = None
+            self._prev_matched[0] = False
             self._correction[0] = None
         M.counters.inc("mapper.initialpose_resets")
 
@@ -222,6 +238,7 @@ class MapperNode(Node):
                     grid=self.shared_grid)
                 self._state_gen[i] += 1
                 self._prev_paired[i] = None
+                self._prev_matched[i] = False
                 self._correction[i] = None
 
     def map_prior(self):
@@ -438,6 +455,7 @@ class MapperNode(Node):
                 # integrating the stale-to-live frame jump — and keep the
                 # fused/matched/closed counters honest.
                 self._prev_paired[i] = None
+                self._prev_matched[i] = False
                 M.counters.inc("mapper.steps_dropped_stale")
                 return False
             # The step's output grid is the fleet's new shared map;
@@ -465,12 +483,38 @@ class MapperNode(Node):
             for j in range(self.n_robots):
                 self.states[j] = self.states[j]._replace(
                     grid=self.shared_grid)
+            # Odometry-scale calibration sample (report.pdf §III.D/§V.B:
+            # SPEED_COEFF was hand-measured with 13% CV; wheel slip and
+            # battery level drift it in the field). Between consecutive
+            # installed steps, the SLAM displacement over the odometry
+            # displacement estimates true_coeff/configured_coeff — on
+            # matched, closure-free, mostly-straight, non-trivial motion
+            # only (closures teleport the estimate; pivots measure the
+            # wheel BASE, not the coeff).
+            prev = self._correction[i]
+            new_est = np.asarray(state.pose, np.float32)
+            new_odo = np.asarray([od.pose.x, od.pose.y, od.pose.theta],
+                                 np.float32)
+            if prev is not None and matched and self._prev_matched[i] \
+                    and not closed:
+                # matched-after-matched only: the re-convergence snap
+                # after a dead-reckoned stretch puts several steps of
+                # accumulated correction into ONE step's d_slam and
+                # would bias the scale (review r5).
+                d_slam = float(np.hypot(*(new_est[:2] - prev[0][:2])))
+                d_odo = float(np.hypot(*(new_odo[:2] - prev[1][:2])))
+                dth = abs(float((new_odo[2] - prev[1][2] + np.pi)
+                                % (2 * np.pi) - np.pi))
+                if d_odo > 0.01 and dth < 0.2 \
+                        and 0.5 < d_slam / d_odo < 2.0:
+                    k = self._calib_decay
+                    self._calib_odo[i] = self._calib_odo[i] * k + d_odo
+                    self._calib_slam[i] = self._calib_slam[i] * k + d_slam
+                    self._calib_n[i] += 1
+            self._prev_matched[i] = matched
             # The installed (estimate, paired odom) pair IS the live
             # map->odom correction for robot i (depth_anchor consumers).
-            self._correction[i] = (
-                np.asarray(state.pose, np.float32),
-                np.asarray([od.pose.x, od.pose.y, od.pose.theta],
-                           np.float32))
+            self._correction[i] = (new_est, new_odo)
         self.n_scans_fused += n_scans
         M.counters.inc("mapper.scans_fused", n_scans)
         if matched:
@@ -479,6 +523,40 @@ class MapperNode(Node):
             self.n_loops_closed += 1
             M.counters.inc("mapper.loops_closed")
         return True
+
+    def calibration(self) -> Optional[dict]:
+        """Fleet odometry-scale estimate from the accumulated matched
+        straight-motion samples, or None before any accumulate.
+
+        `odom_scale` ~ true/configured displacement per wheel unit: the
+        live re-measurement of the reference's hand-calibrated
+        SPEED_COEFF (report.pdf §III.D measured 13% CV between runs),
+        EWMA-weighted so it tracks battery/slip drift.
+        `suggested_speed_coeff` = configured * scale is what an operator
+        would write back into RobotConfig after a drive. `per_robot`
+        exposes each robot's own scale (None before its first sample) so
+        one slipping wheel is visible instead of silently contaminating
+        the fleet figure.
+
+        LOCK-FREE reads, like the /status counter reads: stale-by-one
+        telemetry beats the health endpoint blocking behind a lock-held
+        fleet ring re-fusion."""
+        odo = sum(self._calib_odo)
+        slam = sum(self._calib_slam)
+        n = sum(self._calib_n)
+        if n == 0 or odo <= 0.0:
+            return None
+        per_robot = [
+            (round(s / o, 4) if o > 0.0 else None)
+            for s, o in zip(self._calib_slam, self._calib_odo)]
+        scale = slam / odo
+        return {
+            "odom_scale": round(scale, 4),
+            "suggested_speed_coeff": round(
+                self.cfg.robot.speed_coeff_m_per_unit_s * scale, 7),
+            "n_samples": n,
+            "per_robot": per_robot,
+        }
 
     def _refuse_all_rings(self):
         """Shared-map repair across the fleet: re-fuse every robot's
