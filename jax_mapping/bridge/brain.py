@@ -115,19 +115,26 @@ class ThymioBrain(Node):
         # Manual teleop override (bridge/teleop.py). Applies to robot 0 —
         # one pad drives one robot, the rest keep their autonomous policy.
         self.create_subscription("/cmd_vel", self._cmd_vel_cb)
-        # RViz SetGoal (via the rclpy adapter): a navigation goal for
-        # robot 0 — goal-seek with the reactive shield while exploring
-        # (the reference shipped the RViz tool but no consumer; Nav2 was
-        # future work, report.pdf VI.2). Cleared on arrival.
-        self._nav_goal: Optional[tuple] = None
+        # RViz SetGoal (via the rclpy adapter): navigation goals.
+        # /goal_pose addresses robot 0 (the reference's single-robot
+        # convention; it shipped the RViz tool but no consumer — Nav2
+        # was future work, report.pdf VI.2); fleets also get per-robot
+        # {ns}goal_pose topics so an operator can direct ANY robot.
+        # Cleared per robot on arrival.
+        self._nav_goals: list = [None] * n_robots
         self.goal_reached_dist_m = 0.15
-        self.create_subscription("/goal_pose", self._goal_cb)
+        self.create_subscription("/goal_pose",
+                                 functools.partial(self._goal_cb, 0))
+        for i in range(n_robots):
+            self.create_subscription(
+                f"{robot_ns(i, n_robots)}goal_pose",
+                functools.partial(self._goal_cb, i))
         # Planner waypoint (bridge/planner.py): while fresh, reachable,
         # and computed FOR the current goal, the brain steers at this
         # instead of the raw goal — map-aware navigation around walls.
         # Stale/absent waypoint (planner not launched, goal unreachable)
         # keeps the round-4 straight-line seek under the shield.
-        self._waypoint = None
+        self._waypoints: dict = {}
         self.create_subscription("/goal_waypoint", self._waypoint_cb)
         # Assigned-frontier exploration (FrontierConfig.seek_assigned):
         # the mapper's /frontiers assignments become goal-seek targets
@@ -165,16 +172,17 @@ class ThymioBrain(Node):
             self._last_cmd_vel = msg
             self._last_cmd_vel_t = time.monotonic()
 
-    def _goal_cb(self, msg) -> None:
+    def _goal_cb(self, i: int, msg) -> None:
         """Any pose-shaped message with .x/.y (the adapter's Pose2D)."""
         with self._state_lock:
-            self._nav_goal = (float(msg.x), float(msg.y))
-        self._log(f"navigation goal set: ({msg.x:.2f}, {msg.y:.2f}) — "
-                  "engages while exploring")
+            self._nav_goals[i] = (float(msg.x), float(msg.y))
+        self._log(f"navigation goal set for robot {i}: "
+                  f"({msg.x:.2f}, {msg.y:.2f}) — engages while exploring")
 
     def _waypoint_cb(self, msg) -> None:
         with self._state_lock:
-            self._waypoint = (msg, self.n_ticks)
+            self._waypoints[int(getattr(msg, "robot", 0))] = \
+                (msg, self.n_ticks)
 
     def _frontiers_cb(self, msg) -> None:
         with self._state_lock:
@@ -230,17 +238,22 @@ class ThymioBrain(Node):
                 goals_xy[i] = (wp.x, wp.y)
 
     def nav_goal(self) -> Optional[tuple]:
-        """Current navigation goal (planner reads the brain's copy so a
-        reached-and-cleared goal stops replanning)."""
+        """Robot 0's navigation goal (planner reads the brain's copy so
+        a reached-and-cleared goal stops replanning)."""
         with self._state_lock:
-            return self._nav_goal
+            return self._nav_goals[0]
+
+    def nav_goals(self) -> list:
+        """Every robot's manual goal (None where unset)."""
+        with self._state_lock:
+            return list(self._nav_goals)
 
     def robot_pose(self, i: int) -> np.ndarray:
         with self._state_lock:
             return self.poses[i].copy()
 
-    def _steer_target(self, goal: tuple) -> tuple:
-        """The point robot 0 steers at for `goal`: the planner's lookahead
+    def _steer_target(self, goal: tuple, robot: int = 0) -> tuple:
+        """The point `robot` steers at for `goal`: the planner's lookahead
         waypoint when fresh + reachable + computed for THIS goal, else the
         goal itself. Freshness is measured in CONTROL TICKS, not wall
         time: faster-than-realtime stacks (Stack.run_steps, demo) replan
@@ -249,7 +262,7 @@ class ThymioBrain(Node):
         window of sim steps takes longer than the TTL to execute —
         host-speed-dependent trajectories in the deterministic path."""
         with self._state_lock:
-            entry = self._waypoint
+            entry = self._waypoints.get(robot)
         if entry is None:
             return goal
         wp, at_tick = entry
@@ -310,9 +323,12 @@ class ThymioBrain(Node):
                      "theta": float(p[2])} for p in self.poses],
                 "ticks": self.n_ticks,
                 "io_errors": self.n_io_errors,
-                "goal": (None if self._nav_goal is None
-                         else {"x": self._nav_goal[0],
-                               "y": self._nav_goal[1]}),
+                "goal": (None if self._nav_goals[0] is None
+                         else {"x": self._nav_goals[0][0],
+                               "y": self._nav_goals[0][1]}),
+                "goals": [
+                    (None if g is None else {"x": g[0], "y": g[1]})
+                    for g in self._nav_goals],
             }
 
     # -- the 10 Hz loop ------------------------------------------------------
@@ -369,20 +385,26 @@ class ThymioBrain(Node):
             with self._state_lock:
                 poses = self.poses.copy()
                 exploring = np.full(R, self.is_exploring)
-                goal = self._nav_goal
+                goals = list(self._nav_goals)
             ranges = self._ranges_matrix()
             goals_xy = np.zeros((R, 2), np.float32)
             goal_valid = np.zeros(R, bool)
-            if goal is not None:
-                if np.hypot(poses[0, 0] - goal[0],
-                            poses[0, 1] - goal[1]) \
+            for i, goal in enumerate(goals):
+                if goal is None:
+                    continue
+                if np.hypot(poses[i, 0] - goal[0],
+                            poses[i, 1] - goal[1]) \
                         <= self.goal_reached_dist_m:
                     with self._state_lock:
-                        self._nav_goal = None
-                    self._log("navigation goal reached")
+                        # Compare-and-clear: a goal published between
+                        # this tick's snapshot and now must not be
+                        # silently erased by arrival at the OLD goal.
+                        if self._nav_goals[i] == goal:
+                            self._nav_goals[i] = None
+                    self._log(f"navigation goal reached (robot {i})")
                 else:
-                    goals_xy[0] = self._steer_target(goal)
-                    goal_valid[0] = True
+                    goals_xy[i] = self._steer_target(goal, i)
+                    goal_valid[i] = True
             self._apply_frontier_goals(goals_xy, goal_valid)
 
             new_poses, twists, targets, leds, _ = brain_tick(

@@ -169,6 +169,10 @@ class MapApiServer:
             if self.planner is not None:
                 body["n_plans"] = self.planner.n_plans
                 body["plan_reachable"] = self.planner.last_reachable
+                if self.planner.reachable_by_robot:
+                    body["plan_reachable_by_robot"] = {
+                        str(k): v for k, v in
+                        self.planner.reachable_by_robot.items()}
             if self.extra_status is not None:
                 body.update(self.extra_status())
             return 200, "application/json", json.dumps(body).encode()

@@ -89,6 +89,8 @@ class PlannerNode(Node):
         self.n_frontier_plans = 0
         self.n_goal_fields = 0
         self.last_reachable: Optional[bool] = None
+        #: Per-robot manual-plan reachability (fleet goals).
+        self.reachable_by_robot: dict = {}
         #: Planner tick counter — the staleness clock for /frontiers.
         #: The repo's TTL doctrine (brain._steer_target): freshness in
         #: the DETERMINISTIC time base, never wall time, or slow hosts
@@ -106,6 +108,16 @@ class PlannerNode(Node):
         if self.brain is not None:
             return self.brain.nav_goal()
         return self._goal
+
+    def _manual_goals(self) -> list:
+        """Per-robot manual goals (None where unset). Standalone mode
+        has only the /goal_pose-tracked goal for robot_idx."""
+        if self.brain is not None:
+            return self.brain.nav_goals()
+        goals = [None] * self.mapper.n_robots
+        if self._goal is not None:
+            goals[self.robot_idx] = self._goal
+        return goals
 
     def _robot_pose_xy(self, i: Optional[int] = None
                        ) -> Optional[np.ndarray]:
@@ -167,37 +179,57 @@ class PlannerNode(Node):
     def tick(self) -> None:
         self._n_ticks += 1
         with M.stages.stage("planner.tick"):
-            manual = self._tick_manual_goal()
+            manual_robots = self._tick_manual_goals()
             if self.cfg.planner.frontier_waypoints:
-                self._tick_frontier_waypoints(manual_active=manual)
+                self._tick_frontier_waypoints(manual_robots=manual_robots)
 
-    def _tick_manual_goal(self) -> bool:
-        """Plan for the RViz nav goal; returns whether one is active."""
-        goal = self._current_goal()
-        if goal is None:
-            return False
-        pose_xy = self._robot_pose_xy()
-        if pose_xy is None:
-            return True
-        path, reachable, wp, arrived = self._plan(goal, pose_xy)
-        if self.brain is None and arrived:
-            # Standalone arrival bookkeeping: with a brain the brain
-            # clears the goal (and this node reads its copy); without one
-            # the planner must stop itself or it replans forever.
-            self._goal = None
-            return False
+    def _tick_manual_goals(self) -> set:
+        """Plan for every robot's manual nav goal (/goal_pose is robot
+        0's; fleets address the rest via {ns}goal_pose). Returns the set
+        of robot indices with an active manual goal — the frontier pass
+        must leave those robots alone."""
+        goals = self._manual_goals()
+        active: set = set()
         hdr = Header.now("map")
-        self.plan_pub.publish(Path(header=hdr, poses_xy=path))
-        self.wp_pub.publish(Waypoint(header=hdr, x=float(wp[0]),
-                                     y=float(wp[1]), reachable=reachable,
-                                     goal_x=float(goal[0]),
-                                     goal_y=float(goal[1])))
-        self.n_plans += 1
-        self.last_reachable = reachable
-        M.counters.inc("planner.plans")
-        return True
+        for i, goal in enumerate(goals):
+            if goal is None:
+                continue
+            active.add(i)
+            pose_xy = self._robot_pose_xy(i)
+            if pose_xy is None:
+                continue
+            path, reachable, wp, arrived = self._plan(goal, pose_xy)
+            if self.brain is None and arrived:
+                # Standalone arrival bookkeeping: with a brain the brain
+                # clears the goal (and this node reads its copy);
+                # without one the planner must stop itself or it replans
+                # forever.
+                self._goal = None
+                active.discard(i)
+                continue
+            self.wp_pub.publish(Waypoint(
+                header=hdr, x=float(wp[0]), y=float(wp[1]),
+                reachable=reachable, goal_x=float(goal[0]),
+                goal_y=float(goal[1]), robot=i))
+            # Per-robot reachability: the health endpoint must not keep
+            # reporting robot 0's old plan as THE answer while another
+            # fleet robot's goal is unreachable.
+            self.reachable_by_robot[i] = reachable
+            if i == self.robot_idx:
+                # /plan is single-Path (the RViz display); it follows
+                # the goal robot (robot 0, the SetGoal convention).
+                self.plan_pub.publish(Path(header=hdr, poses_xy=path))
+                self.last_reachable = reachable
+            self.n_plans += 1
+            M.counters.inc("planner.plans")
+        # Entries for robots whose goals cleared are pruned — stale
+        # reachability was the exact misleading telemetry this dict
+        # exists to fix.
+        self.reachable_by_robot = {
+            i: v for i, v in self.reachable_by_robot.items() if i in active}
+        return active
 
-    def _tick_frontier_waypoints(self, manual_active: bool) -> None:
+    def _tick_frontier_waypoints(self, manual_robots: set) -> None:
         """Plan per exploring robot toward its /frontiers assignment and
         publish per-robot waypoints (+ robot 0's plan for RViz when no
         manual goal claims /plan)."""
@@ -228,8 +260,8 @@ class PlannerNode(Node):
         fields: dict = {}
         plan_lo = None                       # fetched once, on first use
         for i in range(min(self.mapper.n_robots, len(assign))):
-            if manual_active and i == self.robot_idx:
-                continue                     # the nav goal owns robot 0
+            if i in manual_robots:
+                continue                     # a manual goal owns robot i
             a = int(assign[i])
             if not 0 <= a < len(targets):
                 continue
@@ -258,7 +290,9 @@ class PlannerNode(Node):
                 goal_y=float(target[1]), robot=i))
             self.n_frontier_plans += 1
             M.counters.inc("planner.frontier_plans")
-            if i == self.robot_idx and not manual_active:
+            if i == self.robot_idx:
+                # (Robots in manual_robots were skipped above, so this
+                # can only be the frontier plan for the /plan robot.)
                 path = np.asarray(r.path_xy)[np.asarray(r.path_valid)]
                 self.plan_pub.publish(Path(header=hdr, poses_xy=path))
 
@@ -266,4 +300,5 @@ class PlannerNode(Node):
         return {"n_plans": self.n_plans,
                 "n_frontier_plans": self.n_frontier_plans,
                 "last_reachable": self.last_reachable,
+                "reachable_by_robot": dict(self.reachable_by_robot),
                 "goal": self._current_goal()}

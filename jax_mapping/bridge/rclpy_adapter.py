@@ -297,14 +297,25 @@ class RclpyAdapter:
                 lambda m, _p=pub: _p.publish(self.pose_cov_from_ros(m)),
                 self._ros_qos())
         if "goal_pose" in topics:
-            # RViz's SetGoal tool; bridged for Nav2-style consumers (the
-            # reference never launched a consumer either — Nav2 was future
-            # work, report.pdf VI.2).
+            # RViz's SetGoal tool; consumed by the brain + global
+            # planner (the reference never launched a consumer — Nav2
+            # was future work, report.pdf VI.2). /goal_pose addresses
+            # robot 0; fleets also get /robotN/goal_pose so an operator
+            # can direct ANY robot (brain's per-robot goal topics).
             pub = self.bus.publisher(self.BUS_TOPICS["goal_pose"])
             n.create_subscription(
                 geo.PoseStamped, "/goal_pose",
                 lambda m, _p=pub: _p.publish(self.pose_stamped_from_ros(m)),
                 self._ros_qos())
+            if self.n_robots > 1:
+                for ns in self._robot_namespaces():
+                    bus_t = ns + "goal_pose"
+                    npub = self.bus.publisher(bus_t)
+                    n.create_subscription(
+                        geo.PoseStamped, "/" + bus_t,
+                        lambda m, _p=npub: _p.publish(
+                            self.pose_stamped_from_ros(m)),
+                        self._ros_qos())
 
     def _wire_tf(self) -> None:
         import tf2_ros

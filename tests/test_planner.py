@@ -400,3 +400,51 @@ def test_planner_reaches_goal_behind_wall(tiny_cfg):
         assert d < 3 * st.brain.goal_reached_dist_m
     finally:
         st.shutdown()
+
+
+def test_fleet_manual_goals_reach_and_clear(tiny_cfg):
+    """Fleet goal dispatch: /goal_pose drives robot 0 and {ns}goal_pose
+    drives robot 1 SIMULTANEOUSLY; each arrives within
+    goal_reached_dist_m and clears its own goal, with planner waypoints
+    per robot."""
+    from jax_mapping.bridge.messages import Pose2D
+    from jax_mapping.sim import world as W
+
+    world = W.empty_arena(96, tiny_cfg.grid.resolution_m)
+    cfg = dataclasses.replace(
+        tiny_cfg,
+        robot=dataclasses.replace(tiny_cfg.robot, cruise_speed_units=600),
+        planner=dataclasses.replace(tiny_cfg.planner, lookahead_cells=3,
+                                    bfs_iters=128))
+    from jax_mapping.bridge.launch import launch_sim_stack
+    st = launch_sim_stack(cfg, world, n_robots=2, http_port=None, seed=20)
+    try:
+        st.brain.start_exploring()
+        st.run_steps(3)
+        starts = st.sim.truth_poses().copy()
+        g0 = (float(starts[0, 0]) + 0.5, float(starts[0, 1]) + 0.2)
+        g1 = (float(starts[1, 0]) - 0.5, float(starts[1, 1]) - 0.2)
+        st.bus.publisher("/goal_pose").publish(Pose2D(*g0, 0.0))
+        st.bus.publisher("robot1/goal_pose").publish(Pose2D(*g1, 0.0))
+        status = st.brain.status()
+        assert status["goals"][0] is not None
+        assert status["goals"][1] is not None
+        done = [None, None]
+        for step in range(700):
+            st.run_steps(1)
+            goals = st.brain.status()["goals"]
+            for i in (0, 1):
+                if done[i] is None and goals[i] is None:
+                    done[i] = step
+            if all(d is not None for d in done):
+                break
+        assert all(d is not None for d in done), (
+            f"goals never both cleared: {done}, "
+            f"{st.brain.status()['goals']}")
+        poses = st.sim.truth_poses()
+        assert math.hypot(poses[0, 0] - g0[0], poses[0, 1] - g0[1]) \
+            < 3 * st.brain.goal_reached_dist_m
+        assert math.hypot(poses[1, 0] - g1[0], poses[1, 1] - g1[1]) \
+            < 3 * st.brain.goal_reached_dist_m
+    finally:
+        st.shutdown()
