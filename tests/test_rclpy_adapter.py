@@ -417,6 +417,30 @@ def test_inbound_goal_pose_reaches_bus(tiny_cfg, stub_ros):
     assert got[0].theta == pytest.approx(-0.3, abs=1e-6)
 
 
+def test_inbound_namespaced_goal_pose_for_fleets(tiny_cfg, stub_ros):
+    """Fleets bridge /robotN/goal_pose to the bus's namespaced goal
+    topics (the brain's per-robot manual goals); single-robot stacks
+    keep only /goal_pose."""
+    bus, _tf, ad = _adapter(tiny_cfg, stub_ros, n_robots=2)
+    got = []
+    bus.subscribe("robot1/goal_pose", callback=got.append)
+    m = Obj()
+    m.pose.position.x = -1.5
+    m.pose.position.y = 0.5
+    m.pose.orientation.z = 0.0
+    m.pose.orientation.w = 1.0
+    ad.node.subs["/robot1/goal_pose"](m)
+    assert len(got) == 1 and got[0].x == pytest.approx(-1.5)
+    # The other half of the contract: plain /goal_pose (RViz SetGoal ->
+    # robot 0) and /robot0/goal_pose both survive in fleet mode.
+    assert "/goal_pose" in ad.node.subs
+    assert "/robot0/goal_pose" in ad.node.subs
+
+    _bus2, _tf2, ad2 = _adapter(tiny_cfg, stub_ros)   # n_robots = 1
+    assert "/robot1/goal_pose" not in ad2.node.subs
+    assert "/goal_pose" in ad2.node.subs
+
+
 def test_fleet_namespaced_scan_odom_bridging(tiny_cfg, stub_ros):
     """n_robots>1 bridges every robot's namespaced scan/odom topics both
     ways (robot_ns convention: 'robot<i>/scan'), not just robot 0."""
