@@ -174,10 +174,19 @@ class ThymioBrain(Node):
 
     def _goal_cb(self, i: int, msg) -> None:
         """Any pose-shaped message with .x/.y (the adapter's Pose2D)."""
+        x, y = float(msg.x), float(msg.y)
+        if not (np.isfinite(x) and np.isfinite(y)):
+            # The single goal ingress rejects non-finite coordinates: a
+            # NaN goal can never be reached or cleared and would feed
+            # NaN through brain_tick into that robot's wheel targets
+            # until restart.
+            self._log(f"ignoring non-finite goal for robot {i}: "
+                      f"({x}, {y})")
+            return
         with self._state_lock:
-            self._nav_goals[i] = (float(msg.x), float(msg.y))
+            self._nav_goals[i] = (x, y)
         self._log(f"navigation goal set for robot {i}: "
-                  f"({msg.x:.2f}, {msg.y:.2f}) — engages while exploring")
+                  f"({x:.2f}, {y:.2f}) — engages while exploring")
 
     def _waypoint_cb(self, msg) -> None:
         with self._state_lock:

@@ -192,6 +192,16 @@ class MapApiServer:
                     {"error": f"{route} requires POST "
                               f"(curl -X POST ...{route})"}).encode()
             return self._checkpoint(route, path)
+        if route == "/goal":
+            # Navigation goal dispatch without RViz: POST /goal?x=..&y=..
+            # [&robot=N] — the HTTP twin of the SetGoal tool, addressed
+            # like the namespaced goal topics. POST-only: a goal MOVES a
+            # robot.
+            if method != "POST":
+                return 405, "application/json", json.dumps(
+                    {"error": "/goal requires POST "
+                              "(curl -X POST '.../goal?x=1&y=2')"}).encode()
+            return self._set_goal(path)
         if route == "/save-map":
             # Writes to disk -> POST-only, same stance as /save.
             if method != "POST":
@@ -319,6 +329,41 @@ class MapApiServer:
                 self.voxel_mapper.restore_keyframes(vkf)
                 body["keyframes_restored"] = int(len(vkf["robot"]))
         return 200, "application/json", json.dumps(body).encode()
+
+    def _set_goal(self, path: str) -> Tuple[int, str, bytes]:
+        if self.brain is None:
+            return 404, "application/json", json.dumps(
+                {"error": "no brain attached"}).encode()
+        from jax_mapping.bridge.brain import robot_ns
+        from jax_mapping.bridge.messages import Pose2D
+        q = parse_qs(urlparse(path).query)
+        import math as _math
+        try:
+            x = float(q["x"][0])
+            y = float(q["y"][0])
+            robot = int(q.get("robot", ["0"])[0])
+        except (KeyError, ValueError, IndexError):
+            return 400, "application/json", json.dumps(
+                {"error": "need numeric x and y (optional integer "
+                          "robot)"}).encode()
+        if not (_math.isfinite(x) and _math.isfinite(y)):
+            # float('nan')/'inf' parse fine; the brain ingress also
+            # rejects them, but the HTTP caller deserves a 400.
+            return 400, "application/json", json.dumps(
+                {"error": "x and y must be finite"}).encode()
+        n = self.brain.n_robots
+        if not 0 <= robot < n:
+            return 400, "application/json", json.dumps(
+                {"error": f"robot {robot} out of range (fleet of {n})"}
+            ).encode()
+        # Through the same bus topic the adapter and RViz use — ONE goal
+        # ingress path, not a side channel.
+        topic = "/goal_pose" if robot == 0 else \
+            robot_ns(robot, n) + "goal_pose"
+        self.bus.publisher(topic).publish(Pose2D(x, y, 0.0))
+        return 200, "application/json", json.dumps(
+            {"status": "goal set", "robot": robot,
+             "x": x, "y": y}).encode()
 
     def _G_empty(self):
         """Template grid for the prior sidecar's shape/dtype check."""
