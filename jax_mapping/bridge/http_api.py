@@ -29,6 +29,13 @@ variants' routes merged:
   (no path traversal); load refuses config-drifted checkpoints. POST
   only (ADVICE r3): GET /load would let a link prefetcher or stray
   browser request silently replace the running map; GET answers 405.
+* `POST /save-map[?name=x]` — export the live map in the ROS map_server
+  format (map.pgm + map.yaml, the map_saver_cli artifact) for external
+  consumers; `demo --map-prior` re-imports it (io/rosmap.py).
+* `POST /goal?x=..&y=..[&robot=N]` — navigation goal dispatch without
+  RViz: the HTTP twin of the SetGoal tool, published through the same
+  bus topics the adapter uses (one goal ingress). 400 on malformed,
+  out-of-range, or non-finite input.
 
 Served threaded like the reference (Flask's threaded dev server); shutdown
 uses the pi variant's graceful `make_server`/`shutdown` pattern
