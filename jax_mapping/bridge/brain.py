@@ -183,6 +183,17 @@ class ThymioBrain(Node):
             self._log(f"ignoring non-finite goal for robot {i}: "
                       f"({x}, {y})")
             return
+        g = self.cfg.grid
+        ox, oy = g.origin_m
+        span = g.extent_m
+        if not (ox <= x < ox + span and oy <= y < oy + span):
+            # Same guard as the HTTP endpoint, at the SHARED ingress:
+            # goals from any publisher (RViz, adapter, foreign DDS)
+            # outside the map would clip to a border cell and drive the
+            # robot toward a place that does not exist, never clearing.
+            self._log(f"ignoring out-of-map goal for robot {i}: "
+                      f"({x:.2f}, {y:.2f})")
+            return
         with self._state_lock:
             self._nav_goals[i] = (x, y)
         self._log(f"navigation goal set for robot {i}: "
@@ -256,6 +267,17 @@ class ThymioBrain(Node):
         """Every robot's manual goal (None where unset)."""
         with self._state_lock:
             return list(self._nav_goals)
+
+    def cancel_goal(self, i: int) -> bool:
+        """Clear robot i's manual goal; returns whether one was set.
+        The robot reverts to frontier exploration (or cruise) — the
+        escape hatch for an unreachable goal the operator regrets."""
+        with self._state_lock:
+            had = self._nav_goals[i] is not None
+            self._nav_goals[i] = None
+        if had:
+            self._log(f"navigation goal cancelled (robot {i})")
+        return had
 
     def robot_pose(self, i: int) -> np.ndarray:
         with self._state_lock:

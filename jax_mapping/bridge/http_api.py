@@ -35,7 +35,9 @@ variants' routes merged:
 * `POST /goal?x=..&y=..[&robot=N]` — navigation goal dispatch without
   RViz: the HTTP twin of the SetGoal tool, published through the same
   bus topics the adapter uses (one goal ingress). 400 on malformed,
-  out-of-range, or non-finite input.
+  out-of-range, non-finite, or out-of-map input.
+* `POST /goal/cancel[?robot=N]` — clear a manual goal (the escape hatch
+  RViz lacks); the robot reverts to frontier exploration.
 
 Served threaded like the reference (Flask's threaded dev server); shutdown
 uses the pi variant's graceful `make_server`/`shutdown` pattern
@@ -209,6 +211,28 @@ class MapApiServer:
                     {"error": "/goal requires POST "
                               "(curl -X POST '.../goal?x=1&y=2')"}).encode()
             return self._set_goal(path)
+        if route == "/goal/cancel":
+            # The escape hatch RViz lacks: clear a manual goal (e.g. an
+            # unreachable one) and let the robot go back to exploring.
+            if method != "POST":
+                return 405, "application/json", json.dumps(
+                    {"error": "/goal/cancel requires POST"}).encode()
+            if self.brain is None:
+                return 404, "application/json", json.dumps(
+                    {"error": "no brain attached"}).encode()
+            q = parse_qs(urlparse(path).query)
+            try:
+                robot = int(q.get("robot", ["0"])[0])
+            except (ValueError, IndexError):
+                return 400, "application/json", json.dumps(
+                    {"error": "robot must be an integer"}).encode()
+            if not 0 <= robot < self.brain.n_robots:
+                return 400, "application/json", json.dumps(
+                    {"error": f"robot {robot} out of range"}).encode()
+            had = self.brain.cancel_goal(robot)
+            return 200, "application/json", json.dumps(
+                {"status": "goal cancelled" if had else "no goal set",
+                 "robot": robot}).encode()
         if route == "/save-map":
             # Writes to disk -> POST-only, same stance as /save.
             if method != "POST":
@@ -358,6 +382,20 @@ class MapApiServer:
             # rejects them, but the HTTP caller deserves a 400.
             return 400, "application/json", json.dumps(
                 {"error": "x and y must be finite"}).encode()
+        if self.mapper is not None:
+            # An out-of-grid goal would clip to the border cell and plan
+            # "reachable" toward a place that does not exist; refuse
+            # with the valid extent so the caller can correct. Upper
+            # bound EXCLUSIVE: x == ox+span maps to cell size_cells,
+            # which only exists by clipping.
+            g = self.mapper.cfg.grid
+            ox, oy = g.origin_m
+            span = g.extent_m
+            if not (ox <= x < ox + span and oy <= y < oy + span):
+                return 400, "application/json", json.dumps(
+                    {"error": f"goal outside the map extent "
+                              f"[{ox}, {ox + span}) x [{oy}, {oy + span})"}
+                ).encode()
         n = self.brain.n_robots
         if not 0 <= robot < n:
             return 400, "application/json", json.dumps(

@@ -478,11 +478,24 @@ def test_http_goal_endpoint(tiny_cfg):
         assert goals[0] == {"x": 0.5, "y": 0.25}
         assert goals[1] == {"x": -0.5, "y": 0.1}
         for bad in ("/goal?x=abc&y=2", "/goal?y=2", "/goal?x=1&y=2&robot=7",
-                    "/goal?x=nan&y=2", "/goal?x=1&y=inf"):
+                    "/goal?x=nan&y=2", "/goal?x=1&y=inf",
+                    "/goal?x=99&y=0"):       # outside the map extent
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(urllib.request.Request(
                     base + bad, method="POST"))
             assert ei.value.code == 400
+        # Cancel: the escape hatch for a goal the operator regrets.
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/goal/cancel?robot=1", method="POST")) as r:
+            assert _json.loads(r.read())["status"] == "goal cancelled"
+        assert st.brain.status()["goals"][1] is None
+        assert st.brain.status()["goals"][0] is not None   # untouched
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/goal/cancel?robot=1", method="POST")) as r:
+            assert _json.loads(r.read())["status"] == "no goal set"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/goal/cancel")   # GET
+        assert ei.value.code == 405
     finally:
         st.shutdown()
 
