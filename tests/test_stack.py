@@ -194,3 +194,32 @@ def test_stack_survives_scan_loss(tiny_cfg):
         assert (np.abs(lo) > 0.3).sum() > 100        # still mapped
     finally:
         st.shutdown()
+
+
+@pytest.mark.slow
+def test_bridge_stack_at_baseline_64_robots(tiny_cfg):
+    """BASELINE configs-4's robot count through the ACTUAL node graph —
+    bus fan-in, brain batch, shared-grid mapper, planner — not just the
+    fleet model: 64 robots boot, every robot's scans fuse, no node
+    errors. (The model-level 64-robot tick is bench.py's job; this pins
+    that the BRIDGE composes at that scale.)"""
+    import dataclasses as _dc
+
+    cfg = _dc.replace(tiny_cfg,
+                      fleet=_dc.replace(tiny_cfg.fleet, n_robots=64))
+    world = W.rooms_world(128, cfg.grid.resolution_m, seed=6)
+    st = launch_sim_stack(cfg, world, n_robots=64, http_port=None,
+                          seed=28)
+    try:
+        st.brain.start_exploring()
+        st.run_steps(4)
+        s = st.brain.status()
+        assert s["n_robots"] == 64
+        assert st.mapper.n_scans_fused == 64 * 4, \
+            "some robot's scans never fused"
+        assert st.brain.n_errors == 0 and st.mapper.n_errors == 0
+        assert st.planner.n_errors == 0
+        lo = np.asarray(st.mapper.merged_grid())
+        assert int((np.abs(lo) > 0.3).sum()) > 1000
+    finally:
+        st.shutdown()
