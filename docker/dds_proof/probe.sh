@@ -19,7 +19,7 @@ echo "== topic list =="
 ros2 topic list
 
 for t in /map /map_updates /scan /odom /pose /tf /frontiers_markers \
-         /voxel_points /plan; do
+         /voxel_points /plan /graph; do
   ros2 topic list | grep -qx "$t" || fail "topic $t not advertised"
 done
 

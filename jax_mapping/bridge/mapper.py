@@ -138,8 +138,12 @@ class MapperNode(Node):
 
         period = tick_period_s if tick_period_s is not None \
             else 1.0 / cfg.robot.control_rate_hz
+        self.graph_pub = self.create_publisher("/graph")
         self.create_timer(period, self.tick)
         self.create_timer(cfg.map_publish_period_s, self.publish_map)
+        # Graph viz rides the slow map cadence: nodes move only on key
+        # scans/closures, and RViz redraws the whole MarkerArray.
+        self.create_timer(cfg.map_publish_period_s, self.publish_graph)
         self._last_map_stamp = 0.0
 
     # -- callbacks ----------------------------------------------------------
@@ -523,6 +527,38 @@ class MapperNode(Node):
             self.n_loops_closed += 1
             M.counters.inc("mapper.loops_closed")
         return True
+
+    def publish_graph(self) -> None:
+        """The fleet's pose graphs as `/graph` (GraphMarkers) — the
+        slam_toolbox interactive-mode graph view (slam_config.yaml:32),
+        served continuously instead of behind a service call. Loop
+        edges = non-consecutive constraints."""
+        from jax_mapping.bridge.messages import GraphMarkers
+        with self._state_lock:               # refs only; fetch after
+            states = list(self.states)
+        nodes, nrob, edges, isloop = [], [], [], []
+        cap = self.cfg.loop.max_poses
+        for i, st in enumerate(states):
+            g = st.graph
+            poses = np.asarray(g.poses[:cap], np.float32)
+            valid = np.asarray(g.pose_valid[:cap])
+            for k in np.nonzero(valid)[0]:
+                nodes.append(poses[k, :2])
+                nrob.append(i)
+            eij = np.asarray(g.edge_ij)
+            evalid = np.asarray(g.edge_valid)
+            for k in np.nonzero(evalid)[0]:
+                a, b = int(eij[k, 0]), int(eij[k, 1])
+                if not (valid[a] and valid[b]):
+                    continue
+                edges.append([poses[a, :2], poses[b, :2]])
+                isloop.append(abs(b - a) > 1)
+        self.graph_pub.publish(GraphMarkers(
+            header=Header.now("map"),
+            nodes_xy=np.asarray(nodes, np.float32).reshape(-1, 2),
+            node_robot=np.asarray(nrob, np.int32),
+            edges_xy=np.asarray(edges, np.float32).reshape(-1, 2, 2),
+            edge_is_loop=np.asarray(isloop, bool)))
 
     def calibration(self) -> Optional[dict]:
         """Fleet odometry-scale estimate from the accumulated matched

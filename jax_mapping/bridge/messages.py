@@ -284,6 +284,25 @@ class Waypoint:
     robot: int = 0
 
 
+@dataclasses.dataclass
+class GraphMarkers:
+    """`/graph` payload: the fleet's pose graphs for visualization — the
+    capability slam_toolbox's interactive mode renders in RViz (graph
+    nodes + constraints; slam_config.yaml:32 enables it, the reference
+    never used it). Flat arrays: nodes with their owning robot, edges as
+    endpoint pairs, loop edges flagged (non-consecutive constraints)."""
+
+    header: Header = dataclasses.field(default_factory=Header)
+    nodes_xy: np.ndarray = dataclasses.field(        # (N, 2) metres
+        default_factory=lambda: np.zeros((0, 2), np.float32))
+    node_robot: np.ndarray = dataclasses.field(      # (N,)
+        default_factory=lambda: np.zeros(0, np.int32))
+    edges_xy: np.ndarray = dataclasses.field(        # (E, 2, 2)
+        default_factory=lambda: np.zeros((0, 2, 2), np.float32))
+    edge_is_loop: np.ndarray = dataclasses.field(    # (E,)
+        default_factory=lambda: np.zeros(0, bool))
+
+
 def occupancy_from_logodds(logodds: np.ndarray, occ_threshold: float,
                            free_threshold: float, resolution: float,
                            origin_xy: Tuple[float, float],

@@ -97,7 +97,7 @@ class RclpyAdapter:
     """
 
     OUTBOUND_DEFAULT = ("map", "map_updates", "pose", "scan", "odom",
-                        "frontiers", "voxel_points", "plan")
+                        "frontiers", "voxel_points", "plan", "graph")
     INBOUND_DEFAULT = ("cmd_vel", "initialpose", "goal_pose")
 
     def __init__(self, bus: Bus, cfg: SlamConfig,
@@ -181,6 +181,7 @@ class RclpyAdapter:
         "scan": "scan", "odom": "odom",
         "voxel_points": "/voxel_points",
         "plan": "/plan",
+        "graph": "/graph",
     }
 
     def _wire_outbound(self, topics) -> None:
@@ -226,6 +227,12 @@ class RclpyAdapter:
             pub = n.create_publisher(nav.Path, "/plan",
                                      self._ros_qos(depth=1))
             self._bus_to_ros("plan", pub, self.path_to_ros)
+        if "graph" in topics and self._msgs["vis"] is not None:
+            # The pose graph as markers (slam_toolbox's interactive-mode
+            # graph view); same MarkerArray class as the frontier layer.
+            pub = n.create_publisher(self._msgs["vis"].MarkerArray,
+                                     "/graph", self._ros_qos(depth=1))
+            self._bus_to_ros("graph", pub, self.graph_to_ros_markers)
         if "voxel_points" in topics:
             # The 3D voxel map as a point cloud (RViz PointCloud2
             # display) — published only when a voxel mapper runs; the
@@ -562,6 +569,65 @@ class RclpyAdapter:
             else:
                 m.color.r = 1.0
                 m.color.g = 0.6
+            markers.append(m)
+        out.markers = markers
+        return out
+
+    def graph_to_ros_markers(self, msg):
+        """GraphMarkers -> MarkerArray: one SPHERE_LIST per robot's nodes
+        (color cycled per robot), one LINE_LIST of gray odometry edges,
+        one LINE_LIST of red loop constraints. DELETEALL leads so a
+        thinned/reset graph vanishes cleanly."""
+        vis = self._msgs["vis"]
+        if vis is None:
+            return None
+        bi = self._msgs["bi"]
+        stamp = _to_ros_time(bi.Time, msg.header.stamp)
+
+        def mk(ns, mid, mtype):
+            m = vis.Marker()
+            m.header.stamp = stamp
+            m.header.frame_id = "map"
+            m.ns = ns
+            m.id = mid
+            m.type = mtype
+            m.action = 0
+            m.pose.orientation.w = 1.0
+            return m
+
+        def pt(xy):
+            g = self._msgs["geo"].Point()
+            g.x, g.y, g.z = float(xy[0]), float(xy[1]), 0.02
+            return g
+
+        out = vis.MarkerArray()
+        clear = vis.Marker()
+        clear.action = 3                      # DELETEALL
+        markers = [clear]
+        nodes = np.asarray(msg.nodes_xy)
+        nrob = np.asarray(msg.node_robot)
+        palette = [(0.2, 0.6, 1.0), (1.0, 0.8, 0.2), (0.6, 1.0, 0.4),
+                   (1.0, 0.4, 0.8)]
+        for r in sorted(set(int(x) for x in nrob)):
+            m = mk("graph_nodes", r, 7)       # SPHERE_LIST
+            m.scale.x = m.scale.y = m.scale.z = 0.06
+            cr, cg, cb = palette[r % len(palette)]
+            m.color.r, m.color.g, m.color.b, m.color.a = cr, cg, cb, 0.9
+            m.points = [pt(xy) for xy in nodes[nrob == r]]
+            markers.append(m)
+        edges = np.asarray(msg.edges_xy)
+        isloop = np.asarray(msg.edge_is_loop)
+        for name, mid, sel, col in (
+                ("graph_edges", 0, ~isloop, (0.6, 0.6, 0.6)),
+                ("graph_loops", 1, isloop, (1.0, 0.2, 0.2))):
+            m = mk(name, mid, 5)              # LINE_LIST
+            m.scale.x = 0.015
+            m.color.r, m.color.g, m.color.b = col
+            m.color.a = 0.8
+            pts = []
+            for e in edges[sel] if len(edges) else []:
+                pts += [pt(e[0]), pt(e[1])]
+            m.points = pts
             markers.append(m)
         out.markers = markers
         return out

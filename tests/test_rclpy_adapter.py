@@ -113,6 +113,7 @@ def stub_ros(monkeypatch):
     geo.PoseArray = _msg("PoseArray")
     geo.PoseStamped = _msg("PoseStamped")
     geo.Pose = _msg("Pose")
+    geo.Point = _msg("Point")
     geo.TransformStamped = _msg("TransformStamped")
     bi = types.ModuleType("builtin_interfaces.msg")
     bi.Time = StubTime
@@ -624,3 +625,75 @@ def test_voxel_mapper_publishes_points(tiny_cfg):
     assert len(got[-1].points) > 0
     # All points on the synthetic wall plane.
     assert np.abs(got[-1].points[:, 0] - 0.7).max() < 0.2
+
+
+def test_graph_markers_outbound(tiny_cfg, stub_ros):
+    """GraphMarkers on the bus -> MarkerArray on /graph: DELETEALL lead,
+    per-robot SPHERE_LIST node layers, gray odometry LINE_LIST and red
+    loop LINE_LIST (the slam_toolbox interactive-mode graph view)."""
+    from jax_mapping.bridge.messages import GraphMarkers, Header
+
+    bus, _tf, ad = _adapter(tiny_cfg, stub_ros)
+    nodes = np.asarray([[0.0, 0.0], [0.5, 0.0], [0.5, 0.5], [1.0, 1.0]],
+                       np.float32)
+    nrob = np.asarray([0, 0, 0, 1], np.int32)
+    edges = np.asarray([[[0.0, 0.0], [0.5, 0.0]],      # odometry
+                        [[0.5, 0.0], [0.5, 0.5]],      # odometry
+                        [[0.5, 0.5], [0.0, 0.0]]],     # loop (non-consec)
+                       np.float32)
+    isloop = np.asarray([False, False, True])
+    bus.publisher("/graph").publish(GraphMarkers(
+        header=Header(stamp=4.0, frame_id="map"), nodes_xy=nodes,
+        node_robot=nrob, edges_xy=edges, edge_is_loop=isloop))
+    out = ad.node.pubs["/graph"].published[-1]
+    ms = out.markers
+    assert ms[0].action == 3                 # DELETEALL
+    node_layers = [m for m in ms if m.ns == "graph_nodes"]
+    assert {m.id for m in node_layers} == {0, 1}
+    assert len(node_layers[0].points) == 3   # robot 0's nodes
+    assert len(node_layers[1].points) == 1
+    odo = [m for m in ms if m.ns == "graph_edges"][0]
+    loops = [m for m in ms if m.ns == "graph_loops"][0]
+    assert len(odo.points) == 4              # 2 edges x 2 endpoints
+    assert len(loops.points) == 2
+    assert loops.color.r == pytest.approx(1.0)
+
+
+def test_mapper_publishes_graph(tiny_cfg):
+    """The mapper's periodic /graph export carries the live graphs: after
+    real key scans there are nodes and consecutive odometry edges."""
+    import jax.numpy as jnp
+
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.mapper import MapperNode
+    from jax_mapping.bridge.messages import Header, LaserScan, Odometry, \
+        Pose2D
+    from jax_mapping.sim import lidar
+    from jax_mapping.sim import world as W
+
+    bus = Bus()
+    mapper = MapperNode(tiny_cfg, bus, n_robots=1)
+    got = []
+    bus.subscribe("/graph", callback=got.append)
+    world = jnp.asarray(W.empty_arena(96, tiny_cfg.grid.resolution_m))
+    n_samples = int(tiny_cfg.scan.range_max_m
+                    / (tiny_cfg.grid.resolution_m * 0.5))
+    for k in range(5):
+        t, x = 0.5 * k, 0.15 * k
+        r = np.asarray(lidar.simulate_scans(
+            tiny_cfg.scan, world, tiny_cfg.grid.resolution_m, n_samples,
+            jnp.asarray([[x, 0.0, 0.0]]))[0])[:tiny_cfg.scan.n_beams]
+        bus.publisher("odom").publish(Odometry(
+            header=Header(stamp=t, frame_id="odom"),
+            pose=Pose2D(x, 0.0, 0.0)))
+        bus.publisher("scan").publish(LaserScan(
+            header=Header(stamp=t, frame_id="base_laser"),
+            angle_increment=tiny_cfg.scan.angle_increment_rad, ranges=r))
+        mapper.tick()
+    mapper.publish_graph()
+    assert got, "no /graph message"
+    g = got[-1]
+    assert len(g.nodes_xy) >= 3
+    assert (g.node_robot == 0).all()
+    assert len(g.edges_xy) >= 2
+    assert not g.edge_is_loop.any()          # straight drive: no loops
