@@ -1,0 +1,28 @@
+"""Repo-native static analysis: JAX hazard linter + lock-discipline checker.
+
+The framework's correctness rests on two mechanically checkable
+disciplines that ordinary linters know nothing about:
+
+* the **device boundary** — host syncs (`np.asarray`, `.item()`, ...)
+  must stay out of jit-traced code and be deliberate (baselined) in
+  per-tick bridge code; jit entry points must not hide recompile-storm
+  or tracer-leak hazards (ROADMAP north-star: "runs as fast as the
+  hardware allows");
+* the **lock discipline** of the threaded bridge layer (`bus.py`,
+  `node.py`, `mapper.py`, ...) — consistent acquisition order, no
+  callbacks invoked under a lock, no unguarded writes to state that is
+  elsewhere lock-protected.
+
+`core` holds the checker framework (Finding, baseline, driver),
+`jax_hazards` the A-family checkers, `lock_discipline` the B-family,
+`lockwatch` a runtime lock-order recorder that validates the static
+graph against a live stack, and `cli` the `jax-mapping-lint` console
+entry point. The repo gates itself in tier-1 via
+`tests/test_analysis_selfcheck.py`: the full analyzer over
+`jax_mapping/` must report zero non-baselined findings.
+"""
+
+from jax_mapping.analysis.core import (  # noqa: F401
+    Baseline, Finding, SourceModule, all_checkers, analyze_paths,
+    analyze_modules, default_baseline_path, load_package_modules,
+)

@@ -1,0 +1,449 @@
+"""Shared AST machinery for the checker families.
+
+Everything here is deliberately *syntactic* — no imports are executed,
+no types inferred. Resolution is by name through each module's import
+table, which is exactly as strong as the repo's own conventions
+(`import jax.numpy as jnp`, `from jax_mapping.ops import planner as P`)
+and degrades to silence, not false positives, on code that breaks them.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from jax_mapping.analysis.core import SourceModule
+
+
+# -- imports -----------------------------------------------------------------
+
+def import_table(tree: ast.Module) -> Dict[str, str]:
+    """Alias -> dotted target for module-level imports.
+
+    `import jax.numpy as jnp`         -> {"jnp": "jax.numpy"}
+    `import functools`                -> {"functools": "functools"}
+    `from jax_mapping.ops import planner as P`
+                                      -> {"P": "jax_mapping.ops.planner"}
+    `from jax_mapping.bridge.brain import brain_tick`
+                                      -> {"brain_tick":
+                                          "jax_mapping.bridge.brain.brain_tick"}
+    Function-local imports are included too (the repo defers heavy
+    imports into tick bodies).
+    """
+    table: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    table[a.asname] = a.name
+                else:
+                    table[a.name.split(".")[0]] = a.name.split(".")[0]
+                    table[a.name] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                table[a.asname or a.name] = f"{node.module}.{a.name}"
+    return table
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` expression -> "a.b.c"; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Expression -> fully-qualified dotted name through the import
+    table: `jnp.asarray` -> "jax.numpy.asarray"."""
+    d = dotted(node)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    base = imports.get(head, head)
+    return f"{base}.{rest}" if rest else base
+
+
+# -- symbols -----------------------------------------------------------------
+
+def walk_functions(tree: ast.Module) -> Iterator[Tuple[ast.AST, str,
+                                                       Optional[str]]]:
+    """Yield (funcdef, dotted symbol, enclosing class name) for every
+    function/method, depth-first."""
+    def rec(node, prefix: str, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sym = f"{prefix}{child.name}"
+                yield child, sym, cls
+                yield from rec(child, f"{sym}.", cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, f"{prefix}{child.name}.", child.name)
+    yield from rec(tree, "", None)
+
+
+def param_names(func: ast.FunctionDef) -> List[str]:
+    a = func.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    """Every bare Name loaded anywhere inside `node`."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+#: attributes whose access yields trace-STATIC metadata, not values.
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def traced_names(node: ast.AST) -> Set[str]:
+    """Names in `node` whose *values* flow into the result — skipping
+    trace-static subexpressions: `x is None` identity checks, `len(x)`,
+    `isinstance(x, T)`, and `.shape`/`.ndim`/`.dtype`/`.size` access.
+    `B = ranges.shape[0]` therefore taints nothing: under jit, shapes
+    are Python ints at trace time."""
+    out: Set[str] = set()
+    stack: List[ast.AST] = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+            continue
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id in ("len", "isinstance"):
+            continue
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            continue
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def target_names(target: ast.AST) -> Set[str]:
+    """Names *bound* by an assignment target (x, (a, b), x[i] binds x)."""
+    out: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            break                    # self.x = ... binds no local
+    return out
+
+
+def receiver_base(node: ast.AST) -> Optional[str]:
+    """The root Name of an attribute/subscript chain (`r.path_xy[v]`
+    -> "r"); None when rooted elsewhere (call result, literal)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+# -- jit registry ------------------------------------------------------------
+
+@dataclass
+class JitSite:
+    module: SourceModule
+    func: ast.FunctionDef
+    symbol: str
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    decorator: ast.AST = None
+
+    @property
+    def params(self) -> List[str]:
+        return param_names(self.func)
+
+    @property
+    def static_params(self) -> Set[str]:
+        ps = self.params
+        out = {ps[i] for i in self.static_argnums if 0 <= i < len(ps)}
+        out |= set(self.static_argnames) & set(ps)
+        return out
+
+    @property
+    def traced_params(self) -> Set[str]:
+        return set(self.params) - self.static_params
+
+
+def _const_ints(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _const_strs(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def jit_decorator_info(dec: ast.AST, imports: Dict[str, str]
+                       ) -> Optional[Tuple[Tuple[int, ...],
+                                           Tuple[str, ...]]]:
+    """(static_argnums, static_argnames) when `dec` is a jit decorator:
+    `@jax.jit`, `@jit`, `@functools.partial(jax.jit, static_argnums=..)`
+    or `@jax.jit(...)` called with keyword statics. None otherwise."""
+    if resolve(dec, imports) == "jax.jit":
+        return (), ()
+    if not isinstance(dec, ast.Call):
+        return None
+    fn = resolve(dec.func, imports)
+    if fn == "jax.jit":
+        call = dec
+    elif fn == "functools.partial" and dec.args \
+            and resolve(dec.args[0], imports) == "jax.jit":
+        call = dec
+    else:
+        return None
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _const_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _const_strs(kw.value)
+    return nums, names
+
+
+def build_jit_registry(modules: Sequence[SourceModule]
+                       ) -> Dict[Tuple[str, str], JitSite]:
+    """(module dotted name, function name) -> JitSite, package-wide."""
+    registry: Dict[Tuple[str, str], JitSite] = {}
+    for mod in modules:
+        imports = import_table(mod.tree)
+        for func, symbol, _cls in walk_functions(mod.tree):
+            for dec in getattr(func, "decorator_list", ()):
+                info = jit_decorator_info(dec, imports)
+                if info is not None:
+                    registry[(mod.dotted, func.name)] = JitSite(
+                        module=mod, func=func, symbol=symbol,
+                        static_argnums=info[0], static_argnames=info[1],
+                        decorator=dec)
+                    break
+    return registry
+
+
+def resolve_call_target(call: ast.Call, mod: SourceModule,
+                        imports: Dict[str, str]) -> Optional[Tuple[str,
+                                                                   str]]:
+    """Call site -> (module dotted, func name) candidate for registry
+    lookup. `brain_tick(...)` in its own module -> (mod, brain_tick);
+    `P.plan_to_goal(...)` -> (resolved P, plan_to_goal)."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        tgt = imports.get(f.id)
+        if tgt and "." in tgt:                   # from-import of a symbol
+            m, _, n = tgt.rpartition(".")
+            return m, n
+        return mod.dotted, f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        base = imports.get(f.value.id)
+        if base:
+            return base, f.attr
+    return None
+
+
+# -- ordered, lightly flow-sensitive taint walk ------------------------------
+
+@dataclass
+class TaintWalk:
+    """Statement-ordered walk of one function body tracking a tainted
+    name set. Callers subscribe via `on_expr` (called with each visited
+    statement-level expression while the *current* taint set applies)
+    and supply `call_taints` / `call_sanitizes` predicates deciding
+    whether an assignment's RHS call introduces or clears taint.
+
+    Single forward pass, branches visited in order without merge —
+    a linter's approximation, biased toward the repo's straight-line
+    tick bodies."""
+    tainted: Set[str]
+    call_taints: object = None           # Callable[[ast.Call], bool]
+    call_sanitizes: object = None        # Callable[[ast.Call], bool]
+    on_stmt: object = None               # Callable[[ast.stmt, Set[str]], None]
+
+    def is_tainted(self, expr: ast.AST) -> bool:
+        """Tainted names in `expr`, or a taint-introducing call nested
+        anywhere in it (`float(step(x))` must flag even though `step`'s
+        RESULT never got a name)."""
+        if traced_names(expr) & self.tainted:
+            return True
+        return self._rhs_taints(expr) is True
+
+    def _rhs_taints(self, value: ast.AST) -> Optional[bool]:
+        """True taint / False sanitize / None = propagate by names."""
+        for call in [n for n in ast.walk(value)
+                     if isinstance(n, ast.Call)]:
+            if self.call_sanitizes and self.call_sanitizes(call):
+                return False
+            if self.call_taints and self.call_taints(call):
+                return True
+        return None
+
+    def _assign(self, targets: List[ast.AST], value: ast.AST) -> None:
+        verdict = self._rhs_taints(value)
+        if verdict is None:
+            verdict = self.is_tainted(value)
+        for t in targets:
+            names = target_names(t)
+            if verdict:
+                self.tainted |= names
+            else:
+                self.tainted -= names
+
+    def run(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            if self.on_stmt:
+                self.on_stmt(stmt, self.tainted)
+            if isinstance(stmt, ast.Assign):
+                self._assign(stmt.targets, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._assign([stmt.target], stmt.value)
+            elif isinstance(stmt, ast.AugAssign):
+                if self.is_tainted(stmt.value):
+                    self.tainted |= target_names(stmt.target)
+            elif isinstance(stmt, ast.For):
+                if self.is_tainted(stmt.iter):
+                    self.tainted |= target_names(stmt.target)
+                self.run(stmt.body)
+                self.run(stmt.orelse)
+            elif isinstance(stmt, (ast.While, ast.If)):
+                self.run(stmt.body)
+                self.run(stmt.orelse)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    if item.optional_vars is not None \
+                            and self.is_tainted(item.context_expr):
+                        self.tainted |= target_names(item.optional_vars)
+                self.run(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self.run(stmt.body)
+                for h in stmt.handlers:
+                    self.run(h.body)
+                self.run(stmt.orelse)
+                self.run(stmt.finalbody)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue                     # nested defs analyzed separately
+
+
+def statement_calls(stmt: ast.stmt) -> List[ast.Call]:
+    """Every Call in `stmt`'s own expressions — nested statements are
+    excluded (TaintWalk.run visits them with their own on_stmt call, so
+    descending here would double-count), as are nested def bodies."""
+    out: List[ast.Call] = []
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                continue
+            if isinstance(child, ast.Call):
+                out.append(child)
+            stack.append(child)
+    return out
+
+
+# -- class structure (bridge checkers + hot-path roots) ----------------------
+
+@dataclass
+class ClassInfo:
+    module: SourceModule
+    node: ast.ClassDef
+    name: str
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    #: self attrs assigned threading.Lock()/RLock()/Condition() in any
+    #: method, attr -> "Lock"|"RLock"|"Condition"
+    lock_attrs: Dict[str, str] = field(default_factory=dict)
+    #: methods registered as timer callbacks (per-tick hot roots)
+    timer_callbacks: Set[str] = field(default_factory=set)
+    #: methods registered as subscription callbacks
+    sub_callbacks: Set[str] = field(default_factory=set)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _callback_method(arg: ast.AST) -> Optional[str]:
+    """`self.tick` or `functools.partial(self._scan_cb, i)` -> method."""
+    m = _self_attr(arg)
+    if m is not None:
+        return m
+    if isinstance(arg, ast.Call) and arg.args:
+        fn = dotted(arg.func) or ""
+        if fn.endswith("partial"):
+            return _self_attr(arg.args[0])
+    return None
+
+
+def collect_classes(mod: SourceModule) -> List[ClassInfo]:
+    imports = import_table(mod.tree)
+    out: List[ClassInfo] = []
+    for node in mod.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = ClassInfo(module=mod, node=node, name=node.name)
+        for item in node.body:
+            if isinstance(item, ast.FunctionDef):
+                info.methods[item.name] = item
+        for meth in info.methods.values():
+            for sub in ast.walk(meth):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    attr = _self_attr(sub.targets[0])
+                    if attr is None or not isinstance(sub.value, ast.Call):
+                        continue
+                    target = resolve(sub.value.func, imports) or ""
+                    kind = target.rpartition(".")[2]
+                    if target.startswith("threading.") and kind in (
+                            "Lock", "RLock", "Condition"):
+                        info.lock_attrs[attr] = kind
+                elif isinstance(sub, ast.Call):
+                    fn = dotted(sub.func) or ""
+                    if fn == "self.create_timer" and len(sub.args) >= 2:
+                        cb = _callback_method(sub.args[1])
+                        if cb:
+                            info.timer_callbacks.add(cb)
+                    elif fn == "self.create_subscription" \
+                            and len(sub.args) >= 2:
+                        cb = _callback_method(sub.args[1])
+                        if cb:
+                            info.sub_callbacks.add(cb)
+        out.append(info)
+    return out
+
+
+def self_calls(func: ast.FunctionDef) -> Set[str]:
+    """Names of same-class methods invoked as `self.m(...)`."""
+    out: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            m = _self_attr(node.func)
+            if m is not None:
+                out.add(m)
+    return out

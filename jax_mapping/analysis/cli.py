@@ -1,0 +1,151 @@
+"""`jax-mapping-lint` — run the repo's static-analysis pass.
+
+    jax-mapping-lint jax_mapping/                 # full pass, committed
+                                                  # baseline applied
+    jax-mapping-lint --no-baseline jax_mapping/   # everything, raw
+    jax-mapping-lint --write-baseline jax_mapping/  # accept current
+                                                  # findings (ratchet)
+    jax-mapping-lint --format json jax_mapping/   # machine-readable
+
+Exit codes: 0 clean (all findings baselined), 1 new findings, 2 usage
+or parse error. The tier-1 gate (`tests/test_analysis_selfcheck.py`)
+is exactly "exit code 0 over `jax_mapping/` with the committed
+baseline".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from jax_mapping.analysis.core import (
+    Baseline, all_checkers, analyze_modules, default_baseline_path,
+    load_package_modules, load_paths,
+)
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="jax-mapping-lint",
+        description="JAX hazard + lock-discipline static analysis for "
+                    "jax_mapping.")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to analyze (default: the "
+                        "installed jax_mapping package)")
+    p.add_argument("--baseline", default=None, metavar="JSON",
+                   help="baseline file (default: the committed "
+                        "analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, baselined or not")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into the baseline "
+                        "file and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--checker", action="append", default=None,
+                   metavar="ID", help="run only these checker ids "
+                   "(repeatable), e.g. --checker B1-lock-order")
+    p.add_argument("--list-checkers", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+    checkers = all_checkers()
+    if args.list_checkers:
+        for c in checkers:
+            print(c.id)
+        return 0
+    if args.checker:
+        known = {c.id for c in checkers}
+        unknown = set(args.checker) - known
+        if unknown:
+            print(f"unknown checker id(s): {sorted(unknown)}; "
+                  f"known: {sorted(known)}", file=sys.stderr)
+            return 2
+        checkers = [c for c in checkers if c.id in args.checker]
+
+    try:
+        modules = (load_paths(args.paths) if args.paths
+                   else load_package_modules())
+    except (OSError, SyntaxError) as e:
+        print(f"jax-mapping-lint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = None
+    try:
+        if not args.no_baseline and not args.write_baseline \
+                and os.path.exists(baseline_path):
+            baseline = Baseline.load(baseline_path)
+    except (OSError, ValueError) as e:       # ValueError covers bad JSON
+        print(f"jax-mapping-lint: baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    res = analyze_modules(modules, baseline, checkers)
+
+    if args.write_baseline:
+        # Merge, never clobber: keep the notes of entries that are
+        # still live, and keep entries this scoped run could not have
+        # re-observed (filtered-out checkers / unanalyzed files) —
+        # otherwise `--write-baseline --checker B1-lock-order` would
+        # silently delete every A-family suppression.
+        notes, keep = {}, []
+        if os.path.exists(baseline_path):
+            ids = {c.id for c in checkers}
+            analyzed = {m.path for m in modules}
+            try:
+                existing = Baseline.load(baseline_path).suppressions
+            except (OSError, ValueError) as e:
+                print(f"jax-mapping-lint: baseline {baseline_path}: {e} "
+                      "— refusing to overwrite what cannot be merged",
+                      file=sys.stderr)
+                return 2
+            # An entry may be dropped (trusted to re-appear as a
+            # finding if still valid) only when this run could have
+            # re-observed it: its checker ran, its file was analyzed,
+            # and the run had full cross-module context — a subset run
+            # finds strictly less (the A checkers need the package-wide
+            # jit registry) and must not destroy entries it cannot see.
+            full_context = {s["path"] for s in existing} <= analyzed
+            for s in existing:
+                key = (s["checker"], s["path"], s.get("symbol", ""),
+                       s.get("code", ""))
+                if full_context and s["checker"] in ids \
+                        and s["path"] in analyzed:
+                    if s.get("note"):
+                        notes[key] = s["note"]
+                else:
+                    keep.append(s)
+        Baseline.dump(res.all_findings, baseline_path, notes=notes,
+                      keep=keep)
+        print(f"wrote {len(res.all_findings) + len(keep)} "
+              f"suppression(s) to {baseline_path}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "files": res.n_files,
+            "findings": [vars(f) for f in res.findings],
+            "baselined": [vars(f) for f in res.baselined],
+            "unused_suppressions": res.unused_suppressions,
+        }, indent=1))
+        return 1 if res.findings else 0
+
+    for f in res.findings:
+        print(f.format())
+    for s in res.unused_suppressions:
+        print(f"note: unused baseline suppression: {s['checker']} "
+              f"{s['path']} [{s.get('symbol', '')}] — ratchet it out")
+    print(f"{res.n_files} files: {len(res.findings)} new finding(s), "
+          f"{len(res.baselined)} baselined"
+          + (f", {len(res.unused_suppressions)} unused suppression(s)"
+             if res.unused_suppressions else ""))
+    return 1 if res.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,401 @@
+"""Family A — JAX hazard checkers.
+
+A1 `A1-host-sync`    host syncs (`np.asarray`, `np.array`, `float()`,
+                     `.item()`, `.tolist()`) on traced values inside
+                     jitted functions (error — breaks tracing or forces
+                     a device round-trip per call), and on device values
+                     inside per-tick bridge code (warning — each is a
+                     blocking transfer in the hot loop; sanctioned
+                     boundary sites live in the baseline).
+A2 `A2-jit-hygiene`  jit-boundary hazards: Python `if`/`while` on traced
+                     values (TracerBoolConversionError at best, silent
+                     trace-time constant at worst), `for` over a traced
+                     range (concretization), static_argnums out of
+                     range, unhashable literals passed in static
+                     positions at call sites of known jit entry points
+                     (recompile storm / TypeError).
+A3 `A3-dtype-drift`  float64 leaking toward TPU-path arrays: explicit
+                     `np.float64`, `dtype=float`, and dtype-less
+                     `np.array([...])` over float literals (NumPy
+                     defaults to float64; x64-disabled JAX then inserts
+                     a silent downcast per transfer).
+A4 `A4-impure-jit`   impurity under trace: `time.*` / `random.*` /
+                     `np.random.*` calls and `self.<attr>` mutation
+                     inside jitted functions or their package-local
+                     callees (executed once at trace time, then frozen
+                     into the compiled program).
+
+Hot-path roots for A1's per-tick rule are discovered, not configured:
+any method registered via `self.create_timer(period, self.m)` plus
+everything reachable from it through `self.m()` calls — so new nodes
+are covered the day they gain a timer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from jax_mapping.analysis import astutil as A
+from jax_mapping.analysis.core import Finding, SourceModule
+
+#: numpy conversion calls that synchronize device values onto the host.
+_HOST_CONVERTERS = {"numpy.asarray", "numpy.array"}
+#: method names that synchronize when invoked on a device array.
+_SYNC_METHODS = {"item", "tolist"}
+
+
+def _np_target(call: ast.Call, imports: Dict[str, str]) -> str:
+    return A.resolve(call.func, imports) or ""
+
+
+def _function_registry(modules: Sequence[SourceModule]
+                       ) -> Dict[Tuple[str, str],
+                                 Tuple[SourceModule, ast.FunctionDef]]:
+    reg: Dict[Tuple[str, str], Tuple[SourceModule, ast.FunctionDef]] = {}
+    for mod in modules:
+        for func, _sym, cls in A.walk_functions(mod.tree):
+            if cls is None and isinstance(func, ast.FunctionDef):
+                reg[(mod.dotted, func.name)] = (mod, func)
+    return reg
+
+
+class _SharedRegistry:
+    """One `build_jit_registry` pass feeding A1/A2/A4 (the analogue of
+    `lock_discipline._SharedWalk`): `all_checkers` hands the trio a
+    shared instance so a full analysis walks every module once for jit
+    discovery, not three times; a checker constructed on its own gets a
+    private one. Re-keyed by module-set identity."""
+
+    def __init__(self):
+        self._key = None
+        self._registry = None
+
+    def get(self, modules: Sequence[SourceModule]):
+        key = tuple(id(m) for m in modules)
+        if key != self._key:
+            self._registry = A.build_jit_registry(modules)
+            self._key = key
+        return self._registry
+
+
+class _Base:
+    id = ""
+    severity = "error"
+
+    def __init__(self, shared: Optional[_SharedRegistry] = None):
+        self._shared = shared or _SharedRegistry()
+
+    def jit_registry(self, modules: Sequence[SourceModule]):
+        return self._shared.get(modules)
+
+    def run(self, modules: List[SourceModule]) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+# -- A1 ----------------------------------------------------------------------
+
+class HostSyncChecker(_Base):
+    id = "A1-host-sync"
+
+    def run(self, modules: List[SourceModule]) -> Iterable[Finding]:
+        registry = self.jit_registry(modules)
+        findings: List[Finding] = []
+        for mod in modules:
+            imports = A.import_table(mod.tree)
+
+            def jit_call(call: ast.Call) -> bool:
+                tgt = A.resolve_call_target(call, mod, imports)
+                return tgt is not None and tgt in registry
+
+            # Inside jitted functions: any sync on a traced value.
+            for site in registry.values():
+                if site.module is not mod:
+                    continue
+                findings += self._scan(
+                    mod, site.func, site.symbol, imports,
+                    seeds=site.traced_params, severity="error",
+                    context="inside @jax.jit", call_taints=jit_call,
+                    call_sanitizes=None, flag_converters_always=False)
+
+            # Per-tick hot paths: syncs on values produced by jit entry
+            # points (device arrays crossing back to the host).
+            for cls in A.collect_classes(mod):
+                for name in self._hot_methods(cls):
+                    meth = cls.methods[name]
+                    findings += self._scan(
+                        mod, meth, f"{cls.name}.{name}", imports,
+                        seeds=set(), severity="warning",
+                        context="in per-tick hot path",
+                        call_taints=jit_call,
+                        call_sanitizes=lambda c: _np_target(c, imports)
+                        in _HOST_CONVERTERS,
+                        flag_converters_always=False)
+        return findings
+
+    @staticmethod
+    def _hot_methods(cls: "A.ClassInfo") -> Set[str]:
+        """Timer callbacks plus their transitive same-class callees."""
+        seen: Set[str] = set()
+        frontier = [m for m in cls.timer_callbacks if m in cls.methods]
+        while frontier:
+            m = frontier.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            frontier += [c for c in A.self_calls(cls.methods[m])
+                         if c in cls.methods and c not in seen]
+        return seen
+
+    def _scan(self, mod: SourceModule, func: ast.FunctionDef, symbol: str,
+              imports: Dict[str, str], seeds: Set[str], severity: str,
+              context: str, call_taints, call_sanitizes,
+              flag_converters_always: bool) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def on_stmt(stmt: ast.stmt, _tainted: Set[str]) -> None:
+            for call in A.statement_calls(stmt):
+                tgt = _np_target(call, imports)
+                if tgt in _HOST_CONVERTERS and call.args and (
+                        flag_converters_always
+                        or walk.is_tainted(call.args[0])):
+                    findings.append(mod.finding(
+                        self.id, severity, call, symbol,
+                        f"{tgt.replace('numpy.', 'np.')} on a "
+                        f"device/traced value {context} forces a host "
+                        "sync"))
+                elif isinstance(call.func, ast.Name) \
+                        and call.func.id == "float" and call.args \
+                        and walk.is_tainted(call.args[0]):
+                    findings.append(mod.finding(
+                        self.id, severity, call, symbol,
+                        f"float() on a device/traced value {context} "
+                        "forces a host sync"))
+                elif isinstance(call.func, ast.Attribute) \
+                        and call.func.attr in _SYNC_METHODS:
+                    recv = call.func.value
+                    base = A.receiver_base(recv)
+                    # Name-rooted receivers go by the taint set; a
+                    # call-rooted chain (`jnp.sum(x).item()`, base is
+                    # None) is judged by the expression's own names —
+                    # the most common one-line form of the hazard.
+                    if (base is not None and base in walk.tainted) or \
+                            (base is None and walk.is_tainted(recv)):
+                        findings.append(mod.finding(
+                            self.id, severity, call, symbol,
+                            f".{call.func.attr}() on a device/traced "
+                            f"value {context} forces a host sync"))
+
+        walk = A.TaintWalk(tainted=set(seeds), call_taints=call_taints,
+                           call_sanitizes=call_sanitizes, on_stmt=on_stmt)
+        walk.run(func.body)
+        return findings
+
+
+# -- A2 ----------------------------------------------------------------------
+
+_traced_test_names = A.traced_names
+
+
+class JitHygieneChecker(_Base):
+    id = "A2-jit-hygiene"
+
+    def run(self, modules: List[SourceModule]) -> Iterable[Finding]:
+        registry = self.jit_registry(modules)
+        findings: List[Finding] = []
+        for site in registry.values():
+            mod = site.module
+            nparams = len(site.params)
+            for i in site.static_argnums:
+                if not 0 <= i < nparams:
+                    findings.append(mod.finding(
+                        self.id, "error", site.decorator, site.symbol,
+                        f"static_argnums index {i} out of range for "
+                        f"{nparams} parameters"))
+            findings += self._scan_body(site)
+        findings += self._scan_call_sites(modules, registry)
+        return findings
+
+    def _scan_body(self, site: "A.JitSite") -> List[Finding]:
+        mod, symbol = site.module, site.symbol
+        findings: List[Finding] = []
+
+        def on_stmt(stmt: ast.stmt, tainted: Set[str]) -> None:
+            if isinstance(stmt, (ast.If, ast.While)):
+                bad = _traced_test_names(stmt.test) & tainted
+                if bad:
+                    kind = "while" if isinstance(stmt, ast.While) else "if"
+                    findings.append(mod.finding(
+                        self.id, "error", stmt, symbol,
+                        f"Python `{kind}` on traced value(s) "
+                        f"{sorted(bad)} inside @jax.jit — use lax.cond/"
+                        "lax.while_loop or jnp.where"))
+            elif isinstance(stmt, ast.For) \
+                    and isinstance(stmt.iter, ast.Call) \
+                    and isinstance(stmt.iter.func, ast.Name) \
+                    and stmt.iter.func.id == "range":
+                bad = set()
+                for arg in stmt.iter.args:
+                    bad |= _traced_test_names(arg) & tainted
+                if bad:
+                    findings.append(mod.finding(
+                        self.id, "error", stmt, symbol,
+                        f"Python `for` over range of traced value(s) "
+                        f"{sorted(bad)} inside @jax.jit — concretization "
+                        "error or per-shape unroll"))
+
+        walk = A.TaintWalk(tainted=set(site.traced_params),
+                           on_stmt=on_stmt)
+        walk.run(site.func.body)
+        return findings
+
+    def _scan_call_sites(self, modules: Sequence[SourceModule],
+                         registry) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in modules:
+            imports = A.import_table(mod.tree)
+            for func, symbol, _cls in A.walk_functions(mod.tree):
+                for call in [n for n in ast.walk(func)
+                             if isinstance(n, ast.Call)]:
+                    tgt = A.resolve_call_target(call, mod, imports)
+                    site = registry.get(tgt) if tgt else None
+                    if site is None:
+                        continue
+                    for i in site.static_argnums:
+                        if i < len(call.args) and isinstance(
+                                call.args[i],
+                                (ast.List, ast.Dict, ast.Set)):
+                            findings.append(mod.finding(
+                                self.id, "error", call.args[i], symbol,
+                                f"unhashable literal passed in static "
+                                f"position {i} of jitted "
+                                f"`{site.func.name}` — TypeError at "
+                                "call time (or a recompile per value)"))
+        return findings
+
+
+# -- A3 ----------------------------------------------------------------------
+
+#: path segments marking modules whose arrays feed the device path.
+_TPU_PATH_SEGMENTS = {"ops", "models", "parallel", "native", "bridge",
+                      "sim"}
+#: numpy constructors whose dtype defaults to float64 over float data.
+_F64_DEFAULT_CTORS = {"numpy.array", "numpy.asarray", "numpy.full"}
+
+
+def _has_float_literal(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Constant) and isinstance(n.value, float)
+               for n in ast.walk(node))
+
+
+class DtypeDriftChecker(_Base):
+    id = "A3-dtype-drift"
+
+    def run(self, modules: List[SourceModule]) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for mod in modules:
+            if not _TPU_PATH_SEGMENTS & set(mod.path.split("/")[:-1]):
+                continue
+            imports = A.import_table(mod.tree)
+            sym_of = {}
+            for func, symbol, _cls in A.walk_functions(mod.tree):
+                for n in ast.walk(func):
+                    sym_of.setdefault(id(n), symbol)
+            for node in ast.walk(mod.tree):
+                symbol = sym_of.get(id(node), "")
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == "float64":
+                    tgt = A.resolve(node, imports) or ""
+                    if tgt in ("numpy.float64", "jax.numpy.float64"):
+                        findings.append(mod.finding(
+                            self.id, "warning", node, symbol,
+                            "explicit float64 in a TPU-path module — "
+                            "x64-disabled JAX downcasts per transfer; "
+                            "use float32 (or baseline a deliberate "
+                            "host-side use)"))
+                elif isinstance(node, ast.Call):
+                    findings += self._check_call(mod, node, symbol,
+                                                 imports)
+        return findings
+
+    def _check_call(self, mod, call: ast.Call, symbol: str,
+                    imports) -> List[Finding]:
+        out = []
+        for kw in call.keywords:
+            if kw.arg == "dtype" and isinstance(kw.value, ast.Name) \
+                    and kw.value.id == "float":
+                out.append(mod.finding(
+                    self.id, "warning", kw.value, symbol,
+                    "dtype=float is float64 — name the width "
+                    "(np.float32) in TPU-path code"))
+        tgt = A.resolve(call.func, imports) or ""
+        if tgt in _F64_DEFAULT_CTORS and call.args \
+                and isinstance(call.args[0], (ast.List, ast.Tuple)) \
+                and _has_float_literal(call.args[0]) \
+                and len(call.args) < 2 \
+                and not any(kw.arg == "dtype" for kw in call.keywords):
+            out.append(mod.finding(
+                self.id, "warning", call, symbol,
+                f"{tgt.replace('numpy.', 'np.')} over float literals "
+                "without dtype defaults to float64 in a TPU-path "
+                "module"))
+        return out
+
+
+# -- A4 ----------------------------------------------------------------------
+
+_IMPURE_PREFIXES = ("time.", "random.", "numpy.random.")
+
+
+class ImpureJitChecker(_Base):
+    id = "A4-impure-jit"
+
+    def run(self, modules: List[SourceModule]) -> Iterable[Finding]:
+        registry = self.jit_registry(modules)
+        functions = _function_registry(modules)
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, str]] = set()
+        # BFS from each jit site through package-local callees: trace
+        # time runs the whole Python call tree, so impurity anywhere
+        # beneath the jit boundary freezes into the compiled program.
+        frontier: List[Tuple[SourceModule, ast.FunctionDef, str, int]] = [
+            (s.module, s.func, s.symbol, 0) for s in registry.values()]
+        while frontier:
+            mod, func, symbol, depth = frontier.pop()
+            key = (mod.dotted, symbol)
+            if key in seen:
+                continue
+            seen.add(key)
+            imports = A.import_table(mod.tree)
+            findings += self._scan(mod, func, symbol, imports)
+            if depth >= 2:
+                continue
+            for call in [n for n in ast.walk(func)
+                         if isinstance(n, ast.Call)]:
+                tgt = A.resolve_call_target(call, mod, imports)
+                if tgt and tgt in functions and tgt not in registry:
+                    cmod, cfunc = functions[tgt]
+                    frontier.append((cmod, cfunc, cfunc.name, depth + 1))
+        return findings
+
+    def _scan(self, mod, func, symbol, imports) -> List[Finding]:
+        out = []
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                tgt = A.resolve(node.func, imports) or ""
+                if tgt.startswith(_IMPURE_PREFIXES):
+                    out.append(mod.finding(
+                        self.id, "error", node, symbol,
+                        f"`{tgt}` under jit runs ONCE at trace time and "
+                        "is frozen into the compiled program"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if A._self_attr(t) is not None:
+                        out.append(mod.finding(
+                            self.id, "error", node, symbol,
+                            "mutation of `self` under jit happens at "
+                            "trace time only — the compiled program "
+                            "never repeats it"))
+        return out

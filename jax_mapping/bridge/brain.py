@@ -125,10 +125,15 @@ class ThymioBrain(Node):
         self.goal_reached_dist_m = 0.15
         self.create_subscription("/goal_pose",
                                  functools.partial(self._goal_cb, 0))
-        for i in range(n_robots):
-            self.create_subscription(
-                f"{robot_ns(i, n_robots)}goal_pose",
-                functools.partial(self._goal_cb, i))
+        if n_robots > 1:
+            # Single-robot stacks skip this: robot_ns(0, 1) is '', so
+            # the loop would subscribe a bare 'goal_pose' topic that
+            # differs from the canonical '/goal_pose' every publisher
+            # uses — a dead subscription that never fires.
+            for i in range(n_robots):
+                self.create_subscription(
+                    f"{robot_ns(i, n_robots)}goal_pose",
+                    functools.partial(self._goal_cb, i))
         # Planner waypoint (bridge/planner.py): while fresh, reachable,
         # and computed FOR the current goal, the brain steers at this
         # instead of the raw goal — map-aware navigation around walls.
@@ -175,24 +180,16 @@ class ThymioBrain(Node):
     def _goal_cb(self, i: int, msg) -> None:
         """Any pose-shaped message with .x/.y (the adapter's Pose2D)."""
         x, y = float(msg.x), float(msg.y)
-        if not (np.isfinite(x) and np.isfinite(y)):
-            # The single goal ingress rejects non-finite coordinates: a
-            # NaN goal can never be reached or cleared and would feed
-            # NaN through brain_tick into that robot's wheel targets
-            # until restart.
-            self._log(f"ignoring non-finite goal for robot {i}: "
-                      f"({x}, {y})")
-            return
-        g = self.cfg.grid
-        ox, oy = g.origin_m
-        span = g.extent_m
-        if not (ox <= x < ox + span and oy <= y < oy + span):
-            # Same guard as the HTTP endpoint, at the SHARED ingress:
-            # goals from any publisher (RViz, adapter, foreign DDS)
-            # outside the map would clip to a border cell and drive the
-            # robot toward a place that does not exist, never clearing.
-            self._log(f"ignoring out-of-map goal for robot {i}: "
-                      f"({x:.2f}, {y:.2f})")
+        if not self.cfg.grid.contains_m(x, y):
+            # The single goal ingress rejects non-finite and off-map
+            # coordinates (GridConfig.contains_m — the same predicate
+            # the planner and HTTP ingresses gate on): a NaN goal can
+            # never be reached or cleared and would feed NaN through
+            # brain_tick into that robot's wheel targets until restart;
+            # a goal outside the map would clip to a border cell and
+            # drive the robot toward a place that does not exist.
+            self._log(f"ignoring non-finite or out-of-map goal for "
+                      f"robot {i}: ({x}, {y})")
             return
         with self._state_lock:
             self._nav_goals[i] = (x, y)

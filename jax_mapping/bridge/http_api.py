@@ -385,13 +385,14 @@ class MapApiServer:
         if self.mapper is not None:
             # An out-of-grid goal would clip to the border cell and plan
             # "reachable" toward a place that does not exist; refuse
-            # with the valid extent so the caller can correct. Upper
-            # bound EXCLUSIVE: x == ox+span maps to cell size_cells,
-            # which only exists by clipping.
+            # with the valid extent so the caller can correct
+            # (GridConfig.contains_m — the shared goal-ingress
+            # predicate; x/y are already finite here, so a False means
+            # out of extent).
             g = self.mapper.cfg.grid
-            ox, oy = g.origin_m
-            span = g.extent_m
-            if not (ox <= x < ox + span and oy <= y < oy + span):
+            if not g.contains_m(x, y):
+                ox, oy = g.origin_m
+                span = g.extent_m
                 return 400, "application/json", json.dumps(
                     {"error": f"goal outside the map extent "
                               f"[{ox}, {ox + span}) x [{oy}, {oy + span})"}

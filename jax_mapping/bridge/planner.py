@@ -99,7 +99,17 @@ class PlannerNode(Node):
         self.create_timer(cfg.planner.period_s, self.tick)
 
     def _goal_cb(self, msg) -> None:
-        self._goal = (float(msg.x), float(msg.y))
+        # Same ingress guard as ThymioBrain._goal_cb and the HTTP
+        # route (GridConfig.contains_m): in standalone/live mode this
+        # subscription is the ONLY goal ingress, and a NaN or
+        # out-of-map goal would clip to a border cell and publish a
+        # plan toward a place that does not exist, replanning forever.
+        x, y = float(msg.x), float(msg.y)
+        if not self.cfg.grid.contains_m(x, y):
+            print(f"[planner] ignoring non-finite or out-of-map goal "
+                  f"({x}, {y})", flush=True)
+            return
+        self._goal = (x, y)
 
     def _frontiers_cb(self, msg) -> None:
         self._frontiers = (msg, self._n_ticks)

@@ -64,6 +64,19 @@ class GridConfig:
     def max_range_cells(self) -> float:
         return self.max_range_m / self.resolution_m
 
+    def contains_m(self, x: float, y: float) -> bool:
+        """True when world point (x, y) lies on the grid: finite and
+        inside the half-open extent [origin, origin + extent) on both
+        axes. Upper bound EXCLUSIVE: x == origin + extent maps to cell
+        `size_cells`, which only exists by clipping. THE goal-ingress
+        predicate — brain, planner, and HTTP route all gate on this, so
+        extent semantics can never diverge between ingresses."""
+        if not (math.isfinite(x) and math.isfinite(y)):
+            return False
+        ox, oy = self.origin_m
+        span = self.extent_m
+        return ox <= x < ox + span and oy <= y < oy + span
+
 
 @_frozen
 class ScanConfig:

@@ -109,12 +109,18 @@ def load_map(yaml_path: str) -> Tuple[np.ndarray, float,
         if magic != b"P5":
             raise ValueError(f"unsupported PGM magic {magic!r} "
                              "(binary P5 only)")
-        dims = f.readline().split()
-        while dims and dims[0].startswith(b"#"):     # comment lines
-            dims = f.readline().split()
+        def _header_line():
+            # PNM allows comment lines anywhere in the header — between
+            # the magic and dims AND between dims and maxval.
+            tokens = f.readline().split()
+            while tokens and tokens[0].startswith(b"#"):
+                tokens = f.readline().split()
+            return tokens
+
+        dims = _header_line()
         try:
             w, h = int(dims[0]), int(dims[1])
-            maxval = int(f.readline().strip())
+            maxval = int(_header_line()[0])
             px = np.frombuffer(f.read(w * h), np.uint8).reshape(h, w)
         except (IndexError, ValueError) as e:
             # Truncated/malformed header or short pixel payload — the

@@ -51,8 +51,10 @@ def simulate_scan(scan_cfg: ScanConfig, world: Array, world_res_m: float,
     any_hit = hit.any(axis=1)
     first = jnp.argmax(hit, axis=1)                          # (B,)
     r = jnp.where(any_hit, rs[first], 0.0)
-    if noise_key is not None and noise_std_m > 0.0:
-        r = jnp.where(any_hit,
+    if noise_key is not None:
+        # noise_std_m is TRACED (not in static_argnums): comparing it in
+        # Python would concretize the tracer, so gate inside the where.
+        r = jnp.where(any_hit & (noise_std_m > 0.0),
                       r + noise_std_m * jax.random.normal(noise_key, r.shape),
                       r)
     # Padded tail beams report nothing.

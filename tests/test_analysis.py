@@ -1,0 +1,609 @@
+"""Fixture tests for the static-analysis checkers (`jax_mapping.analysis`).
+
+Each checker (A1-A4, B1-B3) gets at least one known-bad snippet it must
+flag and one known-clean snippet it must stay silent on — the contract
+ISSUE 1 gates on. Snippets are analyzed in-memory via
+`SourceModule.from_source`, so these tests never touch the real package
+(that is `test_analysis_selfcheck.py`'s job) and stay immune to
+unrelated repo edits.
+"""
+
+import json
+import textwrap
+import threading
+
+from jax_mapping.analysis import jax_hazards, lock_discipline
+from jax_mapping.analysis.core import (
+    Baseline, Finding, SourceModule, analyze_modules,
+)
+from jax_mapping.analysis.lockwatch import LockWatch
+
+
+def run_checker(checker, src, path="jax_mapping/ops/snippet.py"):
+    mod = SourceModule.from_source(textwrap.dedent(src), path=path)
+    return list(checker.run([mod]))
+
+
+def ids(findings):
+    return [f.checker for f in findings]
+
+
+# ---------------------------------------------------------------- A1
+
+def test_a1_flags_np_asarray_on_traced_value_inside_jit():
+    findings = run_checker(jax_hazards.HostSyncChecker(), """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def fuse(scan):
+            host = np.asarray(scan)
+            return jnp.sum(host)
+        """)
+    assert ids(findings) == ["A1-host-sync"]
+    assert findings[0].severity == "error"
+    assert findings[0].symbol == "fuse"
+
+
+def test_a1_flags_item_and_float_on_traced_values():
+    findings = run_checker(jax_hazards.HostSyncChecker(), """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def score(x):
+            s = jnp.sum(x)
+            return s.item()
+
+        @jax.jit
+        def scale(x):
+            return float(x) * 2.0
+        """)
+    assert ids(findings) == ["A1-host-sync", "A1-host-sync"]
+    assert {f.symbol for f in findings} == {"score", "scale"}
+
+
+def test_a1_flags_sync_chained_on_call_result():
+    """`jnp.sum(x).item()` — the most common one-line form: the traced
+    result never gets a name, so the receiver chain is call-rooted and
+    must be judged by the expression itself."""
+    findings = run_checker(jax_hazards.HostSyncChecker(), """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def total(x):
+            return jnp.sum(x).item()
+
+        @jax.jit
+        def as_host(x):
+            return float(jnp.max(x))
+        """)
+    assert ids(findings) == ["A1-host-sync", "A1-host-sync"]
+    assert {f.symbol for f in findings} == {"total", "as_host"}
+
+
+def test_a1_silent_on_pure_jit_and_host_side_numpy():
+    findings = run_checker(jax_hazards.HostSyncChecker(), """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def fuse(scan):
+            return jnp.sum(scan * 2.0)
+
+        def host_prep(raw_list):
+            # host value, never traced: converting it is fine anywhere
+            return np.asarray(raw_list)
+        """)
+    assert findings == []
+
+
+def test_a1_flags_sync_on_jit_result_in_timer_hot_path():
+    src = """
+        import functools
+        import jax
+        import numpy as np
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def step(state, n):
+            return state + n
+
+        class MapperNode:
+            def __init__(self, cfg):
+                self.state = None
+                self.create_timer(0.1, self.tick)
+
+            def tick(self):
+                out = step(self.state, 3)
+                return float(out)
+        """
+    findings = run_checker(jax_hazards.HostSyncChecker(), src,
+                           path="jax_mapping/bridge/snippet.py")
+    assert ids(findings) == ["A1-host-sync"]
+    assert findings[0].severity == "warning"
+    assert findings[0].symbol == "MapperNode.tick"
+
+
+def test_a1_silent_in_hot_path_without_device_values():
+    src = """
+        import numpy as np
+
+        class StatusNode:
+            def __init__(self, cfg):
+                self.rows = []
+                self.create_timer(1.0, self.tick)
+
+            def tick(self):
+                # plain host data: np.asarray here is not a device sync
+                return np.asarray(self.rows)
+        """
+    findings = run_checker(jax_hazards.HostSyncChecker(), src,
+                           path="jax_mapping/bridge/snippet.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------- A2
+
+def test_a2_flags_python_if_on_traced_value():
+    findings = run_checker(jax_hazards.JitHygieneChecker(), """
+        import jax
+
+        @jax.jit
+        def clip(x):
+            if x > 0:
+                return x
+            return -x
+        """)
+    assert ids(findings) == ["A2-jit-hygiene"]
+    assert "if" in findings[0].message
+
+
+def test_a2_flags_for_over_traced_range_and_bad_static_argnums():
+    findings = run_checker(jax_hazards.JitHygieneChecker(), """
+        import functools
+        import jax
+
+        @jax.jit
+        def unroll(x, n):
+            acc = x
+            for i in range(n):
+                acc = acc + i
+            return acc
+
+        @functools.partial(jax.jit, static_argnums=(5,))
+        def lonely(x):
+            return x
+        """)
+    assert sorted(ids(findings)) == ["A2-jit-hygiene", "A2-jit-hygiene"]
+    messages = " | ".join(f.message for f in findings)
+    assert "range" in messages and "out of range" in messages
+
+
+def test_a2_flags_unhashable_literal_in_static_position():
+    findings = run_checker(jax_hazards.JitHygieneChecker(), """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def reshape(x, shape):
+            return x.reshape(shape)
+
+        def caller(x):
+            return reshape(x, [4, 4])
+        """)
+    assert ids(findings) == ["A2-jit-hygiene"]
+    assert findings[0].symbol == "caller"
+
+
+def test_a2_silent_on_static_branch_and_hashable_static_args():
+    findings = run_checker(jax_hazards.JitHygieneChecker(), """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def fuse(x, n_windows):
+            if n_windows > 2:          # static: plain Python int
+                x = x * 2.0
+            for _ in range(n_windows):  # static range: fixed unroll
+                x = x + 1.0
+            return jnp.where(x > 0, x, -x)
+
+        def caller(x):
+            return fuse(x, 4)
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------- A3
+
+def test_a3_flags_float64_and_dtypeless_float_arrays_in_tpu_path():
+    findings = run_checker(jax_hazards.DtypeDriftChecker(), """
+        import numpy as np
+
+        def make_scale():
+            return np.float64(3.0)
+
+        def make_offsets():
+            return np.array([0.5, 1.5])
+
+        def make_field(n):
+            return np.full(n, 0.0, dtype=float)
+        """)
+    assert ids(findings) == ["A3-dtype-drift"] * 3
+    assert {f.symbol for f in findings} == \
+        {"make_scale", "make_offsets", "make_field"}
+
+
+def test_a3_silent_with_explicit_float32_or_outside_tpu_path():
+    clean = """
+        import numpy as np
+
+        def make_offsets():
+            return np.array([0.5, 1.5], np.float32)
+
+        def make_index():
+            return np.array([1, 2, 3])
+        """
+    assert run_checker(jax_hazards.DtypeDriftChecker(), clean) == []
+    # float64 is fine in modules that never feed the device path
+    host_only = """
+        import numpy as np
+
+        def exact_millimetres(r):
+            return np.float64(r) * 1000.0
+        """
+    assert run_checker(jax_hazards.DtypeDriftChecker(), host_only,
+                       path="jax_mapping/analysis/snippet.py") == []
+
+
+# ---------------------------------------------------------------- A4
+
+def test_a4_flags_time_call_and_self_mutation_under_jit():
+    findings = run_checker(jax_hazards.ImpureJitChecker(), """
+        import time
+        import jax
+
+        @jax.jit
+        def stamp(x):
+            return x * time.time()
+
+        class Model:
+            @jax.jit
+            def step(self, x):
+                self.cache = x
+                return x
+        """)
+    assert ids(findings) == ["A4-impure-jit"] * 2
+    messages = " | ".join(f.message for f in findings)
+    assert "trace time" in messages and "self" in messages
+
+
+def test_a4_flags_impurity_in_transitive_callee():
+    findings = run_checker(jax_hazards.ImpureJitChecker(), """
+        import random
+        import jax
+
+        def jitter(x):
+            return x + random.random()
+
+        @jax.jit
+        def step(x):
+            return jitter(x)
+        """)
+    assert ids(findings) == ["A4-impure-jit"]
+    assert findings[0].symbol == "jitter"
+
+
+def test_a4_silent_on_jax_random_and_host_side_time():
+    findings = run_checker(jax_hazards.ImpureJitChecker(), """
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def noisy(x, key):
+            return x + jax.random.normal(key, x.shape)
+
+        def wall_clock():
+            # never reached from a jit site
+            return time.time()
+        """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------- B1
+
+_B1_BAD = """
+    import threading
+
+    class Pipeline:
+        def __init__(self):
+            self._head = threading.Lock()
+            self._tail = threading.Lock()
+
+        def forward(self):
+            with self._head:
+                with self._tail:
+                    pass
+
+        def backward(self):
+            with self._tail:
+                with self._head:
+                    pass
+    """
+
+
+def test_b1_flags_lock_order_cycle():
+    findings = run_checker(lock_discipline.LockOrderChecker(), _B1_BAD,
+                           path="jax_mapping/bridge/snippet.py")
+    assert len(findings) == 2          # both edges of the cycle reported
+    assert set(ids(findings)) == {"B1-lock-order"}
+    assert all("Pipeline._head" in f.message and "Pipeline._tail"
+               in f.message for f in findings)
+
+
+def test_b1_sees_nesting_through_method_calls():
+    findings = run_checker(lock_discipline.LockOrderChecker(), """
+        import threading
+
+        class Pipeline:
+            def __init__(self):
+                self._head = threading.Lock()
+                self._tail = threading.Lock()
+
+            def _drain(self):
+                with self._tail:
+                    pass
+
+            def forward(self):
+                with self._head:
+                    self._drain()       # head -> tail, hidden in a call
+
+            def backward(self):
+                with self._tail:
+                    with self._head:
+                        pass
+        """, path="jax_mapping/bridge/snippet.py")
+    assert len(findings) == 2
+    assert set(ids(findings)) == {"B1-lock-order"}
+
+
+def test_b1_silent_on_consistent_order_and_condition_aliases():
+    findings = run_checker(lock_discipline.LockOrderChecker(), """
+        import threading
+
+        class Pipeline:
+            def __init__(self):
+                self._head = threading.Lock()
+                self._tail = threading.Lock()
+                # Condition over _head IS _head, not a third lock
+                self._ready = threading.Condition(self._head)
+
+            def forward(self):
+                with self._head:
+                    with self._tail:
+                        pass
+
+            def flush(self):
+                with self._ready:
+                    with self._tail:
+                        pass
+        """, path="jax_mapping/bridge/snippet.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------- B2
+
+def test_b2_flags_callback_and_publish_under_lock():
+    findings = run_checker(lock_discipline.CallbackUnderLockChecker(), """
+        import threading
+
+        class Topic:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._subs = []
+                self.pub = None
+
+            def deliver(self, msg):
+                with self._lock:
+                    for sub in self._subs:
+                        sub.callback(msg)
+
+            def forward(self, msg):
+                with self._lock:
+                    self.pub.publish(msg)
+        """, path="jax_mapping/bridge/snippet.py")
+    assert ids(findings) == ["B2-callback-lock"] * 2
+    assert all("Topic._lock" in f.message for f in findings)
+
+
+def test_b2_silent_when_snapshot_taken_then_lock_released():
+    findings = run_checker(lock_discipline.CallbackUnderLockChecker(), """
+        import threading
+
+        class Topic:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._subs = []
+
+            def deliver(self, msg):
+                with self._lock:
+                    subs = list(self._subs)
+                for sub in subs:
+                    sub.callback(msg)
+
+            def wake(self):
+                with self._lock:
+                    self._lock.release()   # lock protocol, not a callback
+                    self._lock.acquire()
+        """, path="jax_mapping/bridge/snippet.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------- B3
+
+def test_b3_flags_unguarded_write_to_lock_protected_state():
+    findings = run_checker(lock_discipline.UnguardedWriteChecker(), """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.value = None
+
+            def get(self):
+                with self._lock:
+                    return self.value
+
+            def set_fast(self, v):
+                self.value = v          # racing get()'s guarded read
+        """, path="jax_mapping/bridge/snippet.py")
+    assert ids(findings) == ["B3-unguarded-write"]
+    assert findings[0].symbol == "Cache.set_fast"
+    assert "self.value" in findings[0].message
+
+
+def test_b3_silent_when_writes_guarded_or_state_never_shared():
+    findings = run_checker(lock_discipline.UnguardedWriteChecker(), """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.value = None
+                self.n_sets = 0         # never accessed under the lock
+
+            def get(self):
+                with self._lock:
+                    return self.value
+
+            def set(self, v):
+                with self._lock:
+                    self.value = v
+                self.n_sets += 1
+        """, path="jax_mapping/bridge/snippet.py")
+    assert findings == []
+
+
+# ------------------------------------------------------- baseline plumbing
+
+def test_baseline_suppresses_and_reports_unused(tmp_path):
+    mod = SourceModule.from_source(textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def fuse(scan):
+            return np.asarray(scan)
+        """), path="jax_mapping/ops/snippet.py")
+    checkers = [jax_hazards.HostSyncChecker()]
+    raw = analyze_modules([mod], baseline=None, checkers=checkers)
+    assert len(raw.findings) == 1
+
+    # Accept the finding, add one stale suppression on top (same file,
+    # so the run has full context — a line that no longer exists).
+    path = str(tmp_path / "baseline.json")
+    Baseline.dump(raw.findings, path)
+    data = json.load(open(path))
+    data["suppressions"].append({
+        "checker": "A1-host-sync", "path": mod.path,
+        "symbol": "fuse", "code": "x = np.asarray(y_removed)"})
+    json.dump(data, open(path, "w"))
+
+    res = analyze_modules([mod], baseline=Baseline.load(path),
+                          checkers=checkers)
+    assert res.findings == []
+    assert len(res.baselined) == 1
+    assert len(res.unused_suppressions) == 1
+    assert res.unused_suppressions[0]["code"] == "x = np.asarray(y_removed)"
+
+
+def test_unused_reporting_needs_full_context(tmp_path):
+    """A path-subset run finds strictly less than the package-wide pass
+    (the A checkers build a cross-module jit registry), so it must not
+    call other files' — or even its own file's — suppressions stale."""
+    mod = SourceModule.from_source(textwrap.dedent("""
+        import numpy as np
+
+        def harmless():
+            return np.zeros(3, np.float32)
+        """), path="jax_mapping/ops/snippet.py")
+    base = Baseline([{
+        "checker": "A1-host-sync", "path": "jax_mapping/ops/other.py",
+        "symbol": "f", "code": "x = np.asarray(y)", "note": "boundary"}])
+    res = analyze_modules([mod], baseline=base,
+                          checkers=[jax_hazards.HostSyncChecker()])
+    assert res.findings == []
+    assert res.unused_suppressions == []
+
+
+def test_finding_key_survives_line_moves():
+    a = Finding("A1-host-sync", "error", "p.py", 10, "f", "m", "x = 1")
+    b = Finding("A1-host-sync", "error", "p.py", 99, "f", "m", "x = 1")
+    assert a.key == b.key
+
+
+# ------------------------------------------------------------- lockwatch
+
+class _Box:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.RLock()
+
+
+def test_lockwatch_records_edges_and_detects_cycles():
+    box = _Box()
+    watch = LockWatch()
+    assert watch.watch(box, "_a") == "_Box._a"
+    watch.watch(box, "_b")
+    with box._a:
+        with box._b:
+            pass
+    assert watch.cycle() is None
+    with box._b:
+        with box._a:
+            pass
+    watch.unwatch_all()
+    assert ("_Box._a", "_Box._b") in watch.edges()
+    assert ("_Box._b", "_Box._a") in watch.edges()
+    cycle = watch.cycle()
+    assert cycle is not None and set(cycle) >= {"_Box._a", "_Box._b"}
+
+
+def test_lockwatch_reentrant_rlock_is_not_a_self_edge():
+    box = _Box()
+    watch = LockWatch()
+    watch.watch(box, "_b")
+    with box._b:
+        with box._b:                   # RLock re-acquire on same thread
+            pass
+    watch.unwatch_all()
+    assert watch.edges() == set()
+    assert watch.cycle() is None
+
+
+def test_lockwatch_unwatch_restores_real_locks():
+    box = _Box()
+    watch = LockWatch()
+    watch.watch(box, "_a")
+    watch.unwatch_all()
+    assert isinstance(box._a, type(threading.Lock()))
+
+
+def test_lockwatch_check_against_static_reports_missed_edges():
+    box = _Box()
+    watch = LockWatch()
+    watch.watch(box, "_a")
+    watch.watch(box, "_b")
+    with box._b:
+        with box._a:
+            pass
+    watch.unwatch_all()
+    static = {("_Box._a", "_Box._b")}
+    assert watch.check_against_static(static) == {("_Box._b", "_Box._a")}
+    # edges touching locks the static graph never saw are ignored
+    assert watch.check_against_static({("Other.x", "Other.y")}) == set()

@@ -1,0 +1,268 @@
+"""Tier-1 self-check gate: the repo passes its own static analysis.
+
+The contract (ISSUE 1): `jax-mapping-lint jax_mapping/` over the
+committed baseline reports ZERO new findings, every suppression in the
+baseline still matches something (the ratchet only goes down), and the
+static lock graph is consistent with the lock order a live
+`launch_sim_stack` run actually exercises.
+"""
+
+import numpy as np
+import pytest
+
+from jax_mapping.analysis.core import (
+    Baseline, all_checkers, analyze_modules, default_baseline_path,
+    load_package_modules,
+)
+from jax_mapping.analysis.lock_discipline import LockGraph, build_lock_graph
+from jax_mapping.analysis.lockwatch import LockWatch
+
+
+@pytest.fixture(scope="module")
+def package_modules():
+    mods = load_package_modules()
+    assert len(mods) > 40, "package discovery looks broken"
+    return mods
+
+
+# ---------------------------------------------------------------- the gate
+
+def test_package_passes_static_analysis(package_modules):
+    """THE tier-1 gate: zero non-baselined findings over jax_mapping/."""
+    res = analyze_modules(package_modules,
+                          Baseline.load(default_baseline_path()))
+    assert not res.findings, (
+        "new static-analysis findings (fix them, or baseline a "
+        "deliberate site WITH a note in analysis/baseline.json):\n"
+        + "\n".join(f.format() for f in res.findings))
+
+
+def test_baseline_has_no_unused_suppressions(package_modules):
+    """The baseline ratchets DOWN: a suppression whose site was fixed
+    or moved must be deleted, not left to shadow a future regression."""
+    res = analyze_modules(package_modules,
+                          Baseline.load(default_baseline_path()))
+    assert not res.unused_suppressions, (
+        "stale baseline suppressions:\n"
+        + "\n".join(str(s) for s in res.unused_suppressions))
+
+
+def test_baseline_entries_carry_justifications():
+    """Every accepted finding documents WHY it is acceptable."""
+    base = Baseline.load(default_baseline_path())
+    missing = [s for s in base.suppressions if not s.get("note")]
+    assert not missing, f"baseline entries without a note: {missing}"
+
+
+def test_cli_runs_clean_with_committed_baseline(capsys):
+    from jax_mapping.analysis.cli import main
+    assert main([]) == 0                       # package mode, baseline
+    out = capsys.readouterr().out
+    assert "0 new finding(s)" in out
+
+
+def test_cli_no_baseline_mode_surfaces_accepted_sites(capsys):
+    """--no-baseline must re-expose the baselined findings (proves the
+    gate's cleanliness comes from the baseline, not a silent skip)."""
+    from jax_mapping.analysis.cli import main
+    assert main(["--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "A1-host-sync" in out and "B3-unguarded-write" in out
+
+
+def test_cli_rejects_unknown_checker_id():
+    from jax_mapping.analysis.cli import main
+    assert main(["--checker", "Z9-not-a-checker"]) == 2
+
+
+def test_cli_corrupt_baseline_is_usage_error_not_findings(tmp_path):
+    """Exit 2 (usage/parse), never 1 (findings) or a traceback, for a
+    broken baseline — CI consumers branch on that distinction. Same for
+    --write-baseline, which must refuse to overwrite what it cannot
+    merge."""
+    from jax_mapping.analysis.cli import main
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert main(["--baseline", str(bad)]) == 2
+    assert main(["--write-baseline", "--baseline", str(bad)]) == 2
+    assert bad.read_text() == "{not json"        # untouched
+    wrong = tmp_path / "v99.json"
+    wrong.write_text('{"version": 99, "suppressions": []}')
+    assert main(["--baseline", str(wrong)]) == 2
+
+
+def test_single_file_keys_match_committed_baseline():
+    """Subset invocations must produce the same baseline keys as the
+    full run: `jax-mapping-lint <pkg>/bridge/planner.py` anchors at the
+    package parent (not the file's own directory), nothing resurfaces
+    as a new finding, and — because a lone file lacks the cross-module
+    jit context the A checkers need — no staleness claims are made."""
+    import os
+
+    import jax_mapping
+    from jax_mapping.analysis.core import analyze_paths, load_paths
+
+    pkg = os.path.dirname(os.path.abspath(jax_mapping.__file__))
+    target = os.path.join(pkg, "bridge", "planner.py")
+    [mod] = load_paths([target])
+    assert mod.path == "jax_mapping/bridge/planner.py"
+    assert mod.dotted == "jax_mapping.bridge.planner"
+    res = analyze_paths([target], baseline_path=default_baseline_path())
+    assert not res.findings, "\n".join(f.format() for f in res.findings)
+    assert not res.unused_suppressions, \
+        "single-file run flagged suppressions as stale without context"
+
+
+def test_baseline_paths_all_exist(package_modules):
+    """Deleted-but-still-baselined files bypass the unused-suppression
+    report (their path is never analyzed, so staleness reporting is
+    disabled for safety) — catch them here instead."""
+    analyzed = {m.path for m in package_modules}
+    base = Baseline.load(default_baseline_path())
+    missing = {s["path"] for s in base.suppressions} - analyzed
+    assert not missing, f"baseline references deleted files: {missing}"
+
+
+def test_scoped_checker_run_does_not_report_foreign_unused(
+        package_modules):
+    """`--checker B1-lock-order` runs nothing that could match the
+    A-family suppressions — they are out of scope, not stale."""
+    from jax_mapping.analysis.lock_discipline import LockOrderChecker
+
+    res = analyze_modules(package_modules,
+                          Baseline.load(default_baseline_path()),
+                          checkers=[LockOrderChecker()])
+    assert res.findings == []
+    assert res.unused_suppressions == []
+
+
+def test_write_baseline_merges_notes_and_out_of_scope_entries(tmp_path):
+    """A scoped --write-baseline must not clobber: entries the run
+    could not re-observe survive verbatim, and still-live entries keep
+    their hand-written notes."""
+    import json
+    import shutil
+
+    from jax_mapping.analysis.cli import main
+
+    tmp = str(tmp_path / "baseline.json")
+    shutil.copy(default_baseline_path(), tmp)
+    before = json.load(open(default_baseline_path()))["suppressions"]
+    assert main(["--write-baseline", "--baseline", tmp,
+                 "--checker", "B1-lock-order"]) == 0
+    after = json.load(open(tmp))["suppressions"]
+    key = lambda s: (s["checker"], s["path"], s.get("symbol", ""),
+                     s.get("code", ""))                          # noqa: E731
+    assert {key(s) for s in after} >= {key(s) for s in before}
+    notes = {key(s): s.get("note") for s in after}
+    assert all(notes[key(s)] == s["note"] for s in before)
+
+    # Unscoped rewrite over the package: same sites, notes intact.
+    assert main(["--write-baseline", "--baseline", tmp]) == 0
+    rewritten = json.load(open(tmp))["suppressions"]
+    assert {key(s) for s in rewritten} == {key(s) for s in before}
+    assert all(s.get("note") for s in rewritten)
+
+
+def test_checker_ids_are_unique_and_complete():
+    ids = [c.id for c in all_checkers()]
+    assert len(ids) == len(set(ids))
+    assert set(ids) == {"A1-host-sync", "A2-jit-hygiene", "A3-dtype-drift",
+                        "A4-impure-jit", "B1-lock-order",
+                        "B2-callback-lock", "B3-unguarded-write"}
+
+
+# ---------------------------------------- static graph vs live stack
+
+def test_static_lock_graph_is_acyclic(package_modules):
+    """Today every bridge class owns exactly ONE lock, so the static
+    intra-class graph is edge-free (cross-object nesting like
+    bus._lock -> Subscription._lock is lockwatch's territory). What
+    must hold: lock DISCOVERY sees the bridge locks, and whatever
+    edges exist never form a cycle."""
+    from jax_mapping.analysis import astutil
+
+    found = {f"{cls.name}.{attr}"
+             for mod in package_modules
+             for cls in astutil.collect_classes(mod)
+             for attr in cls.lock_attrs}
+    assert {"Bus._lock", "Node._cb_lock", "ThymioBrain._state_lock",
+            "MapperNode._state_lock", "Subscription._lock"} <= found, found
+    assert build_lock_graph(package_modules).sccs() == []
+
+
+def test_lockwatch_validates_static_graph_on_live_stack(
+        tiny_cfg, package_modules):
+    """Drive the real stack with recording locks installed and check the
+    runtime acquisition order against the static B1 graph: no runtime
+    cycle, and no observed edge may ever be the REVERSE of a static
+    edge (that exact pair is a deadlock two threads away).
+
+    Per-node `_cb_lock`s are watched under instance-distinct names —
+    they are one `Node._cb_lock` site statically, but distinct runtime
+    locks, and folding them together would fake reentrancy."""
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    world = W.empty_arena(64, tiny_cfg.grid.resolution_m)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=2, http_port=None,
+                          seed=3)
+    watch = LockWatch()
+    try:
+        watch.watch(st.bus, "_lock")                     # "Bus._lock"
+        watch.watch(st.brain, "_state_lock")
+        watch.watch(st.mapper, "_state_lock")
+        for node in (st.sim, st.brain, st.mapper):
+            watch.watch(node, "_cb_lock",
+                        name=f"Node._cb_lock@{node.name}")
+        st.brain.start_exploring()
+        st.run_steps(12)
+    finally:
+        watch.unwatch_all()
+        st.shutdown()
+
+    observed = watch.edges()
+    assert observed, "no lock nesting observed — the watch is broken"
+    assert watch.cycle() is None
+
+    static = build_lock_graph(package_modules).edge_set()
+    for a, b in observed:
+        assert (b, a) not in static, (
+            f"runtime acquires {a} before {b}, but a static site orders "
+            f"{b} before {a} — lock-order violation")
+
+    # The union of both views must still be deadlock-free.
+    combined = LockGraph(edges={e: None for e in static | observed})
+    assert combined.sccs() == []
+
+    # Cross-object edges the static pass cannot see are expected (that
+    # is lockwatch's reason to exist) — but they must only ADD order,
+    # never contradict it, which the union check above proved.
+    watch.check_against_static(static)
+
+
+def test_lockwatch_poses_match_unwatched_run(tiny_cfg):
+    """Watching locks must not perturb the stack's behavior: the same
+    seeded run with and without recording proxies lands on identical
+    robot poses."""
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    def run(watched: bool):
+        world = W.empty_arena(64, tiny_cfg.grid.resolution_m)
+        st = launch_sim_stack(tiny_cfg, world, n_robots=1,
+                              http_port=None, seed=7)
+        watch = LockWatch()
+        try:
+            if watched:
+                watch.watch(st.bus, "_lock")
+                watch.watch(st.brain, "_state_lock")
+            st.brain.start_exploring()
+            st.run_steps(8)
+            return np.array(st.brain.poses)
+        finally:
+            watch.unwatch_all()
+            st.shutdown()
+
+    np.testing.assert_array_equal(run(False), run(True))
