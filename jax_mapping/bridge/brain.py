@@ -43,6 +43,11 @@ from jax_mapping.bridge.tf import TfTree
 from jax_mapping.config import SlamConfig, sign_extend_16bit
 from jax_mapping.models.explorer import frontier_policy
 from jax_mapping.ops.odometry import rk2_step, wheel_velocities
+from jax_mapping.resilience.health import (
+    DRIVER_OFFLINE, DRIVER_OK, DRIVER_RECOVERING, FleetHealth,
+    acquire_bounded,
+)
+from jax_mapping.resilience.supervisor import Heartbeater
 
 
 def robot_ns(i: int, n_robots: int) -> str:
@@ -83,7 +88,8 @@ class ThymioBrain(Node):
     def __init__(self, cfg: SlamConfig, bus: Bus, driver,
                  tf: Optional[TfTree] = None, n_robots: int = 1,
                  connect_retries: int = 3, connect_timeout_s: float = 3.0,
-                 reconnect_period_s: float = 2.0):
+                 reconnect_period_s: float = 2.0,
+                 health: Optional[FleetHealth] = None):
         super().__init__("thymio_brain", bus, tf)
         self.cfg = cfg
         self.driver = driver
@@ -91,6 +97,10 @@ class ThymioBrain(Node):
         self.connect_retries = connect_retries
         self.connect_timeout_s = connect_timeout_s
         self.reconnect_period_s = reconnect_period_s
+        #: Shared degraded-mode registry (resilience/health.py): this
+        #: node FEEDS it (scan arrivals, tick clock, driver link) and
+        #: READS the coast mask. None = pre-resilience behavior.
+        self._health = health
 
         self._state_lock = threading.Lock()
         self.poses = np.zeros((n_robots, 3), np.float32)
@@ -156,6 +166,15 @@ class ThymioBrain(Node):
         self.create_subscription("/frontier_waypoints",
                                  self._frontier_wp_cb)
 
+        # Heartbeat for the Supervisor (beats EVERY update_loop call,
+        # link up or not — the node is alive even when the robot link
+        # is not).
+        self._heartbeater = Heartbeater(self)
+        # Safe-stop pending after a reconnect: the first post-reconnect
+        # tick zeroes the motors and shows LED red instead of running
+        # the policy, so stale pre-fault wheel targets never replay.
+        self._safe_stop_pending = False
+
         # Boot connect, offline mode on failure (pi variant semantics).
         self.link_up = connect_with_retries(
             driver, max_retries=connect_retries,
@@ -171,6 +190,10 @@ class ThymioBrain(Node):
     def _scan_cb(self, robot_idx: int, msg: LaserScan) -> None:
         with self._state_lock:
             self._latest_scans[robot_idx] = msg
+        if self._health is not None:
+            # Outside the state lock: FleetHealth is a leaf lock and
+            # must never nest inside a node lock (B1 discipline).
+            self._health.note_scan(robot_idx, self.n_ticks)
 
     def _cmd_vel_cb(self, msg: Twist) -> None:
         with self._state_lock:
@@ -339,9 +362,16 @@ class ThymioBrain(Node):
             except Exception:                       # noqa: BLE001
                 self._drop_link()
 
-    def status(self) -> dict:
-        """The pi variant's `/status` payload (`pi/src/.../main.py:332-341`)."""
-        with self._state_lock:
+    def status(self, lock_timeout_s: Optional[float] = None) -> dict:
+        """The pi variant's `/status` payload (`pi/src/.../main.py:332-341`).
+
+        `lock_timeout_s` bounds the state-lock wait (the HTTP plane
+        passes ResilienceConfig.http_lock_timeout_s); expiry raises
+        LockTimeout, which the API layer answers as 503 degraded instead
+        of hanging a worker thread behind a wedged tick."""
+        acquire_bounded(self._state_lock, lock_timeout_s,
+                        "thymio_brain state")
+        try:
             return {
                 "connected": self.link_up,
                 "exploring": self.is_exploring,
@@ -358,12 +388,16 @@ class ThymioBrain(Node):
                     (None if g is None else {"x": g[0], "y": g[1]})
                     for g in self._nav_goals],
             }
+        finally:
+            self._state_lock.release()
 
     # -- the 10 Hz loop ------------------------------------------------------
 
     def _drop_link(self) -> None:
         self.n_io_errors += 1
         self.link_up = False
+        if self._health is not None:
+            self._health.note_driver(DRIVER_OFFLINE)
         try:
             self.driver.disconnect()
         except Exception:                           # noqa: BLE001
@@ -387,19 +421,59 @@ class ThymioBrain(Node):
                 out[i] = r[idx]
         return out
 
+    def _beat(self) -> None:
+        """One heartbeat per update_loop call — the node is alive even
+        when the robot link is not (payload says which)."""
+        self._heartbeater.beat(
+            {"link_up": self.link_up, "ticks": self.n_ticks,
+             "io_errors": self.n_io_errors})
+
+    def _safe_stop_all(self) -> None:
+        """Zero every robot's motors + LED red: the post-reconnect (and
+        degraded-entry) posture. Raises on I/O error like any driver
+        write — callers handle via the usual drop-link path."""
+        for i in range(self.n_robots):
+            self.driver[i][MOTOR_LEFT_TARGET] = 0
+            self.driver[i][MOTOR_RIGHT_TARGET] = 0
+            self.driver[i][LEDS_TOP] = [32, 0, 0]       # red: degraded
+
     def update_loop(self) -> None:
         cfg = self.cfg
         now = time.monotonic()
+        if self._health is not None:
+            self._health.note_tick(self.n_ticks)
         if not self.link_up:
+            if self._health is not None:
+                self._health.note_driver(DRIVER_OFFLINE)
             # Throttled reconnect probe (`server/.../main.py:84-88`).
             if now - self._last_reconnect_probe < self.reconnect_period_s:
+                self._beat()
                 return
             self._last_reconnect_probe = now
             self.link_up = connect_with_retries(
                 self.driver, max_retries=1,
                 timeout_s=self.connect_timeout_s, log=self._log)
             if not self.link_up:
+                self._beat()
                 return
+            # Reconnected: next tick runs the safe-stop BEFORE any
+            # policy output reaches the motors.
+            self._safe_stop_pending = True
+
+        if self._safe_stop_pending:
+            # One recovery tick: motors zeroed, LED red — the stale
+            # targets a pre-fault tick wrote must not keep driving the
+            # robot, and no policy targets are computed from the stale
+            # sensor snapshot either (no duplicate motor commands).
+            try:
+                self._safe_stop_all()
+                self._safe_stop_pending = False
+                if self._health is not None:
+                    self._health.note_driver(DRIVER_RECOVERING)
+            except Exception:                   # noqa: BLE001
+                self._drop_link()
+            self._beat()
+            return
 
         try:
             R = self.n_robots
@@ -414,6 +488,16 @@ class ThymioBrain(Node):
                 poses = self.poses.copy()
                 exploring = np.full(R, self.is_exploring)
                 goals = list(self._nav_goals)
+            coast = np.zeros(R, bool)
+            if self._health is not None:
+                # Degraded mode: a robot whose lidar went silent COASTS —
+                # no commanded motion (exploring off ⇒ the policy zeros
+                # its targets), odometry keeps integrating so the pose
+                # estimate survives for the rejoin. DEAD robots coast
+                # too; the fleet has already reassigned their frontiers.
+                lidar_ok = self._health.lidar_ok_mask()
+                coast = ~lidar_ok
+                exploring = exploring & lidar_ok
             ranges = self._ranges_matrix()
             goals_xy = np.zeros((R, 2), np.float32)
             goal_valid = np.zeros(R, bool)
@@ -449,6 +533,14 @@ class ThymioBrain(Node):
                 targets_np[0] = manual
                 leds_np[0] = (32, 32, 32)   # white: manual drive (extension
                 #                             to the reference's LED states)
+            if coast.any():
+                # Orange = degraded (the reference's warn color): lidar
+                # silent, coasting. Outranks policy colors; manual drive
+                # white still wins (the operator IS the safety system).
+                coast_led = coast.copy()
+                if manual is not None:
+                    coast_led[0] = False
+                leds_np[coast_led] = (32, 16, 0)
 
             for i in range(R):
                 self.driver[i][MOTOR_LEFT_TARGET] = int(targets_np[i, 0])
@@ -459,9 +551,12 @@ class ThymioBrain(Node):
                 self.poses = new_poses
             self.publish_tf(new_poses, twists)
             self.n_ticks += 1
+            if self._health is not None:
+                self._health.note_driver(DRIVER_OK)
         except Exception:                           # noqa: BLE001
             # Reference catch-all: drop and re-probe (`main.py:198-200`).
             self._drop_link()
+        self._beat()
 
     def publish_tf(self, poses: np.ndarray, twists: np.ndarray) -> None:
         """TF odom->base_link + `/odom`, honest stamps

@@ -132,6 +132,42 @@ class Bus:
         self._subs: Dict[str, List[Subscription]] = {}
         self._latched: Dict[str, Any] = {}
         self._reorder_hold: Dict[Tuple[int, str], Any] = {}
+        #: Topics whose link is down (FaultPlan windows): publishes are
+        #: dropped entirely, Reliable included — a dead transport loses
+        #: everything, unlike the probabilistic Best-Effort weather.
+        self._partitioned: set = set()
+        self.n_partition_dropped = 0
+
+    # -- fault injection (resilience/faultplan.py boundaries) ---------------
+
+    def set_fault_injection(self, drop_prob: Optional[float] = None,
+                            reorder_prob: Optional[float] = None) -> None:
+        """Adjust the Best-Effort loss weather mid-run (FaultPlan
+        drop/reorder windows). None leaves a knob unchanged."""
+        with self._lock:
+            if drop_prob is not None:
+                self.drop_prob = drop_prob
+            if reorder_prob is not None:
+                self.reorder_prob = reorder_prob
+
+    def partition(self, *topics: str) -> None:
+        """Take topic links down — every publish on them vanishes until
+        `heal`. The scripted stand-in for a dead sensor transport or a
+        network partition between nodes."""
+        with self._lock:
+            self._partitioned.update(topics)
+
+    def heal(self, *topics: str) -> None:
+        """Restore partitioned topics (all of them when none named)."""
+        with self._lock:
+            if topics:
+                self._partitioned.difference_update(topics)
+            else:
+                self._partitioned.clear()
+
+    def partitioned_topics(self) -> List[str]:
+        with self._lock:
+            return sorted(self._partitioned)
 
     # -- graph construction -------------------------------------------------
 
@@ -166,6 +202,11 @@ class Bus:
         # snapshot, so a subscriber joining mid-publish cannot receive the
         # sample twice (once from the latch, once from the snapshot).
         with self._lock:
+            if topic in self._partitioned:
+                # Link down (FaultPlan): nothing latches, nothing
+                # delivers — a dead transport, not lossy weather.
+                self.n_partition_dropped += 1
+                return
             if pub_qos.durability is Durability.TRANSIENT_LOCAL:
                 self._latched[topic] = msg
             subs = list(self._subs.get(topic, ()))

@@ -87,6 +87,10 @@ class SimulatedThymioDriver:
         self._speeds_raw = np.zeros((n_robots, 2), np.uint16)
         self._prox = np.zeros((n_robots, 7), np.int32)
         self._leds = np.zeros((n_robots, 3), np.int32)
+        # Per-robot kill switch (resilience/faultplan.py "kill_robot"):
+        # a disabled robot's motor-target writes are forced to 0 — the
+        # firmware-watchdog behavior of a robot whose link died.
+        self._enabled = np.ones(n_robots, bool)
 
     # -- thymiodirect-shaped surface ---------------------------------------
 
@@ -134,6 +138,14 @@ class SimulatedThymioDriver:
             p = np.asarray(prox, np.int32)
             self._prox[:, :p.shape[1]] = p
 
+    def set_robot_enabled(self, node_id: int, enabled: bool) -> None:
+        """Kill / revive one robot (fault injection): while disabled its
+        wheel targets pin to 0 regardless of what the brain writes."""
+        with self._lock:
+            self._enabled[node_id] = enabled
+            if not enabled:
+                self._targets[node_id] = 0
+
     def targets(self) -> np.ndarray:
         with self._lock:
             return self._targets.copy()
@@ -174,9 +186,11 @@ class SimulatedThymioDriver:
         self._check_io()
         with self._lock:
             if name == MOTOR_LEFT_TARGET:
-                self._targets[node_id, 0] = int(value)
+                self._targets[node_id, 0] = \
+                    int(value) if self._enabled[node_id] else 0
             elif name == MOTOR_RIGHT_TARGET:
-                self._targets[node_id, 1] = int(value)
+                self._targets[node_id, 1] = \
+                    int(value) if self._enabled[node_id] else 0
             elif name == LEDS_TOP:
                 self._leds[node_id] = np.asarray(value, np.int32)
             else:

@@ -60,6 +60,7 @@ from jax_mapping.bridge import png as png_codec
 from jax_mapping.bridge.bus import Bus
 from jax_mapping.bridge.messages import FrontierArray, OccupancyGrid
 from jax_mapping.bridge.qos import qos_map
+from jax_mapping.resilience.health import LockTimeout
 
 
 class MapApiServer:
@@ -73,7 +74,9 @@ class MapApiServer:
                  port: int = 5000, png_cache_s: float = 1.0,
                  extra_status: Optional[Callable[[], dict]] = None,
                  mapper=None, checkpoint_dir: str = "checkpoints",
-                 voxel_mapper=None, planner=None):
+                 voxel_mapper=None, planner=None, health=None,
+                 supervisor=None, lock_timeout_s: Optional[float] = 2.0,
+                 socket_timeout_s: Optional[float] = 30.0):
         self.bus = bus
         self.brain = brain
         self.mapper = mapper
@@ -82,6 +85,14 @@ class MapApiServer:
         self.checkpoint_dir = checkpoint_dir
         self.png_cache_s = png_cache_s
         self.extra_status = extra_status
+        #: Degraded-mode plumbing (resilience/): FleetHealth and the
+        #: Supervisor ride along on /status and /metrics; lock_timeout_s
+        #: bounds every node-lock wait a handler makes (expiry -> 503
+        #: {"state": "degraded"} instead of a hung worker thread).
+        self.health = health
+        self.supervisor = supervisor
+        self.lock_timeout_s = lock_timeout_s
+        self.n_degraded_responses = 0
         self._lock = threading.Lock()
         self._latest_map: Optional[OccupancyGrid] = None
         self._latest_frontiers: Optional[FrontierArray] = None
@@ -101,11 +112,23 @@ class MapApiServer:
             def log_message(self, fmt, *args):    # silence per-request spam
                 pass
 
+            # Per-connection socket timeout (StreamRequestHandler
+            # honors the class attribute): a stalled client cannot pin
+            # a worker thread forever.
+            timeout = socket_timeout_s
+
             def _dispatch(self, method):
                 api.n_requests += 1
                 try:
                     status, ctype, body = api.handle(self.path,
                                                      method=method)
+                except LockTimeout as e:
+                    # Bounded-wait contract: a wedged node lock answers
+                    # 503 degraded, not a hung worker thread.
+                    api.n_degraded_responses += 1
+                    status, ctype, body = 503, "application/json", \
+                        json.dumps({"state": "degraded",
+                                    "error": str(e)}).encode()
                 except Exception as e:            # noqa: BLE001
                     status, ctype, body = 500, "application/json", json.dumps(
                         {"error": str(e)}).encode()
@@ -144,8 +167,34 @@ class MapApiServer:
 
     # -- request handling ---------------------------------------------------
 
+    def _dead_node_guard(self, route: str) -> Optional[Tuple[int, str, bytes]]:
+        """503 degraded for routes whose backing node the supervisor has
+        declared dead: /save against a dead mapper would checkpoint a
+        frozen (possibly mid-crash) snapshot, /load and /goal would
+        mutate state nobody is serving. Read-only routes keep answering
+        — the cached map is exactly what an operator debugging the
+        outage wants to see."""
+        if self.supervisor is None:
+            return None
+        # /stop is deliberately NOT guarded: the safe-stop escape hatch
+        # must work regardless of what the supervisor believes.
+        needs = {"/save": "jax_mapper", "/load": "jax_mapper",
+                 "/save-map": "jax_mapper", "/goal": "thymio_brain",
+                 "/goal/cancel": "thymio_brain", "/start": "thymio_brain"}
+        node = needs.get(route)
+        if node is not None and not self.supervisor.is_alive(node):
+            self.n_degraded_responses += 1
+            return 503, "application/json", json.dumps(
+                {"state": "degraded",
+                 "error": f"{node} is down (supervisor restart pending); "
+                          f"{route} unavailable"}).encode()
+        return None
+
     def handle(self, path: str, method: str = "GET") -> Tuple[int, str, bytes]:
         route = path.split("?")[0].rstrip("/") or "/"
+        dead = self._dead_node_guard(route)
+        if dead is not None:
+            return dead
         if route == "/start":
             if self.brain is not None:
                 self.brain.start_exploring()
@@ -157,7 +206,14 @@ class MapApiServer:
             return 200, "application/json", \
                 json.dumps({"status": "exploration stopped"}).encode()
         if route == "/status":
-            body = self.brain.status() if self.brain is not None else {}
+            body = (self.brain.status(lock_timeout_s=self.lock_timeout_s)
+                    if self.brain is not None else {})
+            if self.health is not None:
+                # The whole degraded-mode picture in one glance: driver
+                # link, per-robot OK/no_lidar/dead ladder, health clock.
+                body["health"] = self.health.snapshot()
+            if self.supervisor is not None:
+                body["supervisor"] = self.supervisor.status()
             if self.mapper is not None:
                 # Mapping-pipeline health alongside the brain's motion
                 # fields — from the attached nodes directly, so every
@@ -514,7 +570,7 @@ class MapApiServer:
             f"jax_mapping_png_cache_hits_total {self.n_png_cache_hits}",
         ]
         if self.brain is not None:
-            st = self.brain.status()
+            st = self.brain.status(lock_timeout_s=self.lock_timeout_s)
             lines += [
                 "# TYPE jax_mapping_brain_ticks_total counter",
                 f"jax_mapping_brain_ticks_total {st.get('ticks', 0)}",
@@ -524,6 +580,46 @@ class MapApiServer:
                 f"jax_mapping_brain_connected "
                 f"{int(bool(st.get('connected')))}",
             ]
+        if self.health is not None:
+            # Degraded-mode ladder as gauges: ok=0 no_lidar=1 dead=2 per
+            # robot, driver ok=0 offline=1 recovering=2 — thresholdable
+            # without string parsing.
+            snap = self.health.snapshot()
+            rank = {"ok": 0, "no_lidar": 1, "dead": 2,
+                    "offline": 1, "recovering": 2}
+            lines += ["# TYPE jax_mapping_health_robot_state gauge"]
+            lines += [
+                f'jax_mapping_health_robot_state{{robot="{i}"}} '
+                f"{rank.get(s, 0)}"
+                for i, s in enumerate(snap["robots"])]
+            lines += [
+                "# TYPE jax_mapping_health_driver_state gauge",
+                f"jax_mapping_health_driver_state "
+                f"{rank.get(snap['driver'], 0)}",
+                "# TYPE jax_mapping_health_transitions_total counter",
+                f"jax_mapping_health_transitions_total "
+                f"{snap['n_transitions']}",
+            ]
+        if self.supervisor is not None:
+            sup = self.supervisor.status()
+            lines += [
+                "# TYPE jax_mapping_supervisor_dead_nodes gauge",
+                f"jax_mapping_supervisor_dead_nodes {len(sup['dead'])}",
+                "# TYPE jax_mapping_supervisor_restarts_total counter",
+                f"jax_mapping_supervisor_restarts_total "
+                f"{sum(sup['restarts'].values())}",
+                "# TYPE jax_mapping_supervisor_checkpoints_total counter",
+                f"jax_mapping_supervisor_checkpoints_total "
+                f"{sup['checkpoints']}",
+            ]
+        lines += [
+            "# TYPE jax_mapping_http_degraded_responses_total counter",
+            f"jax_mapping_http_degraded_responses_total "
+            f"{self.n_degraded_responses}",
+            "# TYPE jax_mapping_bus_partition_dropped_total counter",
+            f"jax_mapping_bus_partition_dropped_total "
+            f"{self.bus.n_partition_dropped}",
+        ]
         # Process-wide registry (utils/profiling.py): event counters and
         # per-stage timings fed by the mapper/brain loops.
         from jax_mapping.utils import global_metrics

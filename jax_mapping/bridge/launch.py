@@ -13,7 +13,8 @@ shutdown, replacing ros2-launch orchestration (SURVEY.md §1 L5).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+import os
+from typing import Optional, Set
 
 import jax.numpy as jnp
 import numpy as np
@@ -28,6 +29,8 @@ from jax_mapping.bridge.node import Executor
 from jax_mapping.bridge.sim_node import SimNode
 from jax_mapping.bridge.tf import TfTree
 from jax_mapping.config import SlamConfig
+from jax_mapping.resilience.health import FleetHealth
+from jax_mapping.resilience.supervisor import Supervisor
 
 #: Laser mount height from the reference's static TF
 #: (`pi_hardware.launch.py:26-30`).
@@ -49,6 +52,14 @@ class Stack:
     executor: Executor
     voxel_mapper: Optional[object] = None    # VoxelMapperNode when depth_cam
     planner: Optional[object] = None         # PlannerNode when cfg.planner.enabled
+    health: Optional[FleetHealth] = None     # shared degraded-mode registry
+    supervisor: Optional[Supervisor] = None  # heartbeat watch + restarts
+    fault_plan: Optional[object] = None      # attached FaultPlan, if any
+    #: Auto-checkpoint file the supervisor saves to / resumes the mapper
+    #: from ("" = auto-checkpointing disabled; pass checkpoint_dir to
+    #: launch_sim_stack to enable).
+    auto_checkpoint_path: str = ""
+    _killed: Set[str] = dataclasses.field(default_factory=set)
     _steps_run: int = 0
 
     def run_steps(self, n: int) -> None:
@@ -56,19 +67,107 @@ class Stack:
         n sensor ticks (realtime=False stacks only). The planner keeps its
         real cadence RATIO (one plan per period_s of simulated control
         time), not wall time — deterministic stepping must replan exactly
-        as often as the realtime executor would."""
+        as often as the realtime executor would.
+
+        An attached FaultPlan fires before each step on the step index;
+        the supervisor ticks once per step AFTER the nodes, so a node
+        killed at step k misses its k-th beat and the dead-declaration
+        countdown starts the same step — deterministic chaos."""
         steps_per_plan = max(1, round(self.cfg.planner.period_s
                                       * self.cfg.robot.control_rate_hz))
         for _ in range(n):
+            if self.fault_plan is not None:
+                self.fault_plan.apply(self, self._steps_run)
             self.sim.step()
-            self.brain.update_loop()
-            self.mapper.tick()
+            if "thymio_brain" not in self._killed:
+                self.brain.update_loop()
+            if "jax_mapper" not in self._killed:
+                self.mapper.tick()
             if self.voxel_mapper is not None:
                 self.voxel_mapper.tick()
             self._steps_run += 1
             if self.planner is not None \
                     and self._steps_run % steps_per_plan == 0:
                 self.planner.tick()
+            if self.supervisor is not None:
+                self.supervisor.tick()
+
+    # -- resilience surface (supervisor / FaultPlan boundaries) -------------
+
+    def attach_fault_plan(self, plan) -> None:
+        """Arm a FaultPlan: `run_steps` applies it on the step clock."""
+        self.fault_plan = plan
+
+    def kill_node(self, name: str) -> None:
+        """Destroy a node mid-mission (FaultPlan `kill_node`): timers
+        cancelled, subscriptions closed, its deterministic tick skipped.
+        The supervisor notices the silent heartbeat and restarts it."""
+        node = {"thymio_brain": self.brain,
+                "jax_mapper": self.mapper}.get(name)
+        if node is None:
+            raise ValueError(f"kill_node: unknown node {name!r}")
+        self._killed.add(name)
+        node.destroy()
+
+    def save_auto_checkpoint(self) -> None:
+        """The supervisor's checkpoint cadence hook: snapshot the mapper
+        to `auto_checkpoint_path` (save_checkpoint rotates the previous
+        generation to the .prev slot — the corruption fallback)."""
+        from jax_mapping.io.checkpoint import save_checkpoint
+        os.makedirs(os.path.dirname(self.auto_checkpoint_path),
+                    exist_ok=True)
+        save_checkpoint(self.auto_checkpoint_path,
+                        self.mapper.snapshot_states(),
+                        config_json=self.cfg.to_json())
+
+    def restart_mapper(self) -> None:
+        """The supervisor's mapper restarter: rebuild the MapperNode and
+        resume it from the latest auto-checkpoint with pose re-anchoring.
+
+        The crash-mid-mission contract (SURVEY.md §5's gap): the map
+        resumes from the newest intact checkpoint generation
+        (`load_checkpoint_with_fallback` degrades to the rotated
+        last-good file when the newest is corrupt), and each robot's
+        chain re-anchors at the BRAIN's live pose — odometry kept
+        integrating while the mapper was down, so the checkpointed
+        endpoint poses are stale; fusing at them would smear the resumed
+        map. No checkpoint at all degrades to a blank map, still
+        anchored at the live poses."""
+        n = self.mapper.n_robots
+        old = self.mapper
+        old.destroy()
+        states = None
+        if self.auto_checkpoint_path:
+            from jax_mapping.io.checkpoint import (
+                CheckpointCorrupt, load_checkpoint_with_fallback)
+            from jax_mapping.models import slam as _S
+            template = [_S.init_state(self.cfg) for _ in range(n)]
+            try:
+                states, _cfg_json, _used = load_checkpoint_with_fallback(
+                    self.auto_checkpoint_path, template)
+            except (FileNotFoundError, CheckpointCorrupt):
+                states = None                # no intact generation: blank
+        new = MapperNode(self.cfg, self.bus, tf=self.tf, n_robots=n,
+                         health=self.health)
+        anchors = self.brain.poses.copy()
+        if states is not None:
+            new.restore_states(states, anchor_poses=anchors)
+        else:
+            for i, st in enumerate(new.states):
+                new.states[i] = st._replace(pose=jnp.asarray(anchors[i]))
+        # Re-wire every holder of the old node (the launch-time graph).
+        self.mapper = new
+        self.executor.nodes = [new if nd is old else nd
+                               for nd in self.executor.nodes]
+        if self.planner is not None:
+            self.planner.mapper = new
+            if getattr(self.planner, "voxel_mapper", None) is not None:
+                new.frontier_grid_provider = self.planner._planning_grid
+        if self.voxel_mapper is not None:
+            self.voxel_mapper.mapper = new
+        if self.api is not None:
+            self.api.mapper = new
+        self._killed.discard("jax_mapper")
 
     def shutdown(self) -> None:
         if self.api is not None:
@@ -81,13 +180,16 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
                      n_robots: int = 1, http_port: Optional[int] = None,
                      realtime: bool = False,
                      drop_prob: float = 0.0, seed: int = 0,
-                     depth_cam: bool = False) -> Stack:
+                     depth_cam: bool = False,
+                     checkpoint_dir: Optional[str] = None) -> Stack:
     """Boot the whole graph. realtime=False leaves timers idle so tests can
     step deterministically via `Stack.run_steps`; realtime=True spins the
     executor thread like the reference's rclpy daemon thread
     (`server/.../main.py:285-287`). http_port=0 picks a free port.
     depth_cam=True adds the 3D pipeline: per-robot simulated depth images
-    fused into a shared voxel grid (BASELINE configs[4])."""
+    fused into a shared voxel grid (BASELINE configs[4]).
+    checkpoint_dir arms the supervisor's auto-checkpoint cadence (and
+    therefore restart-from-checkpoint); None keeps the stack disk-free."""
     res = world_res_m if world_res_m is not None else cfg.grid.resolution_m
     bus = Bus(domain_id=cfg.domain_id, drop_prob=drop_prob, seed=seed)
     tf = TfTree()
@@ -101,12 +203,15 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     sim = SimNode(cfg, bus, driver, world, res, tf=tf,
                   rate_hz=cfg.robot.control_rate_hz, seed=seed,
                   realtime=realtime, depth_cam=depth_cam)
-    brain = ThymioBrain(cfg, bus, driver, tf=tf, n_robots=n_robots)
+    health = (FleetHealth(cfg.resilience, n_robots)
+              if cfg.resilience.enabled else None)
+    brain = ThymioBrain(cfg, bus, driver, tf=tf, n_robots=n_robots,
+                        health=health)
     # Start calibrated: the odom frame origin is the boot pose; expressing
     # boot poses in the map frame up front keeps multi-robot maps aligned
     # (the fleet model's convention, models/fleet.py init_fleet_state).
     brain.poses = sim.truth_poses().copy()
-    mapper = MapperNode(cfg, bus, tf=tf, n_robots=n_robots)
+    mapper = MapperNode(cfg, bus, tf=tf, n_robots=n_robots, health=health)
     for i, st in enumerate(mapper.states):
         mapper.states[i] = st._replace(pose=jnp.asarray(brain.poses[i]))
 
@@ -120,26 +225,53 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     if cfg.planner.enabled:
         from jax_mapping.bridge.planner import PlannerNode
         planner = PlannerNode(cfg, bus, mapper=mapper, brain=brain,
-                              voxel_mapper=voxel_mapper)
+                              voxel_mapper=voxel_mapper, health=health)
         if planner.voxel_mapper is not None:
             # ONE map for assignment and planning: the auction must not
             # assign frontiers whose corridors only the 3D overlay knows
             # are blocked (see mapper.publish_frontiers).
             mapper.frontier_grid_provider = planner._planning_grid
 
+    supervisor = None
+    if cfg.resilience.enabled:
+        # Supervisor ticks at the CONTROL rate, matching the 1:1
+        # supervisor-tick-per-step cadence of deterministic run_steps:
+        # missed-beat thresholds then mean "control periods" in both
+        # modes. A fixed fast tick would declare slow-platform nodes
+        # (low control_rate_hz) perpetually dead in realtime stacks.
+        supervisor = Supervisor(cfg.resilience, bus, seed=seed,
+                                tick_period_s=1.0
+                                / cfg.robot.control_rate_hz)
+
     api = None
     if http_port is not None:
         api = MapApiServer(bus, brain=brain, port=http_port,
                            mapper=mapper, voxel_mapper=voxel_mapper,
-                           planner=planner)
+                           planner=planner, health=health,
+                           supervisor=supervisor,
+                           lock_timeout_s=cfg.resilience.http_lock_timeout_s)
         api.serve_thread()
 
     nodes = [sim, brain, mapper] + \
         ([voxel_mapper] if voxel_mapper is not None else []) + \
-        ([planner] if planner is not None else [])
+        ([planner] if planner is not None else []) + \
+        ([supervisor] if supervisor is not None else [])
     executor = Executor(nodes)
+    stack = Stack(cfg=cfg, bus=bus, tf=tf, driver=driver, sim=sim,
+                  brain=brain, mapper=mapper, api=api, executor=executor,
+                  voxel_mapper=voxel_mapper, planner=planner,
+                  health=health, supervisor=supervisor)
+    if supervisor is not None:
+        # Registration needs the Stack (restarter + checkpointer close
+        # over it), so it happens after construction. The brain has no
+        # restarter — its process-local state (driver link, poses) can't
+        # be rebuilt from a checkpoint; death is declared and exported.
+        supervisor.register("thymio_brain")
+        supervisor.register("jax_mapper", stack.restart_mapper)
+        if checkpoint_dir is not None:
+            stack.auto_checkpoint_path = os.path.join(
+                checkpoint_dir, "auto_checkpoint.npz")
+            supervisor.attach_checkpointer(stack.save_auto_checkpoint)
     if realtime:
         executor.spin_thread()
-    return Stack(cfg=cfg, bus=bus, tf=tf, driver=driver, sim=sim,
-                 brain=brain, mapper=mapper, api=api, executor=executor,
-                 voxel_mapper=voxel_mapper, planner=planner)
+    return stack

@@ -43,6 +43,12 @@ class Ld06IngestNode(Node):
                                  band_m=band_m)
         self.pub = self.create_publisher(topic, qos_sensor_data)
         self.n_scans_published = 0
+        # Heartbeat for the Supervisor; the payload surfaces the
+        # transport's reconnect pressure (TcpTransport.stats: counters +
+        # current jittered backoff) so an operator sees a flapping lidar
+        # bridge on /status without shelling into the pi.
+        from jax_mapping.resilience.supervisor import Heartbeater
+        self._heartbeater = Heartbeater(self)
         if realtime:
             self.create_timer(poll_period_s, self.poll)
 
@@ -69,3 +75,8 @@ class Ld06IngestNode(Node):
                 ranges=np.asarray(ranges, np.float32),
                 intensities=np.asarray(intensities, np.float32)))
             self.n_scans_published += 1
+        payload = {"scans_published": self.n_scans_published}
+        stats = getattr(self.transport, "stats", None)
+        if callable(stats):
+            payload["transport"] = stats()
+        self._heartbeater.beat(payload)

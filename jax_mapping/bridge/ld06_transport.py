@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import errno
 import os
+import random
 import socket
 import time
 from typing import Optional
@@ -79,21 +80,38 @@ class TcpTransport:
     timeout every backoff window while the lidar bridge is down).
     Counters: `n_connects` counts every established connection;
     `n_reconnects` only those after a previous one existed (a healthy
-    single-connection session reads 0)."""
+    single-connection session reads 0).
+
+    Backoff carries SEEDED jitter: each scheduled retry waits
+    `backoff * (1 + jitter * rng())`. Without it, a fleet of clients
+    that all lost the same lidar bridge redial in lockstep and hammer
+    it the instant it returns (the thundering-herd reconnect the
+    resilience subsystem's Supervisor backoff also avoids); the seed
+    keeps chaos tests reproducible. `last_backoff_s` and the counters
+    feed the ingest node's heartbeat payload."""
 
     def __init__(self, host: str, port: int,
                  reconnect_backoff_s: float = 0.5,
-                 max_backoff_s: float = 5.0):
+                 max_backoff_s: float = 5.0,
+                 jitter: float = 0.25, seed: Optional[int] = None):
         self.host, self.port = host, port
         self._sock: Optional[socket.socket] = None
         self._pending: Optional[socket.socket] = None
         self._backoff = reconnect_backoff_s
         self._backoff0 = reconnect_backoff_s
         self._max_backoff = max_backoff_s
+        self._jitter = jitter
+        self._rng = random.Random(seed)
         self._next_attempt = 0.0
         self.n_connects = 0
         self.n_reconnects = 0
+        #: The jittered wait the most recent failure scheduled (0.0
+        #: while connected) — exported in heartbeats.
+        self.last_backoff_s = 0.0
         self._closed = False
+
+    def _jittered(self, base_s: float) -> float:
+        return base_s * (1.0 + self._jitter * self._rng.random())
 
     def _fail_attempt(self) -> None:
         if self._pending is not None:
@@ -102,7 +120,8 @@ class TcpTransport:
             except OSError:
                 pass
             self._pending = None
-        self._next_attempt = time.monotonic() + self._backoff
+        self.last_backoff_s = self._jittered(self._backoff)
+        self._next_attempt = time.monotonic() + self.last_backoff_s
         self._backoff = min(self._backoff * 2, self._max_backoff)
 
     def _established(self, s: socket.socket) -> None:
@@ -112,6 +131,15 @@ class TcpTransport:
         self._sock = s
         self._pending = None
         self._backoff = self._backoff0
+        self.last_backoff_s = 0.0
+
+    def stats(self) -> dict:
+        """Heartbeat-payload export (ld06_node): reconnect pressure and
+        the current backoff posture at a glance."""
+        return {"connected": self._sock is not None,
+                "n_connects": self.n_connects,
+                "n_reconnects": self.n_reconnects,
+                "backoff_s": round(self.last_backoff_s, 4)}
 
     def _connect_step(self) -> None:
         """Advance the non-blocking dial one step; never blocks."""
@@ -165,7 +193,8 @@ class TcpTransport:
                 pass
             if self._sock is s:
                 self._sock = None
-            self._next_attempt = time.monotonic() + self._backoff0
+            self.last_backoff_s = self._jittered(self._backoff0)
+            self._next_attempt = time.monotonic() + self.last_backoff_s
             return b""
         return data
 

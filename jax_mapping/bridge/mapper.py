@@ -53,7 +53,7 @@ class MapperNode(Node):
 
     def __init__(self, cfg: SlamConfig, bus: Bus,
                  tf: Optional[TfTree] = None, n_robots: int = 1,
-                 tick_period_s: Optional[float] = None):
+                 tick_period_s: Optional[float] = None, health=None):
         super().__init__("jax_mapper", bus, tf)
         import jax.numpy as jnp
 
@@ -112,8 +112,19 @@ class MapperNode(Node):
         self._prev_matched = [False] * n_robots
         self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
         self._prev_paired: List[Optional[Odometry]] = [None] * n_robots
+        #: Shared degraded-mode registry (resilience/health.py) — read
+        #: for the dead-robot frontier reassignment; None = pre-
+        #: resilience behavior.
+        self._health = health
+        #: Stamp of the newest scan accepted for fusion, per robot: a
+        #: scan OLDER than this arrived late (cross-tick reorder, a
+        #: healed partition flushing a stale queue) and is rejected —
+        #: fusing it would smear old evidence at a newer pose.
+        self._last_accepted_stamp = [-float("inf")] * n_robots
         self.n_scans_fused = 0
         self.n_scans_dropped_unpaired = 0
+        self.n_scans_rejected_stale = 0
+        self.n_windows_rejected_low_agreement = 0
         self.n_loops_closed = 0
         self.n_windows_fused = 0
         self.n_low_agreement_windows = 0
@@ -139,6 +150,10 @@ class MapperNode(Node):
         period = tick_period_s if tick_period_s is not None \
             else 1.0 / cfg.robot.control_rate_hz
         self.graph_pub = self.create_publisher("/graph")
+        # Heartbeat for the Supervisor (supervisor restarts THIS node
+        # from checkpoint when beats stop).
+        from jax_mapping.resilience.supervisor import Heartbeater
+        self._heartbeater = Heartbeater(self)
         self.create_timer(period, self.tick)
         self.create_timer(cfg.map_publish_period_s, self.publish_map)
         # Graph viz rides the slow map cadence: nodes move only on key
@@ -351,11 +366,28 @@ class MapperNode(Node):
             for i in range(self.n_robots):
                 for scan in sorted(self._scan_q[i],
                                    key=lambda s: s.header.stamp):
+                    if self.cfg.resilience.enabled and \
+                            scan.header.stamp < \
+                            self._last_accepted_stamp[i]:
+                        # Degraded-mode gate: a scan older than the
+                        # newest already-fused one arrived LATE (cross-
+                        # tick reorder / a healed partition flushing its
+                        # backlog) — fusing it would smear stale
+                        # evidence at the current pose chain.
+                        self.n_scans_rejected_stale += 1
+                        M.counters.inc("mapper.scans_rejected_stale")
+                        continue
                     od = self._pair_odom(i, scan.header.stamp)
                     if od is None:
                         self.n_scans_dropped_unpaired += 1
                         M.counters.inc("mapper.scans_unpaired")
                         continue
+                    # The watermark advances at INSTALL time
+                    # (_finish_step), not here: evidence later rejected
+                    # (low agreement) or dropped stale must not push it
+                    # forward, or good reordered scans arriving next
+                    # tick would be discarded against a watermark no
+                    # fused evidence ever set.
                     work[i].append((scan, od))
                 self._scan_q[i].clear()
 
@@ -374,6 +406,10 @@ class MapperNode(Node):
 
         if any(work):
             self.publish_frontiers()
+        self._heartbeater.beat(
+            {"scans_fused": self.n_scans_fused,
+             "rejected_stale": self.n_scans_rejected_stale,
+             "loops_closed": self.n_loops_closed})
 
     def _step_window(self, i: int, items: List) -> None:
         jnp = self._jnp
@@ -399,8 +435,13 @@ class MapperNode(Node):
             agreement = float(diag.window_agreement)
             if matched:
                 self._last_cov[i] = np.asarray(diag.cov, np.float32)
+        if self.cfg.resilience.enabled and \
+                agreement < self.cfg.resilience.window_agreement_reject:
+            self._reject_low_agreement(i)
+            return
         installed = self._finish_step(i, state, items[-1][1], W, matched,
-                                      closed, base_grid, base_gen)
+                                      closed, base_grid, base_gen,
+                                      items[-1][0].header.stamp)
         if not installed:
             return
         self.n_windows_fused += 1
@@ -430,16 +471,52 @@ class MapperNode(Node):
             # so the stage measures the device step, not the enqueue.
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
+            agreement = float(diag.window_agreement)
             if matched:
                 self._last_cov[i] = np.asarray(diag.cov, np.float32)
+        if self.cfg.resilience.enabled and \
+                agreement < self.cfg.resilience.window_agreement_reject:
+            # Same do-no-harm floor as _step_window: the single-scan
+            # cadence is the COMMON path, and a garbage scan must not
+            # overwrite known-good map there either (slam_step computes
+            # the pre-fusion agreement for key scans; skip/localization
+            # steps report a neutral 1.0 — they add no evidence).
+            # enabled=False restores pre-resilience fusion exactly (the
+            # baseline-comparison contract of the flag).
+            self._reject_low_agreement(i)
+            return
         self._finish_step(i, state, od, 1, matched, closed, base_grid,
-                          base_gen)
+                          base_gen, scan.header.stamp)
+
+    def _reject_low_agreement(self, i: int) -> None:
+        """Degraded-mode gate, shared by the window and single paths:
+        near-zero agreement means essentially ALL of the evidence landed
+        in known-free space — a garbage burst (glitching sensor, grossly
+        misanchored odometry) that must not overwrite known-good map.
+        Nothing installs; like a stale-step drop, the pairing chain
+        resets so the next step bootstraps cleanly."""
+        with self._state_lock:
+            self._prev_paired[i] = None
+            self._prev_matched[i] = False
+        # Counters outside the lock (single tick-thread writer, like
+        # every mapper counter). A rejected step is still a
+        # low-agreement OBSERVATION: that telemetry counter keeps its
+        # pre-rejection meaning (operators alert on it); rejection only
+        # changes what happens to the evidence.
+        self.n_windows_rejected_low_agreement += 1
+        M.counters.inc("mapper.windows_rejected_low_agreement")
+        self.n_low_agreement_windows += 1
+        M.counters.inc("mapper.low_agreement_windows")
 
     def _finish_step(self, i: int, state, od: Odometry, n_scans: int,
                      matched: bool, closed: bool, base_grid,
-                     base_gen: int) -> bool:
+                     base_gen: int, newest_stamp: float = -float("inf")
+                     ) -> bool:
         """Install the step's results; returns False when the step was
-        dropped as stale (callers gate their own telemetry on it)."""
+        dropped as stale (callers gate their own telemetry on it).
+        `newest_stamp` is the newest fused scan's stamp — it advances
+        the robot's stale-rejection watermark only when the step really
+        installs."""
         with self._state_lock:
             if self.shared_grid is not base_grid \
                     or self._state_gen[i] != base_gen:
@@ -467,6 +544,8 @@ class MapperNode(Node):
             # aliasing is free).
             self.shared_grid = state.grid
             self.states[i] = state
+            self._last_accepted_stamp[i] = max(
+                self._last_accepted_stamp[i], newest_stamp)
             if closed and self.n_robots > 1:
                 # The closure's in-step repair re-fused only robot
                 # i's ring; rebuild the shared map from EVERY robot's
@@ -687,6 +766,36 @@ class MapperNode(Node):
         self.map_pub.publish(msg)
         self.map_updates_pub.publish(msg)
 
+    def _reassign_dead(self, assignment: np.ndarray, targets: np.ndarray,
+                       poses: np.ndarray) -> np.ndarray:
+        """Strip DEAD robots from the frontier auction's output and hand
+        their orphaned targets to the nearest alive robot.
+
+        The device-side auction cannot see health (poses is a static
+        (R, ...) batch), so the fleet-reassignment contract lives here
+        on the host: a dead robot's assignment becomes -1 (the brain and
+        planner stop steering/planning for it), and any frontier ONLY it
+        was assigned to transfers to the closest living robot — mid-
+        mission robot loss shrinks the fleet, not the explored map."""
+        if self._health is None or len(assignment) == 0:
+            return assignment
+        alive = self._health.alive_mask()[:len(assignment)]
+        if alive.all() or not alive.any():
+            return assignment
+        assignment = assignment.copy()
+        live_idx = np.nonzero(alive)[0]
+        for d in np.nonzero(~alive)[0]:
+            a = int(assignment[d])
+            assignment[d] = -1
+            if 0 <= a < len(targets) \
+                    and not np.any(assignment[live_idx] == a):
+                # Orphaned frontier: nearest alive robot adopts it.
+                dists = np.hypot(poses[live_idx, 0] - targets[a, 0],
+                                 poses[live_idx, 1] - targets[a, 1])
+                assignment[live_idx[int(np.argmin(dists))]] = a
+                M.counters.inc("mapper.frontiers_reassigned")
+        return assignment
+
     def publish_frontiers(self) -> None:
         with self._state_lock:
             poses = np.stack([np.asarray(st.pose) for st in self.states])
@@ -706,12 +815,15 @@ class MapperNode(Node):
                 traceback.print_exc()
         fr = self._F.compute_frontiers(self.cfg.frontier, self.cfg.grid,
                                        lo, self._jnp.asarray(poses))
+        targets = np.asarray(fr.targets)
+        assignment = self._reassign_dead(np.asarray(fr.assignment),
+                                         targets, poses)
         hdr = Header.now("map")    # one stamp for the whole publish cycle
         self.frontiers_pub.publish(FrontierArray(
             header=hdr,
-            targets_xy=np.asarray(fr.targets),
+            targets_xy=targets,
             sizes=np.asarray(fr.sizes),
-            assignment=np.asarray(fr.assignment)))
+            assignment=assignment))
         self.pose_pub.publish([
             {"x": float(p[0]), "y": float(p[1]), "theta": float(p[2]),
              "stamp": hdr.stamp,

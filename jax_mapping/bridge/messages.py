@@ -285,6 +285,26 @@ class Waypoint:
 
 
 @dataclasses.dataclass
+class Heartbeat:
+    """`/heartbeat` payload: one node's liveness beat for the Supervisor.
+
+    The reference has nothing like it — node death is discovered by a
+    human watching RViz go stale (SURVEY.md §5). Every framework node
+    publishes a beat each loop iteration; the supervisor declares a node
+    dead after `ResilienceConfig.supervisor_missed_beats` of ITS ticks
+    without one and applies the restart policy. `seq` is the node's own
+    monotonically increasing loop counter (the deterministic time base —
+    wall stamps ride along in the header for humans); `payload` carries
+    node-specific health extras (the LD06 transport's reconnect counters
+    and current backoff, the brain's link state, queue depths)."""
+
+    header: Header = dataclasses.field(default_factory=Header)
+    node: str = ""
+    seq: int = 0
+    payload: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class GraphMarkers:
     """`/graph` payload: the fleet's pose graphs for visualization — the
     capability slam_toolbox's interactive mode renders in RViz (graph

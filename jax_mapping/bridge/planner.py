@@ -45,12 +45,18 @@ class PlannerNode(Node):
     SetGoal drives — brain._goal_cb's convention)."""
 
     def __init__(self, cfg: SlamConfig, bus: Bus, mapper, brain=None,
-                 robot_idx: int = 0, voxel_mapper=None):
+                 robot_idx: int = 0, voxel_mapper=None, health=None):
         super().__init__("planner", bus)
         self.cfg = cfg
         self.mapper = mapper
         self.brain = brain
         self.robot_idx = robot_idx
+        #: Shared degraded-mode registry (resilience/health.py): plans
+        #: are never computed for DEAD robots — a BFS per period toward
+        #: a robot that cannot move is pure waste, and its manual goal
+        #: (if any) must wait for the rejoin. None = plan for everyone.
+        self._health = health
+        self.n_plans_skipped_dead = 0
         # 3D-aware planning (PlannerConfig.use_voxel_obstacles): with a
         # voxel mapper attached, plans search the 2D grid overlaid with
         # the 3D map's obstacle slice — depth-camera obstacles the LiDAR
@@ -201,8 +207,13 @@ class PlannerNode(Node):
         goals = self._manual_goals()
         active: set = set()
         hdr = Header.now("map")
+        alive = (self._health.alive_mask()
+                 if self._health is not None else None)
         for i, goal in enumerate(goals):
             if goal is None:
+                continue
+            if alive is not None and i < len(alive) and not alive[i]:
+                self.n_plans_skipped_dead += 1
                 continue
             active.add(i)
             pose_xy = self._robot_pose_xy(i)
@@ -269,9 +280,16 @@ class PlannerNode(Node):
         from jax_mapping.ops import planner as P
         fields: dict = {}
         plan_lo = None                       # fetched once, on first use
+        alive = (self._health.alive_mask()
+                 if self._health is not None else None)
         for i in range(min(self.mapper.n_robots, len(assign))):
             if i in manual_robots:
                 continue                     # a manual goal owns robot i
+            if alive is not None and i < len(alive) and not alive[i]:
+                # DEAD robot (the mapper's auction post-pass has already
+                # handed its frontier to a living one): no waypoint.
+                self.n_plans_skipped_dead += 1
+                continue
             a = int(assign[i])
             if not 0 <= a < len(targets):
                 continue

@@ -391,6 +391,48 @@ class DepthCamConfig:
 
 
 @_frozen
+class ResilienceConfig:
+    """Fleet supervision + graceful degradation (resilience/ subsystem).
+
+    The reference simply dies when a link or sensor drops (SURVEY.md §5
+    "Failure detection / recovery": driver retries only; the map is lost
+    on any restart). These knobs parameterize the degraded-mode state
+    machine threaded through brain/mapper/planner and the Supervisor's
+    restart policy. Staleness thresholds are in CONTROL TICKS, the
+    deterministic time base (the repo's TTL doctrine,
+    brain._steer_target): wall-clock thresholds would make health
+    transitions host-speed-dependent in faster-than-realtime runs.
+    """
+
+    enabled: bool = True
+    # Robot-level degradation: control ticks without a scan before the
+    # robot coasts on odometry (NO_LIDAR: stop commanding motion, keep
+    # integrating pose, stop expecting fusion), and before it is
+    # declared DEAD (fleet reassigns its frontier work).
+    lidar_silent_ticks: int = 10
+    dead_after_ticks: int = 30
+    # Node-level supervision: supervisor ticks without a heartbeat
+    # before a node is declared dead, and the restart policy's
+    # exponential backoff (in supervisor ticks) with seeded jitter.
+    supervisor_missed_beats: int = 3
+    restart_backoff_base_steps: int = 2
+    restart_backoff_max_steps: int = 64
+    restart_backoff_jitter: float = 0.25
+    # Supervisor auto-checkpoint cadence (steps); the resume source for
+    # restart-from-checkpoint. 0 disables auto-checkpointing.
+    checkpoint_every_steps: int = 50
+    # Mapper degraded-mode gate: windows whose fused-evidence agreement
+    # falls below this are REJECTED (not installed) — a garbage burst
+    # from a glitching sensor must not overwrite known-good map. The
+    # telemetry threshold (0.5, n_low_agreement_windows) stays separate:
+    # this is the do-no-harm floor, far below normal operation.
+    window_agreement_reject: float = 0.02
+    # HTTP management plane: bounded lock wait before answering 503
+    # degraded instead of blocking a worker thread indefinitely.
+    http_lock_timeout_s: float = 2.0
+
+
+@_frozen
 class FleetConfig:
     """Multi-robot scaling (BASELINE.json configs 4-5: 8-64 simulated Thymios)."""
 
@@ -416,6 +458,7 @@ class SlamConfig:
     planner: PlannerConfig = PlannerConfig()
     voxel: VoxelConfig = VoxelConfig()
     depthcam: DepthCamConfig = DepthCamConfig()
+    resilience: ResilienceConfig = ResilienceConfig()
     # slam_toolbox's operating mode (slam_config.yaml:20: "mapping" —
     # the file's comment offers localization as the alternative).
     # "localization" freezes the map: key scans MATCH against it for
@@ -449,6 +492,7 @@ class SlamConfig:
             planner=PlannerConfig(**raw.get("planner", {})),
             voxel=VoxelConfig(**raw.get("voxel", {})),
             depthcam=DepthCamConfig(**raw.get("depthcam", {})),
+            resilience=ResilienceConfig(**raw.get("resilience", {})),
             **{k: v for k, v in raw.items()
                if k in ("mode", "map_publish_period_s",
                         "tf_publish_period_s", "domain_id")},
@@ -474,6 +518,14 @@ def tiny_config(n_robots: int = 2) -> SlamConfig:
                           align_y=8, align_x=8),
         depthcam=DepthCamConfig(width_px=40, height_px=30,
                                 range_max_m=1.2),
+        # Short staleness horizons so chaos tests exercise the full
+        # degrade -> dead -> rejoin ladder within a short mission.
+        resilience=ResilienceConfig(lidar_silent_ticks=8,
+                                    dead_after_ticks=20,
+                                    supervisor_missed_beats=3,
+                                    restart_backoff_base_steps=2,
+                                    restart_backoff_max_steps=16,
+                                    checkpoint_every_steps=25),
     )
 
 

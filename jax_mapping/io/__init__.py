@@ -8,6 +8,7 @@ golden-test backbone (SURVEY.md §4 "Implication for the TPU build").
 """
 
 from jax_mapping.io.checkpoint import (  # noqa: F401
-    load_checkpoint, save_checkpoint,
+    CheckpointCorrupt, load_checkpoint, load_checkpoint_with_fallback,
+    previous_checkpoint_path, save_checkpoint,
 )
 from jax_mapping.io.trace import TraceRecorder, TraceReplayer  # noqa: F401

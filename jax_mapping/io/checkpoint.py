@@ -18,12 +18,32 @@ from __future__ import annotations
 import io
 import json
 import os
+import zipfile
+import zlib
 from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
 
 _META_KEY = "__jax_mapping_meta__"
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint file exists but cannot be trusted: truncated zip,
+    unreadable meta, or a per-leaf CRC32 mismatch (bit rot, power loss
+    despite the atomic rename, a corrupted sidecar copy). Subclasses
+    ValueError so every existing load-error handler still catches it;
+    the supervisor's auto-resume catches it SPECIFICALLY and falls back
+    to the rotated last-good file (`previous_checkpoint_path`)."""
+
+
+def previous_checkpoint_path(path: str) -> str:
+    """Rotation slot for the last-good checkpoint: `save_checkpoint`
+    moves the existing file here before installing the new one, so a
+    save that lands corrupt (or corrupts later on disk) always leaves
+    one older intact generation to fall back to."""
+    root, ext = os.path.splitext(path)
+    return root + ".prev" + (ext or ".npz")
 
 
 def _path_str(path) -> str:
@@ -40,21 +60,38 @@ def _path_str(path) -> str:
     return ".".join(parts) or "value"
 
 
+def _leaf_crc(arr: np.ndarray) -> int:
+    """CRC32 over the raw leaf bytes (C-contiguous), the integrity
+    check zip-member CRCs cannot replace: numpy's zip reader surfaces a
+    bad member as an opaque zlib/zipfile error mid-array, and a
+    truncated-but-valid-zip (partial sidecar copy) passes zipfile
+    entirely."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
 def save_checkpoint(path: str, state: Any,
                     config_json: Optional[str] = None) -> None:
-    """Write `state` (any pytree of arrays/scalars) to `path` atomically."""
+    """Write `state` (any pytree of arrays/scalars) to `path` atomically.
+
+    Meta carries a per-leaf CRC32 (`load_checkpoint` verifies) and any
+    existing file at `path` rotates to `previous_checkpoint_path(path)`
+    first — corruption on load degrades to the previous generation
+    instead of losing the map."""
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state)
     arrays = {}
     keys = []
+    crcs = {}
     for kpath, leaf in leaves_with_paths:
         key = _path_str(kpath)
         assert key not in arrays, f"duplicate checkpoint key {key}"
         arrays[key] = np.asarray(leaf)
+        crcs[key] = _leaf_crc(arrays[key])
         keys.append(key)
     meta = {
         "keys": keys,                       # leaf order for exact rebuild
         "treedef": str(treedef),            # debugging aid only
         "config": config_json,
+        "crc32": crcs,
         "version": 1,
     }
     arrays[_META_KEY] = np.frombuffer(
@@ -62,7 +99,25 @@ def save_checkpoint(path: str, state: Any,
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
+    if os.path.exists(path) and _looks_intact(path):
+        # Rotate ONLY a structurally sound file into the last-good slot:
+        # rotating a truncated/corrupted primary would evict the genuine
+        # last-good generation and leave nothing to fall back to. (Cheap
+        # check — zip directory + meta member, no full-array CRC; a
+        # bit-rotted-but-well-formed file can still slip through, which
+        # load's per-leaf CRC then catches at resume time.)
+        os.replace(path, previous_checkpoint_path(path))
     os.replace(tmp, path)                   # crash-safe swap
+
+
+def _looks_intact(path: str) -> bool:
+    """Structural sanity for rotation: readable zip with a meta member."""
+    try:
+        with np.load(path) as z:
+            json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        return True
+    except Exception:                        # noqa: BLE001
+        return False
 
 
 def load_checkpoint(path: str, like: Any
@@ -72,11 +127,35 @@ def load_checkpoint(path: str, like: Any
 
     Leaf dtypes follow the template (so restored state is jit-compatible
     with the running program); a shape mismatch raises with the offending
-    key named.
+    key named. A file that cannot be read or whose per-leaf CRC32 does
+    not match raises `CheckpointCorrupt` (a ValueError) instead of a raw
+    zipfile/KeyError — callers with a fallback generation (the
+    supervisor) branch on it.
     """
-    with np.load(path) as z:
-        meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
-        data = {k: z[k] for k in meta["keys"]}
+    if not os.path.exists(path):
+        # Missing-file stays FileNotFoundError (callers distinguish
+        # "no checkpoint yet" from "checkpoint rotted").
+        raise FileNotFoundError(path)
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+            data = {k: z[k] for k in meta["keys"]}
+    except (OSError, KeyError, ValueError, json.JSONDecodeError,
+            zipfile.BadZipFile, zlib.error) as e:
+        # Raw zipfile/KeyError escapes are exactly what the corruption
+        # contract forbids: a truncated npz, a missing meta member, or
+        # a zlib stream error all mean the same thing to a caller.
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is unreadable ({type(e).__name__}: {e})"
+        ) from e
+    crcs = meta.get("crc32")
+    if crcs is not None:
+        bad = [k for k in meta["keys"]
+               if k in crcs and _leaf_crc(data[k]) != crcs[k]]
+        if bad:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} failed CRC32 verification on "
+                f"leaves {bad} — corrupted on disk")
 
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
     if len(leaves_with_paths) != len(meta["keys"]):
@@ -96,6 +175,28 @@ def load_checkpoint(path: str, like: Any
                 f"{tmpl.shape} — was the config changed?")
         new_leaves.append(jax.numpy.asarray(arr.astype(tmpl.dtype)))
     return jax.tree_util.tree_unflatten(treedef, new_leaves), meta["config"]
+
+
+def load_checkpoint_with_fallback(path: str, like: Any
+                                  ) -> Tuple[Any, Optional[str], str]:
+    """`load_checkpoint`, degrading to the rotated last-good generation.
+
+    Returns (state, config_json, used_path). A corrupt or missing
+    `path` falls back to `previous_checkpoint_path(path)`; only when
+    BOTH generations fail does the error propagate (CheckpointCorrupt
+    for corruption, FileNotFoundError when neither file exists). THE
+    resume path for the supervisor's restart-from-checkpoint: a mapper
+    crash right after a corrupted save must still resume from the
+    previous map rather than restart blank."""
+    prev = previous_checkpoint_path(path)
+    try:
+        state, cfg_json = load_checkpoint(path, like)
+        return state, cfg_json, path
+    except (CheckpointCorrupt, FileNotFoundError):
+        if not os.path.exists(prev):
+            raise
+        state, cfg_json = load_checkpoint(prev, like)
+        return state, cfg_json, prev
 
 
 def voxel_sidecar_path(path: str) -> str:

@@ -192,6 +192,15 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
                             cov=res.cov)
             return st2, diag
 
+        # Pre-fusion map agreement at the chosen pose — the same health
+        # signal the window path computes for its leading scans, so the
+        # mapper's do-no-harm floor (ResilienceConfig
+        # .window_agreement_reject) covers the single-scan cadence too,
+        # not just queued bursts. One (beams,)-point gather, free next
+        # to the fusion below.
+        agreement = _window_agreement(cfg, st.grid, ranges[None],
+                                      pose[None])
+
         grid = G.fuse_scan(cfg.grid, cfg.scan, st.grid, ranges, pose)
 
         # Ring full? Halve keyframe density first (PG.thin_keyframes) so
@@ -263,7 +272,7 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
                         n_keyscans=st.n_keyscans + 1)
         diag = SlamDiag(matched=res.accepted, response=res.response,
                         key_added=jnp.bool_(True), loop_closed=closed,
-                        window_agreement=jnp.float32(1.0), cov=res.cov)
+                        window_agreement=agreement, cov=res.cov)
         return st2, diag
 
     def skip_branch(st: SlamState):

@@ -1,0 +1,29 @@
+"""Fleet supervision, graceful degradation, and deterministic chaos.
+
+The subsystem ISSUE 2 adds on top of the per-module robustness islands
+(driver retries, bus drop knobs, transport reconnect, checkpointing):
+
+* `health`     — FleetHealth, the shared degraded-mode state machine
+                 (per-robot OK/NO_LIDAR/DEAD ladder, driver link state)
+                 plus the HTTP plane's bounded-lock primitives.
+* `supervisor` — Supervisor node: heartbeat monitoring, exponential-
+                 backoff restart policy, auto-checkpoint cadence.
+* `faultplan`  — FaultEvent/FaultPlan: scripted, seeded, reproducible
+                 multi-fault missions injected at existing boundaries.
+
+Import order note: `bridge.brain` imports `resilience.health` at module
+top, and `faultplan` needs `bridge.brain.robot_ns` — the latter import
+is function-local (lazy) so this package stays importable from either
+direction.
+"""
+
+from jax_mapping.resilience.health import (  # noqa: F401
+    DEAD, DRIVER_OFFLINE, DRIVER_OK, DRIVER_RECOVERING, NO_LIDAR, OK,
+    FleetHealth, LockTimeout, acquire_bounded,
+)
+from jax_mapping.resilience.supervisor import (  # noqa: F401
+    Heartbeater, Supervisor, beat,
+)
+from jax_mapping.resilience.faultplan import (  # noqa: F401
+    FaultEvent, FaultPlan, random_plan,
+)

@@ -1,0 +1,257 @@
+"""Deterministic chaos injection: a scripted, seeded schedule of faults.
+
+The fault-injection knobs this repo accumulated — bus drop/reorder
+probabilities, driver read-failure injection, transport disconnects,
+checkpoint files that can rot — are islands: each is reachable only from
+hand-written test code, so no test can exercise a *mission* where several
+of them fire in sequence. A `FaultPlan` is that mission script: an
+ordered list of `FaultEvent`s, each firing at a specific `Stack.run_steps`
+step index and auto-clearing after `duration` steps, injected at the
+EXISTING boundaries (bus partition/probability setters, driver injection
+fields, node kill) — no monkeypatching, so the chaos path exercises the
+same code real faults would.
+
+Determinism: events fire on the deterministic step clock; the only
+randomness is the constructor's seeded RNG, used by `random_plan` to
+GENERATE schedules — applying a given plan is fully deterministic, so a
+chaos soak can assert two same-seed runs produce identical maps.
+
+Fault kinds and their boundaries:
+
+    lidar_dead          bus.partition("{ns}scan") — the robot's scan
+                        stream goes dark (transport dead / sensor loss);
+                        heals after `duration`.
+    driver_offline      driver.fail_reads_after = now — the next read
+                        raises DriverError; the brain's catch-all drops
+                        the link (`main.py:198-200` semantics); clears
+                        after `duration` (reconnect probe then succeeds).
+    bus_drop            bus.set_fault_injection(drop_prob=value) for the
+                        window — lossy-Wi-Fi weather (report.pdf §V.A).
+    bus_reorder         same, reorder_prob.
+    kill_node           Stack.kill_node(name) — destroy the node
+                        mid-mission; the Supervisor notices the silent
+                        heartbeat and restarts it (mapper: from the
+                        latest checkpoint, pose re-anchored).
+    kill_robot          partition the robot's scan topic AND disable its
+                        motors (driver.set_robot_enabled) — mid-mission
+                        robot loss; FleetHealth declares it DEAD and the
+                        fleet reassigns its frontier work.
+    rejoin_robot        undo kill_robot — the robot relocalizes through
+                        the mapper's normal matching against the shared
+                        map.
+    corrupt_checkpoint  truncate the file at `name` (default: the
+                        stack's auto-checkpoint) — the power-loss /
+                        bit-rot case the CRC32 + last-good rotation in
+                        io/checkpoint.py exists for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+from typing import Dict, List, Optional
+
+KINDS = frozenset({
+    "lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
+    "kill_node", "kill_robot", "rejoin_robot", "corrupt_checkpoint",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault. `step` is the Stack.run_steps index it fires
+    at; `duration` > 0 auto-clears that many steps later (0 = permanent
+    or cleared by a paired event, e.g. kill_robot/rejoin_robot)."""
+
+    step: int
+    kind: str
+    robot: int = 0
+    duration: int = 0
+    value: float = 0.0          # kind-specific (drop/reorder probability)
+    name: str = ""              # node name / file path
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(one of {sorted(KINDS)})")
+        if self.step < 0 or self.duration < 0:
+            raise ValueError("step and duration must be >= 0")
+
+
+class FaultPlan:
+    """Apply a schedule of FaultEvents against a running Stack.
+
+    `apply(stack, step)` is called once per step (Stack.run_steps does
+    this automatically when a plan is attached); it runs due clears,
+    then fires due events. `log` records every action as
+    (step, description) — two same-seed runs of the same plan produce
+    identical logs, the soak test's determinism anchor."""
+
+    def __init__(self, events: List[FaultEvent], seed: int = 0):
+        self.events = sorted(events, key=lambda e: (e.step, e.kind,
+                                                    e.robot))
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._fired = [False] * len(self.events)
+        #: (due_step, callable, description) pending auto-clears.
+        self._clears: List[tuple] = []
+        self.log: List[tuple] = []
+        # Overlap bookkeeping: clears are REFCOUNTED so two windows on
+        # the same resource compose — the first window's clear must not
+        # heal a partition (or restore weather) the second still holds.
+        self._partition_refs: Dict[str, int] = {}
+        self._robot_kill_refs: Dict[int, int] = {}
+        self._driver_refs = 0
+        #: knob -> (baseline captured at first fire, active values).
+        self._weather: Dict[str, tuple] = {}
+
+    # -- boundary helpers ----------------------------------------------------
+
+    @staticmethod
+    def _scan_topic(stack, robot: int) -> str:
+        from jax_mapping.bridge.brain import robot_ns
+        return f"{robot_ns(robot, stack.brain.n_robots)}scan"
+
+    def _note(self, step: int, desc: str) -> None:
+        self.log.append((step, desc))
+
+    # -- the per-step hook ---------------------------------------------------
+
+    def apply(self, stack, step: int) -> None:
+        still_pending = []
+        for due, fn, desc in self._clears:
+            if step >= due:
+                fn()
+                self._note(step, f"clear: {desc}")
+            else:
+                still_pending.append((due, fn, desc))
+        self._clears = still_pending
+        for i, ev in enumerate(self.events):
+            if not self._fired[i] and ev.step <= step:
+                self._fired[i] = True
+                self._fire(stack, ev, step)
+
+    # -- refcounted resource holds (overlapping windows compose) -----------
+
+    def _hold_partition(self, bus, topic: str) -> None:
+        self._partition_refs[topic] = \
+            self._partition_refs.get(topic, 0) + 1
+        bus.partition(topic)
+
+    def _release_partition(self, bus, topic: str) -> None:
+        n = self._partition_refs.get(topic, 1) - 1
+        self._partition_refs[topic] = max(0, n)
+        if n <= 0:
+            bus.heal(topic)                  # last window out heals
+
+    def _apply_weather(self, bus, key: str, value: Optional[float]
+                       ) -> None:
+        """Add (value) or remove (None pops the given value via the
+        caller) one active weather window; the bus runs the WORST of the
+        active windows, reverting to the pre-chaos baseline when the
+        last one clears."""
+        base, active = self._weather.setdefault(
+            key, (getattr(bus, key), []))
+        if value is not None:
+            active.append(value)
+        bus.set_fault_injection(**{key: max(active) if active else base})
+
+    def _fire(self, stack, ev: FaultEvent, step: int) -> None:
+        bus = stack.bus
+        if ev.kind == "lidar_dead":
+            topic = self._scan_topic(stack, ev.robot)
+            self._hold_partition(bus, topic)
+            self._note(step, f"lidar_dead robot{ev.robot}")
+            if ev.duration:
+                self._clears.append((
+                    step + ev.duration,
+                    lambda: self._release_partition(bus, topic),
+                    f"lidar_dead robot{ev.robot}"))
+        elif ev.kind == "driver_offline":
+            drv = stack.driver
+            self._driver_refs += 1
+            drv.fail_reads_after = drv._n_reads
+            self._note(step, "driver_offline")
+            if ev.duration:
+                def _heal_driver():
+                    self._driver_refs -= 1
+                    if self._driver_refs <= 0:
+                        drv.fail_reads_after = None
+                self._clears.append((step + ev.duration, _heal_driver,
+                                     "driver_offline"))
+        elif ev.kind in ("bus_drop", "bus_reorder"):
+            key = "drop_prob" if ev.kind == "bus_drop" else "reorder_prob"
+            self._apply_weather(bus, key, ev.value)
+            self._note(step, f"{ev.kind}={ev.value}")
+            if ev.duration:
+                def _clear_weather(key=key, value=ev.value):
+                    self._weather[key][1].remove(value)
+                    self._apply_weather(bus, key, None)
+                self._clears.append((step + ev.duration, _clear_weather,
+                                     f"{ev.kind}"))
+        elif ev.kind == "kill_node":
+            stack.kill_node(ev.name or "jax_mapper")
+            self._note(step, f"kill_node {ev.name or 'jax_mapper'}")
+        elif ev.kind == "kill_robot":
+            topic = self._scan_topic(stack, ev.robot)
+            self._hold_partition(bus, topic)
+            self._robot_kill_refs[ev.robot] = \
+                self._robot_kill_refs.get(ev.robot, 0) + 1
+            stack.driver.set_robot_enabled(ev.robot, False)
+            self._note(step, f"kill_robot robot{ev.robot}")
+            if ev.duration:
+                self._clears.append((
+                    step + ev.duration,
+                    lambda: self._rejoin(stack, ev.robot),
+                    f"kill_robot robot{ev.robot}"))
+        elif ev.kind == "rejoin_robot":
+            self._rejoin(stack, ev.robot)
+            self._note(step, f"rejoin_robot robot{ev.robot}")
+        elif ev.kind == "corrupt_checkpoint":
+            path = ev.name or getattr(stack, "auto_checkpoint_path", "")
+            if path and os.path.exists(path):
+                size = os.path.getsize(path)
+                with open(path, "rb+") as f:
+                    f.truncate(max(1, int(size * 0.6)))
+                self._note(step, f"corrupt_checkpoint {path} "
+                                 f"({size} -> {max(1, int(size * 0.6))}B)")
+            else:
+                self._note(step, f"corrupt_checkpoint skipped "
+                                 f"(no file at {path!r})")
+
+    def _rejoin(self, stack, robot: int) -> None:
+        if self._robot_kill_refs.get(robot, 0) <= 0:
+            # No kill held: a stray rejoin_robot must not heal a
+            # partition some OTHER window (e.g. lidar_dead) still owns.
+            return
+        self._robot_kill_refs[robot] -= 1
+        self._release_partition(stack.bus, self._scan_topic(stack, robot))
+        if self._robot_kill_refs[robot] == 0:
+            stack.driver.set_robot_enabled(robot, True)
+
+    def done(self) -> bool:
+        return all(self._fired) and not self._clears
+
+    def summary(self) -> List[str]:
+        return [f"step {s}: {d}" for s, d in self.log]
+
+
+def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
+                n_robots: int = 1) -> FaultPlan:
+    """Generate a reproducible schedule: `seed` fully determines the
+    fault mix, placement, and durations (fuzz-style soak variety with
+    CI-replayable failures)."""
+    rng = random.Random(seed)
+    kinds = ["lidar_dead", "driver_offline", "bus_drop", "bus_reorder"]
+    events = []
+    for _ in range(n_faults):
+        kind = rng.choice(kinds)
+        step = rng.randrange(1, max(2, mission_steps - 10))
+        duration = rng.randrange(3, 12)
+        events.append(FaultEvent(
+            step=step, kind=kind,
+            robot=rng.randrange(n_robots), duration=duration,
+            value=round(rng.uniform(0.2, 0.7), 3)
+            if kind.startswith("bus_") else 0.0))
+    return FaultPlan(events, seed=seed)

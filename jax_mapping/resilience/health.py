@@ -1,0 +1,166 @@
+"""Degraded-mode state machine: per-robot + per-link health for the fleet.
+
+The reference's failure handling is per-module heroics (driver retries in
+`main.py:198-200`, nothing else — SURVEY.md §5 "Failure detection /
+recovery"); there is no shared notion of "robot 2's lidar is silent" that
+the brain, mapper, planner, and HTTP plane could all act on. `FleetHealth`
+is that shared notion: a small, lock-guarded registry the nodes FEED
+(brain notes scans and the driver link, mapper notes fusion trouble) and
+READ (brain coasts a NO_LIDAR robot, mapper/planner reassign a DEAD
+robot's frontiers, the HTTP plane exports it all on /status and /metrics).
+
+Time base: CONTROL TICKS, not wall clock (the repo's TTL doctrine,
+brain._steer_target): faster-than-realtime runs must walk the identical
+degrade -> dead -> rejoin ladder a realtime mission would, or chaos tests
+become host-speed-dependent.
+
+Per-robot ladder:
+
+    OK ──(lidar_silent_ticks without a scan)──▶ NO_LIDAR (coast: hold
+      position on odometry, stop expecting fusion, LED orange)
+    NO_LIDAR ──(dead_after_ticks without a scan)──▶ DEAD (fleet
+      reassigns its frontier work; planner stops planning for it)
+    any ──(a scan arrives)──▶ OK (rejoin: the mapper relocalizes by
+      matching the robot's next scans against the shared map)
+
+The driver link is fleet-wide (one dongle): OK / OFFLINE / RECOVERING,
+fed by the brain's connect machinery; RECOVERING is the one-tick
+safe-stop window after a reconnect (motors zeroed, LED red) that keeps
+stale pre-fault wheel targets from replaying.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from jax_mapping.config import ResilienceConfig
+
+#: Per-robot states.
+OK = "ok"
+NO_LIDAR = "no_lidar"
+DEAD = "dead"
+
+#: Driver-link states.
+DRIVER_OK = "ok"
+DRIVER_OFFLINE = "offline"
+DRIVER_RECOVERING = "recovering"
+
+
+class LockTimeout(RuntimeError):
+    """A bounded lock acquisition expired — the HTTP plane's signal to
+    answer 503 degraded instead of hanging a worker thread behind a
+    wedged node (http_api's bounded-wait contract)."""
+
+
+class FleetHealth:
+    """Thread-safe health registry; a LEAF in the lock order (its methods
+    never call out while holding `_lock`, so no node lock ever nests
+    inside it — the B1 checker's invariant by construction)."""
+
+    def __init__(self, cfg: ResilienceConfig, n_robots: int):
+        self.cfg = cfg
+        self.n_robots = n_robots
+        self._lock = threading.Lock()
+        #: Last control tick a scan arrived, per robot. Boot counts as
+        #: tick 0 "activity" so a robot gets lidar_silent_ticks of grace
+        #: before its first scan instead of booting degraded.
+        self._last_scan_tick = [0] * n_robots
+        self._tick = 0
+        self._driver = DRIVER_OK
+        #: Per-robot current state (recomputed on note_tick) + the
+        #: transition log chaos tests assert against:
+        #: (tick, "robot<i>"|"driver", old, new).
+        self._robot_state = [OK] * n_robots
+        self.transitions: List[tuple] = []
+
+    # -- feeders (brain/mapper threads) -------------------------------------
+
+    def note_scan(self, robot: int, tick: int) -> None:
+        with self._lock:
+            self._last_scan_tick[robot] = max(
+                self._last_scan_tick[robot], tick)
+
+    def note_tick(self, tick: int) -> None:
+        """Advance the health clock (brain.update_loop, once per control
+        tick) and fold any staleness into the per-robot states."""
+        with self._lock:
+            self._tick = max(self._tick, tick)
+            for i in range(self.n_robots):
+                silent = self._tick - self._last_scan_tick[i]
+                if silent > self.cfg.dead_after_ticks:
+                    new = DEAD
+                elif silent > self.cfg.lidar_silent_ticks:
+                    new = NO_LIDAR
+                else:
+                    new = OK
+                old = self._robot_state[i]
+                if new != old:
+                    self._robot_state[i] = new
+                    self.transitions.append(
+                        (self._tick, f"robot{i}", old, new))
+
+    def note_driver(self, state: str) -> None:
+        assert state in (DRIVER_OK, DRIVER_OFFLINE, DRIVER_RECOVERING)
+        with self._lock:
+            if state != self._driver:
+                self.transitions.append(
+                    (self._tick, "driver", self._driver, state))
+                self._driver = state
+
+    # -- readers (any thread) ------------------------------------------------
+
+    @property
+    def driver(self) -> str:
+        with self._lock:
+            return self._driver
+
+    def robot_states(self) -> List[str]:
+        with self._lock:
+            return list(self._robot_state)
+
+    def alive_mask(self) -> np.ndarray:
+        """(R,) bool: robots not declared DEAD — the mask the frontier
+        auction and the planner honor."""
+        with self._lock:
+            return np.array([s != DEAD for s in self._robot_state])
+
+    def lidar_ok_mask(self) -> np.ndarray:
+        """(R,) bool: robots whose lidar is fresh — the others coast
+        (no commanded motion; odometry keeps integrating)."""
+        with self._lock:
+            return np.array([s == OK for s in self._robot_state])
+
+    def snapshot(self) -> dict:
+        """The /status export: one dict an operator (or a test) reads
+        the whole degraded-mode picture from."""
+        with self._lock:
+            return {
+                "driver": self._driver,
+                "robots": list(self._robot_state),
+                "tick": self._tick,
+                "last_scan_tick": list(self._last_scan_tick),
+                "n_transitions": len(self.transitions),
+            }
+
+    def transitions_for(self, name: str) -> List[tuple]:
+        """The (tick, old, new) ladder one component walked — direct
+        assertion surface for degraded-mode tests."""
+        with self._lock:
+            return [(t, a, b) for t, n, a, b in self.transitions
+                    if n == name]
+
+
+def acquire_bounded(lock, timeout_s: Optional[float], what: str) -> None:
+    """Acquire `lock`, raising LockTimeout after `timeout_s` (None =
+    block forever — the in-process callers' behavior). ONE bounded-wait
+    implementation for every handler the HTTP plane must not hang in."""
+    if timeout_s is None:
+        lock.acquire()
+        return
+    if not lock.acquire(timeout=timeout_s):
+        raise LockTimeout(
+            f"{what} lock not acquired within {timeout_s}s — node "
+            "wedged or under heavy load")

@@ -1,0 +1,234 @@
+"""Supervisor: heartbeat monitoring + restart-with-backoff for the stack.
+
+The reference's node graph has no supervisor — a crashed slam_toolbox
+takes the map with it and a human restarts the launch file from scratch
+(SURVEY.md §5: "the map is lost on any restart"). This node watches the
+`/heartbeat` topic every framework node beats on, declares a node dead
+after `ResilienceConfig.supervisor_missed_beats` supervisor ticks without
+a beat, and applies a restart policy with exponential backoff and SEEDED
+jitter (deterministic across same-seed runs; a fleet of supervisors never
+restarts in lockstep).
+
+Restart is delegated: the launch layer registers a restarter callable per
+node name (e.g. `Stack.restart_mapper`, which rebuilds the MapperNode and
+resumes it from the latest auto-checkpoint with pose re-anchoring —
+`io.checkpoint.load_checkpoint_with_fallback` degrades to the rotated
+last-good file when the newest checkpoint is corrupt). The supervisor
+also owns the auto-checkpoint cadence: it invokes a registered
+checkpointer every `checkpoint_every_steps` ticks, so there IS a recent
+generation to resume from when the crash comes.
+
+Time base: supervisor TICKS (one per `Stack.run_steps` step in
+deterministic mode, one per timer period in realtime mode) — the repo's
+deterministic-time doctrine; wall-clock supervision would make chaos
+tests host-speed-dependent.
+
+Threading: a Node like any other — the heartbeat subscription and the
+timer callback are serialized by `Node._cb_lock`, and `tick()` plus the
+export readers (`status`, `is_alive`, ...) take the same re-entrant
+lock themselves, so HTTP worker threads polling /status never iterate
+`_restart_due` mid-mutation (deterministic `run_steps` calls `tick()`
+directly, outside the timer guard). No second lock exists, so the
+supervisor cannot deadlock against node locks (B1 by construction);
+restarters invoked from `tick()` may take node/bus locks freely —
+nothing acquires the supervisor's lock while holding those.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Dict, List, Optional
+
+from jax_mapping.bridge.bus import Bus
+from jax_mapping.bridge.node import Node
+from jax_mapping.config import ResilienceConfig
+
+
+class Supervisor(Node):
+    """Watches heartbeats; schedules and executes restarts."""
+
+    def __init__(self, cfg: ResilienceConfig, bus: Bus, seed: int = 0,
+                 tick_period_s: float = 0.1):
+        super().__init__("supervisor", bus)
+        self.cfg = cfg
+        self._rng = random.Random(seed)
+        self.n_ticks = 0
+        #: name -> (last seq, supervisor tick the beat arrived). A fresh
+        #: registration/restart seeds a grace entry at the current tick.
+        self._beats: Dict[str, tuple] = {}
+        self._restarters: Dict[str, Optional[Callable[[], None]]] = {}
+        #: name -> tick the restart attempt is due (node currently dead).
+        self._restart_due: Dict[str, int] = {}
+        self._n_restarts: Dict[str, int] = {}
+        #: Event log chaos tests assert against:
+        #: (tick, name, "dead"|"restart"|"restart_failed", detail).
+        self.events: List[tuple] = []
+        #: Every scheduled backoff: (name, attempt#, backoff_ticks) —
+        #: the exponential-growth assertion surface.
+        self.backoff_log: List[tuple] = []
+        self._checkpointer: Optional[Callable[[], None]] = None
+        self.n_checkpoints = 0
+        self.n_checkpoint_errors = 0
+        self.create_subscription("/heartbeat", self._hb_cb)
+        self.create_timer(tick_period_s, self.tick)
+
+    # -- wiring (launch layer) ----------------------------------------------
+
+    def register(self, name: str,
+                 restarter: Optional[Callable[[], None]] = None) -> None:
+        """Watch node `name`; with a restarter, dead nodes are restarted
+        (without one, death is only declared and exported)."""
+        self._restarters[name] = restarter
+        self._beats[name] = (-1, self.n_ticks)          # boot grace
+
+    def attach_checkpointer(self, fn: Callable[[], None]) -> None:
+        """The auto-checkpoint hook (launch wires `Stack`'s saver)."""
+        self._checkpointer = fn
+
+    # -- heartbeat ingestion -------------------------------------------------
+
+    def _hb_cb(self, msg) -> None:
+        self._beats[msg.node] = (int(msg.seq), self.n_ticks)
+
+    def backoff_ticks(self, attempt: int) -> int:
+        """Restart delay for the attempt-th consecutive restart:
+        base * 2^attempt capped at max, times seeded jitter in
+        [1, 1+jitter). Deterministic for a given seed and call
+        sequence."""
+        raw = min(self.cfg.restart_backoff_base_steps * (2 ** attempt),
+                  self.cfg.restart_backoff_max_steps)
+        return max(1, int(round(
+            raw * (1.0 + self.cfg.restart_backoff_jitter
+                   * self._rng.random()))))
+
+    # -- the supervision loop ------------------------------------------------
+
+    def tick(self) -> None:
+        # Serialized with the heartbeat subscription AND the export
+        # readers via the node's re-entrant _cb_lock (the timer path
+        # already holds it; deterministic run_steps calls arrive bare).
+        with self._cb_lock:
+            self._tick_locked()
+
+    def _tick_locked(self) -> None:
+        self.n_ticks += 1
+        now = self.n_ticks
+        if self._checkpointer is not None \
+                and self.cfg.checkpoint_every_steps > 0 \
+                and now % self.cfg.checkpoint_every_steps == 0:
+            try:
+                self._checkpointer()
+                self.n_checkpoints += 1
+            except Exception as e:               # noqa: BLE001
+                # A failing auto-save must not take down supervision —
+                # the previous generation is still on disk.
+                self.n_checkpoint_errors += 1
+                self.events.append((now, "checkpoint", "error", str(e)))
+        for name in list(self._restarters):
+            if name in self._restart_due:
+                self._attempt_restart(name, now)
+                continue
+            _seq, at = self._beats.get(name, (-1, 0))
+            if now - at > self.cfg.supervisor_missed_beats:
+                self._declare_dead(name, now)
+
+    def _declare_dead(self, name: str, now: int) -> None:
+        attempt = self._n_restarts.get(name, 0)
+        delay = self.backoff_ticks(attempt)
+        self.backoff_log.append((name, attempt, delay))
+        self._restart_due[name] = now + delay
+        self.events.append((now, name, "dead",
+                            f"restart due in {delay} ticks"))
+
+    def _attempt_restart(self, name: str, now: int) -> None:
+        # Beats resumed while the restart was pending (transient stall,
+        # external recovery): cancel it — destroying a LIVE node would
+        # throw away everything since the last checkpoint to cure a
+        # hiccup that already healed.
+        _seq, at = self._beats.get(name, (-1, 0))
+        if now - at <= self.cfg.supervisor_missed_beats:
+            del self._restart_due[name]
+            self.events.append((now, name, "recovered",
+                                "beats resumed before restart"))
+            return
+        if now < self._restart_due[name]:
+            return
+        restarter = self._restarters.get(name)
+        if restarter is None:
+            # Unrestartable node: stay declared dead (exported on
+            # /status) until beats resume (handled above).
+            return
+        self._n_restarts[name] = self._n_restarts.get(name, 0) + 1
+        try:
+            restarter()
+        except Exception as e:                   # noqa: BLE001
+            attempt = self._n_restarts[name]
+            delay = self.backoff_ticks(attempt)
+            self.backoff_log.append((name, attempt, delay))
+            self._restart_due[name] = now + delay
+            self.events.append((now, name, "restart_failed",
+                                f"{e}; retry in {delay} ticks"))
+            return
+        del self._restart_due[name]
+        self._beats[name] = (-1, now)            # fresh grace window
+        self.events.append((now, name, "restart",
+                            f"attempt {self._n_restarts[name]}"))
+
+    # -- export ---------------------------------------------------------------
+
+    def dead_nodes(self) -> List[str]:
+        with self._cb_lock:
+            return sorted(self._restart_due)
+
+    def is_alive(self, name: str) -> bool:
+        with self._cb_lock:
+            return name not in self._restart_due
+
+    def n_restarts(self, name: str) -> int:
+        with self._cb_lock:
+            return self._n_restarts.get(name, 0)
+
+    def status(self) -> dict:
+        """The /status export (and the soak test's assertion surface)."""
+        with self._cb_lock:
+            return {
+                "watched": sorted(self._restarters),
+                "dead": sorted(self._restart_due),
+                "ticks": self.n_ticks,
+                "restarts": dict(self._n_restarts),
+                "checkpoints": self.n_checkpoints,
+                "checkpoint_errors": self.n_checkpoint_errors,
+                "n_events": len(self.events),
+            }
+
+    def heartbeat_ages(self) -> Dict[str, int]:
+        """Supervisor ticks since each watched node last beat."""
+        with self._cb_lock:
+            return {name: self.n_ticks - self._beats.get(name, (-1, 0))[1]
+                    for name in self._restarters}
+
+
+def beat(pub, node_name: str, seq: int, payload: Optional[dict] = None
+         ) -> None:
+    """Publish one heartbeat. Shared by every beating node so the
+    payload shape can never drift between them."""
+    from jax_mapping.bridge.messages import Header, Heartbeat
+    pub.publish(Heartbeat(header=Header(stamp=time.monotonic()),
+                          node=node_name, seq=seq,
+                          payload=payload or {}))
+
+
+class Heartbeater:
+    """One per beating node: owns the `/heartbeat` publisher and the
+    monotone seq counter, so every node beats through the identical
+    plumbing instead of re-implementing pub + counter in its loop."""
+
+    def __init__(self, node: Node):
+        self._pub = node.create_publisher("/heartbeat")
+        self._name = node.name
+        self.seq = 0
+
+    def beat(self, payload: Optional[dict] = None) -> None:
+        self.seq += 1
+        beat(self._pub, self._name, self.seq, payload)

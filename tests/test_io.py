@@ -68,6 +68,116 @@ def test_checkpoint_shape_drift_detected(tiny_cfg, tmp_path):
         load_checkpoint(path, S.init_state(bigger))
 
 
+# --------------------------------------------- corruption (resilience PR)
+
+def test_checkpoint_truncation_raises_checkpoint_corrupt(tiny_cfg, tmp_path):
+    """The power-loss case: a truncated .npz must raise CheckpointCorrupt
+    (a ValueError), never a raw zipfile/KeyError escape."""
+    from jax_mapping.io import CheckpointCorrupt
+    st = S.init_state(tiny_cfg)
+    path = str(tmp_path / "trunc.ckpt.npz")
+    save_checkpoint(path, st)
+    import os
+    with open(path, "rb+") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(CheckpointCorrupt):
+        load_checkpoint(path, S.init_state(tiny_cfg))
+    assert issubclass(CheckpointCorrupt, ValueError)  # old handlers catch
+
+
+def test_checkpoint_crc_detects_bit_rot(tiny_cfg, tmp_path):
+    """A checkpoint that is a VALID zip but whose leaf bytes changed
+    (bit rot, partial sidecar copy) fails the per-leaf CRC32: exactly
+    the corruption zipfile-level checks cannot see when the whole
+    member was rewritten."""
+    from jax_mapping.io import CheckpointCorrupt
+    from jax_mapping.io.checkpoint import _META_KEY
+    st = S.init_state(tiny_cfg)
+    path = str(tmp_path / "rot.ckpt.npz")
+    save_checkpoint(path, st)
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    key = next(k for k in arrays if k != _META_KEY
+               and arrays[k].size > 0 and arrays[k].dtype == np.float32)
+    arrays[key] = arrays[key].copy()
+    arrays[key].flat[0] += 1.0              # one flipped value
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **arrays)    # re-zipped: zip CRCs now FINE
+    with pytest.raises(CheckpointCorrupt, match="CRC32"):
+        load_checkpoint(path, S.init_state(tiny_cfg))
+
+
+def test_checkpoint_fallback_rotates_to_last_good(tiny_cfg, tmp_path):
+    """save_checkpoint keeps the previous generation; the fallback loader
+    degrades to it when the newest file rots — the supervisor's
+    auto-resume contract."""
+    from jax_mapping.io import (CheckpointCorrupt,
+                                load_checkpoint_with_fallback,
+                                previous_checkpoint_path)
+    world = W.plank_course(96, tiny_cfg.grid.resolution_m, seed=5)
+    st_a = _run_slam(tiny_cfg, world, 4)
+    st_b = _run_slam(tiny_cfg, world, 4, state=st_a)
+    path = str(tmp_path / "gen.ckpt.npz")
+    save_checkpoint(path, st_a)
+    save_checkpoint(path, st_b)             # rotates gen A to .prev
+    prev = previous_checkpoint_path(path)
+    import os
+    assert os.path.exists(prev)
+
+    # Intact newest: fallback loads it and reports the primary path.
+    got, _, used = load_checkpoint_with_fallback(
+        path, S.init_state(tiny_cfg))
+    assert used == path
+    np.testing.assert_array_equal(np.asarray(got.grid),
+                                  np.asarray(st_b.grid))
+
+    # Corrupt newest: fallback degrades to the rotated last-good gen.
+    with open(path, "rb+") as f:
+        f.truncate(os.path.getsize(path) // 3)
+    got, _, used = load_checkpoint_with_fallback(
+        path, S.init_state(tiny_cfg))
+    assert used == prev
+    np.testing.assert_array_equal(np.asarray(got.grid),
+                                  np.asarray(st_a.grid))
+
+    # BOTH generations gone: the corruption propagates.
+    with open(prev, "rb+") as f:
+        f.truncate(8)
+    with pytest.raises(CheckpointCorrupt):
+        load_checkpoint_with_fallback(path, S.init_state(tiny_cfg))
+
+
+def test_save_does_not_rotate_corrupt_primary_over_last_good(tiny_cfg,
+                                                             tmp_path):
+    """A corrupted primary must NOT be rotated into the .prev slot on
+    the next save — that would evict the genuine last-good generation
+    (the corrupt-then-save-then-crash chaos sequence)."""
+    import os
+
+    from jax_mapping.io import (load_checkpoint_with_fallback,
+                                previous_checkpoint_path)
+    world = W.plank_course(96, tiny_cfg.grid.resolution_m, seed=5)
+    st_a = _run_slam(tiny_cfg, world, 4)
+    st_b = _run_slam(tiny_cfg, world, 4, state=st_a)
+    st_c = _run_slam(tiny_cfg, world, 4, state=st_b)
+    path = str(tmp_path / "rot.ckpt.npz")
+    save_checkpoint(path, st_a)
+    save_checkpoint(path, st_b)              # .prev = A (intact)
+    with open(path, "rb+") as f:             # primary (B) rots on disk
+        f.truncate(os.path.getsize(path) // 3)
+    save_checkpoint(path, st_c)              # must NOT move B over A
+    got, _ = load_checkpoint(
+        previous_checkpoint_path(path), S.init_state(tiny_cfg))
+    np.testing.assert_array_equal(np.asarray(got.grid),
+                                  np.asarray(st_a.grid))
+    # And the new primary is C, loadable.
+    got, _, used = load_checkpoint_with_fallback(
+        path, S.init_state(tiny_cfg))
+    assert used == path
+    np.testing.assert_array_equal(np.asarray(got.grid),
+                                  np.asarray(st_c.grid))
+
+
 def test_trace_record_replay_golden(tiny_cfg, tmp_path):
     """Record a live run's /scan+/odom, replay into a FRESH mapper, and the
     rebuilt map must equal the live mapper's map (golden-trace path)."""

@@ -141,6 +141,61 @@ def test_tcp_transport_reconnects(tiny_cfg):
     srv.close()
 
 
+def test_tcp_backoff_jitter_is_seeded_and_desynchronizes():
+    """A fleet of clients that lost the same lidar bridge must not redial
+    in lockstep: each scheduled retry is jittered in
+    [backoff, backoff*(1+jitter)), seeded so chaos runs replay exactly."""
+    def waits(seed, n=6):
+        # Port 1 on localhost refuses instantly: every attempt fails.
+        tr = TcpTransport("127.0.0.1", 1, reconnect_backoff_s=0.5,
+                          max_backoff_s=4.0, jitter=0.25, seed=seed)
+        out = []
+        for _ in range(n):
+            tr._fail_attempt()
+            out.append(tr.last_backoff_s)
+        tr.close()
+        return out
+
+    a, b, c = waits(0), waits(0), waits(1)
+    assert a == b                            # seeded: same-seed replay
+    assert a != c                            # different clients differ
+    # Every wait respects the jittered-exponential envelope.
+    base = 0.5
+    for i, w in enumerate(a):
+        lo = min(base * 2 ** i, 4.0)
+        assert lo <= w < lo * 1.25 + 1e-9
+    # Heartbeat-payload export carries the reconnect posture.
+    tr = TcpTransport("127.0.0.1", 1, seed=3)
+    st = tr.stats()
+    assert st == {"connected": False, "n_connects": 0,
+                  "n_reconnects": 0, "backoff_s": 0.0}
+    tr._fail_attempt()
+    assert tr.stats()["backoff_s"] > 0
+    tr.close()
+
+
+def test_ld06_node_heartbeat_carries_transport_stats(tiny_cfg):
+    """The ingest node beats on /heartbeat with the transport's reconnect
+    counters in the payload — the supervisor (and /status) see a
+    flapping lidar bridge without shelling into the pi."""
+    if not N.native_available():
+        pytest.skip("libld06 not buildable")
+    bus = Bus()
+    beats = []
+    bus.subscribe("/heartbeat", callback=beats.append)
+    tr = TcpTransport("127.0.0.1", 1, reconnect_backoff_s=0.01, seed=0)
+    node = Ld06IngestNode(tiny_cfg.scan, bus, tr, realtime=False)
+    node.poll()
+    node.poll()
+    tr.close()
+    assert [b.seq for b in beats] == [1, 2]
+    assert beats[-1].node == "ld06_ingest"
+    payload = beats[-1].payload
+    assert payload["scans_published"] == 0
+    assert payload["transport"]["n_reconnects"] == 0
+    assert "backoff_s" in payload["transport"]
+
+
 def test_transports_nonblocking_when_idle(tiny_cfg):
     """Empty reads return immediately — the poll timer must never stall."""
     tr = UdpTransport(bind_host="127.0.0.1", bind_port=0)

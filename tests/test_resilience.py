@@ -1,0 +1,464 @@
+"""Resilience subsystem tests: FleetHealth's degraded-mode ladder, the
+Supervisor's restart-with-backoff policy, FaultPlan's deterministic chaos
+injection, the HTTP plane's bounded-wait 503 contract — and the headline
+chaos missions: a scripted multi-fault run (ISSUE 2 acceptance: lidar
+transport dead >= 5 s mid-mission, one robot killed and rejoined, the
+mapper node killed and supervisor-resumed from checkpoint) that still
+produces a map within quality thresholds of the fault-free run,
+bit-deterministically across same-seed runs.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jax_mapping.bridge.launch import launch_sim_stack
+from jax_mapping.config import ResilienceConfig, tiny_config
+from jax_mapping.resilience import (
+    DEAD, NO_LIDAR, OK, FaultEvent, FaultPlan, FleetHealth, LockTimeout,
+    Supervisor, acquire_bounded, random_plan,
+)
+from jax_mapping.sim import world as W
+
+
+# ------------------------------------------------------------ FleetHealth
+
+def _health(n_robots=2, **kw):
+    kw.setdefault("lidar_silent_ticks", 3)
+    kw.setdefault("dead_after_ticks", 8)
+    return FleetHealth(ResilienceConfig(**kw), n_robots)
+
+
+def test_health_ladder_ok_no_lidar_dead_rejoin():
+    """The per-robot ladder: OK -> NO_LIDAR -> DEAD on silence, straight
+    back to OK on a scan (rejoin), with every transition logged."""
+    h = _health()
+    for t in range(1, 20):
+        h.note_scan(1, t)                   # robot 1 stays chatty
+        h.note_tick(t)
+    assert h.robot_states() == [DEAD, OK]
+    assert h.transitions_for("robot0") == [(4, OK, NO_LIDAR),
+                                           (9, NO_LIDAR, DEAD)]
+    assert h.transitions_for("robot1") == []
+
+    h.note_scan(0, 20)                      # the rejoin scan
+    h.note_tick(20)
+    assert h.robot_states() == [OK, OK]
+    assert h.transitions_for("robot0")[-1] == (20, DEAD, OK)
+
+
+def test_health_masks_and_boot_grace():
+    h = _health()
+    # Boot counts as activity: no robot boots degraded.
+    h.note_tick(1)
+    assert h.robot_states() == [OK, OK]
+    for t in range(2, 12):
+        h.note_scan(0, t)
+        h.note_tick(t)
+    assert h.alive_mask().tolist() == [True, False]
+    assert h.lidar_ok_mask().tolist() == [True, False]
+    snap = h.snapshot()
+    assert snap["robots"] == [OK, DEAD] and snap["driver"] == "ok"
+
+
+def test_acquire_bounded_times_out():
+    lock = threading.Lock()
+    acquire_bounded(lock, 0.05, "t")        # uncontended: acquires
+    with pytest.raises(LockTimeout, match="wedged"):
+        acquire_bounded(lock, 0.05, "t")    # held (by us): times out
+    lock.release()
+
+
+# ------------------------------------------------------------- Supervisor
+
+def _supervisor(**kw):
+    from jax_mapping.bridge.bus import Bus
+    kw.setdefault("supervisor_missed_beats", 2)
+    kw.setdefault("restart_backoff_base_steps", 2)
+    kw.setdefault("restart_backoff_max_steps", 16)
+    bus = Bus()
+    sup = Supervisor(ResilienceConfig(**kw), bus, seed=7)
+    return sup, bus
+
+
+def test_supervisor_declares_dead_and_restarts():
+    restarts = []
+    sup, bus = _supervisor()
+    sup.register("worker", lambda: restarts.append(sup.n_ticks))
+    hb = bus.publisher("/heartbeat")
+    from jax_mapping.resilience.supervisor import beat
+    for i in range(5):
+        beat(hb, "worker", i)
+        sup.tick()
+    assert sup.is_alive("worker") and not restarts
+    # Beats stop: dead after missed_beats ticks, restart after backoff.
+    for _ in range(12):
+        sup.tick()
+        if restarts:
+            break
+    assert restarts and sup.n_restarts("worker") == 1
+    kinds = [k for _, n, k, _ in sup.events if n == "worker"]
+    assert kinds == ["dead", "restart"]
+    # The restarted node resumes beating: stays alive, no more restarts.
+    assert sup.is_alive("worker")
+    for i in range(5, 10):
+        beat(hb, "worker", i)
+        sup.tick()
+    assert sup.is_alive("worker") and sup.n_restarts("worker") == 1
+
+
+def test_supervisor_cancels_pending_restart_when_beats_resume():
+    """A node that recovers from a transient stall BEFORE its backoff
+    expires must NOT be restarted — destroying a live node would throw
+    away everything since the last checkpoint to cure a healed hiccup."""
+    restarts = []
+    sup, bus = _supervisor(restart_backoff_base_steps=6)
+    sup.register("worker", lambda: restarts.append(True))
+    hb = bus.publisher("/heartbeat")
+    from jax_mapping.resilience.supervisor import beat
+    for i in range(3):
+        beat(hb, "worker", i)
+        sup.tick()
+    for _ in range(3):
+        sup.tick()                          # stall: declared dead
+    assert not sup.is_alive("worker")
+    beat(hb, "worker", 99)                  # ...but it comes back
+    for _ in range(10):
+        sup.tick()
+        beat(hb, "worker", 100 + sup.n_ticks)
+    assert sup.is_alive("worker")
+    assert not restarts                     # never destroyed
+    kinds = [k for _, n, k, _ in sup.events if n == "worker"]
+    assert kinds == ["dead", "recovered"]
+
+
+def test_supervisor_backoff_grows_exponentially_with_jitter():
+    sup, _ = _supervisor(restart_backoff_jitter=0.25)
+    raw = [sup.backoff_ticks(a) for a in range(6)]
+    # Jitter never exceeds +25%, growth doubles, cap at max: each delay
+    # sits in [base*2^a, 1.25*base*2^a] until the cap.
+    for a, d in enumerate(raw):
+        lo = min(2 * 2 ** a, 16)
+        assert lo <= d <= int(round(lo * 1.25)) + 1
+    # Seeded: a same-seed supervisor reproduces the exact sequence.
+    sup2, _ = _supervisor(restart_backoff_jitter=0.25)
+    assert [sup2.backoff_ticks(a) for a in range(6)] == raw
+
+
+def test_supervisor_restart_failure_reschedules_with_longer_backoff():
+    boom = {"n": 0}
+
+    def flaky():
+        boom["n"] += 1
+        if boom["n"] < 3:
+            raise RuntimeError("still broken")
+
+    sup, _ = _supervisor()
+    sup.register("worker", flaky)
+    for _ in range(60):
+        sup.tick()
+        if boom["n"] >= 3 and sup.is_alive("worker"):
+            break
+    assert boom["n"] == 3                   # two failures, then success
+    kinds = [k for _, n, k, _ in sup.events if n == "worker"]
+    assert kinds == ["dead", "restart_failed", "restart_failed", "restart"]
+    # Backoff_log records growing delays across the failed attempts.
+    delays = [d for name, _, d in sup.backoff_log if name == "worker"]
+    assert len(delays) == 3 and delays[0] <= delays[1] <= delays[2]
+
+
+def test_supervisor_checkpoint_cadence_and_error_tolerance():
+    saves = []
+
+    def saver():
+        saves.append(sup.n_ticks)
+        if len(saves) == 2:
+            raise OSError("disk full")
+
+    sup, _ = _supervisor(checkpoint_every_steps=5)
+    sup.attach_checkpointer(saver)
+    for _ in range(20):
+        sup.tick()
+    assert saves == [5, 10, 15, 20]
+    assert sup.n_checkpoints == 3 and sup.n_checkpoint_errors == 1
+    # The failing save was contained: supervision kept ticking.
+    assert sup.n_ticks == 20
+
+
+# --------------------------------------------------------------- FaultPlan
+
+def test_fault_event_validation():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultEvent(step=0, kind="meteor_strike")
+    with pytest.raises(ValueError, match=">= 0"):
+        FaultEvent(step=-1, kind="lidar_dead")
+
+
+def test_fault_plan_overlapping_windows_compose():
+    """Two overlapping windows on the same resource: the first window's
+    auto-clear must not end the second one early (refcounted holds; the
+    weather knob runs the worst active window, then the baseline)."""
+    class _Bus:
+        def __init__(self):
+            self.drop_prob = 0.05            # pre-chaos baseline
+            self.parts = set()
+
+        def partition(self, *t):
+            self.parts.update(t)
+
+        def heal(self, *t):
+            self.parts.difference_update(t)
+
+        def set_fault_injection(self, drop_prob=None, reorder_prob=None):
+            if drop_prob is not None:
+                self.drop_prob = drop_prob
+
+    class _Stack:
+        def __init__(self):
+            self.bus = _Bus()
+
+    plan = FaultPlan([
+        FaultEvent(step=0, kind="bus_drop", value=0.4, duration=10),
+        FaultEvent(step=5, kind="bus_drop", value=0.2, duration=10),
+    ], seed=0)
+    st = _Stack()
+    plan.apply(st, 0)
+    assert st.bus.drop_prob == 0.4
+    plan.apply(st, 5)
+    assert st.bus.drop_prob == 0.4           # worst active window wins
+    plan.apply(st, 10)                       # first window clears
+    assert st.bus.drop_prob == 0.2           # second still active
+    plan.apply(st, 15)                       # second clears
+    assert st.bus.drop_prob == 0.05          # baseline restored
+    assert plan.done()
+
+    # Same for partitions: overlapping lidar_dead windows, one robot.
+    plan2 = FaultPlan([
+        FaultEvent(step=0, kind="lidar_dead", robot=0, duration=10),
+        FaultEvent(step=5, kind="lidar_dead", robot=0, duration=10),
+    ], seed=0)
+    st2 = _Stack()
+    st2.brain = type("B", (), {"n_robots": 1})()
+    plan2.apply(st2, 0)
+    plan2.apply(st2, 5)
+    plan2.apply(st2, 10)                     # first clear: still held
+    assert "scan" in st2.bus.parts
+    plan2.apply(st2, 15)                     # last window out heals
+    assert "scan" not in st2.bus.parts
+
+    # A stray rejoin_robot with NO kill held must not heal a partition
+    # another window owns.
+    plan3 = FaultPlan([
+        FaultEvent(step=0, kind="lidar_dead", robot=0, duration=20),
+        FaultEvent(step=5, kind="rejoin_robot", robot=0),
+    ], seed=0)
+    st3 = _Stack()
+    st3.brain = type("B", (), {"n_robots": 1})()
+    plan3.apply(st3, 0)
+    plan3.apply(st3, 5)
+    assert "scan" in st3.bus.parts           # lidar_dead still owns it
+    plan3.apply(st3, 20)
+    assert "scan" not in st3.bus.parts
+
+
+def test_random_plan_is_seed_deterministic():
+    a = random_plan(100, n_faults=5, seed=3, n_robots=2)
+    b = random_plan(100, n_faults=5, seed=3, n_robots=2)
+    assert a.events == b.events
+    c = random_plan(100, n_faults=5, seed=4, n_robots=2)
+    assert a.events != c.events
+    for ev in a.events:
+        assert 1 <= ev.step < 90 and 0 <= ev.robot < 2
+
+
+# ----------------------------------------------- HTTP degraded responses
+
+def test_http_status_503_when_brain_lock_wedged(tiny_cfg):
+    world = W.plank_course(96, tiny_cfg.grid.resolution_m, n_planks=3,
+                           seed=3)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=1, http_port=0)
+    try:
+        st.run_steps(3)
+        st.api.lock_timeout_s = 0.1
+        url = f"http://127.0.0.1:{st.api.port}/status"
+        assert json.load(urllib.request.urlopen(url))["connected"]
+        # Wedge the brain's state lock from another thread: the bounded
+        # wait must answer 503 degraded, not hang the worker.
+        st.brain._state_lock.acquire()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url, timeout=10)
+            assert ei.value.code == 503
+            body = json.load(ei.value)
+            assert body["state"] == "degraded"
+        finally:
+            st.brain._state_lock.release()
+        # Healthy again once the lock frees.
+        assert json.load(urllib.request.urlopen(url))["connected"]
+        assert st.api.n_degraded_responses == 1
+    finally:
+        st.shutdown()
+
+
+def test_http_mutations_503_while_mapper_dead(tiny_cfg, tmp_path):
+    """Between the supervisor's dead declaration and the restart, /save
+    answers 503 degraded; after the restart it works again."""
+    world = W.plank_course(96, tiny_cfg.grid.resolution_m, n_planks=3,
+                           seed=3)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=1, http_port=0,
+                          checkpoint_dir=str(tmp_path))
+    try:
+        st.api.checkpoint_dir = str(tmp_path)
+        st.run_steps(5)
+        st.kill_node("jax_mapper")
+        missed = st.cfg.resilience.supervisor_missed_beats
+        st.run_steps(missed + 1)            # dead declared, restart pending
+        assert not st.supervisor.is_alive("jax_mapper")
+        url = f"http://127.0.0.1:{st.api.port}/save"
+        req = urllib.request.Request(url, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        assert json.load(ei.value)["state"] == "degraded"
+        # /status keeps answering (read-only) and exports the death.
+        status = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{st.api.port}/status"))
+        assert status["supervisor"]["dead"] == ["jax_mapper"]
+        st.run_steps(30)                    # backoff elapses, restart runs
+        assert st.supervisor.is_alive("jax_mapper")
+        req = urllib.request.Request(url, method="POST")
+        assert json.load(urllib.request.urlopen(req, timeout=10))[
+            "status"] == "saved"
+    finally:
+        st.shutdown()
+
+
+# -------------------------------------------------- chaos smoke (tier-1)
+
+def _known_cells(grid, thresh=0.5):
+    return int((np.abs(np.asarray(grid)) > thresh).sum())
+
+
+def _chaos_mission(seed, plan_events, steps, tmp_dir, n_robots=2):
+    cfg = tiny_config()
+    world = W.plank_course(96, cfg.grid.resolution_m, n_planks=4, seed=3)
+    st = launch_sim_stack(cfg, world, n_robots=n_robots, realtime=False,
+                          checkpoint_dir=tmp_dir, seed=seed)
+    st.brain.start_exploring()
+    st.brain.reconnect_period_s = 0.0       # probe every tick (stepped time)
+    plan = FaultPlan([FaultEvent(**e) for e in plan_events], seed=seed)
+    st.attach_fault_plan(plan)
+    st.run_steps(steps)
+    grid = np.asarray(st.mapper.merged_grid()).copy()
+    st.shutdown()
+    return st, plan, grid
+
+
+def test_chaos_smoke_single_fault(tmp_path):
+    """Tier-1 chaos: ONE scripted lidar outage mid-mission. The robot
+    walks the NO_LIDAR ladder and back, mapping continues after the
+    heal, and the fault log is exactly the scripted schedule."""
+    events = [dict(step=8, kind="lidar_dead", robot=0, duration=15)]
+    st, plan, grid = _chaos_mission(0, events, 45, str(tmp_path),
+                                    n_robots=1)
+    assert plan.done()
+    assert [d for _, d in plan.log] == ["lidar_dead robot0",
+                                        "clear: lidar_dead robot0"]
+    ladder = [(a, b) for _, a, b in st.health.transitions_for("robot0")]
+    assert (OK, NO_LIDAR) in ladder          # degraded during the outage
+    assert ladder[-1][1] == OK               # healed by mission end
+    assert st.mapper.n_scans_fused > 0
+    assert _known_cells(grid) > 200
+    assert st.bus.n_partition_dropped > 0   # the outage really dropped scans
+
+
+# ---------------------------------------------------- chaos soak (slow)
+
+#: The acceptance plan: lidar transport dead 5 s (50 control ticks at
+#: 10 Hz) mid-mission, one robot killed and later rejoined, the mapper
+#: node killed and supervisor-resumed from checkpoint.
+SOAK_STEPS = 240
+SOAK_EVENTS = [
+    dict(step=40, kind="lidar_dead", robot=0, duration=50),
+    dict(step=70, kind="kill_robot", robot=1, duration=80),
+    dict(step=130, kind="kill_node", name="jax_mapper"),
+]
+
+
+@pytest.mark.slow
+def test_chaos_soak_multi_fault_map_quality_and_determinism(tmp_path):
+    st_f, plan, grid_f = _chaos_mission(0, SOAK_EVENTS, SOAK_STEPS,
+                                        str(tmp_path / "a"))
+    assert plan.done()
+
+    # The mapper died and the supervisor resumed it from checkpoint.
+    assert st_f.supervisor.n_restarts("jax_mapper") == 1
+    kinds = [k for _, n, k, _ in st_f.supervisor.events
+             if n == "jax_mapper"]
+    assert "dead" in kinds and "restart" in kinds
+
+    # Robot 1 was declared DEAD mid-mission and rejoined.
+    ladder1 = [(a, b) for _, a, b in st_f.health.transitions_for("robot1")]
+    assert (NO_LIDAR, DEAD) in ladder1
+    assert ladder1[-1][1] == OK             # rejoined by mission end
+
+    # Robot 0's 5 s lidar outage walked the degrade ladder and healed.
+    ladder0 = [(a, b) for _, a, b in st_f.health.transitions_for("robot0")]
+    assert (OK, NO_LIDAR) in ladder0
+    assert ladder0[-1][1] == OK
+
+    # Map quality vs the fault-free run: the faulted mission must still
+    # deliver >= 55% of the fault-free coverage, and agree on >= 90% of
+    # the cells both runs claim to know (sign of the log-odds evidence).
+    cfg = tiny_config()
+    world = W.plank_course(96, cfg.grid.resolution_m, n_planks=4, seed=3)
+    st0 = launch_sim_stack(cfg, world, n_robots=2, realtime=False, seed=0)
+    st0.brain.start_exploring()
+    st0.run_steps(SOAK_STEPS)
+    grid_0 = np.asarray(st0.mapper.merged_grid()).copy()
+    st0.shutdown()
+
+    known_f, known_0 = _known_cells(grid_f), _known_cells(grid_0)
+    assert known_0 > 1000                   # the baseline actually mapped
+    coverage = known_f / known_0
+    assert coverage >= 0.55, f"coverage ratio {coverage:.2f}"
+
+    both = (np.abs(grid_f) > 0.5) & (np.abs(grid_0) > 0.5)
+    agree = float((np.sign(grid_f[both]) == np.sign(grid_0[both])).mean())
+    assert agree >= 0.90, f"sign agreement {agree:.3f}"
+
+    # Determinism: the SAME seed and plan reproduce the chaos run
+    # bit-for-bit — fault log included (CI-replayable chaos).
+    st_g, plan_g, grid_g = _chaos_mission(0, SOAK_EVENTS, SOAK_STEPS,
+                                          str(tmp_path / "b"))
+    assert plan_g.log == plan.log
+    np.testing.assert_array_equal(grid_f, grid_g)
+    assert st_g.supervisor.backoff_log == st_f.supervisor.backoff_log
+
+
+@pytest.mark.slow
+def test_chaos_soak_corrupt_checkpoint_falls_back(tmp_path):
+    """corrupt_checkpoint + kill_node: the newest auto-checkpoint is
+    truncated before the mapper dies, so the supervisor's resume must
+    fall back to the rotated last-good generation — and still produce a
+    live, growing map."""
+    every = tiny_config().resilience.checkpoint_every_steps   # 25
+    events = [
+        # Two checkpoint generations exist after step 2*every; corrupt
+        # the newest right before killing the mapper.
+        dict(step=2 * every + 5, kind="corrupt_checkpoint"),
+        dict(step=2 * every + 6, kind="kill_node", name="jax_mapper"),
+    ]
+    st, plan, grid = _chaos_mission(1, events, 2 * every + 60,
+                                    str(tmp_path))
+    assert plan.done()
+    assert any("corrupt_checkpoint" in d and "skipped" not in d
+               for _, d in plan.log)
+    assert st.supervisor.n_restarts("jax_mapper") == 1
+    # The resumed mapper kept fusing (map alive after the fallback).
+    assert st.mapper.n_scans_fused > 0
+    assert _known_cells(grid) > 500

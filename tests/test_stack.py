@@ -181,6 +181,58 @@ def test_brain_reconnect_after_io_failure(tiny_cfg):
         st.shutdown()
 
 
+def test_brain_driver_flapping_safe_stop_and_health_ladder(tiny_cfg):
+    """Driver FLAPPING — offline ⇒ reconnect ⇒ offline again within one
+    mission (the reconnect probe's multi-transition case the single-
+    transition test above can't see). Each reconnect must run exactly
+    one safe-stop tick (motors zeroed, LED red) BEFORE any policy
+    output reaches the wheels — stale pre-fault targets never replay —
+    and the shared health registry must walk the full driver ladder
+    twice: ok → offline → recovering → ok, both times."""
+    world = W.empty_arena(96, tiny_cfg.grid.resolution_m)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=1, realtime=False)
+    try:
+        st.brain.start_exploring()
+        st.brain.reconnect_period_s = 0.0
+        st.run_steps(8)
+        assert np.any(st.driver.targets() != 0)      # policy is driving
+
+        def flap():
+            st.driver.fail_reads_after = st.driver._n_reads
+            st.run_steps(1)                          # I/O error: offline
+            assert not st.brain.link_up
+            pre_fault = st.driver.targets().copy()
+            st.driver.fail_reads_after = None
+            st.run_steps(1)          # probe reconnects + safe-stop tick
+            assert st.brain.link_up
+            # No duplicate motor commands: the reconnect tick's only
+            # writes are the zeroing ones — pre-fault targets (still
+            # nonzero in the driver registers) never replay — and the
+            # LED shows the red degraded posture.
+            assert np.any(pre_fault != 0)
+            assert np.all(st.driver.targets() == 0)
+            assert st.driver.leds()[0].tolist() == [32, 0, 0]
+            return pre_fault
+
+        flap()
+        st.run_steps(4)                              # policy resumes
+        assert np.any(st.driver.targets() != 0)
+        flap()                                       # ...and flaps AGAIN
+        st.run_steps(1)                              # recovering -> ok
+
+        ladder = [(a, b) for _, a, b in
+                  st.health.transitions_for("driver")]
+        assert ladder == [("ok", "offline"), ("offline", "recovering"),
+                          ("recovering", "ok"),
+                          ("ok", "offline"), ("offline", "recovering"),
+                          ("recovering", "ok")]
+        # Each outage counted exactly one I/O error: the probe path
+        # reconnected without spurious extra drops.
+        assert st.brain.n_io_errors == 2
+    finally:
+        st.shutdown()
+
+
 def test_stack_survives_scan_loss(tiny_cfg):
     """Best-Effort drops must not wedge the mapper (report.pdf §V.A)."""
     world = W.empty_arena(96, tiny_cfg.grid.resolution_m)
