@@ -89,7 +89,8 @@ class ThymioBrain(Node):
                  tf: Optional[TfTree] = None, n_robots: int = 1,
                  connect_retries: int = 3, connect_timeout_s: float = 3.0,
                  reconnect_period_s: float = 2.0,
-                 health: Optional[FleetHealth] = None):
+                 health: Optional[FleetHealth] = None,
+                 recovery=None):
         super().__init__("thymio_brain", bus, tf)
         self.cfg = cfg
         self.driver = driver
@@ -101,6 +102,11 @@ class ThymioBrain(Node):
         #: node FEEDS it (scan arrivals, tick clock, driver link) and
         #: READS the coast mask. None = pre-resilience behavior.
         self._health = health
+        #: Estimator guardrails (recovery/manager.py): this node runs
+        #: the anti-stuck recovery ladder each tick and advances the
+        #: frontier blacklist's control-tick clock. None = pre-guardrail
+        #: behavior exactly.
+        self._recovery = recovery
 
         self._state_lock = threading.Lock()
         self.poses = np.zeros((n_robots, 3), np.float32)
@@ -163,6 +169,10 @@ class ThymioBrain(Node):
         # (bridge/planner.py frontier waypoints): preferred over the raw
         # target when fresh, reachable, and planned for the SAME target.
         self._frontier_wps: dict = {}
+        #: Per-stream high-water header stamps for the goal-state
+        #: caches (_fresher): reorder protection that survives the TTL
+        #: prune deleting the entries themselves.
+        self._goal_stamp_hwm: dict = {}
         self.create_subscription("/frontier_waypoints",
                                  self._frontier_wp_cb)
 
@@ -219,19 +229,62 @@ class ThymioBrain(Node):
         self._log(f"navigation goal set for robot {i}: "
                   f"({x:.2f}, {y:.2f}) — engages while exploring")
 
+    def _fresher(self, key, msg) -> bool:
+        """Goal-state reorder watermark: under Best-Effort delivery (and
+        the chaos bus's reorder weather) a STALE /frontiers or waypoint
+        message can arrive after a fresher one — accepting it would
+        resurrect an assignment the mapper has since dropped and send a
+        robot seeking a goal that no longer exists. The high-water
+        stamps live in their OWN map (`_goal_stamp_hwm`), deliberately
+        NOT in the cached entries: the TTL prune deletes entries, and a
+        watermark that died with its entry would wave through a stale
+        message flushed after a TTL-length gap (a healed reorder window
+        draining its backlog past a dead mapper). Caller holds the
+        state lock."""
+        hwm = self._goal_stamp_hwm.get(key)
+        if hwm is not None and msg.header.stamp < hwm:
+            return False
+        self._goal_stamp_hwm[key] = msg.header.stamp
+        return True
+
     def _waypoint_cb(self, msg) -> None:
         with self._state_lock:
-            self._waypoints[int(getattr(msg, "robot", 0))] = \
-                (msg, self.n_ticks)
+            r = int(getattr(msg, "robot", 0))
+            if self._fresher(("wp", r), msg):
+                self._waypoints[r] = (msg, self.n_ticks)
 
     def _frontiers_cb(self, msg) -> None:
         with self._state_lock:
-            self._frontiers = (msg, self.n_ticks)
+            if self._fresher("frontiers", msg):
+                self._frontiers = (msg, self.n_ticks)
 
     def _frontier_wp_cb(self, msg) -> None:
         with self._state_lock:
-            self._frontier_wps[int(getattr(msg, "robot", 0))] = \
-                (msg, self.n_ticks)
+            r = int(getattr(msg, "robot", 0))
+            if self._fresher(("fwp", r), msg):
+                self._frontier_wps[r] = (msg, self.n_ticks)
+
+    def _prune_stale_goal_state(self) -> None:
+        """Expire frontier-goal state past its TTL (once per tick).
+
+        The TTL gates at the READ sites already keep stale entries from
+        steering; this prune makes expiry STRUCTURAL — the entries are
+        deleted, so no future read path can forget the gate, a dead
+        mapper's last assignment cannot linger in memory for the rest of
+        the mission, and the waypoint dicts stay bounded."""
+        rate = self.cfg.robot.control_rate_hz
+        ttl_wp = self.cfg.planner.waypoint_ttl_s * rate
+        ttl_fr = self.cfg.frontier.seek_ttl_s * rate
+        with self._state_lock:
+            self._waypoints = {
+                r: (m, t) for r, (m, t) in self._waypoints.items()
+                if self.n_ticks - t <= ttl_wp}
+            self._frontier_wps = {
+                r: (m, t) for r, (m, t) in self._frontier_wps.items()
+                if self.n_ticks - t <= ttl_wp}
+            if self._frontiers is not None \
+                    and self.n_ticks - self._frontiers[1] > ttl_fr:
+                self._frontiers = None
 
     def _apply_frontier_goals(self, goals_xy: np.ndarray,
                               goal_valid: np.ndarray) -> None:
@@ -276,6 +329,41 @@ class ThymioBrain(Node):
                     and np.hypot(wp.goal_x - targets[a][0],
                                  wp.goal_y - targets[a][1]) <= tol):
                 goals_xy[i] = (wp.x, wp.y)
+
+    def _blacklist_current_goal(self, i: int) -> None:
+        """Anti-stuck rung 3 — goal reassignment: robot i has proven its
+        current goal unreachable-in-practice (two maneuver rungs did not
+        free it). A manual nav goal is CANCELLED (the escape hatch — the
+        operator's goal is the thing the robot cannot reach); a frontier
+        assignment is blacklisted with TTL so the auction's post-pass
+        (mapper._apply_blacklist) hands robot i a different frontier."""
+        with self._state_lock:
+            manual = self._nav_goals[i]
+            entry = self._frontiers
+        if manual is not None:
+            # Cancelled, NOT blacklisted: the operator deliberately
+            # pointed at this area — barring every frontier within the
+            # blacklist tolerance of it would suppress exploration
+            # around the very point they care about. Cancelling reverts
+            # the robot to frontier exploration, which approaches the
+            # region by other routes.
+            self.cancel_goal(i)
+            self._log(f"anti-stuck: unreachable manual goal cancelled "
+                      f"(robot {i})")
+            return
+        if entry is None:
+            return
+        msg, _ = entry
+        assign = np.asarray(msg.assignment)
+        if i >= len(assign):
+            return
+        a = int(assign[i])
+        targets = np.asarray(msg.targets_xy, np.float32)
+        if 0 <= a < len(targets):
+            self._recovery.blacklist.add(
+                i, (float(targets[a][0]), float(targets[a][1])))
+            self._log(f"anti-stuck: frontier ({targets[a][0]:.2f}, "
+                      f"{targets[a][1]:.2f}) blacklisted for robot {i}")
 
     def nav_goal(self) -> Optional[tuple]:
         """Robot 0's navigation goal (planner reads the brain's copy so
@@ -342,7 +430,7 @@ class ThymioBrain(Node):
         half_w = r.wheel_base_m / 2.0
         left = (cmd.linear_x - cmd.angular_z * half_w) / k
         right = (cmd.linear_x + cmd.angular_z * half_w) / k
-        lim = 600.0                                   # Thymio target range
+        lim = float(r.motor_limit_units)              # Thymio target range
         return (int(np.clip(left, -lim, lim)), int(np.clip(right, -lim, lim)))
 
     def start_exploring(self) -> None:
@@ -442,6 +530,14 @@ class ThymioBrain(Node):
         now = time.monotonic()
         if self._health is not None:
             self._health.note_tick(self.n_ticks)
+        # Structural expiry of stale frontier-goal state runs regardless
+        # of recovery: the read-site TTL gates already ignore these
+        # entries, deletion just makes that un-forgettable (and bounds
+        # the dicts) — behavior under the gates is unchanged.
+        self._prune_stale_goal_state()
+        if self._recovery is not None:
+            # One clock for every recovery TTL (blacklist expiry).
+            self._recovery.blacklist.note_tick(self.n_ticks)
         if not self.link_up:
             if self._health is not None:
                 self._health.note_driver(DRIVER_OFFLINE)
@@ -529,6 +625,28 @@ class ThymioBrain(Node):
             leds_np = np.array(leds)
 
             manual = self._manual_targets(now)
+            if self._recovery is not None:
+                # Anti-stuck recovery ladder: detect commanded-but-
+                # motionless robots, escalate rotate -> backup ->
+                # blacklist. Detection skips coasting / idle / manual
+                # robots; maneuver overrides yield to the IR emergency
+                # pivot (the shield stays the last word on contact) and
+                # to manual drive (the operator IS the safety system).
+                active = exploring & ~coast
+                if manual is not None:
+                    active[0] = False
+                overrides, blacklist_req = self._recovery.antistuck.step(
+                    self.n_ticks, new_poses, targets_np, active)
+                # The IR emergency from the HOST-side prox snapshot (the
+                # same predicate the policy's state 2 computes on
+                # device) — no extra device fetch in the hot path.
+                ir_stop = prox[:, :5].max(axis=1) > cfg.robot.ir_threshold
+                for r, tgt in overrides.items():
+                    if not ir_stop[r]:              # IR pivot outranks
+                        targets_np[r] = tgt
+                        leds_np[r] = (0, 32, 32)    # cyan: recovery
+                for r in blacklist_req:
+                    self._blacklist_current_goal(r)
             if manual is not None:
                 targets_np[0] = manual
                 leds_np[0] = (32, 32, 32)   # white: manual drive (extension
@@ -541,6 +659,12 @@ class ThymioBrain(Node):
                 if manual is not None:
                     coast_led[0] = False
                 leds_np[coast_led] = (32, 16, 0)
+                if self._health is not None:
+                    # Magenta = estimator diverged (quarantined, the
+                    # mapper is relocalizing it) — distinguishable from
+                    # the lidar-silent orange at a glance.
+                    div = self._health.diverged_mask()[:R] & coast_led
+                    leds_np[div] = (32, 0, 32)
 
             for i in range(R):
                 self.driver[i][MOTOR_LEFT_TARGET] = int(targets_np[i, 0])

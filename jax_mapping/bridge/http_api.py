@@ -75,7 +75,8 @@ class MapApiServer:
                  extra_status: Optional[Callable[[], dict]] = None,
                  mapper=None, checkpoint_dir: str = "checkpoints",
                  voxel_mapper=None, planner=None, health=None,
-                 supervisor=None, lock_timeout_s: Optional[float] = 2.0,
+                 supervisor=None, recovery=None,
+                 lock_timeout_s: Optional[float] = 2.0,
                  socket_timeout_s: Optional[float] = 30.0):
         self.bus = bus
         self.brain = brain
@@ -91,6 +92,10 @@ class MapApiServer:
         #: {"state": "degraded"} instead of a hung worker thread).
         self.health = health
         self.supervisor = supervisor
+        #: Estimator guardrails (recovery/manager.py): watchdog states,
+        #: quarantine/relocalization counters, anti-stuck ladder and
+        #: frontier blacklist ride along on /status and /metrics.
+        self.recovery = recovery
         self.lock_timeout_s = lock_timeout_s
         self.n_degraded_responses = 0
         self._lock = threading.Lock()
@@ -214,6 +219,16 @@ class MapApiServer:
                 body["health"] = self.health.snapshot()
             if self.supervisor is not None:
                 body["supervisor"] = self.supervisor.status()
+            if self.recovery is not None:
+                # The estimator-guardrail picture: per-robot watchdog
+                # state/score, quarantine + relocalization progress,
+                # anti-stuck ladder modes, live blacklist entries.
+                body["recovery"] = self.recovery.snapshot()
+                if self.mapper is not None:
+                    body["recovery"]["n_scans_quarantined"] = \
+                        self.mapper.n_scans_quarantined
+                    body["recovery"]["n_relocalizations"] = \
+                        self.mapper.n_relocalizations
             if self.mapper is not None:
                 # Mapping-pipeline health alongside the brain's motion
                 # fields — from the attached nodes directly, so every
@@ -582,10 +597,12 @@ class MapApiServer:
             ]
         if self.health is not None:
             # Degraded-mode ladder as gauges: ok=0 no_lidar=1 dead=2 per
-            # robot, driver ok=0 offline=1 recovering=2 — thresholdable
-            # without string parsing.
+            # robot (estimator_diverged=3 — a distinct severity, not a
+            # silence rung), driver ok=0 offline=1 recovering=2 —
+            # thresholdable without string parsing.
             snap = self.health.snapshot()
             rank = {"ok": 0, "no_lidar": 1, "dead": 2,
+                    "estimator_diverged": 3,
                     "offline": 1, "recovering": 2}
             lines += ["# TYPE jax_mapping_health_robot_state gauge"]
             lines += [
@@ -611,6 +628,32 @@ class MapApiServer:
                 "# TYPE jax_mapping_supervisor_checkpoints_total counter",
                 f"jax_mapping_supervisor_checkpoints_total "
                 f"{sup['checkpoints']}",
+            ]
+        if self.recovery is not None:
+            rec = self.recovery.snapshot()
+            wd = rec["watchdog"]
+            lines += ["# TYPE jax_mapping_recovery_estimator_score gauge"]
+            lines += [
+                f'jax_mapping_recovery_estimator_score{{robot="{i}"}} {s}'
+                for i, s in enumerate(wd["scores"])]
+            lines += [
+                "# TYPE jax_mapping_recovery_diverge_events_total counter",
+                f"jax_mapping_recovery_diverge_events_total "
+                f"{wd['n_diverge_events']}",
+                "# TYPE jax_mapping_recovery_readmits_total counter",
+                f"jax_mapping_recovery_readmits_total {wd['n_readmits']}",
+                "# TYPE jax_mapping_recovery_reloc_attempts_total counter",
+                f"jax_mapping_recovery_reloc_attempts_total "
+                f"{rec['relocalization']['n_attempts']}",
+                "# TYPE jax_mapping_recovery_reloc_verified_total counter",
+                f"jax_mapping_recovery_reloc_verified_total "
+                f"{rec['relocalization']['n_verified']}",
+                "# TYPE jax_mapping_recovery_stuck_detections_total counter",
+                f"jax_mapping_recovery_stuck_detections_total "
+                f"{rec['antistuck']['n_stuck_detections']}",
+                "# TYPE jax_mapping_recovery_blacklisted_total counter",
+                f"jax_mapping_recovery_blacklisted_total "
+                f"{rec['blacklist']['n_blacklisted']}",
             ]
         lines += [
             "# TYPE jax_mapping_http_degraded_responses_total counter",

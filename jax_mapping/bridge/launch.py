@@ -54,6 +54,7 @@ class Stack:
     planner: Optional[object] = None         # PlannerNode when cfg.planner.enabled
     health: Optional[FleetHealth] = None     # shared degraded-mode registry
     supervisor: Optional[Supervisor] = None  # heartbeat watch + restarts
+    recovery: Optional[object] = None        # estimator guardrails (RecoveryManager)
     fault_plan: Optional[object] = None      # attached FaultPlan, if any
     #: Auto-checkpoint file the supervisor saves to / resumes the mapper
     #: from ("" = auto-checkpointing disabled; pass checkpoint_dir to
@@ -148,7 +149,7 @@ class Stack:
             except (FileNotFoundError, CheckpointCorrupt):
                 states = None                # no intact generation: blank
         new = MapperNode(self.cfg, self.bus, tf=self.tf, n_robots=n,
-                         health=self.health)
+                         health=self.health, recovery=self.recovery)
         anchors = self.brain.poses.copy()
         if states is not None:
             new.restore_states(states, anchor_poses=anchors)
@@ -205,13 +206,29 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
                   realtime=realtime, depth_cam=depth_cam)
     health = (FleetHealth(cfg.resilience, n_robots)
               if cfg.resilience.enabled else None)
+    recovery = None
+    if cfg.recovery.enabled and health is not None:
+        # Estimator guardrails (recovery/): ONE manager shared by the
+        # brain (anti-stuck ladder, blacklist clock), the mapper
+        # (watchdog feed, quarantine + relocalization, blacklist
+        # post-pass) and the HTTP plane (export) — the FleetHealth
+        # wiring pattern. enabled=False keeps every node on its
+        # pre-guardrail path exactly. The guardrails ACT through the
+        # health ladder (coast, LED, frontier reassignment, /status),
+        # so they require resilience: quarantining a robot nobody
+        # coasts or reassigns would silently stall exploration with no
+        # operator-visible signal.
+        from jax_mapping.recovery import RecoveryManager
+        recovery = RecoveryManager(cfg.recovery, n_robots,
+                                   robot=cfg.robot)
     brain = ThymioBrain(cfg, bus, driver, tf=tf, n_robots=n_robots,
-                        health=health)
+                        health=health, recovery=recovery)
     # Start calibrated: the odom frame origin is the boot pose; expressing
     # boot poses in the map frame up front keeps multi-robot maps aligned
     # (the fleet model's convention, models/fleet.py init_fleet_state).
     brain.poses = sim.truth_poses().copy()
-    mapper = MapperNode(cfg, bus, tf=tf, n_robots=n_robots, health=health)
+    mapper = MapperNode(cfg, bus, tf=tf, n_robots=n_robots, health=health,
+                        recovery=recovery)
     for i, st in enumerate(mapper.states):
         mapper.states[i] = st._replace(pose=jnp.asarray(brain.poses[i]))
 
@@ -248,7 +265,7 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
         api = MapApiServer(bus, brain=brain, port=http_port,
                            mapper=mapper, voxel_mapper=voxel_mapper,
                            planner=planner, health=health,
-                           supervisor=supervisor,
+                           supervisor=supervisor, recovery=recovery,
                            lock_timeout_s=cfg.resilience.http_lock_timeout_s)
         api.serve_thread()
 
@@ -260,7 +277,7 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     stack = Stack(cfg=cfg, bus=bus, tf=tf, driver=driver, sim=sim,
                   brain=brain, mapper=mapper, api=api, executor=executor,
                   voxel_mapper=voxel_mapper, planner=planner,
-                  health=health, supervisor=supervisor)
+                  health=health, supervisor=supervisor, recovery=recovery)
     if supervisor is not None:
         # Registration needs the Stack (restarter + checkpointer close
         # over it), so it happens after construction. The brain has no

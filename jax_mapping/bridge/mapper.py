@@ -53,7 +53,8 @@ class MapperNode(Node):
 
     def __init__(self, cfg: SlamConfig, bus: Bus,
                  tf: Optional[TfTree] = None, n_robots: int = 1,
-                 tick_period_s: Optional[float] = None, health=None):
+                 tick_period_s: Optional[float] = None, health=None,
+                 recovery=None):
         super().__init__("jax_mapper", bus, tf)
         import jax.numpy as jnp
 
@@ -116,6 +117,17 @@ class MapperNode(Node):
         #: for the dead-robot frontier reassignment; None = pre-
         #: resilience behavior.
         self._health = health
+        #: Estimator guardrails (recovery/manager.py) — watchdog feed,
+        #: quarantine + relocalization, frontier blacklist. None =
+        #: pre-guardrail behavior exactly (every use gates on it).
+        self._recovery = recovery
+        #: Per-robot quarantined (scan, odom) evidence while diverged —
+        #: BUFFERED, never fused (the paired poses are exactly what
+        #: diverged); bounded by RecoveryConfig.quarantine_cap.
+        self._quarantine: List[List] = [[] for _ in range(n_robots)]
+        self.n_scans_quarantined = 0
+        self.n_quarantine_overflow = 0
+        self.n_relocalizations = 0
         #: Stamp of the newest scan accepted for fusion, per robot: a
         #: scan OLDER than this arrived late (cross-tick reorder, a
         #: healed partition flushing a stale queue) and is rejected —
@@ -164,26 +176,33 @@ class MapperNode(Node):
     # -- callbacks ----------------------------------------------------------
 
     def _initialpose_cb(self, msg) -> None:
-        jnp = self._jnp
-        pose = jnp.asarray([float(msg.x), float(msg.y), float(msg.theta)],
-                           dtype="float32")
-        with self._state_lock:
-            # A user-asserted pose starts a FRESH chain: keeping the old
-            # graph would leave an odometry edge spanning the teleport,
-            # and the next loop optimisation would drag the estimate back
-            # toward the pre-reset frame (silently undoing the user). The
-            # map is kept — mapping continues in the same grid from the
-            # asserted pose (slam_toolbox's localization-reset semantics).
-            fresh = self._S.init_state(self.cfg, pose0=pose)
-            # fresh.last_key_pose forces an immediate key scan, promptly
-            # re-anchoring graph node 0 at the asserted pose. The map is
-            # kept: the fresh state aliases the shared grid.
-            self.states[0] = fresh._replace(grid=self.shared_grid)
-            self._state_gen[0] += 1
-            self._prev_paired[0] = None
-            self._prev_matched[0] = False
-            self._correction[0] = None
+        self.reset_robot_pose(0, [float(msg.x), float(msg.y),
+                                  float(msg.theta)])
         M.counters.inc("mapper.initialpose_resets")
+
+    def reset_robot_pose(self, i: int, pose) -> None:
+        """Re-anchor robot i's chain at an asserted pose, keeping the
+        map — slam_toolbox's localization-reset semantics. ONE
+        implementation for both assertion ingresses: the RViz
+        SetInitialPose tool (`_initialpose_cb`, robot 0) and the
+        recovery relocalizer's verified re-anchor (any robot).
+
+        An asserted pose starts a FRESH chain: keeping the old graph
+        would leave an odometry edge spanning the teleport, and the
+        next loop optimisation would drag the estimate back toward the
+        pre-reset frame (silently undoing the assertion).
+        fresh.last_key_pose forces an immediate key scan, promptly
+        re-anchoring graph node 0 at the asserted pose. The map is
+        kept: the fresh state aliases the shared grid."""
+        jnp = self._jnp
+        pose = jnp.asarray(np.asarray(pose, np.float32))
+        with self._state_lock:
+            fresh = self._S.init_state(self.cfg, pose0=pose)
+            self.states[i] = fresh._replace(grid=self.shared_grid)
+            self._state_gen[i] += 1
+            self._prev_paired[i] = None
+            self._prev_matched[i] = False
+            self._correction[i] = None
 
     # -- checkpoint surface --------------------------------------------------
 
@@ -392,16 +411,35 @@ class MapperNode(Node):
                 self._scan_q[i].clear()
 
         for i, items in enumerate(work):
+            if items and self._diverged(i):
+                # Quarantine rung: this robot's estimator is declared
+                # lost — its evidence buffers (never fuses) and every
+                # tick attempts a wide-window relocalization with the
+                # freshest scan; a verified re-anchor re-admits it.
+                self._quarantine_and_relocalize(i, items)
+                continue
             W = max(2, self.cfg.fleet.batch_scans)
             k = 0
             while k < len(items):
+                if self._diverged(i):
+                    # A step above just DECLARED divergence: the rest of
+                    # this tick's queue is the same fault's evidence and
+                    # quarantines with it (the watchdog's already-
+                    # diverged early-exit would otherwise let later
+                    # chunks fuse — the exact corruption quarantine
+                    # exists to prevent).
+                    self._quarantine_items(i, items[k:])
+                    break
                 if len(items) - k >= W:
                     self._step_window(i, items[k:k + W])
                     k += W
                 else:
                     self._step_single(i, *items[k])
                     k += 1
-            if items:
+            if items and not self._diverged(i):
+                # A step above may have DECLARED divergence: freezing
+                # the correction TF at the last healthy step beats
+                # re-asserting the diverged estimate.
                 self._publish_correction(i, *items[-1])
 
         if any(work):
@@ -437,7 +475,13 @@ class MapperNode(Node):
                 self._last_cov[i] = np.asarray(diag.cov, np.float32)
         if self.cfg.resilience.enabled and \
                 agreement < self.cfg.resilience.window_agreement_reject:
-            self._reject_low_agreement(i)
+            self._reject_low_agreement(i, items)
+            return
+        if self._observe_watchdog(i, matched, bool(diag.key_added),
+                                  agreement, window=True):
+            # The declaring step's own evidence is the first quarantined
+            # window — by definition it is what pushed the score over.
+            self._quarantine_items(i, items)
             return
         installed = self._finish_step(i, state, items[-1][1], W, matched,
                                       closed, base_grid, base_gen,
@@ -483,21 +527,38 @@ class MapperNode(Node):
             # steps report a neutral 1.0 — they add no evidence).
             # enabled=False restores pre-resilience fusion exactly (the
             # baseline-comparison contract of the flag).
-            self._reject_low_agreement(i)
+            self._reject_low_agreement(i, [(scan, od)])
+            return
+        if self._observe_watchdog(i, matched, bool(diag.key_added),
+                                  agreement, window=False,
+                                  ranges=ranges, grid=base_grid,
+                                  pose=state.pose):
+            self._quarantine_items(i, [(scan, od)])
             return
         self._finish_step(i, state, od, 1, matched, closed, base_grid,
                           base_gen, scan.header.stamp)
 
-    def _reject_low_agreement(self, i: int) -> None:
+    def _reject_low_agreement(self, i: int,
+                              items: Optional[List] = None) -> None:
         """Degraded-mode gate, shared by the window and single paths:
         near-zero agreement means essentially ALL of the evidence landed
         in known-free space — a garbage burst (glitching sensor, grossly
         misanchored odometry) that must not overwrite known-good map.
         Nothing installs; like a stale-step drop, the pairing chain
-        resets so the next step bootstraps cleanly."""
+        resets so the next step bootstraps cleanly.
+
+        The rejection is also a maximum-badness watchdog observation
+        (recovery/): a STREAK of garbage bursts is estimator divergence,
+        and the declaring burst's evidence moves to the quarantine
+        buffer like any other diverged-robot evidence."""
         with self._state_lock:
             self._prev_paired[i] = None
             self._prev_matched[i] = False
+        if self._recovery is not None \
+                and self._recovery.watchdog.observe_rejected(i):
+            self._declare_diverged(i)
+            if items:
+                self._quarantine_items(i, items)
         # Counters outside the lock (single tick-thread writer, like
         # every mapper counter). A rejected step is still a
         # low-agreement OBSERVATION: that telemetry counter keeps its
@@ -507,6 +568,93 @@ class MapperNode(Node):
         M.counters.inc("mapper.windows_rejected_low_agreement")
         self.n_low_agreement_windows += 1
         M.counters.inc("mapper.low_agreement_windows")
+
+    # -- estimator guardrails (recovery/) ------------------------------------
+
+    def _diverged(self, i: int) -> bool:
+        return (self._recovery is not None
+                and self._recovery.watchdog.is_diverged(i))
+
+    def _observe_watchdog(self, i: int, matched: bool, key_added: bool,
+                          agreement: float, window: bool,
+                          ranges=None, grid=None, pose=None) -> bool:
+        """Feed one step's health sample to the divergence watchdog;
+        returns True when the observation DECLARES divergence (the
+        caller then quarantines the step's evidence instead of
+        installing it).
+
+        Observation policy — FULL scan cadence: key steps carry the
+        diag's pre-fusion agreement + match telemetry; window steps
+        carry the window mean; sub-gate single steps sample
+        models.slam.scan_agreement at the post-step pose (their diag
+        agreement is a neutral 1.0 — no evidence was added — but the
+        SCAN is still a health sample, and a ghosting sensor fires
+        every scan, not every 0.1 m of travel)."""
+        if self._recovery is None:
+            return False
+        if not key_added and not window and ranges is not None:
+            agreement = float(self._S.scan_agreement(
+                self.cfg, grid, self._jnp.asarray(ranges), pose))
+        cov_trace = None
+        if key_added and matched and self._last_cov[i] is not None:
+            cov_trace = float(np.sum(self._last_cov[i]))
+        declared = self._recovery.watchdog.observe(
+            i, key_added, matched, agreement, cov_trace)
+        if declared:
+            self._declare_diverged(i)
+        return declared
+
+    def _declare_diverged(self, i: int) -> None:
+        """ESTIMATOR_DIVERGED side effects: the fleet health ladder gets
+        the rung (brain coasts the robot, auction reassigns its
+        frontier), the relocalizer's streak starts clean."""
+        if self._health is not None:
+            self._health.note_estimator(i, True)
+        self._recovery.relocalizer.reset(i)
+        M.counters.inc("mapper.estimator_diverged_events")
+
+    def _quarantine_items(self, i: int, items: List) -> None:
+        """Buffer (scan, odom) pairs instead of fusing them; bounded —
+        oldest evidence drops first (its pairing is the most stale)."""
+        q = self._quarantine[i]
+        q.extend(items)
+        cap = self.cfg.recovery.quarantine_cap
+        overflow = len(q) - cap
+        if overflow > 0:
+            del q[:overflow]
+            self.n_quarantine_overflow += overflow
+        self.n_scans_quarantined += len(items)
+        M.counters.inc("mapper.scans_quarantined", len(items))
+
+    def _quarantine_and_relocalize(self, i: int, items: List) -> None:
+        """One quarantine tick for robot i: buffer the evidence, then
+        attempt relocalization with the freshest scan against the live
+        shared map (clean by construction — this robot's garbage was
+        never fused). A verified re-anchor re-admits the robot through
+        the SetInitialPose path semantics (fresh chain, kept map)."""
+        self._quarantine_items(i, items)
+        scan, _od = items[-1]
+        ranges = self._pad_ranges(scan)
+        with self._state_lock:
+            grid = self.shared_grid
+            guess = np.asarray(self.states[i].pose, np.float32)
+        pose = self._recovery.relocalizer.attempt_for(
+            i, self.cfg, grid, ranges, guess)
+        M.counters.inc("mapper.relocalization_attempts")
+        if pose is None:
+            return
+        self.reset_robot_pose(i, pose)
+        with self._state_lock:
+            # Quarantined-era stragglers still in flight are older than
+            # the verifying scan — the stale watermark rejects them.
+            self._last_accepted_stamp[i] = max(
+                self._last_accepted_stamp[i], scan.header.stamp)
+            self._quarantine[i].clear()
+        self._recovery.watchdog.readmit(i)
+        if self._health is not None:
+            self._health.note_estimator(i, False)
+        self.n_relocalizations += 1
+        M.counters.inc("mapper.relocalizations")
 
     def _finish_step(self, i: int, state, od: Odometry, n_scans: int,
                      matched: bool, closed: bool, base_grid,
@@ -768,18 +916,21 @@ class MapperNode(Node):
 
     def _reassign_dead(self, assignment: np.ndarray, targets: np.ndarray,
                        poses: np.ndarray) -> np.ndarray:
-        """Strip DEAD robots from the frontier auction's output and hand
-        their orphaned targets to the nearest alive robot.
+        """Strip unavailable robots from the frontier auction's output
+        and hand their orphaned targets to the nearest available robot.
 
-        The device-side auction cannot see health (poses is a static
+        Unavailable = DEAD (cannot map) or ESTIMATOR_DIVERGED (coasting
+        while the mapper relocalizes it — a frontier pinned to it would
+        stall until the re-anchor): FleetHealth.assignable_mask. The
+        device-side auction cannot see health (poses is a static
         (R, ...) batch), so the fleet-reassignment contract lives here
-        on the host: a dead robot's assignment becomes -1 (the brain and
+        on the host: the robot's assignment becomes -1 (the brain and
         planner stop steering/planning for it), and any frontier ONLY it
-        was assigned to transfers to the closest living robot — mid-
+        was assigned to transfers to the closest available robot — mid-
         mission robot loss shrinks the fleet, not the explored map."""
         if self._health is None or len(assignment) == 0:
             return assignment
-        alive = self._health.alive_mask()[:len(assignment)]
+        alive = self._health.assignable_mask()[:len(assignment)]
         if alive.all() or not alive.any():
             return assignment
         assignment = assignment.copy()
@@ -794,6 +945,44 @@ class MapperNode(Node):
                                  poses[live_idx, 1] - targets[a, 1])
                 assignment[live_idx[int(np.argmin(dists))]] = a
                 M.counters.inc("mapper.frontiers_reassigned")
+        return assignment
+
+    def _apply_blacklist(self, assignment: np.ndarray,
+                         targets: np.ndarray,
+                         poses: np.ndarray) -> np.ndarray:
+        """Anti-stuck rung 3 (recovery/antistuck.FrontierBlacklist):
+        a robot repeatedly stuck en route to a frontier has proven it
+        unreachable-in-practice — strip the assignment and hand the
+        robot the nearest frontier NOT blacklisted for it (goal
+        reassignment), or -1 (blind cruise under the shield) when none
+        remains. Per-robot: the frontier stays auctionable to robots
+        approaching from elsewhere. Tolerance = one clustering cell,
+        the same echo tolerance the brain's waypoint match uses."""
+        if self._recovery is None or len(assignment) == 0 \
+                or len(targets) == 0:
+            return assignment
+        bl = self._recovery.blacklist
+        if not bl.entries():
+            return assignment
+        tol = (self.cfg.grid.resolution_m * self.cfg.frontier.downsample
+               * self.cfg.frontier.cluster_downsample)
+        assignment = assignment.copy()
+        for i in range(len(assignment)):
+            a = int(assignment[i])
+            if not 0 <= a < len(targets) \
+                    or not bl.is_blacklisted(i, targets[a], tol):
+                continue
+            allowed = [j for j in range(len(targets))
+                       if not bl.is_blacklisted(i, targets[j], tol)]
+            if allowed:
+                p = poses[i] if i < len(poses) else targets[a]
+                dists = [float(np.hypot(targets[j][0] - p[0],
+                                        targets[j][1] - p[1]))
+                         for j in allowed]
+                assignment[i] = allowed[int(np.argmin(dists))]
+            else:
+                assignment[i] = -1
+            M.counters.inc("mapper.frontiers_blacklist_redirects")
         return assignment
 
     def publish_frontiers(self) -> None:
@@ -818,6 +1007,7 @@ class MapperNode(Node):
         targets = np.asarray(fr.targets)
         assignment = self._reassign_dead(np.asarray(fr.assignment),
                                          targets, poses)
+        assignment = self._apply_blacklist(assignment, targets, poses)
         hdr = Header.now("map")    # one stamp for the whole publish cycle
         self.frontiers_pub.publish(FrontierArray(
             header=hdr,

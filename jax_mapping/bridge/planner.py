@@ -118,6 +118,13 @@ class PlannerNode(Node):
         self._goal = (x, y)
 
     def _frontiers_cb(self, msg) -> None:
+        # Reorder watermark (the brain's _fresher rule): a stale
+        # /frontiers message arriving after a fresher one must not
+        # resurrect assignments the mapper has since dropped — the
+        # planner would burn a BFS per period toward each of them.
+        if self._frontiers is not None and \
+                msg.header.stamp < self._frontiers[0].header.stamp:
+            return
         self._frontiers = (msg, self._n_ticks)
 
     def _current_goal(self) -> Optional[tuple]:
@@ -280,14 +287,16 @@ class PlannerNode(Node):
         from jax_mapping.ops import planner as P
         fields: dict = {}
         plan_lo = None                       # fetched once, on first use
-        alive = (self._health.alive_mask()
+        # assignable = not DEAD and not ESTIMATOR_DIVERGED: a diverged
+        # robot coasts while the mapper relocalizes it — the auction's
+        # post-pass has already handed its frontier elsewhere, so a
+        # waypoint BFS for it is pure waste.
+        avail = (self._health.assignable_mask()
                  if self._health is not None else None)
         for i in range(min(self.mapper.n_robots, len(assign))):
             if i in manual_robots:
                 continue                     # a manual goal owns robot i
-            if alive is not None and i < len(alive) and not alive[i]:
-                # DEAD robot (the mapper's auction post-pass has already
-                # handed its frontier to a living one): no waypoint.
+            if avail is not None and i < len(avail) and not avail[i]:
                 self.n_plans_skipped_dead += 1
                 continue
             a = int(assign[i])

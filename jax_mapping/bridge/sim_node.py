@@ -74,9 +74,56 @@ class SimNode(Node):
                 self.create_publisher(f"{robot_ns(i, R)}depth",
                                       qos_sensor_data)
                 for i in range(R)]
+        # Adversarial sensor-fault state (resilience/faultplan.py kinds
+        # wheel_slip / lidar_miscal / ghost_returns / scan_jam). Written
+        # only by FaultPlan.apply on the run_steps thread, read by
+        # step() — the deterministic step clock serializes them; the
+        # identity values keep the healthy hot path byte-identical
+        # (_faults_active gates every application).
+        self._wheel_slip = np.ones(R, np.float32)
+        self._lidar_miscal = np.zeros(R, np.float32)
+        self._ghost_frac = np.zeros(R, np.float32)
+        self._scan_jam = np.zeros(R, bool)
+        #: Last healthy ranges per robot — what a jammed sensor keeps
+        #: re-reporting (frozen data, fresh stamps).
+        self._jam_cache = [None] * R
+        self._fault_seed = seed
         self.n_steps = 0
         if realtime:
             self.create_timer(1.0 / rate_hz, self.step)
+
+    # -- adversarial sensor-fault boundary (FaultPlan setters) ---------------
+
+    def set_wheel_slip(self, robot: int, factor: float) -> None:
+        """Bias robot's MEASURED wheel speeds by `factor` (1.0 = healthy):
+        odometry integrates motion the robot did not make."""
+        self._wheel_slip[robot] = factor
+
+    def set_lidar_miscal(self, robot: int, offset_rad: float) -> None:
+        """Rotate robot's lidar mount by `offset_rad` (0 = healthy):
+        every beam reports the range of a rotated world angle."""
+        self._lidar_miscal[robot] = offset_rad
+
+    def set_ghost_returns(self, robot: int, frac: float) -> None:
+        """Replace a seeded `frac` of robot's live beams with spurious
+        short ranges (0 = healthy)."""
+        self._ghost_frac[robot] = frac
+
+    def set_scan_jam(self, robot: int, jammed: bool) -> None:
+        """Freeze robot's scan at the last healthy reading (fresh
+        stamps, stale data) until cleared. Clearing drops the cache so
+        a LATER jam window pins its own onset reading — not a scan
+        recorded during a previous fault epoch (the cache only
+        refreshes while some fault is active)."""
+        self._scan_jam[robot] = jammed
+        if not jammed:
+            self._jam_cache[robot] = None
+
+    def _faults_active(self) -> bool:
+        return bool((self._wheel_slip != 1.0).any()
+                    or (self._lidar_miscal != 0.0).any()
+                    or (self._ghost_frac != 0.0).any()
+                    or self._scan_jam.any())
 
     def truth_poses(self) -> np.ndarray:
         return np.asarray(self.sim_state.poses)
@@ -92,12 +139,45 @@ class SimNode(Node):
                                         self.sim_state.poses)
         prox7 = np.zeros((self.driver.n_robots, 7), np.int32)
         prox7[:, :5] = np.clip(np.asarray(prox), 0, 4500).astype(np.int32)
-        self.driver.ingest_state(np.asarray(measured), prox7)
+        faults = self._faults_active()
+        measured_np = np.asarray(measured)
+        if faults:
+            # wheel_slip: odometry bias at the measured-speed boundary
+            # (ground truth untouched — sim/thymio.apply_wheel_slip).
+            measured_np = self._thymio.apply_wheel_slip(
+                measured_np, self._wheel_slip)
+        self.driver.ingest_state(measured_np, prox7)
 
+        scan_poses = self.sim_state.poses
+        if faults:
+            # lidar_miscal: raycast from heading-offset poses — beam k
+            # reports a rotated world angle but keeps its label.
+            scan_poses = self._jnp.asarray(self._lidar.apply_lidar_miscal(
+                np.asarray(scan_poses), self._lidar_miscal))
         scans = self._lidar.simulate_scans(
             cfg.scan, self.world, self.world_res_m, self.n_samples,
-            self.sim_state.poses)
+            scan_poses)
         scans_np = np.asarray(scans)
+        if faults:
+            scans_np = scans_np.copy()   # device fetch may be read-only
+            for i in range(self.driver.n_robots):
+                if self._scan_jam[i]:
+                    # Frozen data, fresh stamps; the cache pins the
+                    # reading at jam onset.
+                    if self._jam_cache[i] is None:
+                        self._jam_cache[i] = scans_np[i].copy()
+                    else:
+                        scans_np[i] = self._jam_cache[i]
+                else:
+                    self._jam_cache[i] = scans_np[i].copy()
+                if self._ghost_frac[i] > 0.0:
+                    # Seeded per (launch seed, step, robot): two
+                    # same-seed runs ghost the identical beams.
+                    rng = np.random.default_rng(
+                        (self._fault_seed, self.n_steps, i))
+                    scans_np[i] = self._lidar.apply_ghost_returns(
+                        cfg.scan, scans_np[i], float(self._ghost_frac[i]),
+                        rng)
         stamp = time.monotonic()
         for i, pub in enumerate(self.scan_pubs):
             pub.publish(LaserScan(

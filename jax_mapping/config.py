@@ -119,6 +119,11 @@ class RobotConfig:
     lidar_stop_dist_m: float = 0.40               # pi variant stop distance (pi main.py)
     swerve_inner_units: int = -10                 # inner-wheel target during swerve (main.py:168-175)
     control_rate_hz: float = 10.0                 # server loop (main.py:60)
+    # Thymio motor target saturation range (|target| <= 600 wire units);
+    # every wheel-target producer clamps here BEFORE the int cast so a
+    # policy can never command a value the firmware would clip
+    # differently than the odometry model assumes.
+    motor_limit_units: int = 600
     # Pi variant odometry reads motor *targets* not measured speeds
     # (pi/src/.../main.py:188-191); the sim models this as first-order lag.
     motor_lag_tau_s: float = 0.15
@@ -433,6 +438,107 @@ class ResilienceConfig:
 
 
 @_frozen
+class RecoveryConfig:
+    """Estimator guardrails (recovery/ subsystem).
+
+    PR 2's resilience layer watches *processes* (heartbeats, links,
+    scan arrival); nothing watches the ESTIMATOR itself — a robot whose
+    scan-matcher quietly diverges keeps fusing garbage into the shared
+    map, and a stuck or oscillating explorer burns the mission clock
+    forever (the reference's "Failure detection / recovery" gap,
+    SURVEY.md §5). These knobs parameterize (1) the divergence watchdog
+    folding the per-step SlamDiag stream into a per-robot health score
+    with hysteresis, (2) the quarantine + wide-window relocalization
+    path that re-admits a diverged robot only after a verified
+    re-anchor, and (3) the anti-stuck recovery ladder (rotate-in-place
+    rescan -> backup -> frontier blacklist with TTL -> goal
+    reassignment). `enabled=False` restores pre-guardrail behavior
+    exactly: no watchdog observations, no quarantine, no overrides.
+
+    Time base: watchdog thresholds count MAPPER OBSERVATIONS (key-scan
+    steps — the only steps that add map evidence); anti-stuck
+    thresholds count CONTROL TICKS (the repo's deterministic TTL
+    doctrine, brain._steer_target).
+    """
+
+    # Requires ResilienceConfig.enabled: the guardrails ACT through the
+    # FleetHealth ladder (coast, LED, frontier reassignment, /status
+    # export) — launch leaves them off when resilience is disabled.
+    enabled: bool = True
+    # -- divergence watchdog -------------------------------------------------
+    # Observations before the score is trusted: with an empty map the
+    # matcher legitimately rejects (bootstrap), and declaring divergence
+    # there would quarantine a healthy robot at mission start.
+    min_keyscans: int = 5
+    # Badness EWMA: score = decay * score + (1 - decay) * bad, where
+    # bad = agreement_weight * min(1, (1-agreement)/deficit_scale)
+    #     + match_weight * (1 - matched)           [key steps only]
+    #     + cov_weight * min(1, cov_trace/cov_scale).
+    # Observed at FULL scan cadence (sub-gate steps sample
+    # models.slam.scan_agreement) — a ghosting sensor fires every scan,
+    # not every 0.1 m of travel.
+    score_decay: float = 0.7
+    match_weight: float = 0.5
+    agreement_weight: float = 0.5
+    # Healthy scans agree within ~0.05 of 1.0; adversarial scans sit
+    # 0.25-0.4 below (measured: ghost_returns 0.5 -> ~0.65, wheel_slip
+    # 1.4 -> ~0.75 during drift). The scale maps that gap onto [0, 1].
+    agreement_deficit_scale: float = 0.35
+    cov_weight: float = 0.1
+    cov_scale_m2: float = 0.05
+    # Hysteresis: the score must sit at or above the threshold for
+    # `diverge_persist_steps` CONSECUTIVE observations to declare
+    # ESTIMATOR_DIVERGED — one bad scan is weather, a streak is a fault.
+    diverge_threshold: float = 0.4
+    diverge_persist_steps: int = 3
+    # -- quarantine + relocalization ----------------------------------------
+    # Bounded per-robot buffer of quarantined (scan, odom) evidence —
+    # telemetry for the operator, never fused (the poses it was paired
+    # with are exactly what diverged).
+    quarantine_cap: int = 64
+    # Re-anchor verification: the wide-window match must ACCEPT with at
+    # least this response for `reloc_consecutive` consecutive scans,
+    # with the candidate poses agreeing within the consistency radii —
+    # one lucky basin must not re-admit a lost robot.
+    reloc_min_response: float = 0.35
+    reloc_consecutive: int = 2
+    reloc_consistency_m: float = 0.2
+    reloc_consistency_rad: float = 0.25
+    # The verifying scan must also AGREE with the map at the candidate
+    # pose: a lost-but-healthy-sensor robot re-admits immediately (its
+    # scan fits the map at the true pose), while an ACTIVELY faulting
+    # sensor — whose wide match can still find plausible basins — stays
+    # quarantined until the fault clears (re-admitting it would resume
+    # fusing the same garbage the watchdog just caught).
+    reloc_min_agreement: float = 0.8
+    # -- anti-stuck recovery ladder -----------------------------------------
+    # Stuck: over the last `stuck_window_ticks` control ticks the robot
+    # was commanded motion (mean |wheel target| >= min_commanded_units)
+    # for >= stuck_commanded_frac of them, yet its net odometric
+    # displacement reached under `stuck_displacement_frac` of the
+    # distance those commands SHOULD have produced (sum of commanded
+    # wheel speed x speed_coeff x dt) — wedged against geometry the
+    # shield oscillates on. (Wheels spinning in place feed phantom
+    # motion into odometry and are the WATCHDOG's case — they surface
+    # as estimator divergence, not as a stuck detection.) The
+    # commanded-relative floor is the point: an absolute floor would
+    # misread a slow-but-healthy platform as stuck (a cruising Thymio
+    # covers only ~0.036 m in 12 ticks).
+    stuck_window_ticks: int = 30
+    stuck_displacement_frac: float = 0.25
+    stuck_commanded_frac: float = 0.6
+    min_commanded_units: int = 20
+    # Escalating recoveries: rotate-in-place rescan, then reverse out,
+    # then blacklist the frontier goal (TTL below) and force
+    # reassignment. A re-detection within escalation_memory_ticks
+    # escalates to the next rung; a clean stretch resets to rung 0.
+    rotate_recovery_ticks: int = 12
+    backup_recovery_ticks: int = 10
+    escalation_memory_ticks: int = 90
+    blacklist_ttl_ticks: int = 300
+
+
+@_frozen
 class FleetConfig:
     """Multi-robot scaling (BASELINE.json configs 4-5: 8-64 simulated Thymios)."""
 
@@ -459,6 +565,7 @@ class SlamConfig:
     voxel: VoxelConfig = VoxelConfig()
     depthcam: DepthCamConfig = DepthCamConfig()
     resilience: ResilienceConfig = ResilienceConfig()
+    recovery: RecoveryConfig = RecoveryConfig()
     # slam_toolbox's operating mode (slam_config.yaml:20: "mapping" —
     # the file's comment offers localization as the alternative).
     # "localization" freezes the map: key scans MATCH against it for
@@ -493,6 +600,7 @@ class SlamConfig:
             voxel=VoxelConfig(**raw.get("voxel", {})),
             depthcam=DepthCamConfig(**raw.get("depthcam", {})),
             resilience=ResilienceConfig(**raw.get("resilience", {})),
+            recovery=RecoveryConfig(**raw.get("recovery", {})),
             **{k: v for k, v in raw.items()
                if k in ("mode", "map_publish_period_s",
                         "tf_publish_period_s", "domain_id")},
@@ -526,6 +634,22 @@ def tiny_config(n_robots: int = 2) -> SlamConfig:
                                     restart_backoff_base_steps=2,
                                     restart_backoff_max_steps=16,
                                     checkpoint_every_steps=25),
+        # Short watchdog/anti-stuck horizons so adversarial-fault tests
+        # walk the full diverge -> quarantine -> relocalize -> re-admit
+        # (and stuck -> rotate -> backup -> blacklist) ladders within a
+        # short mission.
+        recovery=RecoveryConfig(min_keyscans=2,
+                                score_decay=0.5,
+                                diverge_threshold=0.4,
+                                diverge_persist_steps=2,
+                                quarantine_cap=32,
+                                reloc_consecutive=2,
+                                stuck_window_ticks=12,
+                                stuck_displacement_frac=0.25,
+                                rotate_recovery_ticks=6,
+                                backup_recovery_ticks=5,
+                                escalation_memory_ticks=40,
+                                blacklist_ttl_ticks=80),
     )
 
 

@@ -129,5 +129,11 @@ def frontier_policy(robot: RobotConfig, scan_cfg: ScanConfig,
     use_seek = goal_valid & (reactive.state == 1)            # only in cruise
     targets = jnp.where(use_seek[:, None], seek, reactive.targets)
     targets = jnp.where(exploring[:, None], targets, 0.0)
+    # Saturate to the Thymio motor command range BEFORE the int32 cast:
+    # the seek branch's base ± steer*cruise*0.5 can exceed ±motor_limit
+    # for large cruise speeds, and an un-clamped target would be clipped
+    # by the firmware differently than the odometry model assumes.
+    lim = jnp.float32(robot.motor_limit_units)
+    targets = jnp.clip(targets, -lim, lim)
     return PolicyOut(targets=targets.astype(jnp.int32), led=reactive.led,
                      state=reactive.state)

@@ -346,6 +346,18 @@ def slam_step_window(cfg: SlamConfig, state: SlamState, ranges_w: Array,
     return st2, diag._replace(window_agreement=agreement)
 
 
+@functools.partial(jax.jit, static_argnums=(0,))
+def scan_agreement(cfg: SlamConfig, grid: Array, ranges: Array,
+                   pose: Array) -> Array:
+    """Pre-fusion map agreement of ONE scan at `pose` — the per-scan
+    estimator-health signal the recovery watchdog samples at full scan
+    cadence (key steps get it from SlamDiag for free; sub-gate steps
+    carry no diag agreement, and the watchdog must not go blind between
+    key scans — a ghosting sensor fires every scan, not every 0.1 m of
+    travel). One (beams,)-point gather."""
+    return _window_agreement(cfg, grid, ranges[None], pose[None])
+
+
 def _window_agreement(cfg: SlamConfig, grid: Array, ranges_w: Array,
                       poses_w: Array) -> Array:
     """Mean map-agreement of a window's leading scans, BEFORE they fuse.

@@ -1,0 +1,37 @@
+"""Estimator guardrails: divergence watchdog, relocalization, anti-stuck.
+
+The subsystem ISSUE 3 adds above PR 2's process-level resilience: the
+resilience/ layer notices when a node or sensor DIES; this layer notices
+when the ESTIMATOR goes wrong while everything keeps running — the
+reference's "Failure detection / recovery" gap and Occupancy-SLAM's core
+argument that pose error, not process death, is what destroys occupancy
+maps (PAPERS.md).
+
+* `watchdog`    — EstimatorWatchdog: per-robot health score with
+                  hysteresis over the SlamDiag stream; declares the
+                  ESTIMATOR_DIVERGED rung in FleetHealth's ladder.
+* `relocalize`  — wide-window relocalization against the shared map
+                  (the loop-closure sweep machinery, repurposed) with
+                  consecutive-consistency verification before re-entry.
+* `antistuck`   — AntiStuckLadder + FrontierBlacklist: displacement-vs-
+                  commanded-motion stuck detection feeding escalating
+                  recoveries (rotate rescan -> backup -> frontier
+                  blacklist with TTL -> goal reassignment).
+* `manager`     — RecoveryManager, the one handle launch wires through
+                  brain/mapper/HTTP (the FleetHealth pattern).
+
+Everything is host-side, deterministic, and gated on
+`RecoveryConfig.enabled` — disabled, the stack behaves exactly as
+before this subsystem existed.
+"""
+
+from jax_mapping.recovery.antistuck import (  # noqa: F401
+    MONITOR, ROTATE, BACKUP, RUNGS, AntiStuckLadder, FrontierBlacklist,
+)
+from jax_mapping.recovery.manager import RecoveryManager  # noqa: F401
+from jax_mapping.recovery.relocalize import (  # noqa: F401
+    Relocalizer, relocalize_match,
+)
+from jax_mapping.recovery.watchdog import (  # noqa: F401
+    DIVERGED, HEALTHY, EstimatorWatchdog,
+)

@@ -1,0 +1,50 @@
+"""RecoveryManager: one shared handle for the estimator guardrails.
+
+The guardrails span three nodes — the mapper feeds the watchdog and runs
+quarantine/relocalization, the brain runs the anti-stuck ladder and
+advances the blacklist clock, the HTTP plane exports everything — so the
+launch layer builds ONE manager and hands it to each of them, the same
+wiring pattern as FleetHealth. `None` (recovery disabled) restores
+pre-guardrail behavior exactly: every integration point gates on the
+manager's presence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax_mapping.config import RecoveryConfig, RobotConfig
+from jax_mapping.recovery.antistuck import AntiStuckLadder, FrontierBlacklist
+from jax_mapping.recovery.relocalize import Relocalizer
+from jax_mapping.recovery.watchdog import EstimatorWatchdog
+
+
+class RecoveryManager:
+    """Watchdog + relocalizer + anti-stuck ladder + blacklist, built
+    together so their configs can never drift apart."""
+
+    def __init__(self, cfg: RecoveryConfig, n_robots: int,
+                 robot: Optional[RobotConfig] = None):
+        self.cfg = cfg
+        self.n_robots = n_robots
+        self.watchdog = EstimatorWatchdog(cfg, n_robots)
+        self.relocalizer = Relocalizer(cfg, n_robots)
+        self.blacklist = FrontierBlacklist(cfg)
+        self.antistuck = AntiStuckLadder(
+            cfg, n_robots,
+            rotation_units=(robot.rotation_speed_units
+                            if robot is not None else 50),
+            cruise_units=(robot.cruise_speed_units
+                          if robot is not None else 100),
+            m_per_unit_tick=(robot.speed_coeff_m_per_unit_s
+                             / robot.control_rate_hz
+                             if robot is not None else 3.027e-5))
+
+    def snapshot(self) -> dict:
+        """The /status "recovery" object."""
+        return {
+            "watchdog": self.watchdog.snapshot(),
+            "relocalization": self.relocalizer.snapshot(),
+            "antistuck": self.antistuck.snapshot(),
+            "blacklist": self.blacklist.snapshot(),
+        }

@@ -1,0 +1,136 @@
+"""Wide-window relocalization for quarantined robots.
+
+A diverged robot's pose estimate is exactly what cannot be trusted, so
+re-admission must come from the MAP, not the chain: each quarantined
+scan is matched against the fleet's shared grid through the same
+two-stage wide machinery loop closure uses (models/slam._loop_wide_cfgs:
+a coarse sweep of the full loop window on a downsampled view, then a
+fine full-resolution refine) — slam_toolbox's 8 m loop search window
+repurposed as a relocalization basin, seeded at the last estimate (the
+robot COASTS while diverged, so the true pose sits within the fault
+window's accumulated error of the seed).
+
+Verification: one accepted match is a basin, not an anchor — ghost walls
+and corridor aliases produce legitimate-looking responses. A re-anchor
+is VERIFIED only when `reloc_consecutive` consecutive scans accept with
+response >= `reloc_min_response` AND their candidate poses agree within
+the consistency radii. Any miss resets the streak.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import threading
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from jax_mapping.config import RecoveryConfig, SlamConfig
+from jax_mapping.models.slam import _loop_matcher_cfg, _loop_wide_cfgs
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import scan_match as M
+
+Array = jax.Array
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def relocalize_match(cfg: SlamConfig, grid: Array, ranges: Array,
+                     guess: Array) -> M.MatchResult:
+    """One wide-window relocalization attempt against the live shared
+    map. Unlike loop verification this matches the LIVE grid — sound
+    here because the diverged robot's garbage was quarantined, never
+    fused, so the map holds only healthy evidence."""
+    import jax.numpy as jnp
+    g_c, m_c = _loop_wide_cfgs(cfg)
+    wide = M.match(g_c, cfg.scan, m_c,
+                   G.downsample_max(grid, cfg.loop.coarse_downsample),
+                   ranges, guess)
+    seed = jnp.where(wide.accepted, wide.pose, guess)
+    return M.match(cfg.grid, cfg.scan, _loop_matcher_cfg(cfg), grid,
+                   ranges, seed)
+
+
+def _wrap(a: float) -> float:
+    return (a + math.pi) % (2.0 * math.pi) - math.pi
+
+
+class Relocalizer:
+    """Per-robot candidate streak bookkeeping around relocalize_match.
+
+    Host-side and deterministic; fed by the mapper's tick thread only,
+    read by HTTP exporters (leaf lock)."""
+
+    def __init__(self, cfg: RecoveryConfig, n_robots: int):
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        #: Per-robot streak of consistent accepted candidates,
+        #: newest last: list of (x, y, theta).
+        self._streak: List[List[tuple]] = [[] for _ in range(n_robots)]
+        self.n_attempts = 0
+        self.n_accepted = 0
+        self.n_verified = 0
+
+    def attempt_for(self, robot: int, cfg: SlamConfig, grid, ranges,
+                    guess) -> Optional[np.ndarray]:
+        """One attempt with robot `robot`'s freshest quarantined scan.
+        Returns the VERIFIED re-anchor pose (3,) when the consistency
+        streak completes, else None. The caller owns what happens next
+        (fresh chain at the pose, watchdog readmit, FleetHealth
+        clear)."""
+        import jax.numpy as jnp
+        from jax_mapping.models.slam import scan_agreement
+        res = relocalize_match(cfg, grid, jnp.asarray(ranges),
+                               jnp.asarray(guess))
+        accepted = bool(res.accepted)
+        response = float(res.response)
+        pose = np.asarray(res.pose, np.float32)
+        c = self.cfg
+        if accepted and response >= c.reloc_min_response:
+            # Agreement gate at the CANDIDATE pose: the wide matcher can
+            # find plausible basins even for a still-faulting sensor
+            # (half the beams of a ghosting scan are real walls) — but
+            # re-admitting one would resume fusing the same garbage the
+            # watchdog just caught. A healthy scan at the true pose
+            # clears this instantly; a faulting one waits out its fault.
+            agreement = float(scan_agreement(cfg, grid,
+                                             jnp.asarray(ranges),
+                                             jnp.asarray(pose)))
+            accepted = agreement >= c.reloc_min_agreement
+        with self._lock:
+            self.n_attempts += 1
+            streak = self._streak[robot]
+            if not (accepted and response >= c.reloc_min_response):
+                streak.clear()
+                return None
+            self.n_accepted += 1
+            # Consistency against the streak head: every candidate must
+            # sit in the same basin as the first, or the streak restarts
+            # from this candidate.
+            if streak:
+                x0, y0, t0 = streak[0]
+                if (math.hypot(pose[0] - x0, pose[1] - y0)
+                        > c.reloc_consistency_m
+                        or abs(_wrap(float(pose[2]) - t0))
+                        > c.reloc_consistency_rad):
+                    streak.clear()
+            streak.append((float(pose[0]), float(pose[1]),
+                           float(pose[2])))
+            if len(streak) < c.reloc_consecutive:
+                return None
+            self.n_verified += 1
+            streak.clear()
+            return pose
+
+    def reset(self, robot: int) -> None:
+        with self._lock:
+            self._streak[robot].clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "n_attempts": self.n_attempts,
+                "n_accepted": self.n_accepted,
+                "n_verified": self.n_verified,
+            }

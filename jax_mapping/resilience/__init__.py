@@ -18,12 +18,13 @@ direction.
 """
 
 from jax_mapping.resilience.health import (  # noqa: F401
-    DEAD, DRIVER_OFFLINE, DRIVER_OK, DRIVER_RECOVERING, NO_LIDAR, OK,
+    DEAD, DRIVER_OFFLINE, DRIVER_OK, DRIVER_RECOVERING,
+    ESTIMATOR_DIVERGED, NO_LIDAR, OK,
     FleetHealth, LockTimeout, acquire_bounded,
 )
 from jax_mapping.resilience.supervisor import (  # noqa: F401
     Heartbeater, Supervisor, beat,
 )
 from jax_mapping.resilience.faultplan import (  # noqa: F401
-    FaultEvent, FaultPlan, random_plan,
+    SENSOR_KINDS, FaultEvent, FaultPlan, random_plan,
 )

@@ -43,6 +43,27 @@ Fault kinds and their boundaries:
                         stack's auto-checkpoint) — the power-loss /
                         bit-rot case the CRC32 + last-good rotation in
                         io/checkpoint.py exists for.
+
+Adversarial SENSOR faults (ISSUE 3): unlike the kinds above, these do
+not silence anything — the sensors keep reporting, plausibly and
+wrongly, which is precisely what the recovery/ watchdog exists to
+catch. Injected at the sim boundaries (`SimNode.set_*`, which delegate
+to `sim/thymio.apply_wheel_slip` / `sim/lidar.apply_lidar_miscal` /
+`apply_ghost_returns`); ghost beams are seeded per (launch seed, step,
+robot), so same-seed chaos runs stay bit-identical.
+
+    wheel_slip          measured wheel speeds biased by `value`
+                        (e.g. 1.3 = odometry reads 30% fast; ground
+                        truth motion untouched) — slip / miscalibrated
+                        SPEED_COEFF (report.pdf §V.B: 13% CV).
+    lidar_miscal        lidar mount rotated by `value` radians — every
+                        beam reports a rotated world angle under its
+                        old label.
+    ghost_returns       a seeded `value` fraction of live beams replaced
+                        with spurious short ranges (dust / multipath /
+                        hostile reflector).
+    scan_jam            ranges frozen at the jam-onset reading, stamps
+                        stay fresh — a wedged sensor that looks alive.
 """
 
 from __future__ import annotations
@@ -52,10 +73,15 @@ import os
 import random
 from typing import Dict, List, Optional
 
+#: Adversarial sensor-fault kinds (SimNode boundary; recovery/ targets).
+SENSOR_KINDS = frozenset({
+    "wheel_slip", "lidar_miscal", "ghost_returns", "scan_jam",
+})
+
 KINDS = frozenset({
     "lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
     "kill_node", "kill_robot", "rejoin_robot", "corrupt_checkpoint",
-})
+}) | SENSOR_KINDS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +103,21 @@ class FaultEvent:
                              f"(one of {sorted(KINDS)})")
         if self.step < 0 or self.duration < 0:
             raise ValueError("step and duration must be >= 0")
+        # Value-carrying sensor kinds refuse the 0.0 default: for
+        # wheel_slip it is the worst possible fault (a 0x measured-speed
+        # factor is total odometry blackout, not slip — 1.0 is healthy),
+        # and for miscal/ghosts it is a silent no-op that would let a
+        # chaos test "pass" while never injecting the fault it scripted.
+        if self.kind == "wheel_slip" and self.value <= 0.0:
+            raise ValueError(
+                "wheel_slip needs value > 0: the measured-speed factor "
+                "(1.0 = healthy, e.g. 1.3 = odometry reads 30% fast)")
+        if self.kind in ("lidar_miscal", "ghost_returns") \
+                and self.value == 0.0:
+            raise ValueError(
+                f"{self.kind} needs a nonzero value (the angular offset "
+                "in rad / the ghosted beam fraction) — 0.0 injects "
+                "nothing")
 
 
 class FaultPlan:
@@ -93,6 +134,11 @@ class FaultPlan:
                                                     e.robot))
         self.seed = seed
         self._rng = random.Random(seed)
+        #: Faults random_plan ASKED for but could not place (same-
+        #: resource overlap rejection saturated its resample budget) —
+        #: 0 for hand-written plans. A soak that believes it injected
+        #: n_faults must be able to see how many it actually got.
+        self.generation_shortfall = 0
         self._fired = [False] * len(self.events)
         #: (due_step, callable, description) pending auto-clears.
         self._clears: List[tuple] = []
@@ -105,6 +151,11 @@ class FaultPlan:
         self._driver_refs = 0
         #: knob -> (baseline captured at first fire, active values).
         self._weather: Dict[str, tuple] = {}
+        #: (kind, robot) -> active values for the sensor-fault kinds —
+        #: the weather pattern per robot: overlapping windows compose by
+        #: running the WORST active value, the identity baseline returns
+        #: when the last window clears.
+        self._sensor: Dict[tuple, list] = {}
 
     # -- boundary helpers ----------------------------------------------------
 
@@ -157,9 +208,41 @@ class FaultPlan:
             active.append(value)
         bus.set_fault_injection(**{key: max(active) if active else base})
 
+    def _apply_sensor(self, stack, kind: str, robot: int,
+                      value: Optional[float]) -> None:
+        """Add (value) or remove (None; caller popped the list) one
+        active sensor-fault window for (kind, robot); the sim runs the
+        WORST of the active windows, identity when none remain."""
+        active = self._sensor.setdefault((kind, robot), [])
+        if value is not None:
+            active.append(value)
+        sim = stack.sim
+        if kind == "wheel_slip":
+            # Worst = farthest from the healthy 1.0 factor.
+            worst = max(active, key=lambda v: abs(v - 1.0)) \
+                if active else 1.0
+            sim.set_wheel_slip(robot, worst)
+        elif kind == "lidar_miscal":
+            worst = max(active, key=abs) if active else 0.0
+            sim.set_lidar_miscal(robot, worst)
+        elif kind == "ghost_returns":
+            sim.set_ghost_returns(robot, max(active) if active else 0.0)
+        elif kind == "scan_jam":
+            sim.set_scan_jam(robot, bool(active))
+
     def _fire(self, stack, ev: FaultEvent, step: int) -> None:
         bus = stack.bus
-        if ev.kind == "lidar_dead":
+        if ev.kind in SENSOR_KINDS:
+            self._apply_sensor(stack, ev.kind, ev.robot, ev.value)
+            self._note(step, f"{ev.kind} robot{ev.robot}={ev.value}")
+            if ev.duration:
+                def _clear_sensor(kind=ev.kind, robot=ev.robot,
+                                  value=ev.value):
+                    self._sensor[(kind, robot)].remove(value)
+                    self._apply_sensor(stack, kind, robot, None)
+                self._clears.append((step + ev.duration, _clear_sensor,
+                                     f"{ev.kind} robot{ev.robot}"))
+        elif ev.kind == "lidar_dead":
             topic = self._scan_topic(stack, ev.robot)
             self._hold_partition(bus, topic)
             self._note(step, f"lidar_dead robot{ev.robot}")
@@ -237,21 +320,72 @@ class FaultPlan:
         return [f"step {s}: {d}" for s, d in self.log]
 
 
+def _fault_resource(kind: str, robot: int) -> tuple:
+    """The resource a fault window occupies, for overlap rejection:
+    two windows on one resource would need refcount composition at
+    APPLY time (hand-written plans may still do that deliberately);
+    generated fuzz keeps windows disjoint so each fault's effect — and
+    the recovery it provokes — is attributable to one event."""
+    if kind in ("lidar_dead", "lidar_miscal", "ghost_returns",
+                "scan_jam"):
+        return ("scan", robot)
+    if kind == "wheel_slip":
+        return ("odom", robot)
+    if kind == "driver_offline":
+        return ("driver",)
+    return ("bus", kind)                 # bus_drop / bus_reorder
+
+
+def _sample_value(rng: random.Random, kind: str) -> float:
+    """Kind-appropriate magnitudes: bus weather as before; wheel slip a
+    1.15-1.5x odometry bias; miscal 0.05-0.3 rad (sign sampled);
+    ghosts on 10-40% of beams."""
+    if kind.startswith("bus_"):
+        return round(rng.uniform(0.2, 0.7), 3)
+    if kind == "wheel_slip":
+        return round(rng.uniform(1.15, 1.5), 3)
+    if kind == "lidar_miscal":
+        return round(rng.choice((-1.0, 1.0)) * rng.uniform(0.05, 0.3), 3)
+    if kind == "ghost_returns":
+        return round(rng.uniform(0.1, 0.4), 3)
+    return 0.0
+
+
 def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
                 n_robots: int = 1) -> FaultPlan:
     """Generate a reproducible schedule: `seed` fully determines the
     fault mix, placement, and durations (fuzz-style soak variety with
-    CI-replayable failures)."""
+    CI-replayable failures). Samples the adversarial sensor kinds
+    alongside the transport/driver faults, and REJECTS overlapping
+    windows on the same resource at generation time (resampling,
+    bounded) — generated chaos keeps each fault's effect attributable.
+    Short missions can saturate every resource before n_faults place;
+    the dropped count is exposed as `plan.generation_shortfall`, never
+    silently swallowed."""
     rng = random.Random(seed)
-    kinds = ["lidar_dead", "driver_offline", "bus_drop", "bus_reorder"]
-    events = []
+    kinds = ["lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
+             "wheel_slip", "lidar_miscal", "ghost_returns", "scan_jam"]
+    events: List[FaultEvent] = []
+    occupied: List[tuple] = []           # (resource, start, end)
+    shortfall = 0
     for _ in range(n_faults):
-        kind = rng.choice(kinds)
-        step = rng.randrange(1, max(2, mission_steps - 10))
-        duration = rng.randrange(3, 12)
-        events.append(FaultEvent(
-            step=step, kind=kind,
-            robot=rng.randrange(n_robots), duration=duration,
-            value=round(rng.uniform(0.2, 0.7), 3)
-            if kind.startswith("bus_") else 0.0))
-    return FaultPlan(events, seed=seed)
+        for _attempt in range(64):       # bounded resample budget
+            kind = rng.choice(kinds)
+            step = rng.randrange(1, max(2, mission_steps - 10))
+            duration = rng.randrange(3, 12)
+            robot = rng.randrange(n_robots)
+            res = _fault_resource(kind, robot)
+            end = step + duration
+            if any(r == res and step <= e and s <= end
+                   for r, s, e in occupied):
+                continue                 # same-resource overlap: reject
+            occupied.append((res, step, end))
+            events.append(FaultEvent(
+                step=step, kind=kind, robot=robot, duration=duration,
+                value=_sample_value(rng, kind)))
+            break
+        else:
+            shortfall += 1               # every resource window taken
+    plan = FaultPlan(events, seed=seed)
+    plan.generation_shortfall = shortfall
+    return plan

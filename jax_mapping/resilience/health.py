@@ -22,6 +22,11 @@ Per-robot ladder:
       reassigns its frontier work; planner stops planning for it)
     any ──(a scan arrives)──▶ OK (rejoin: the mapper relocalizes by
       matching the robot's next scans against the shared map)
+    OK ──(recovery watchdog declares the estimator diverged)──▶
+      ESTIMATOR_DIVERGED (scans flow but the estimate is garbage: the
+      mapper quarantines the robot's evidence and relocalizes it; the
+      brain coasts it; cleared only by a verified re-anchor —
+      recovery/watchdog.py). Staleness outranks this rung.
 
 The driver link is fleet-wide (one dongle): OK / OFFLINE / RECOVERING,
 fed by the brain's connect machinery; RECOVERING is the one-tick
@@ -42,6 +47,13 @@ from jax_mapping.config import ResilienceConfig
 OK = "ok"
 NO_LIDAR = "no_lidar"
 DEAD = "dead"
+#: Estimator-health rung (recovery/watchdog.py): scans are FLOWING but
+#: the SLAM estimate is garbage — the mapper quarantines this robot's
+#: evidence and relocalizes it; the brain coasts it (like NO_LIDAR: the
+#: pose it would steer by is exactly what diverged). Staleness outranks
+#: it: a diverged robot whose lidar then goes silent walks the normal
+#: NO_LIDAR -> DEAD ladder (silence is the more severe fact).
+ESTIMATOR_DIVERGED = "estimator_diverged"
 
 #: Driver-link states.
 DRIVER_OK = "ok"
@@ -74,6 +86,10 @@ class FleetHealth:
         #: transition log chaos tests assert against:
         #: (tick, "robot<i>"|"driver", old, new).
         self._robot_state = [OK] * n_robots
+        #: Estimator-diverged flags (recovery watchdog feeder). A set
+        #: flag folds into the ladder on note_tick; it never overrides
+        #: staleness (DEAD/NO_LIDAR are the more severe facts).
+        self._estimator_diverged = [False] * n_robots
         self.transitions: List[tuple] = []
 
     # -- feeders (brain/mapper threads) -------------------------------------
@@ -94,6 +110,8 @@ class FleetHealth:
                     new = DEAD
                 elif silent > self.cfg.lidar_silent_ticks:
                     new = NO_LIDAR
+                elif self._estimator_diverged[i]:
+                    new = ESTIMATOR_DIVERGED
                 else:
                     new = OK
                 old = self._robot_state[i]
@@ -101,6 +119,13 @@ class FleetHealth:
                     self._robot_state[i] = new
                     self.transitions.append(
                         (self._tick, f"robot{i}", old, new))
+
+    def note_estimator(self, robot: int, diverged: bool) -> None:
+        """Recovery-watchdog feeder: flag (or clear) robot `robot`'s
+        estimator as diverged. Folds into the ladder on the next
+        note_tick (the control-tick clock, like every transition)."""
+        with self._lock:
+            self._estimator_diverged[robot] = diverged
 
     def note_driver(self, state: str) -> None:
         assert state in (DRIVER_OK, DRIVER_OFFLINE, DRIVER_RECOVERING)
@@ -133,6 +158,23 @@ class FleetHealth:
         with self._lock:
             return np.array([s == OK for s in self._robot_state])
 
+    def assignable_mask(self) -> np.ndarray:
+        """(R,) bool: robots the frontier auction may leave assignments
+        with. DEAD robots cannot map; ESTIMATOR_DIVERGED robots coast
+        while the mapper relocalizes them, so a frontier pinned to one
+        would stall until the re-anchor — hand it to a healthy robot
+        instead (mapper._reassign_dead's mask)."""
+        with self._lock:
+            return np.array([s not in (DEAD, ESTIMATOR_DIVERGED)
+                             for s in self._robot_state])
+
+    def diverged_mask(self) -> np.ndarray:
+        """(R,) bool: robots currently on the ESTIMATOR_DIVERGED rung
+        (the brain's LED + coast annotations)."""
+        with self._lock:
+            return np.array([s == ESTIMATOR_DIVERGED
+                             for s in self._robot_state])
+
     def snapshot(self) -> dict:
         """The /status export: one dict an operator (or a test) reads
         the whole degraded-mode picture from."""
@@ -142,6 +184,7 @@ class FleetHealth:
                 "robots": list(self._robot_state),
                 "tick": self._tick,
                 "last_scan_tick": list(self._last_scan_tick),
+                "estimator_diverged": list(self._estimator_diverged),
                 "n_transitions": len(self.transitions),
             }
 

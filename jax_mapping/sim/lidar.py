@@ -71,6 +71,36 @@ def simulate_scans(scan_cfg: ScanConfig, world: Array, world_res_m: float,
     )(poses)
 
 
+def apply_lidar_miscal(poses, offset_rad):
+    """Adversarial-fault boundary (`lidar_miscal`): a sensor mount
+    rotated by `offset_rad` reports beam k's range for the world angle
+    theta + offset + k*increment while still LABELLING it beam k — the
+    exact effect of raycasting from a pose whose heading is offset.
+    poses (R, 3), offset_rad (R,); returns the raycast poses (numpy)."""
+    import numpy as np
+    out = np.array(poses, np.float32, copy=True)
+    out[:, 2] += np.asarray(offset_rad, np.float32)
+    return out
+
+
+def apply_ghost_returns(scan_cfg: ScanConfig, ranges, frac, rng,
+                        short_max_m: float = 0.5):
+    """Adversarial-fault boundary (`ghost_returns`): replace a seeded
+    `frac` of the LIVE beams with spurious short ranges in
+    [range_min, short_max_m] — dust, multipath, or a hostile reflector
+    painting phantom walls right in front of the robot. Deterministic
+    per (seed, step, robot) via the caller-owned `rng`.
+
+    ranges (padded_beams,) float32, modified copy returned."""
+    import numpy as np
+    out = np.array(ranges, np.float32, copy=True)
+    n = scan_cfg.n_beams
+    mask = rng.random(n) < frac
+    ghosts = rng.uniform(scan_cfg.range_min_m, short_max_m, n)
+    out[:n] = np.where(mask, ghosts.astype(np.float32), out[:n])
+    return out
+
+
 def ir_proximity(world: Array, world_res_m: float, poses: Array,
                  max_dist_m: float = 0.12, n_samples: int = 16) -> Array:
     """Simulated Thymio front IR sensors: 5 horizontal proximity readings.

@@ -62,6 +62,21 @@ def step_fleet(robot: RobotConfig, state: FleetSimState, targets: Array,
     return FleetSimState(poses=poses, wheel_speeds=actual, key=key), measured
 
 
+def apply_wheel_slip(measured, slip_factor):
+    """Adversarial-fault boundary (resilience/faultplan.py `wheel_slip`):
+    bias the MEASURED wheel speeds by a per-robot factor while ground
+    truth motion is untouched — the odometry chain integrates motion the
+    robot did not make, exactly what a slipping or miscalibrated wheel
+    does to the hand-measured SPEED_COEFF (report.pdf §V.B: 13% CV).
+
+    measured (R, 2) float; slip_factor (R,) float, 1.0 = healthy.
+    numpy in, numpy out (the SimNode host boundary, pre-uint16 wire
+    encoding)."""
+    import numpy as np
+    return np.asarray(measured) * np.asarray(slip_factor,
+                                             np.float32)[:, None]
+
+
 def step_robots_keyed(robot: RobotConfig, poses: Array, wheel_speeds: Array,
                       keys: Array, targets: Array, dt: float,
                       speed_noise_frac: float = 0.05):

@@ -161,7 +161,12 @@ def test_planner_publishes_frontier_waypoints(tiny_cfg):
 
     cfg = _dc.replace(
         tiny_cfg,
-        robot=_dc.replace(tiny_cfg.robot, cruise_speed_units=600),
+        # A fast sim platform: cruise at 600 with the saturation range
+        # raised to match — frontier_policy now clamps wheel targets to
+        # motor_limit_units (the real Thymio's ±600), and this rig's
+        # seek steer has always commanded beyond that.
+        robot=_dc.replace(tiny_cfg.robot, cruise_speed_units=600,
+                          motor_limit_units=1200),
         planner=_dc.replace(tiny_cfg.planner, lookahead_cells=3,
                             bfs_iters=128))
     world = W.empty_arena(96, cfg.grid.resolution_m)

@@ -1,0 +1,673 @@
+"""Estimator-guardrail tests: the divergence watchdog's hysteresis, the
+quarantine + wide-window relocalization path, the anti-stuck recovery
+ladder, the adversarial sensor-fault kinds — and the headline missions
+(ISSUE 3 acceptance): a tier-1 ghost_returns smoke where the watchdog
+fires, the robot's evidence quarantines, and relocalization re-admits it
+after the fault clears; plus a `slow` wheel_slip + lidar_miscal soak
+asserting bounded-budget detection, fleet-map protection, re-admission,
+and bit-determinism.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from jax_mapping.bridge.launch import launch_sim_stack
+from jax_mapping.config import RecoveryConfig, tiny_config
+from jax_mapping.recovery import (
+    DIVERGED, HEALTHY, MONITOR, AntiStuckLadder, EstimatorWatchdog,
+    FrontierBlacklist, RecoveryManager,
+)
+from jax_mapping.resilience import (
+    ESTIMATOR_DIVERGED, OK, SENSOR_KINDS, FaultEvent, FaultPlan,
+    FleetHealth, random_plan,
+)
+from jax_mapping.resilience.faultplan import _fault_resource
+from jax_mapping.sim import world as W
+
+
+# -------------------------------------------------------------- watchdog
+
+def _wd(**kw):
+    kw.setdefault("min_keyscans", 2)
+    kw.setdefault("score_decay", 0.5)
+    kw.setdefault("diverge_threshold", 0.4)
+    kw.setdefault("diverge_persist_steps", 2)
+    return EstimatorWatchdog(RecoveryConfig(**kw), 2)
+
+
+def test_watchdog_declares_after_persistent_badness_only():
+    """Hysteresis: one bad observation is weather; a streak past the
+    persist count declares — exactly once."""
+    wd = _wd()
+    for _ in range(10):
+        assert not wd.observe(0, key=True, matched=True, agreement=1.0)
+    assert wd.states() == [HEALTHY, HEALTHY]
+    # One isolated garbage scan: not a declaration.
+    assert not wd.observe(0, key=True, matched=False, agreement=0.0)
+    for _ in range(5):
+        assert not wd.observe(0, key=True, matched=True, agreement=1.0)
+    assert wd.states()[0] == HEALTHY
+    # A persistent streak declares once; further badness cannot re-fire.
+    fired = [wd.observe(0, key=True, matched=False, agreement=0.0)
+             for _ in range(6)]
+    assert fired.count(True) == 1
+    assert wd.is_diverged(0) and not wd.is_diverged(1)
+    assert wd.n_diverge_events == 1
+
+
+def test_watchdog_no_score_based_exit_readmit_resets():
+    """Only a verified re-anchor re-admits: good observations while
+    DIVERGED never clear the state (a quarantined robot produces no
+    fresh evidence to judge)."""
+    wd = _wd()
+    for _ in range(8):
+        wd.observe(0, key=True, matched=False, agreement=0.0)
+    assert wd.is_diverged(0)
+    for _ in range(20):
+        wd.observe(0, key=True, matched=True, agreement=1.0)
+    assert wd.is_diverged(0)            # still: no score-based exit
+    wd.readmit(0)
+    assert not wd.is_diverged(0)
+    assert wd.scores()[0] == 0.0
+    assert wd.n_readmits == 1
+    assert wd.transitions[-1][2:] == (DIVERGED, HEALTHY)
+
+
+def test_watchdog_bootstrap_grace_ignores_match_failures():
+    """With an empty map the matcher legitimately rejects: match
+    failures inside the first min_keyscans key observations must not
+    charge the match term (agreement stays neutral at bootstrap)."""
+    wd = _wd(min_keyscans=5, diverge_persist_steps=1)
+    for _ in range(5):
+        assert not wd.observe(0, key=True, matched=False, agreement=1.0)
+    assert wd.states()[0] == HEALTHY
+    # Past the grace, the same stream declares.
+    declared = False
+    for _ in range(6):
+        declared = declared or wd.observe(0, key=True, matched=False,
+                                          agreement=1.0)
+    assert declared
+
+
+def test_fleet_health_estimator_rung():
+    """ESTIMATOR_DIVERGED folds into the ladder: set while scans flow ->
+    the rung; staleness outranks it; clear -> OK. The assignable mask
+    strips diverged robots, the alive mask keeps them."""
+    from jax_mapping.config import ResilienceConfig
+    h = FleetHealth(ResilienceConfig(lidar_silent_ticks=3,
+                                     dead_after_ticks=8), 2)
+    for t in range(1, 4):
+        h.note_scan(0, t)
+        h.note_scan(1, t)
+        h.note_tick(t)
+    h.note_estimator(0, True)
+    h.note_scan(0, 4)
+    h.note_scan(1, 4)
+    h.note_tick(4)
+    assert h.robot_states() == [ESTIMATOR_DIVERGED, OK]
+    assert h.alive_mask().tolist() == [True, True]
+    assert h.assignable_mask().tolist() == [False, True]
+    assert h.lidar_ok_mask().tolist() == [False, True]
+    assert h.diverged_mask().tolist() == [True, False]
+    assert h.snapshot()["estimator_diverged"] == [True, False]
+    # Lidar silence outranks the estimator rung.
+    for t in range(5, 10):
+        h.note_scan(1, t)
+        h.note_tick(t)
+    assert h.robot_states()[0] == "no_lidar"
+    # Scans resume + estimator cleared -> OK.
+    h.note_estimator(0, False)
+    h.note_scan(0, 10)
+    h.note_tick(10)
+    assert h.robot_states() == [OK, OK]
+    ladder = [(a, b) for _, a, b in h.transitions_for("robot0")]
+    assert (OK, ESTIMATOR_DIVERGED) in ladder
+
+
+# ------------------------------------------------------------- anti-stuck
+
+def _ladder(n_robots=1, **kw):
+    kw.setdefault("stuck_window_ticks", 6)
+    kw.setdefault("stuck_displacement_frac", 0.25)
+    kw.setdefault("rotate_recovery_ticks", 3)
+    kw.setdefault("backup_recovery_ticks", 3)
+    kw.setdefault("escalation_memory_ticks", 30)
+    kw.setdefault("blacklist_ttl_ticks", 20)
+    return AntiStuckLadder(RecoveryConfig(**kw), n_robots,
+                           rotation_units=50, cruise_units=100)
+
+
+def _drive(ladder, ticks, t0, pose, cmd=(100, 100), active=True):
+    """Run `ticks` stationary ticks; returns (events seen, overrides,
+    blacklist requests, final tick)."""
+    ov_log, bl_log = [], []
+    poses = np.asarray([list(pose) + [0.0]], np.float32)
+    for t in range(t0, t0 + ticks):
+        ov, bl = ladder.step(t, poses, np.asarray([cmd], np.int32),
+                             np.asarray([active]))
+        ov_log.append(ov.get(0))
+        bl_log += bl
+    return ov_log, bl_log, t0 + ticks
+
+
+def test_antistuck_ladder_escalates_rotate_backup_blacklist():
+    lad = _ladder()
+    # Commanded motion, zero displacement: rung 0 (rotate) after the
+    # window fills; maneuver overrides for rotate_recovery_ticks.
+    ov, bl, t = _drive(lad, 10, 0, (1.0, 2.0))
+    assert (50, -50) in ov and not bl
+    assert lad.n_recoveries["rotate"] == 1
+    # Still stuck within escalation memory: rung 1 (backup).
+    ov, bl, t = _drive(lad, 10, t, (1.0, 2.0))
+    assert (-100, -100) in ov and not bl
+    assert lad.n_recoveries["backup"] == 1
+    # Still stuck: rung 2 requests a blacklist (no maneuver; it may
+    # re-request if the goal somehow stays assigned — the blacklist's
+    # dedup absorbs that).
+    ov, bl, t = _drive(lad, 10, t, (1.0, 2.0))
+    assert bl and set(bl) == {0}
+    assert lad.n_recoveries["blacklist"] >= 1
+    kinds = [e for _, _, e in lad.events if e.startswith("stuck")]
+    assert kinds[:3] == ["stuck:rung=rotate", "stuck:rung=backup",
+                        "stuck:rung=blacklist"]
+
+
+def test_antistuck_resets_after_clean_stretch_and_skips_inactive():
+    lad = _ladder()
+    ov, _, t = _drive(lad, 10, 0, (0.0, 0.0))
+    assert (50, -50) in ov                   # first detection: rotate
+    # A long clean (moving) stretch: escalation memory expires.
+    poses = np.asarray([[0.0, 0.0, 0.0]], np.float32)
+    for k in range(40):
+        poses[0, 0] += 0.05                  # plenty of displacement
+        lad.step(t + k, poses, np.asarray([[100, 100]], np.int32),
+                 np.asarray([True]))
+    t += 40
+    # Stuck again: the ladder restarts at rung 0 (rotate, not backup).
+    ov, _, t = _drive(lad, 10, t, (5.0, 5.0))
+    assert (50, -50) in ov
+    assert lad.n_recoveries["rotate"] == 2
+    assert lad.n_recoveries["backup"] == 0
+    # Inactive robots (coasting / manual / idle) are never detected.
+    lad2 = _ladder()
+    ov, bl, _ = _drive(lad2, 30, 0, (0.0, 0.0), active=False)
+    assert not any(ov) and not bl
+    assert lad2.n_stuck_detections == 0
+
+
+def test_antistuck_ignores_slow_but_healthy_cruise():
+    """Regression: a Thymio cruising at 100 units covers only ~3 mm per
+    tick — an absolute displacement floor would call that stuck. The
+    commanded-relative detector must not."""
+    lad = _ladder()
+    poses = np.asarray([[0.0, 0.0, 0.0]], np.float32)
+    for t in range(40):
+        # Exactly the commanded distance: 100 units * 3.027e-5 m/unit/tick.
+        poses[0, 0] += 100 * 3.027e-5
+        ov, bl = lad.step(t, poses, np.asarray([[100, 100]], np.int32),
+                          np.asarray([True]))
+        assert not ov and not bl
+    assert lad.n_stuck_detections == 0
+    # Even at HALF the commanded distance (motor lag, soft ground) the
+    # 25% floor keeps a moving robot out of recovery.
+    lad2 = _ladder()
+    poses = np.asarray([[0.0, 0.0, 0.0]], np.float32)
+    for t in range(40):
+        poses[0, 0] += 50 * 3.027e-5
+        lad2.step(t, poses, np.asarray([[100, 100]], np.int32),
+                  np.asarray([True]))
+    assert lad2.n_stuck_detections == 0
+
+
+def test_frontier_blacklist_ttl_and_dedup():
+    bl = FrontierBlacklist(RecoveryConfig(blacklist_ttl_ticks=10))
+    bl.note_tick(5)
+    bl.add(0, (1.0, 2.0))
+    bl.add(0, (1.0, 2.0))                   # dedup: refresh, not stack
+    bl.add(1, (1.0, 2.0))                   # per-robot entries
+    assert bl.n_blacklisted == 2
+    assert bl.is_blacklisted(0, (1.05, 2.0), tol_m=0.1)
+    assert not bl.is_blacklisted(0, (3.0, 2.0), tol_m=0.1)
+    assert bl.is_blacklisted(1, (1.0, 2.0), tol_m=0.1)
+    bl.note_tick(16)                        # past the TTL: expired
+    assert not bl.is_blacklisted(0, (1.0, 2.0), tol_m=0.1)
+    assert bl.entries() == []
+
+
+# ------------------------------------------- adversarial fault kinds
+
+def test_sensor_fault_kind_validation_and_resources():
+    for kind in SENSOR_KINDS:
+        FaultEvent(step=0, kind=kind, value=0.2)    # constructs fine
+    # The value default (0.0) is refused for value-carrying kinds: for
+    # wheel_slip it is the worst possible fault (0x = odometry
+    # blackout, not slip), for miscal/ghosts a silent no-op.
+    with pytest.raises(ValueError, match="wheel_slip needs value > 0"):
+        FaultEvent(step=0, kind="wheel_slip")
+    with pytest.raises(ValueError, match="nonzero value"):
+        FaultEvent(step=0, kind="lidar_miscal")
+    with pytest.raises(ValueError, match="nonzero value"):
+        FaultEvent(step=0, kind="ghost_returns")
+    FaultEvent(step=0, kind="scan_jam")             # value-less kind
+    assert _fault_resource("ghost_returns", 1) == ("scan", 1)
+    assert _fault_resource("scan_jam", 1) == ("scan", 1)
+    assert _fault_resource("wheel_slip", 0) == ("odom", 0)
+    assert _fault_resource("bus_drop", 0) == ("bus", "bus_drop")
+
+
+def test_sensor_fault_windows_compose_worst_active():
+    """Overlapping windows on one robot's sensor run the WORST active
+    value and revert to the identity baseline when the last clears."""
+    class _Sim:
+        def __init__(self):
+            self.slip, self.miscal, self.ghost, self.jam = 1.0, 0.0, 0.0, False
+
+        def set_wheel_slip(self, r, v):
+            self.slip = v
+
+        def set_lidar_miscal(self, r, v):
+            self.miscal = v
+
+        def set_ghost_returns(self, r, v):
+            self.ghost = v
+
+        def set_scan_jam(self, r, v):
+            self.jam = v
+
+    class _Stack:
+        def __init__(self):
+            self.sim = _Sim()
+            self.bus = None
+
+    plan = FaultPlan([
+        FaultEvent(step=0, kind="ghost_returns", value=0.3, duration=10),
+        FaultEvent(step=5, kind="ghost_returns", value=0.2, duration=10),
+        FaultEvent(step=0, kind="wheel_slip", value=1.3, duration=8),
+        FaultEvent(step=2, kind="wheel_slip", value=0.8, duration=10),
+        FaultEvent(step=0, kind="scan_jam", duration=6),
+    ], seed=0)
+    st = _Stack()
+    plan.apply(st, 0)
+    assert st.sim.ghost == 0.3 and st.sim.slip == 1.3 and st.sim.jam
+    plan.apply(st, 2)
+    assert st.sim.slip == 1.3               # |1.3-1| > |0.8-1|: worst wins
+    plan.apply(st, 6)
+    assert not st.sim.jam                   # jam window cleared
+    plan.apply(st, 8)
+    assert st.sim.slip == 0.8               # first slip window out
+    plan.apply(st, 10)
+    assert st.sim.ghost == 0.2              # second ghost window holds
+    # The second window FIRED at apply-step 6 (first apply at or after
+    # its scheduled step), so its clear lands at 6 + 10.
+    plan.apply(st, 16)
+    assert st.sim.ghost == 0.0 and st.sim.slip == 1.0
+    assert plan.done()
+
+
+def test_sensor_fault_helpers_deterministic():
+    from jax_mapping.sim.lidar import apply_ghost_returns, apply_lidar_miscal
+    from jax_mapping.sim.thymio import apply_wheel_slip
+    cfg = tiny_config()
+    ranges = np.linspace(0.5, 2.5, cfg.scan.padded_beams).astype(np.float32)
+    a = apply_ghost_returns(cfg.scan, ranges, 0.4,
+                            np.random.default_rng((7, 3, 0)))
+    b = apply_ghost_returns(cfg.scan, ranges, 0.4,
+                            np.random.default_rng((7, 3, 0)))
+    np.testing.assert_array_equal(a, b)     # seeded: bit-identical
+    changed = (a[:cfg.scan.n_beams] != ranges[:cfg.scan.n_beams])
+    assert 0.2 < changed.mean() < 0.6       # ~the requested fraction
+    assert (a[changed.nonzero()[0]] <= 0.5 + 1e-6).all()   # SHORT ghosts
+    np.testing.assert_array_equal(a[cfg.scan.n_beams:],
+                                  ranges[cfg.scan.n_beams:])  # padded tail
+    m = apply_wheel_slip(np.ones((2, 2), np.float32), np.asarray([1.5, 1.0]))
+    np.testing.assert_allclose(m, [[1.5, 1.5], [1.0, 1.0]])
+    p = apply_lidar_miscal(np.zeros((2, 3), np.float32),
+                           np.asarray([0.25, 0.0]))
+    np.testing.assert_allclose(p[:, 2], [0.25, 0.0])
+
+
+def test_random_plan_samples_adversarial_and_rejects_overlap():
+    """The fuzz generator samples the new kinds and never schedules two
+    windows on one resource that overlap in time (satellite: reject at
+    generation time)."""
+    seen = set()
+    for seed in range(12):
+        plan = random_plan(200, n_faults=8, seed=seed, n_robots=2)
+        assert len(plan.events) > 0
+        windows = []
+        for ev in plan.events:
+            seen.add(ev.kind)
+            res = _fault_resource(ev.kind, ev.robot)
+            for r, s, e in windows:
+                if r == res:
+                    assert not (ev.step <= e and s <= ev.step + ev.duration), \
+                        f"seed {seed}: overlapping windows on {res}"
+            windows.append((res, ev.step, ev.step + ev.duration))
+        # Kind-appropriate magnitudes.
+        for ev in plan.events:
+            if ev.kind == "wheel_slip":
+                assert 1.1 <= ev.value <= 1.5
+            elif ev.kind == "lidar_miscal":
+                assert 0.05 <= abs(ev.value) <= 0.3
+            elif ev.kind == "ghost_returns":
+                assert 0.1 <= ev.value <= 0.4
+    assert seen & SENSOR_KINDS              # the new kinds are sampled
+    a = random_plan(150, n_faults=6, seed=9, n_robots=2)
+    b = random_plan(150, n_faults=6, seed=9, n_robots=2)
+    assert a.events == b.events             # seed-deterministic
+    # Saturation is VISIBLE, never silent: a short mission cannot place
+    # many disjoint windows, and the dropped count is reported.
+    tight = random_plan(20, n_faults=30, seed=1, n_robots=1)
+    assert len(tight.events) + tight.generation_shortfall == 30
+    assert tight.generation_shortfall > 0
+
+
+# ------------------------------------------------- reactive shield (sat 4)
+
+def test_reactive_shield_overrides_seek_at_every_state():
+    """Regression (satellite): `subsumption_policy` outranks the seek
+    branch whenever IR or LiDAR demand it — seek engages ONLY in the
+    cruise state (reactive.state == 1), checked at every state value
+    the policy can produce (0 idle, 1 cruise, 2 ir, 3 warn)."""
+    import jax.numpy as jnp
+    from jax_mapping.models.explorer import (frontier_policy,
+                                             subsumption_policy)
+    cfg = tiny_config()
+    robot, scan = cfg.robot, cfg.scan
+    B = scan.padded_beams
+    goal = jnp.asarray([[-2.0, 0.0]])       # behind: strong seek steer
+    pose = jnp.zeros((1, 3))
+    valid = jnp.asarray([True])
+
+    def both(ranges, prox, exploring=True):
+        r = jnp.asarray(ranges, jnp.float32)[None]
+        p = jnp.asarray(prox, jnp.float32)[None]
+        e = jnp.asarray([exploring])
+        re = subsumption_policy(robot, scan, r, p, e)
+        fr = frontier_policy(robot, scan, pose, goal, valid, r, p, e)
+        return re, fr
+
+    clear = np.full(B, 5.0, np.float32)
+    no_ir = np.zeros(5, np.float32)
+
+    # state 0 (idle): not exploring -> zero targets, seek irrelevant.
+    re, fr = both(clear, no_ir, exploring=False)
+    assert int(re.state[0]) == 0
+    np.testing.assert_array_equal(np.asarray(fr.targets), [[0, 0]])
+
+    # state 1 (cruise): seek ENGAGES — differs from the blind cruise.
+    re, fr = both(clear, no_ir)
+    assert int(re.state[0]) == 1 and int(fr.state[0]) == 1
+    assert not np.array_equal(np.asarray(fr.targets),
+                              np.asarray(re.targets))
+
+    # state 2 (IR emergency): the pivot overrides seek EXACTLY — at the
+    # threshold boundary too (prox must EXCEED ir_threshold).
+    ir_at = np.asarray([robot.ir_threshold] * 5, np.float32)
+    re, fr = both(clear, ir_at)
+    assert int(re.state[0]) == 1            # boundary: == is not over
+    ir_over = ir_at + 1
+    re, fr = both(clear, ir_over)
+    assert int(re.state[0]) == 2 and int(fr.state[0]) == 2
+    np.testing.assert_array_equal(np.asarray(fr.targets),
+                                  np.asarray(re.targets))
+
+    # state 3 (LiDAR warn): the swerve overrides seek EXACTLY — at the
+    # distance boundary too (dist must be UNDER lidar_warn_dist_m).
+    warn = clear.copy()
+    warn[:30] = robot.lidar_warn_dist_m     # boundary: == is not under
+    re, fr = both(warn, no_ir)
+    assert int(re.state[0]) == 1
+    warn[:30] = robot.lidar_warn_dist_m - 0.01
+    re, fr = both(warn, no_ir)
+    assert int(re.state[0]) == 3 and int(fr.state[0]) == 3
+    np.testing.assert_array_equal(np.asarray(fr.targets),
+                                  np.asarray(re.targets))
+
+
+def test_frontier_policy_clamps_to_motor_range():
+    """Satellite: the seek branch's base ± steer*cruise*0.5 must
+    saturate at the Thymio motor command range before the int32 cast."""
+    import jax.numpy as jnp
+    from jax_mapping.models.explorer import frontier_policy
+    cfg = tiny_config()
+    robot = dataclasses.replace(cfg.robot, cruise_speed_units=500)
+    B = cfg.scan.padded_beams
+    # A goal ~45 deg off-axis: |steer| large while base stays high —
+    # the un-clamped right wheel would command 500 + 1.5*250 = 875.
+    out = frontier_policy(
+        robot, cfg.scan, jnp.zeros((1, 3)),
+        jnp.asarray([[2.0, 2.0]]), jnp.asarray([True]),
+        jnp.full((1, B), 5.0), jnp.zeros((1, 5)), jnp.asarray([True]))
+    t = np.asarray(out.targets)
+    assert int(out.state[0]) == 1           # seek really engaged
+    assert np.abs(t).max() == robot.motor_limit_units
+    assert (np.abs(t) <= robot.motor_limit_units).all()
+
+
+# ---------------------------------------- goal staleness (satellite)
+
+def test_brain_goal_state_watermark_and_ttl_prune(tiny_cfg):
+    """A reordered STALE /frontiers message must not clobber a fresher
+    one, and expired goal state is structurally deleted."""
+    from jax_mapping.bridge.brain import ThymioBrain
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.driver import SimulatedThymioDriver
+    from jax_mapping.bridge.messages import FrontierArray, Header
+
+    bus = Bus()
+    brain = ThymioBrain(tiny_cfg, bus, SimulatedThymioDriver(n_robots=1),
+                        n_robots=1)
+
+    def fr_msg(stamp, assignment):
+        return FrontierArray(
+            header=Header(stamp=stamp, frame_id="map"),
+            targets_xy=np.asarray([[1.0, 0.0]], np.float32),
+            sizes=np.asarray([4], np.int32),
+            assignment=np.asarray([assignment], np.int32))
+
+    pub = bus.publisher("/frontiers")
+    pub.publish(fr_msg(100.0, 0))
+    pub.publish(fr_msg(50.0, -1))           # stale reorder: rejected
+    assert brain._frontiers is not None
+    assert brain._frontiers[0].header.stamp == 100.0
+    assert int(np.asarray(brain._frontiers[0].assignment)[0]) == 0
+    # TTL prune: after seek_ttl_s of control ticks with no fresh
+    # message, the entry is DELETED (not just gated).
+    ttl_ticks = int(tiny_cfg.frontier.seek_ttl_s
+                    * tiny_cfg.robot.control_rate_hz)
+    brain.n_ticks = ttl_ticks + 2
+    brain._prune_stale_goal_state()
+    assert brain._frontiers is None
+    # The watermark SURVIVES the prune: a stale message flushed after a
+    # TTL-length gap (healed reorder window, dead mapper) must not be
+    # resurrected as fresh...
+    pub.publish(fr_msg(60.0, 0))
+    assert brain._frontiers is None
+    # ...while a genuinely fresh one is accepted.
+    pub.publish(fr_msg(120.0, 1))
+    assert brain._frontiers is not None
+    assert brain._frontiers[0].header.stamp == 120.0
+
+
+# ---------------------------------------------- tier-1 adversarial smoke
+
+def _known_cells(grid, thresh=0.5):
+    return int((np.abs(np.asarray(grid)) > thresh).sum())
+
+
+def test_adversarial_smoke_ghost_watchdog_relocalize(tmp_path):
+    """Tier-1 (satellite): ONE ghost_returns window mid-mission — the
+    watchdog declares divergence, the robot's evidence quarantines
+    (never fuses), relocalization re-admits it after the heal, and
+    coverage keeps growing afterward."""
+    import json
+    import urllib.request
+    cfg = tiny_config()
+    world = W.plank_course(96, cfg.grid.resolution_m, n_planks=4, seed=3)
+    st = launch_sim_stack(cfg, world, n_robots=1, realtime=False, seed=0,
+                          http_port=0)
+    st.brain.start_exploring()
+    plan = FaultPlan([FaultEvent(step=25, kind="ghost_returns", robot=0,
+                                 duration=15, value=0.5)], seed=0)
+    st.attach_fault_plan(plan)
+    st.run_steps(50)                        # fault window: steps 25-40
+    known_mid = _known_cells(st.mapper.merged_grid())
+    assert st.recovery.watchdog.n_diverge_events >= 1
+    assert st.mapper.n_scans_quarantined > 0
+    st.run_steps(30)                        # post-heal: relocalize + map
+    # The whole guardrail picture is exported on /status and /metrics.
+    base = f"http://127.0.0.1:{st.api.port}"
+    status = json.load(urllib.request.urlopen(f"{base}/status",
+                                              timeout=10))
+    rec = status["recovery"]
+    assert rec["watchdog"]["n_diverge_events"] >= 1
+    assert rec["n_scans_quarantined"] > 0
+    assert rec["n_relocalizations"] >= 1
+    assert "antistuck" in rec and "blacklist" in rec
+    metrics = urllib.request.urlopen(f"{base}/metrics",
+                                     timeout=10).read().decode()
+    assert "jax_mapping_recovery_diverge_events_total 1" in metrics
+    assert "jax_mapping_recovery_reloc_verified_total" in metrics
+    st.shutdown()
+    assert plan.done()
+    # The full ladder: diverged mid-fault, re-admitted after the heal.
+    ladder = [(a, b) for _, a, b in st.health.transitions_for("robot0")]
+    assert (OK, ESTIMATOR_DIVERGED) in ladder
+    assert ladder[-1][1] == OK
+    assert st.mapper.n_relocalizations >= 1
+    assert st.recovery.watchdog.n_readmits >= 1
+    assert st.recovery.watchdog.states() == [HEALTHY]
+    # Coverage recovered: mapping resumed after re-admission.
+    known_end = _known_cells(st.mapper.merged_grid())
+    assert known_end > known_mid
+    assert known_end > 200
+
+
+def test_recovery_disabled_restores_pre_guardrail_behavior(tmp_path):
+    """RecoveryConfig.enabled=False: no manager is built, nothing
+    quarantines, no health rung fires — and two same-seed disabled runs
+    under the same fault plan stay bit-identical."""
+    cfg = tiny_config()
+    cfg = cfg.replace(recovery=dataclasses.replace(cfg.recovery,
+                                                   enabled=False))
+    world = W.plank_course(96, cfg.grid.resolution_m, n_planks=4, seed=3)
+    grids = []
+    for _ in range(2):
+        st = launch_sim_stack(cfg, world, n_robots=1, realtime=False,
+                              seed=0)
+        st.brain.start_exploring()
+        plan = FaultPlan([FaultEvent(step=20, kind="ghost_returns",
+                                     robot=0, duration=10, value=0.5)],
+                         seed=0)
+        st.attach_fault_plan(plan)
+        st.run_steps(45)
+        grids.append(np.asarray(st.mapper.merged_grid()).copy())
+        assert st.recovery is None
+        assert st.mapper._recovery is None
+        assert st.mapper.n_scans_quarantined == 0
+        states = [s for _, _, s in
+                  [(t, a, b) for t, a, b in
+                   st.health.transitions_for("robot0")]]
+        assert ESTIMATOR_DIVERGED not in states
+        st.shutdown()
+    np.testing.assert_array_equal(grids[0], grids[1])
+
+
+def test_no_lint_suppressions_in_recovery():
+    """Satellite: the analysis baseline must not grow — recovery/ ships
+    with ZERO suppressions (the ratchet cannot hide new hazards there)."""
+    from jax_mapping.analysis.core import Baseline, default_baseline_path
+    base = Baseline.load(default_baseline_path())
+    offenders = [s for s in base.suppressions
+                 if "recovery" in s.get("path", "")]
+    assert not offenders, offenders
+
+
+# ------------------------------------------------- adversarial soak (slow)
+
+#: The acceptance mission: seeded wheel_slip + lidar_miscal on robot 0
+#: mid-mission, two robots mapping one world.
+SOAK_STEPS = 200
+SOAK_EVENTS = [
+    dict(step=40, kind="wheel_slip", robot=0, duration=40, value=1.5),
+    dict(step=50, kind="lidar_miscal", robot=0, duration=40, value=0.5),
+]
+#: Steps after the first fault's onset within which the watchdog must
+#: have declared divergence.
+DETECT_BUDGET_STEPS = 60
+
+
+def _soak_mission(seed, events, steps, enabled=True):
+    cfg = tiny_config()
+    if not enabled:
+        cfg = cfg.replace(recovery=dataclasses.replace(cfg.recovery,
+                                                       enabled=False))
+    world = W.plank_course(96, cfg.grid.resolution_m, n_planks=4, seed=3)
+    st = launch_sim_stack(cfg, world, n_robots=2, realtime=False,
+                          seed=seed)
+    st.brain.start_exploring()
+    plan = FaultPlan([FaultEvent(**e) for e in events], seed=seed)
+    st.attach_fault_plan(plan)
+    st.run_steps(steps)
+    grid = np.asarray(st.mapper.merged_grid()).copy()
+    st.shutdown()
+    return st, plan, grid
+
+
+@pytest.mark.slow
+def test_adversarial_soak_slip_miscal_detect_quarantine_readmit():
+    st, plan, grid_f = _soak_mission(0, SOAK_EVENTS, SOAK_STEPS)
+    assert plan.done()
+
+    # Detection within the bounded step budget of the first fault.
+    div = [(t, a, b) for t, a, b in st.health.transitions_for("robot0")
+           if b == ESTIMATOR_DIVERGED]
+    assert div, "watchdog never declared divergence"
+    assert div[0][0] <= SOAK_EVENTS[0]["step"] + DETECT_BUDGET_STEPS
+    assert st.mapper.n_scans_quarantined > 0
+
+    # Relocalization re-admitted the robot (healthy at mission end).
+    assert st.mapper.n_relocalizations >= 1
+    ladder = [(a, b) for _, a, b in st.health.transitions_for("robot0")]
+    assert ladder[-1][1] == OK
+    assert st.recovery.watchdog.states() == [HEALTHY, HEALTHY]
+
+    # The healthy robot never walked any ladder.
+    assert st.health.transitions_for("robot1") == []
+
+    # Map protection: vs the fault-free run, the faulted mission's map
+    # agrees on >= 90% of the cells both runs claim to know.
+    st0, _, grid_0 = _soak_mission(0, [], SOAK_STEPS)
+    known_f, known_0 = _known_cells(grid_f), _known_cells(grid_0)
+    assert known_0 > 1000                   # the baseline actually mapped
+    both = (np.abs(grid_f) > 0.5) & (np.abs(grid_0) > 0.5)
+    agree = float((np.sign(grid_f[both]) == np.sign(grid_0[both])).mean())
+    assert agree >= 0.90, f"sign agreement {agree:.3f}"
+    assert known_f / known_0 >= 0.5, f"coverage {known_f / known_0:.2f}"
+
+    # Bit-determinism: same seed, same plan -> identical map, identical
+    # guardrail history.
+    st_g, plan_g, grid_g = _soak_mission(0, SOAK_EVENTS, SOAK_STEPS)
+    np.testing.assert_array_equal(grid_f, grid_g)
+    assert plan_g.log == plan.log
+    assert st_g.recovery.watchdog.transitions == \
+        st.recovery.watchdog.transitions
+    assert st_g.health.transitions == st.health.transitions
+
+
+@pytest.mark.slow
+def test_adversarial_soak_disabled_is_bit_deterministic():
+    """enabled=False under the SAME fault plan: deterministic, no
+    guardrail activity (the pre-PR baseline the flag restores)."""
+    st_a, _, grid_a = _soak_mission(0, SOAK_EVENTS, SOAK_STEPS,
+                                    enabled=False)
+    st_b, _, grid_b = _soak_mission(0, SOAK_EVENTS, SOAK_STEPS,
+                                    enabled=False)
+    np.testing.assert_array_equal(grid_a, grid_b)
+    assert st_a.recovery is None and st_b.recovery is None
+    for st in (st_a, st_b):
+        states = [b for _, _, b in st.health.transitions_for("robot0")]
+        assert ESTIMATOR_DIVERGED not in states
