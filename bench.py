@@ -117,7 +117,67 @@ def _probe_backend() -> bool:
     return backend_probe_ok(timeout_s=PROBE_TIMEOUT_S)
 
 
+def _serving_main() -> None:
+    """`bench.py --suite serving` — the map-serving benchmark
+    (serving/loadgen.py): N concurrent synthetic clients against a live
+    `launch_sim_stack`, whole-PNG polling vs the tiled delta protocol.
+    Prints exactly ONE JSON line, same contract as the kernel bench.
+
+    A host/stack benchmark: pinned to virtual CPU (the sim stack's jit
+    compiles must not hang on a wedged TPU tunnel, and the serving
+    numbers measure HTTP bytes and host encode work, not device
+    kernels)."""
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        from jax_mapping.utils.backend_guard import scrubbed_cpu_env
+        os.execvpe(sys.executable, [sys.executable] + sys.argv,
+                   scrubbed_cpu_env(extra_env={
+                       "JAX_PLATFORMS": "cpu",
+                       "JAX_MAPPING_BENCH_DEADLINE_S":
+                           str(max(60.0, _remaining()))}))
+    result = {"metric": "map_serving_bytes_per_client",
+              "suite": "serving", "error": "watchdog deadline hit"}
+    emitted = threading.Event()
+
+    def emit(code: int = 0) -> None:
+        if not emitted.is_set():
+            emitted.set()
+            print(json.dumps(result), flush=True)
+        os._exit(code)
+
+    watchdog = threading.Timer(max(_remaining(), 1.0), emit)
+    watchdog.daemon = True
+    watchdog.start()
+    out = None
+    if "--out" in sys.argv:
+        i = sys.argv.index("--out")
+        out = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+    try:
+        from jax_mapping.serving.loadgen import run_serving_benchmark
+        result = run_serving_benchmark(out_path=out)
+        try:
+            load1 = round(os.getloadavg()[0], 1)
+        except OSError:
+            load1 = None
+        result["provenance"] = {
+            "cpu_count": os.cpu_count(), "loadavg_1m": load1,
+            "python": ".".join(map(str, sys.version_info[:3]))}
+    except Exception:
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = "serving benchmark failed (see stderr)"
+    emit(0)
+
+
 def main() -> None:
+    if "--suite" in sys.argv:
+        i = sys.argv.index("--suite")
+        suite = sys.argv[i + 1] if i + 1 < len(sys.argv) else ""
+        if suite == "serving":
+            _serving_main()
+            return
+        print(f"bench: unknown suite {suite!r} (available: serving)",
+              file=sys.stderr, flush=True)
+        sys.exit(2)
     if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") != "1" \
             and not _probe_backend():
         print("bench: backend init/compile probe did not finish in "

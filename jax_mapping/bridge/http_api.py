@@ -19,7 +19,22 @@ variants' routes merged:
 * `GET /frontiers` — JSON frontier targets + assignment (new capability).
 * `GET /voxel-image` — grayscale height-map PNG of the 3D voxel map
   (BASELINE configs[4]; 404 unless the stack runs with depth_cam).
-* `GET /metrics` — framework counters in Prometheus text format.
+* `GET /metrics` — framework counters in Prometheus text format, now
+  including per-route request counters and a request-latency histogram
+  (`jax_mapping_http_request_seconds`).
+* `GET /tiles?since=<revision>[&level=k]` — the serving subsystem's
+  delta protocol (serving/tiles.py): only the tiles whose content
+  changed since the client's revision, as base64 PNGs in a JSON
+  manifest, with a quadtree overview pyramid. `GET /voxel-tiles` is the
+  height-map twin. 404 when `ServingConfig.enabled` is False.
+* `GET /map-events` — SSE push stream of map-revision events
+  (`?mode=poll&since=R` long-polls one JSON event instead); per-client
+  bounded queues with drop-to-latest backpressure, every wait capped by
+  `ServingConfig.event_wait_max_s` (the bounded-wait contract of the
+  503-degraded path, applied to push).
+* Every map route answers conditional GETs: `ETag` keyed on map stamp /
+  voxel fusion key / tile revision, `If-None-Match` hit -> 304 with an
+  empty body (pollers stop paying full-PNG bodies for unchanged maps).
 * `POST /save[?name=x]`, `POST /load[?name=x]` — checkpoint / restore the
   live SLAM state (grid, poses, graphs, scan rings) through
   `io.checkpoint`. The capability slam_toolbox exposes as its
@@ -99,6 +114,13 @@ class MapApiServer:
         self.lock_timeout_s = lock_timeout_s
         self.n_degraded_responses = 0
         self._lock = threading.Lock()
+        #: Request statistics lock: ThreadingHTTPServer runs one worker
+        #: thread per connection, and `n_requests += 1` is a read-
+        #: modify-write — handler threads racing on it under-count.
+        #: Every request counter (totals, per-route, degraded, 304s,
+        #: the latency histogram) mutates under THIS dedicated lock so
+        #: stats can never contend with the map/frontier state lock.
+        self._stats_lock = threading.Lock()
         self._latest_map: Optional[OccupancyGrid] = None
         self._latest_frontiers: Optional[FrontierArray] = None
         # The 1 s PNG cache, implemented for real this time — one policy
@@ -107,6 +129,30 @@ class MapApiServer:
         self.png_cache_hits: Dict[str, int] = {}
         self.n_requests = 0
         self.n_png_cache_hits = 0
+        self.n_304_responses = 0
+        #: Per-route request counters + request-latency histogram
+        #: (Prometheus `jax_mapping_http_request_seconds` buckets) —
+        #: without these, a serving regression on one route hides
+        #: inside the process-wide total.
+        self.route_requests: Dict[str, int] = {}
+        self._lat_buckets_s = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                               0.5, 1.0, 2.5, 5.0)
+        self._lat_counts = [0] * (len(self._lat_buckets_s) + 1)
+        self._lat_sum_s = 0.0
+        self._lat_n = 0
+        #: Tiled delta serving (serving/): built when the attached
+        #: mapper's config enables it. ServingConfig.enabled=False (or
+        #: no mapper) leaves this None — /tiles, /voxel-tiles and
+        #: /map-events answer 404, exact pre-serving behavior.
+        self.serving = None
+        self._shutting_down = threading.Event()
+        if mapper is not None and \
+                getattr(mapper.cfg, "serving", None) is not None and \
+                mapper.cfg.serving.enabled:
+            from jax_mapping.serving import MapServing
+            self.serving = MapServing(mapper.cfg.serving, mapper=mapper,
+                                      voxel_mapper=voxel_mapper)
+            mapper.add_revision_listener(self.serving.on_map_revision)
 
         bus.subscribe("/map", qos_map, callback=self._map_cb)
         bus.subscribe("/frontiers", callback=self._frontiers_cb)
@@ -123,29 +169,47 @@ class MapApiServer:
             timeout = socket_timeout_s
 
             def _dispatch(self, method):
-                api.n_requests += 1
+                t0 = time.monotonic()
+                extra = {}
                 try:
-                    status, ctype, body = api.handle(self.path,
-                                                     method=method)
+                    res = api.handle(self.path, method=method,
+                                     headers=self.headers)
+                    status, ctype, body = res[0], res[1], res[2]
+                    if len(res) > 3 and res[3]:
+                        extra = res[3]
                 except LockTimeout as e:
                     # Bounded-wait contract: a wedged node lock answers
                     # 503 degraded, not a hung worker thread.
-                    api.n_degraded_responses += 1
+                    with api._stats_lock:
+                        api.n_degraded_responses += 1
                     status, ctype, body = 503, "application/json", \
                         json.dumps({"state": "degraded",
                                     "error": str(e)}).encode()
                 except Exception as e:            # noqa: BLE001
                     status, ctype, body = 500, "application/json", json.dumps(
                         {"error": str(e)}).encode()
+                api._record_request(self.path, time.monotonic() - t0,
+                                    status)
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in extra.items():
+                    self.send_header(k, v)
                 if status == 405:
                     self.send_header("Allow", "POST")
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
+                # The SSE stream writes incrementally and owns its
+                # socket until the bounded deadline — it cannot go
+                # through the buffered one-body _dispatch path.
+                route = self.path.split("?")[0].rstrip("/") or "/"
+                qs = self.path.partition("?")[2]
+                if route == "/map-events" and api.serving is not None \
+                        and "mode=poll" not in qs:
+                    api._serve_sse(self)
+                    return
                 self._dispatch("GET")
 
             def do_POST(self):
@@ -170,6 +234,52 @@ class MapApiServer:
         with self._lock:
             self._latest_frontiers = msg
 
+    # -- request statistics -------------------------------------------------
+
+    #: Routes the per-route counter tracks individually; anything else
+    #: aggregates under "other" so hostile paths can't grow label
+    #: cardinality without bound.
+    _KNOWN_ROUTES = frozenset((
+        "/", "/start", "/stop", "/status", "/map-image", "/voxel-image",
+        "/frontiers", "/metrics", "/save", "/load", "/goal",
+        "/goal/cancel", "/save-map", "/tiles", "/voxel-tiles",
+        "/map-events"))
+
+    def _record_request(self, path: str, elapsed_s: float,
+                        status: int = 200) -> None:
+        """One request's bookkeeping (any worker thread): total,
+        per-route counter, latency histogram, 304 count — all under the
+        dedicated stats lock (the unsynchronized `n_requests += 1` of
+        the pre-serving handler lost increments under thread races)."""
+        route = path.split("?")[0].rstrip("/") or "/"
+        if route not in self._KNOWN_ROUTES:
+            route = "other"
+        with self._stats_lock:
+            self.n_requests += 1
+            self.route_requests[route] = \
+                self.route_requests.get(route, 0) + 1
+            if status == 304:
+                self.n_304_responses += 1
+            for k, le in enumerate(self._lat_buckets_s):
+                if elapsed_s <= le:
+                    self._lat_counts[k] += 1
+                    break
+            else:
+                self._lat_counts[-1] += 1
+            self._lat_sum_s += elapsed_s
+            self._lat_n += 1
+
+    @staticmethod
+    def _etag_hit(headers, etag: str) -> bool:
+        """RFC 7232 weak comparison, enough for our self-issued tags."""
+        if headers is None:
+            return False
+        inm = headers.get("If-None-Match")
+        if not inm:
+            return False
+        return etag in [v.strip() for v in inm.split(",")] or \
+            inm.strip() == "*"
+
     # -- request handling ---------------------------------------------------
 
     def _dead_node_guard(self, route: str) -> Optional[Tuple[int, str, bytes]]:
@@ -188,14 +298,18 @@ class MapApiServer:
                  "/goal/cancel": "thymio_brain", "/start": "thymio_brain"}
         node = needs.get(route)
         if node is not None and not self.supervisor.is_alive(node):
-            self.n_degraded_responses += 1
+            with self._stats_lock:
+                self.n_degraded_responses += 1
             return 503, "application/json", json.dumps(
                 {"state": "degraded",
                  "error": f"{node} is down (supervisor restart pending); "
                           f"{route} unavailable"}).encode()
         return None
 
-    def handle(self, path: str, method: str = "GET") -> Tuple[int, str, bytes]:
+    def handle(self, path: str, method: str = "GET",
+               headers=None) -> Tuple:
+        """Route a request; returns (status, content-type, body) or
+        (status, content-type, body, extra-headers-dict)."""
         route = path.split("?")[0].rstrip("/") or "/"
         dead = self._dead_node_guard(route)
         if dead is not None:
@@ -257,9 +371,17 @@ class MapApiServer:
                 body.update(self.extra_status())
             return 200, "application/json", json.dumps(body).encode()
         if route == "/map-image":
-            return self._map_image()
+            return self._map_image(headers)
         if route == "/voxel-image":
-            return self._voxel_image()
+            return self._voxel_image(headers)
+        if route == "/tiles":
+            return self._tiles(path, headers, source="grid")
+        if route == "/voxel-tiles":
+            return self._tiles(path, headers, source="voxel-height")
+        if route == "/map-events":
+            # The SSE variant is intercepted in the handler (it streams);
+            # reaching here means ?mode=poll — one bounded long-poll.
+            return self._map_events_poll(path)
         if route == "/frontiers":
             return self._frontiers()
         if route == "/metrics":
@@ -513,19 +635,25 @@ class MapApiServer:
         return 200, "application/json", json.dumps(
             {"status": "saved", "pgm": pgm, "yaml": yaml}).encode()
 
-    def _map_image(self) -> Tuple[int, str, bytes]:
+    def _map_image(self, headers=None) -> Tuple:
         with self._lock:
             msg = self._latest_map
         if msg is None:
             # Reference guard (`server/.../main.py:244-245`).
             return 404, "application/json", \
                 json.dumps({"error": "map not yet available"}).encode()
+        # Conditional GET keyed on the map stamp: a poller holding the
+        # current ETag pays a 304 header instead of the full PNG body —
+        # the byte-saving half of the cache even before the tile path.
+        etag = f'W/"map-{msg.header.stamp}"'
+        if self._etag_hit(headers, etag):
+            return 304, "image/png", b"", {"ETag": etag}
         data = self._cached_png(
             "map", msg.header.stamp,
             lambda: png_codec.encode_gray(msg.as_image_array()))
-        return 200, "image/png", data
+        return 200, "image/png", data, {"ETag": etag}
 
-    def _voxel_image(self) -> Tuple[int, str, bytes]:
+    def _voxel_image(self, headers=None) -> Tuple:
         """Grayscale height-map PNG of the 3D voxel map (0 = unmapped
         column, brighter = taller top surface) — the /map-image analog
         for the BASELINE configs[4] pipeline, with the same cache policy
@@ -535,12 +663,156 @@ class MapApiServer:
             return 404, "application/json", json.dumps(
                 {"error": "no voxel mapper attached (run the stack with "
                           "depth_cam enabled)"}).encode()
+        key = (self.voxel_mapper.n_images_fused,
+               self.voxel_mapper.map_revision)
+        etag = f'W/"voxel-{key[0]}-{key[1]}"'
+        if self._etag_hit(headers, etag):
+            return 304, "image/png", b"", {"ETag": etag}
         data = self._cached_png(
-            "voxel", (self.voxel_mapper.n_images_fused,
-                      self.voxel_mapper.map_revision),
+            "voxel", key,
             lambda: png_codec.encode_gray(
                 self.voxel_mapper.height_map_image()))
-        return 200, "image/png", data
+        return 200, "image/png", data, {"ETag": etag}
+
+    # -- serving: tiled delta distribution (serving/) ------------------------
+
+    def _tiles(self, path: str, headers, source: str) -> Tuple:
+        """GET /tiles?since=<revision>[&level=k] — the delta protocol:
+        refresh the tile store to the mapper's revision, then return
+        ONLY the tiles stamped newer than the client's `since` as
+        base64 PNGs in a JSON manifest. since=-1 (or omitted) is the
+        initial full snapshot. ETag on the store revision, so a poller
+        that is already current pays a 304."""
+        if self.serving is None:
+            return 404, "application/json", json.dumps(
+                {"error": "serving disabled "
+                          "(ServingConfig.enabled=False)"}).encode()
+        store = self.serving.store(source)
+        if store is None:
+            return 404, "application/json", json.dumps(
+                {"error": f"no {source} tile store (run the stack with "
+                          "the producing mapper attached)"}).encode()
+        q = parse_qs(urlparse(path).query)
+        try:
+            since = int(q.get("since", ["-1"])[0])
+            level = int(q["level"][0]) if "level" in q else None
+        except (ValueError, IndexError):
+            return 400, "application/json", json.dumps(
+                {"error": "since and level must be integers"}).encode()
+        store.refresh()
+        rev, entries, meta = store.tiles_since(since, level)
+        etag = f'W/"{source}-r{rev}"'
+        if self._etag_hit(headers, etag):
+            return 304, "application/json", b"", {"ETag": etag}
+        body = dict(meta)
+        body.update({"revision": rev, "since": since, "tiles": entries})
+        return 200, "application/json", json.dumps(body).encode(), \
+            {"ETag": etag}
+
+    def _map_events_poll(self, path: str) -> Tuple[int, str, bytes]:
+        """GET /map-events?mode=poll&since=R[&wait_s=S] — bounded
+        long-poll: answers as soon as the map revision exceeds `since`
+        (immediately when it already does), or after the capped wait
+        with `timed_out: true`. The worker thread's wait is bounded by
+        `ServingConfig.event_wait_max_s` — the 503-degraded path's
+        bounded-wait contract, applied to push."""
+        if self.serving is None or self.mapper is None:
+            return 404, "application/json", json.dumps(
+                {"error": "serving disabled"}).encode()
+        q = parse_qs(urlparse(path).query)
+        try:
+            since = int(q.get("since", ["-1"])[0])
+            wait_s = float(q.get("wait_s", ["10"])[0])
+        except (ValueError, IndexError):
+            return 400, "application/json", json.dumps(
+                {"error": "since must be an integer, wait_s a "
+                          "number"}).encode()
+        wait_s = max(0.0, min(wait_s, self.serving.cfg.event_wait_max_s))
+        # Subscribe BEFORE the current-revision check (the _serve_sse
+        # order): an event fanned out between a check and a later
+        # subscribe would be missed and the poll would ride out its
+        # whole capped wait for an advance that already happened.
+        sub = self.serving.events.subscribe()
+        try:
+            current = self.mapper.serving_revision()
+            if current > since:
+                return 200, "application/json", json.dumps(
+                    {"map": "grid", "revision": current,
+                     "timed_out": False}).encode()
+            deadline = time.monotonic() + wait_s
+            while not self._shutting_down.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                ev = sub.next(min(0.5, remaining))
+                if ev is not None and int(ev.get("revision", -1)) > since:
+                    return 200, "application/json", json.dumps(
+                        {"map": "grid",
+                         "revision": int(ev["revision"]),
+                         "timed_out": False}).encode()
+        finally:
+            self.serving.events.unsubscribe(sub)
+        return 200, "application/json", json.dumps(
+            {"map": "grid", "revision": self.mapper.serving_revision(),
+             "timed_out": True}).encode()
+
+    def _serve_sse(self, handler) -> None:
+        """GET /map-events — Server-Sent Events stream of map-revision
+        advances, written directly on the handler's socket.
+
+        Backpressure and bounds: each client owns ONE bounded queue
+        (drop-to-latest on overflow — revisions are cumulative, old
+        events carry no information the newest doesn't), the stream
+        lifetime is capped by `event_wait_max_s` (clients reconnect,
+        standard SSE), the per-connection socket timeout covers stalled
+        writes, and shutdown closes every subscription — a slow client
+        can never pin server memory or a worker thread."""
+        self._record_request(handler.path, 0.0)
+        q = parse_qs(urlparse(handler.path).query)
+        try:
+            since = int(q.get("since", ["-1"])[0])
+            max_s = float(q.get("timeout_s",
+                                [str(self.serving.cfg.event_wait_max_s)])[0])
+        except (ValueError, IndexError):
+            since, max_s = -1, self.serving.cfg.event_wait_max_s
+        max_s = max(0.0, min(max_s, self.serving.cfg.event_wait_max_s))
+        sub = self.serving.events.subscribe()
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "text/event-stream")
+            handler.send_header("Cache-Control", "no-cache")
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            last_sent = since
+            current = (self.mapper.serving_revision()
+                       if self.mapper is not None else -1)
+            if current > last_sent:
+                handler.wfile.write(
+                    b"event: map\ndata: " + json.dumps(
+                        {"map": "grid", "revision": current}).encode()
+                    + b"\n\n")
+                last_sent = current
+            deadline = time.monotonic() + max_s
+            while not self._shutting_down.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                ev = sub.next(min(0.5, remaining))
+                if ev is None:
+                    handler.wfile.write(b": keepalive\n\n")
+                    continue
+                rev = int(ev.get("revision", -1))
+                if rev <= last_sent:
+                    continue       # drop-to-latest may reorder history
+                handler.wfile.write(
+                    b"event: map\ndata: "
+                    + json.dumps({"map": ev.get("map", "grid"),
+                                  "revision": rev}).encode() + b"\n\n")
+                last_sent = rev
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass                   # client went away: nothing to salvage
+        finally:
+            self.serving.events.unsubscribe(sub)
 
     def _cached_png(self, name: str, key, render: Callable[[], bytes]
                     ) -> bytes:
@@ -655,10 +927,72 @@ class MapApiServer:
                 f"jax_mapping_recovery_blacklisted_total "
                 f"{rec['blacklist']['n_blacklisted']}",
             ]
+        # Request-serving telemetry: per-route counters + the latency
+        # histogram, snapshotted under the stats lock so the exposition
+        # is internally consistent (bucket counts sum to _count).
+        with self._stats_lock:
+            routes = dict(self.route_requests)
+            lat_counts = list(self._lat_counts)
+            lat_sum = self._lat_sum_s
+            lat_n = self._lat_n
+            n_304 = self.n_304_responses
+            n_degraded = self.n_degraded_responses
+        lines += ["# TYPE jax_mapping_http_requests_by_route_total counter"]
+        lines += [
+            f'jax_mapping_http_requests_by_route_total{{route="{r}"}} {n}'
+            for r, n in sorted(routes.items())]
+        lines += ["# TYPE jax_mapping_http_request_seconds histogram"]
+        cum = 0
+        for le, n in zip(self._lat_buckets_s, lat_counts):
+            cum += n
+            lines += [f'jax_mapping_http_request_seconds_bucket'
+                      f'{{le="{le}"}} {cum}']
+        lines += [
+            f'jax_mapping_http_request_seconds_bucket{{le="+Inf"}} '
+            f"{cum + lat_counts[-1]}",
+            f"jax_mapping_http_request_seconds_sum {lat_sum:.6f}",
+            f"jax_mapping_http_request_seconds_count {lat_n}",
+            "# TYPE jax_mapping_http_not_modified_total counter",
+            f"jax_mapping_http_not_modified_total {n_304}",
+        ]
+        if self.serving is not None:
+            # Tile-store + event-channel health: hit-rates and
+            # backpressure drops for the delta-serving path.
+            sstats = self.serving.stats()
+            for src in ("grid", "voxel"):
+                st = sstats.get(src)
+                if st is None:
+                    continue
+                lines += [
+                    f"# TYPE jax_mapping_serving_{src}_revision gauge",
+                    f"jax_mapping_serving_{src}_revision {st['revision']}",
+                    f"# TYPE jax_mapping_serving_{src}_tiles_encoded_total"
+                    " counter",
+                    f"jax_mapping_serving_{src}_tiles_encoded_total "
+                    f"{st['n_tiles_encoded']}",
+                    f"# TYPE jax_mapping_serving_{src}_tiles_clean_total"
+                    " counter",
+                    f"jax_mapping_serving_{src}_tiles_clean_total "
+                    f"{st['n_tiles_clean_skipped']}",
+                    f"# TYPE jax_mapping_serving_{src}_hint_missed_total"
+                    " counter",
+                    f"jax_mapping_serving_{src}_hint_missed_total "
+                    f"{st['n_hint_missed']}",
+                ]
+            ev = sstats["events"]
+            lines += [
+                "# TYPE jax_mapping_serving_event_clients gauge",
+                f"jax_mapping_serving_event_clients {ev['n_clients']}",
+                "# TYPE jax_mapping_serving_events_total counter",
+                f"jax_mapping_serving_events_total {ev['n_events']}",
+                "# TYPE jax_mapping_serving_events_dropped_total counter",
+                f"jax_mapping_serving_events_dropped_total "
+                f"{ev['n_dropped']}",
+            ]
         lines += [
             "# TYPE jax_mapping_http_degraded_responses_total counter",
             f"jax_mapping_http_degraded_responses_total "
-            f"{self.n_degraded_responses}",
+            f"{n_degraded}",
             "# TYPE jax_mapping_bus_partition_dropped_total counter",
             f"jax_mapping_bus_partition_dropped_total "
             f"{self.bus.n_partition_dropped}",
@@ -696,6 +1030,12 @@ class MapApiServer:
         return self._thread
 
     def shutdown(self) -> None:
+        # Wake every SSE/long-poll wait first: their worker threads are
+        # daemons, but the bounded loops should exit promptly rather
+        # than ride out their deadlines against a closing socket.
+        self._shutting_down.set()
+        if self.serving is not None:
+            self.serving.events.close_all()
         # server.shutdown() blocks until the serve_forever loop acknowledges
         # — calling it when the loop never started would hang forever.
         if self._thread is not None:

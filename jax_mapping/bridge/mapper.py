@@ -133,6 +133,33 @@ class MapperNode(Node):
         #: healed partition flushing a stale queue) and is rejected —
         #: fusing it would smear old evidence at a newer pose.
         self._last_accepted_stamp = [-float("inf")] * n_robots
+        #: Serving (serving/tiles.py): monotonic map revision bumped on
+        #: every grid-content mutation (install, closure re-fuse,
+        #: restore, prior seed) + a boolean dirty-tile mask marking the
+        #: fixed-size tiles each mutation's patch extents touched — the
+        #: conservative superset the tile store's on-device hash diff
+        #: validates against. enabled=False keeps both untracked (exact
+        #: pre-serving behavior; every use gates on the flag).
+        self._serving_enabled = cfg.serving.enabled
+        self.map_revision = 0
+        #: Leaf lock for the dirty-tile mask: markers run while holding
+        #: `_state_lock` (install atomicity), the snapshot consumer
+        #: nests it the same way — one acquisition order, no cycle.
+        self._dirty_lock = threading.Lock()
+        self._dirty_tiles: Optional[np.ndarray] = None
+        if self._serving_enabled:
+            if cfg.grid.size_cells % cfg.serving.tile_cells:
+                raise ValueError(
+                    f"ServingConfig.tile_cells={cfg.serving.tile_cells} "
+                    f"does not divide grid.size_cells="
+                    f"{cfg.grid.size_cells}")
+            nt = cfg.grid.size_cells // cfg.serving.tile_cells
+            self._dirty_tiles = np.zeros((nt, nt), bool)
+        #: Revision listeners (the serving event channel): called with
+        #: the new revision from the tick thread, OUTSIDE every mapper
+        #: lock — fan-out must never run under _state_lock (lint B2).
+        self._revision_listeners: List = []
+        self._last_notified_revision = 0
         self.n_scans_fused = 0
         self.n_scans_dropped_unpaired = 0
         self.n_scans_rejected_stale = 0
@@ -203,6 +230,84 @@ class MapperNode(Node):
             self._prev_paired[i] = None
             self._prev_matched[i] = False
             self._correction[i] = None
+
+    # -- serving surface (serving/tiles.py) ----------------------------------
+
+    def _mark_dirty_patch(self, xy) -> None:
+        """Mark the serving tiles a fusion patch centred near world
+        point `xy` may have touched (caller holds `_state_lock`).
+
+        Derived from the patch geometry the install actually used
+        (`ops/grid.patch_origin`): the patch spans `patch_cells` around
+        the pose, and origin alignment can shift it up to align/2 cells
+        — pad by the alignment plus a small slack so window fallbacks
+        (per-scan patches at poses a few cells apart) stay covered.
+        Deliberately conservative: the tile store's on-device hash diff
+        prunes false positives; a false NEGATIVE here only shows up in
+        the store's `n_hint_missed` telemetry (the hash, not this mask,
+        decides what re-encodes)."""
+        if self._dirty_tiles is None:
+            return
+        g = self.cfg.grid
+        half = (g.patch_cells / 2.0
+                + max(g.align_rows, g.align_cols) / 2.0 + 8.0)
+        col = (xy[0] - g.origin_m[0]) / g.resolution_m
+        row = (xy[1] - g.origin_m[1]) / g.resolution_m
+        t = self.cfg.serving.tile_cells
+        nt = self._dirty_tiles.shape[0]
+        r0 = min(nt - 1, max(0, int((row - half) // t)))
+        r1 = min(nt - 1, max(0, int((row + half) // t)))
+        c0 = min(nt - 1, max(0, int((col - half) // t)))
+        c1 = min(nt - 1, max(0, int((col + half) // t)))
+        with self._dirty_lock:
+            self._dirty_tiles[r0:r1 + 1, c0:c1 + 1] = True
+
+    def _mark_dirty_all(self) -> None:
+        """Whole-map mutation (closure ring re-fuse, restore, prior
+        seed): every tile is suspect. Caller holds `_state_lock`."""
+        if self._dirty_tiles is not None:
+            with self._dirty_lock:
+                self._dirty_tiles[:] = True
+
+    def serving_revision(self) -> int:
+        """Current map revision — lock-free read (the /status counter
+        convention: stale-by-one beats blocking behind a fusion)."""
+        return self.map_revision
+
+    def serving_snapshot(self):
+        """(revision, shared grid, dirty-tile hint) — the tile store's
+        refresh source. The hint mask is CONSUMED (copied and cleared)
+        atomically with the grid snapshot: marks recorded before this
+        moment are by construction contained in the returned grid, and
+        marks landing after it accumulate for the next refresh."""
+        with self._state_lock:
+            rev = self.map_revision
+            grid = self.shared_grid
+            hint = None
+            if self._dirty_tiles is not None:
+                with self._dirty_lock:
+                    hint = self._dirty_tiles.copy()
+                    self._dirty_tiles[:] = False
+        return rev, grid, hint
+
+    def add_revision_listener(self, fn) -> None:
+        """Register fn(revision): called from the tick thread after the
+        tick's installs, outside every mapper lock (serving event
+        fan-out)."""
+        self._revision_listeners.append(fn)
+
+    def _notify_revision_listeners(self) -> None:
+        """Tick-thread fan-out of revision advances — deliberately
+        outside `_state_lock` (lint B2: no foreign code under a lock);
+        a listener landing one tick late is fine, a deadlock is not."""
+        if not self._serving_enabled or not self._revision_listeners:
+            return
+        rev = self.map_revision
+        if rev == self._last_notified_revision:
+            return
+        self._last_notified_revision = rev
+        for fn in list(self._revision_listeners):
+            fn(rev)
 
     # -- checkpoint surface --------------------------------------------------
 
@@ -278,6 +383,10 @@ class MapperNode(Node):
                 self._prev_paired[i] = None
                 self._prev_matched[i] = False
                 self._correction[i] = None
+            if self._serving_enabled:
+                # A restore replaces the whole shared grid out-of-band.
+                self.map_revision += 1
+                self._mark_dirty_all()
 
     def map_prior(self):
         """The live imported-map prior (for checkpoint sidecars), or
@@ -311,6 +420,9 @@ class MapperNode(Node):
                 self.states[i] = self.states[i]._replace(
                     grid=self.shared_grid)
                 self._state_gen[i] += 1
+            if self._serving_enabled:
+                self.map_revision += 1
+                self._mark_dirty_all()
 
     # -- topic callbacks -----------------------------------------------------
 
@@ -444,6 +556,7 @@ class MapperNode(Node):
 
         if any(work):
             self.publish_frontiers()
+        self._notify_revision_listeners()
         self._heartbeater.beat(
             {"scans_fused": self.n_scans_fused,
              "rejected_stale": self.n_scans_rejected_stale,
@@ -726,6 +839,15 @@ class MapperNode(Node):
             new_est = np.asarray(state.pose, np.float32)
             new_odo = np.asarray([od.pose.x, od.pose.y, od.pose.theta],
                                  np.float32)
+            if self._serving_enabled:
+                # Serving delta tracking: this install changed the map.
+                # A closure re-fused (possibly) everything; a plain
+                # step touched at most its fusion patch's tiles.
+                self.map_revision += 1
+                if closed:
+                    self._mark_dirty_all()
+                else:
+                    self._mark_dirty_patch(new_est[:2])
             if prev is not None and matched and self._prev_matched[i] \
                     and not closed:
                 # matched-after-matched only: the re-convergence snap

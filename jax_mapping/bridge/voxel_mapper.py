@@ -363,6 +363,48 @@ class VoxelMapperNode(Node):
         return np.asarray(self._V.obstacle_slice(
             self.cfg.voxel, self.voxel_grid(), z_min_m, z_max_m))
 
+    # -- serving surface (serving/tiles.py) ----------------------------------
+
+    def serving_revision(self) -> int:
+        """Monotonic content revision for the tile store: every grid
+        change bumps exactly one of the two nondecreasing counters the
+        PNG cache already keys on (`n_images_fused` for fusions,
+        `map_revision` for out-of-band replacements), so their sum
+        strictly increases per change. Lock-free read, the /status
+        counter convention."""
+        return self.n_images_fused + self.map_revision
+
+    def serving_snapshot(self):
+        """(revision, height-map uint8 image in GRID orientation) — the
+        voxel height-map tiles ride the same TileStore as the 2D map,
+        so this is `height_map_image` WITHOUT the flipud (tiles compose
+        in grid coordinates; clients flip once for display). The 3D
+        mapper has no patch-extent dirty marks — the store's on-device
+        hash diff alone decides which tiles re-encode.
+
+        Revision is read BEFORE the grid snapshot (counter reads stay
+        lock-free, the /status convention): a fusion landing between
+        the two leaves newer content under an older stamp, which the
+        next freshness peek heals by re-refreshing — the reverse order
+        would stamp OLD content with the new revision and serve it as
+        current forever."""
+        rev = self.n_images_fused + self.map_revision
+        grid = self.voxel_grid()
+        hm = np.asarray(self._V.height_map(self.cfg.voxel, grid))
+        return rev, self._height_to_gray(hm)
+
+    def _height_to_gray(self, hm: np.ndarray) -> np.ndarray:
+        """THE height-to-grayscale palette: 0 = no occupied voxel in
+        the column, 1..255 linear in top-surface height over the z
+        extent — shared by /voxel-image and the tile store so the two
+        renderings of one map can never diverge."""
+        _, _, ez = self.cfg.voxel.extent_m
+        img = np.zeros(hm.shape, np.uint8)
+        mapped = hm >= 0.0
+        img[mapped] = (1.0 + 254.0 * np.clip(hm[mapped] / ez, 0.0, 1.0)) \
+            .astype(np.uint8)
+        return img
+
     # -- checkpoint surface -------------------------------------------------
 
     def snapshot_grid(self):
@@ -474,13 +516,6 @@ class VoxelMapperNode(Node):
                                             points=pts))
 
     def height_map_image(self) -> np.ndarray:
-        """(Y, X) uint8 grayscale: 0 = no occupied voxel in the column,
-        1..255 scale linearly with top-surface height over the grid's z
-        extent; flipud for image coords (the /map-image convention)."""
-        hm = self.height_map()
-        _, _, ez = self.cfg.voxel.extent_m
-        img = np.zeros(hm.shape, np.uint8)
-        mapped = hm >= 0.0
-        img[mapped] = (1.0 + 254.0 * np.clip(hm[mapped] / ez, 0.0, 1.0)) \
-            .astype(np.uint8)
-        return np.flipud(img)
+        """(Y, X) uint8 grayscale (`_height_to_gray` palette), flipud
+        for image coords (the /map-image convention)."""
+        return np.flipud(self._height_to_gray(self.height_map()))

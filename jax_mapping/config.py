@@ -539,6 +539,44 @@ class RecoveryConfig:
 
 
 @_frozen
+class ServingConfig:
+    """Tiled delta map distribution (serving/ subsystem).
+
+    The reference's management plane re-encodes and re-ships the ENTIRE
+    occupancy grid as one PNG to every polling client (`server/.../
+    main.py:241-279`), bounded only by a 1 s wall-clock cache — at fleet
+    scale and 4096^2 grids the dominant serving cost. These knobs
+    parameterize the tile store (fixed-size tiles + a quadtree overview
+    pyramid, re-encoded only when a tile's on-device content hash
+    changes), the mapper's dirty-tile/revision tracking, and the
+    `/map-events` fan-out push channel with per-client bounded queues.
+    `enabled=False` is exact pre-PR behavior: no revision tracking, no
+    tile store, `/tiles` and `/map-events` answer 404.
+    """
+
+    enabled: bool = True
+    # Tile edge length in cells at every pyramid level; must divide
+    # grid.size_cells (and the voxel height-map edge when the 3D
+    # pipeline serves tiles). 256 -> 16x16 tiles over the 4096^2 grid.
+    tile_cells: int = 256
+    # Overview pyramid depth INCLUDING level 0 (full resolution); each
+    # level is 2x coarser (occupied > free > unknown block priority).
+    # Levels whose grid would shrink below one tile are skipped.
+    pyramid_levels: int = 3
+    # Per-client event queue bound (`/map-events`): a slow client's
+    # queue drops its OLDEST revision event on overflow (drop-to-latest
+    # backpressure) so it can never pin memory or a worker thread.
+    event_queue_depth: int = 4
+    # Hard cap on any single long-poll wait / SSE stream lifetime, in
+    # seconds — the bounded-wait contract of the degraded 503 path
+    # applied to the push channel (clients reconnect, SSE-style).
+    event_wait_max_s: float = 30.0
+    # zlib level for tile PNG encoding (the whole-map routes keep the
+    # png codec default).
+    png_compress_level: int = 6
+
+
+@_frozen
 class FleetConfig:
     """Multi-robot scaling (BASELINE.json configs 4-5: 8-64 simulated Thymios)."""
 
@@ -566,6 +604,7 @@ class SlamConfig:
     depthcam: DepthCamConfig = DepthCamConfig()
     resilience: ResilienceConfig = ResilienceConfig()
     recovery: RecoveryConfig = RecoveryConfig()
+    serving: ServingConfig = ServingConfig()
     # slam_toolbox's operating mode (slam_config.yaml:20: "mapping" —
     # the file's comment offers localization as the alternative).
     # "localization" freezes the map: key scans MATCH against it for
@@ -601,6 +640,7 @@ class SlamConfig:
             depthcam=DepthCamConfig(**raw.get("depthcam", {})),
             resilience=ResilienceConfig(**raw.get("resilience", {})),
             recovery=RecoveryConfig(**raw.get("recovery", {})),
+            serving=ServingConfig(**raw.get("serving", {})),
             **{k: v for k, v in raw.items()
                if k in ("mode", "map_publish_period_s",
                         "tf_publish_period_s", "domain_id")},
@@ -650,6 +690,10 @@ def tiny_config(n_robots: int = 2) -> SlamConfig:
                                 backup_recovery_ticks=5,
                                 escalation_memory_ticks=40,
                                 blacklist_ttl_ticks=80),
+        # 4x4 tiles over the 256^2 grid; short event waits so serving
+        # tests never block near a timeout.
+        serving=ServingConfig(tile_cells=64, pyramid_levels=3,
+                              event_wait_max_s=5.0),
     )
 
 

@@ -502,6 +502,70 @@ def to_occupancy(grid_cfg: GridConfig, grid_arr: Array) -> Array:
                      jnp.where(free, jnp.int8(0), jnp.int8(-1)))
 
 
+# ---------------------------------------------------------------------------
+# Serving: tiled delta distribution (jax_mapping/serving/tiles.py)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def to_gray(grid_cfg: GridConfig, grid_arr: Array) -> Array:
+    """Log-odds -> uint8 grayscale in GRID orientation (row 0 = min-y):
+    127 unknown, 255 free, 0 occupied — the /map-image palette WITHOUT
+    the flipud (tiles compose in grid coordinates; the client flips once
+    for display). Stays on device so tile hashing and the pyramid reduce
+    without a host round trip."""
+    occ = grid_arr > grid_cfg.occ_threshold
+    free = grid_arr < grid_cfg.free_threshold
+    return jnp.where(occ, jnp.uint8(0),
+                     jnp.where(free, jnp.uint8(255), jnp.uint8(127)))
+
+
+@jax.jit
+def downsample_gray(img: Array) -> Array:
+    """Uint8 occupancy-gray image -> 2x coarser by block PRIORITY:
+    occupied (0) > free (255) > unknown (127). Plain block-max or -min
+    on the gray values would let unknown shadow free (or free shadow
+    occupied); ranking by priority keeps every wall AND every explored
+    cell visible at overview scale."""
+    rank = jnp.where(img == 0, jnp.uint8(0),
+                     jnp.where(img == 255, jnp.uint8(1), jnp.uint8(2)))
+    n0, n1 = img.shape
+    blk = rank.reshape(n0 // 2, 2, n1 // 2, 2).min(axis=(1, 3))
+    lut = jnp.asarray([0, 255, 127], jnp.uint8)
+    return lut[blk]
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def tile_hashes(arr: Array, tile_cells: int) -> Array:
+    """(H, W) array -> (H//t, W//t, 2) uint32 per-tile content hashes,
+    computed in ONE on-device reduction (both edges must divide).
+
+    The serving tile store re-encodes only tiles whose hash changed —
+    the 4096^2 grid never crosses to the host just to learn that 15 of
+    16 tiles are byte-identical to what every client already holds. Two
+    independent multiplicative-weight lanes (Knuth/Murmur-style odd
+    constants over the within-tile cell index, uint32 wraparound) give a
+    64-bit identity per tile; float grids hash their exact bit patterns
+    (bitcast), so no epsilon can alias two different tiles."""
+    h, w = arr.shape
+    if h % tile_cells or w % tile_cells:
+        raise ValueError(f"array shape ({h}, {w}) not divisible by "
+                         f"tile_cells={tile_cells}")
+    th, tw = h // tile_cells, w // tile_cells
+    if jnp.issubdtype(arr.dtype, jnp.floating):
+        v = jax.lax.bitcast_convert_type(arr, jnp.uint32)
+    else:
+        v = arr.astype(jnp.uint32)
+    idx = jnp.arange(tile_cells * tile_cells,
+                     dtype=jnp.uint32).reshape(tile_cells, tile_cells)
+    w1 = idx * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9)
+    w2 = (idx ^ jnp.uint32(0x85EBCA6B)) * jnp.uint32(2246822519) \
+        + jnp.uint32(1)
+    tv = v.reshape(th, tile_cells, tw, tile_cells).transpose(0, 2, 1, 3)
+    h1 = (tv * w1).sum(axis=(2, 3), dtype=jnp.uint32)
+    h2 = (tv * w2).sum(axis=(2, 3), dtype=jnp.uint32)
+    return jnp.stack([h1, h2], axis=-1)
+
+
 def occupancy_to_png_array(occ_int8) -> "np.ndarray":  # noqa: F821
     """int8 occupancy -> uint8 grayscale image array, reference PNG semantics:
     127 unknown, 255 free, 0 occupied, flipud for image coords

@@ -1,0 +1,34 @@
+"""Tiled delta map distribution — the serving subsystem.
+
+The reference's management plane re-encodes and re-ships the ENTIRE
+occupancy grid as one PNG to every polling client (`server/.../main.py:
+241-279`), bounded only by a 1 s wall-clock cache. At fleet scale and
+4096^2 grids that whole-map re-send is the dominant serving cost — yet
+the mapper KNOWS which cells changed each fusion (the patch/strip
+extents of `ops/grid`), so clients should receive tiles and deltas, not
+snapshots (the robocentric/incremental map-maintenance argument of
+ROG-Map, PAPERS.md).
+
+Pieces:
+
+* `tiles.TileStore` — revision-keyed tile cache with a quadtree overview
+  pyramid; re-encodes ONLY tiles whose on-device content hash
+  (`ops/grid.tile_hashes`, one jitted reduction) changed.
+* `events.EventChannel` — fan-out push for map-revision events with
+  per-client bounded queues and drop-to-latest backpressure.
+* `tiles.MapServing` — the bundle the HTTP plane mounts: 2D map store,
+  optional voxel height-map store (same TileStore, different provider),
+  event channel, serving counters.
+* `client.DeltaMapClient` — reference client: applies tile deltas to a
+  local mosaic, enforcing revision monotonicity (tests + loadgen).
+* `loadgen` — concurrent synthetic clients against a live
+  `launch_sim_stack`; the serving benchmark behind
+  `python bench.py --suite serving`.
+
+`ServingConfig.enabled=False` (config.py) is exact pre-PR behavior.
+"""
+
+from jax_mapping.serving.events import EventChannel, EventSubscription
+from jax_mapping.serving.tiles import MapServing, TileStore
+
+__all__ = ["EventChannel", "EventSubscription", "MapServing", "TileStore"]

@@ -1,0 +1,115 @@
+"""Reference delta-map client: snapshot + tile deltas -> local mosaic.
+
+The consumer half of the tile protocol, used by the serving load
+generator and the delta-correctness tests: polls `GET /tiles?since=R`,
+applies the returned tiles to per-level host mosaics, and ENFORCES the
+protocol's safety properties — the server's revision never goes
+backwards, and no returned tile is stamped at or before the client's
+`since` (a stale tile or a revision regression raises, which is exactly
+what the concurrent hammer test leans on).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.request
+from typing import Dict, Optional
+
+import numpy as np
+
+from jax_mapping.bridge import png as png_codec
+
+
+class RevisionRegression(AssertionError):
+    """The server violated revision monotonicity for this client."""
+
+
+class DeltaMapClient:
+    """Polls one tile route and maintains the reconstructed mosaics."""
+
+    def __init__(self, base_url: str, route: str = "/tiles",
+                 timeout_s: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.route = route
+        self.timeout_s = timeout_s
+        self.revision = -1            # pre-snapshot: everything is new
+        self.meta: dict = {}
+        #: level -> (size, size) uint8 mosaic (unknown-127 before the
+        #: first covering tile arrives; the first poll covers all).
+        self.mosaics: Dict[int, np.ndarray] = {}
+        self.n_polls = 0
+        self.n_not_modified = 0
+        self.n_tiles_applied = 0
+        self.bytes_received = 0
+        self.snapshot_bytes = 0       # first (full) poll's body size
+        self._etag: Optional[str] = None
+
+    # -- protocol ------------------------------------------------------------
+
+    def poll(self, level: Optional[int] = None) -> dict:
+        """One delta round trip; returns the decoded response body.
+
+        Replays the server's ETag as `If-None-Match`: a client that is
+        already at the live revision pays a body-less 304, not even the
+        empty-manifest JSON."""
+        url = f"{self.base_url}{self.route}?since={self.revision}"
+        if level is not None:
+            url += f"&level={level}"
+        req = urllib.request.Request(url)
+        if self._etag:
+            req.add_header("If-None-Match", self._etag)
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as r:
+                raw = r.read()
+                self._etag = r.headers.get("ETag") or self._etag
+        except urllib.error.HTTPError as e:
+            if e.code != 304:
+                raise
+            e.read()
+            self.n_polls += 1
+            self.n_not_modified += 1
+            return {"revision": self.revision, "since": self.revision,
+                    "tiles": [], "not_modified": True}
+        body = json.loads(raw)
+        first = self.n_polls == 0
+        self.n_polls += 1
+        self.bytes_received += len(raw)
+        if first:
+            self.snapshot_bytes = len(raw)
+        self.apply(body)
+        return body
+
+    def apply(self, body: dict) -> None:
+        """Apply one /tiles response; raises on any staleness."""
+        rev = int(body["revision"])
+        if rev < self.revision:
+            raise RevisionRegression(
+                f"server revision went backwards: {self.revision} -> {rev}")
+        since = int(body.get("since", self.revision))
+        self.meta = {k: v for k, v in body.items() if k != "tiles"}
+        t = int(body["tile_cells"])
+        sizes = {lv["level"]: lv["size_cells"] for lv in body["levels"]}
+        for tile in body["tiles"]:
+            tile_rev = int(tile["revision"])
+            if tile_rev <= since:
+                raise RevisionRegression(
+                    f"tile {tile['level']}/{tile['ty']}/{tile['tx']} "
+                    f"stamped {tile_rev} <= since={since}: stale serve")
+            lvl = int(tile["level"])
+            if lvl not in self.mosaics:
+                self.mosaics[lvl] = np.full(
+                    (sizes[lvl], sizes[lvl]), 127, np.uint8)
+            arr = png_codec.decode_gray(
+                base64.b64decode(tile["png"]))
+            ty, tx = int(tile["ty"]), int(tile["tx"])
+            self.mosaics[lvl][ty * t:(ty + 1) * t,
+                              tx * t:(tx + 1) * t] = arr
+            self.n_tiles_applied += 1
+        self.revision = rev
+
+    def image(self, level: int = 0) -> np.ndarray:
+        """The reconstructed mosaic at a pyramid level (grid
+        orientation; `np.flipud` for display coordinates)."""
+        return self.mosaics[level]

@@ -1,0 +1,291 @@
+"""Revision-keyed tile store with a quadtree overview pyramid.
+
+One `TileStore` serves one 2D uint8 image surface (the thresholded
+occupancy gray of the fleet's shared grid, or the voxel mapper's
+height map) as fixed-size PNG tiles at pyramid levels 0..L (level k is
+2^k x coarser). The store is PULL-based: `refresh()` snapshots the
+provider, hashes every tile in ONE jitted on-device reduction
+(`ops/grid.tile_hashes`), and re-encodes only tiles whose 64-bit
+content hash changed — the steady-state serving cost is proportional
+to what the mapper actually touched, not to the map size.
+
+Delta protocol: every re-encoded tile is stamped with the map revision
+it changed at; `tiles_since(r)` returns exactly the tiles stamped
+newer than `r`. A client that applies an initial `since=0` snapshot
+plus every delta reconstructs the live image bit-for-bit
+(tests/test_serving.py proves equality against the mapper's grid).
+
+Consistency: tile bytes, per-tile stamps, and the store revision are
+installed atomically under `_lock`, so a reader can never observe a
+tile whose bytes are older than its stamp (no stale serve, ever).
+`_refresh_lock` single-flights the encode work; readers only ever wait
+on the brief install/read critical sections.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from jax_mapping.bridge import png as png_codec
+from jax_mapping.config import ServingConfig
+
+
+def _downsample_max_u8(img):
+    """2x block max for continuous-gray surfaces (voxel height maps:
+    taller top surface wins, 0 = unmapped loses)."""
+    import jax.numpy as jnp
+    arr = jnp.asarray(img)
+    n0, n1 = arr.shape
+    return arr.reshape(n0 // 2, 2, n1 // 2, 2).max(axis=(1, 3))
+
+
+class TileStore:
+    """Tile cache over one image provider.
+
+    `snapshot_fn() -> (revision, image, dirty_hint)`: `revision` is the
+    provider's monotonic content revision; `image` is the full-res 2D
+    uint8 array (device or host) in GRID orientation; `dirty_hint` is
+    an optional (T, T) bool mask of level-0 tiles the producer marked
+    touched since the last snapshot (the mapper's patch-extent marks) —
+    a conservative superset used for telemetry (`n_hint_missed` counts
+    hash-detected changes the hint failed to cover; it should stay 0).
+    The hash diff, not the hint, decides what re-encodes: correctness
+    never rides on the producer's bookkeeping.
+
+    `revision_fn()` is the cheap freshness peek (no image work).
+    """
+
+    def __init__(self, cfg: ServingConfig, name: str,
+                 revision_fn: Callable[[], int],
+                 snapshot_fn: Callable[[], Tuple[int, object,
+                                                 Optional[np.ndarray]]],
+                 downsample_fn: Optional[Callable] = None,
+                 meta: Optional[dict] = None):
+        self.cfg = cfg
+        self.name = name
+        self._revision_fn = revision_fn
+        self._snapshot_fn = snapshot_fn
+        self._downsample_fn = downsample_fn
+        self.meta = dict(meta or {})
+        self._lock = threading.Lock()
+        self._refresh_lock = threading.Lock()
+        #: (level, ty, tx) -> (revision_changed_at, png_bytes)
+        self._tiles: Dict[Tuple[int, int, int], Tuple[int, bytes]] = {}
+        #: per-level (T, T, 2) uint32 hash arrays from the last refresh.
+        self._hashes: List[Optional[np.ndarray]] = []
+        self.revision = -1          # provider revisions start at 0
+        self.n_refreshes = 0
+        self.n_tiles_encoded = 0
+        self.n_tiles_clean_skipped = 0
+        self.n_hint_missed = 0
+        self._level_sizes: Optional[List[int]] = None
+
+    # -- geometry ------------------------------------------------------------
+
+    def _levels_for(self, size: int) -> List[int]:
+        """Pyramid level edge sizes: full-res first, each next level 2x
+        coarser, stopping at the configured depth or when a level would
+        shrink below one tile / stop dividing evenly."""
+        t = self.cfg.tile_cells
+        if size % t:
+            raise ValueError(
+                f"{self.name}: image edge {size} not divisible by "
+                f"ServingConfig.tile_cells={t}")
+        sizes = [size]
+        while (len(sizes) < self.cfg.pyramid_levels
+               and sizes[-1] // 2 >= t and (sizes[-1] // 2) % t == 0):
+            sizes.append(sizes[-1] // 2)
+        return sizes
+
+    # -- refresh -------------------------------------------------------------
+
+    def refresh(self) -> int:
+        """Bring the cache up to the provider's revision; returns the
+        store revision afterwards. Cheap when already fresh (one
+        revision peek). Single-flighted: concurrent callers serialize
+        on `_refresh_lock`, each re-checking freshness on entry."""
+        with self._refresh_lock:
+            rev = int(self._revision_fn())
+            with self._lock:
+                if rev == self.revision:
+                    return self.revision
+            rev, image, hint = self._snapshot_fn()
+            rev = int(rev)
+            self._install(rev, image, hint)
+            return rev
+
+    def _install(self, rev: int, image, hint: Optional[np.ndarray]) -> None:
+        """Hash, diff, and re-encode under `_refresh_lock`; commit
+        atomically under `_lock`. Caller holds `_refresh_lock`."""
+        from jax_mapping.ops import grid as G
+        import jax.numpy as jnp
+
+        t = self.cfg.tile_cells
+        img = jnp.asarray(image)
+        if img.shape[0] != img.shape[1]:
+            # The pyramid, manifest meta and client mosaics are all
+            # square-edged; a rectangular provider must be rejected
+            # loudly, not crash inside a reshape.
+            raise ValueError(
+                f"{self.name}: tile serving needs a square image, got "
+                f"{tuple(img.shape)}")
+        sizes = self._levels_for(int(img.shape[0]))
+        down = self._downsample_fn or G.downsample_gray
+        imgs = [img]
+        for _ in sizes[1:]:
+            imgs.append(down(imgs[-1]))
+        hashes = [np.asarray(G.tile_hashes(im, t)) for im in imgs]
+
+        first = not self._hashes
+        encoded: Dict[Tuple[int, int, int], Tuple[int, bytes]] = {}
+        n_clean = 0
+        hint_missed = 0
+        for lvl, (im, h) in enumerate(zip(imgs, hashes)):
+            if first:
+                changed = np.ones(h.shape[:2], bool)
+            else:
+                changed = np.any(h != self._hashes[lvl], axis=-1)
+            if lvl == 0 and hint is not None and not first:
+                hint_missed += int(np.count_nonzero(changed & ~hint))
+            n_clean += int(changed.size - np.count_nonzero(changed))
+            if not changed.any():
+                continue
+            host = np.asarray(im)      # fetch this level once, then slice
+            for ty, tx in np.argwhere(changed):
+                tile = host[ty * t:(ty + 1) * t, tx * t:(tx + 1) * t]
+                encoded[(lvl, int(ty), int(tx))] = (rev, png_codec.encode_gray(
+                    tile, compress_level=self.cfg.png_compress_level))
+
+        with self._lock:
+            self._tiles.update(encoded)
+            self._hashes = hashes
+            self._level_sizes = sizes
+            self.revision = rev
+            self.n_refreshes += 1
+            self.n_tiles_encoded += len(encoded)
+            self.n_tiles_clean_skipped += n_clean
+            self.n_hint_missed += hint_missed
+
+    # -- serving -------------------------------------------------------------
+
+    def tiles_since(self, since: int, level: Optional[int] = None
+                    ) -> Tuple[int, List[dict], dict]:
+        """(revision, tile entries stamped newer than `since`, manifest
+        meta). Entries carry base64 PNG bytes ready for the JSON route.
+        `since=0` with fresh stores returns the full snapshot (every
+        tile's first stamp is its first refresh's revision >= 0; clients
+        start at since=-1 via the client helper to be safe)."""
+        with self._lock:
+            rev = self.revision
+            sizes = list(self._level_sizes or [])
+            entries = [
+                {"level": lvl, "ty": ty, "tx": tx, "revision": tile_rev,
+                 "png": base64.b64encode(data).decode("ascii")}
+                for (lvl, ty, tx), (tile_rev, data)
+                in sorted(self._tiles.items())
+                if tile_rev > since and (level is None or lvl == level)]
+        meta = dict(self.meta)
+        meta.update({
+            "map": self.name,
+            "tile_cells": self.cfg.tile_cells,
+            "levels": [{"level": i, "size_cells": s}
+                       for i, s in enumerate(sizes)],
+        })
+        return rev, entries, meta
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "revision": self.revision,
+                "n_refreshes": self.n_refreshes,
+                "n_tiles_encoded": self.n_tiles_encoded,
+                "n_tiles_clean_skipped": self.n_tiles_clean_skipped,
+                "n_hint_missed": self.n_hint_missed,
+                "n_tiles_cached": len(self._tiles),
+            }
+
+
+class MapServing:
+    """The bundle the HTTP plane mounts: tile stores + event channel.
+
+    Wired by `MapApiServer` when the attached mapper's config has
+    `serving.enabled`; the mapper's tick thread calls
+    `on_map_revision(rev)` (registered as a revision listener, invoked
+    OUTSIDE the mapper's state lock) and the channel fans it out to
+    every `/map-events` client queue."""
+
+    def __init__(self, cfg: ServingConfig, mapper=None, voxel_mapper=None):
+        from jax_mapping.serving.events import EventChannel
+        self.cfg = cfg
+        self.events = EventChannel(cfg.event_queue_depth)
+        self.map_store: Optional[TileStore] = None
+        self.voxel_store: Optional[TileStore] = None
+        if mapper is not None:
+            g = mapper.cfg.grid
+
+            def _map_snapshot():
+                from jax_mapping.ops import grid as G
+                rev, grid, hint = mapper.serving_snapshot()
+                return rev, G.to_gray(g, grid), hint
+
+            self.map_store = TileStore(
+                cfg, "grid", mapper.serving_revision, _map_snapshot,
+                meta={"resolution_m": g.resolution_m,
+                      "origin_m": list(g.origin_m),
+                      "size_cells": g.size_cells,
+                      "orientation": "grid-row0-min-y"})
+        if voxel_mapper is not None and \
+                self._voxel_servable(cfg, voxel_mapper.cfg.voxel):
+            v = voxel_mapper.cfg.voxel
+
+            def _voxel_snapshot():
+                rev, img = voxel_mapper.serving_snapshot()
+                return rev, img, None
+
+            self.voxel_store = TileStore(
+                cfg, "voxel-height", voxel_mapper.serving_revision,
+                _voxel_snapshot, downsample_fn=_downsample_max_u8,
+                meta={"resolution_m": v.resolution_m,
+                      "origin_m": list(v.origin_m[:2]),
+                      "size_cells": v.size_x_cells,
+                      "orientation": "grid-row0-min-y",
+                      "palette": "height-ramp"})
+
+    @staticmethod
+    def _voxel_servable(cfg: ServingConfig, voxel) -> bool:
+        """Tile geometry fits the voxel height map? The store needs a
+        square, tile-divisible image; a stack running a rectangular or
+        odd-sized voxel grid keeps working — /voxel-tiles just answers
+        404 (no store) instead of 500ing on every request, and the 2D
+        map serves normally."""
+        return (voxel.size_x_cells == voxel.size_y_cells
+                and voxel.size_x_cells % cfg.tile_cells == 0)
+
+    def on_map_revision(self, rev: int) -> None:
+        """Mapper revision listener — called on the tick thread, outside
+        every mapper lock (the lint B2 contract); fans a small event to
+        the bounded per-client queues."""
+        self.events.emit({"map": "grid", "revision": int(rev)})
+
+    def store(self, source: str) -> Optional[TileStore]:
+        return self.map_store if source == "grid" else \
+            self.voxel_store if source == "voxel-height" else None
+
+    def stats(self) -> dict:
+        out = {
+            "events": {
+                "n_events": self.events.n_events,
+                "n_clients": self.events.n_clients(),
+                "n_clients_peak": self.events.n_clients_peak,
+                "n_dropped": self.events.n_dropped_total(),
+            }
+        }
+        if self.map_store is not None:
+            out["grid"] = self.map_store.stats()
+        if self.voxel_store is not None:
+            out["voxel"] = self.voxel_store.stats()
+        return out

@@ -1,0 +1,486 @@
+"""Serving subsystem tests: tiled delta distribution, revision-keyed
+caching, fan-out push, and the serving load generator.
+
+The load-bearing assertions:
+
+* DELTA CORRECTNESS — a client that applies an initial snapshot plus
+  every tile delta reconstructs the mapper's LIVE grid bit-for-bit.
+* NO STALE TILE EVER — under 8+ concurrent threads mixing /map-image,
+  /tiles?since= and /map-events while the stack runs, every client's
+  revision is monotonic and no returned tile is stamped at or before
+  the client's `since` (DeltaMapClient raises on either violation).
+* BOUNDED BACKPRESSURE — a slow /map-events client's queue stays at
+  its configured depth, dropping oldest (drop-to-latest).
+* `ServingConfig(enabled=False)` runs are bit-identical to serving-on
+  runs (the subsystem observes the mapper; it never perturbs it).
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jax_mapping.bridge.launch import launch_sim_stack
+from jax_mapping.config import ServingConfig, tiny_config
+from jax_mapping.serving.client import DeltaMapClient
+from jax_mapping.serving.events import EventChannel
+from jax_mapping.serving.tiles import TileStore
+from jax_mapping.sim import world as W
+
+
+# ------------------------------------------------------------------ units
+
+def test_tile_hashes_change_iff_content_changes():
+    import jax.numpy as jnp
+    from jax_mapping.ops import grid as G
+    img = np.full((256, 256), 127, np.uint8)
+    h0 = np.asarray(G.tile_hashes(jnp.asarray(img), 64))
+    assert h0.shape == (4, 4, 2)
+    img2 = img.copy()
+    img2[70, 130] = 0                     # tile (1, 2)
+    h1 = np.asarray(G.tile_hashes(jnp.asarray(img2), 64))
+    changed = np.argwhere(np.any(h0 != h1, axis=-1))
+    assert changed.tolist() == [[1, 2]]
+
+
+def test_tile_hashes_float_is_bit_exact():
+    """Float grids hash their BIT PATTERNS: a sub-epsilon log-odds
+    change still changes the hash (no stale tile can hide behind a
+    rounding threshold)."""
+    import jax.numpy as jnp
+    from jax_mapping.ops import grid as G
+    lo = np.zeros((128, 128), np.float32)
+    h0 = np.asarray(G.tile_hashes(jnp.asarray(lo), 64))
+    lo2 = lo.copy()
+    lo2[3, 3] = 1e-7
+    h1 = np.asarray(G.tile_hashes(jnp.asarray(lo2), 64))
+    assert np.any(h0 != h1)
+
+
+def test_downsample_gray_priority():
+    """Occupied (0) beats free (255) beats unknown (127) per block."""
+    from jax_mapping.ops import grid as G
+    img = np.full((4, 4), 127, np.uint8)
+    img[0, 0] = 0                          # block (0,0): occ + unknown
+    img[0, 2] = 255                        # block (0,1): free + unknown
+    out = np.asarray(G.downsample_gray(img))
+    assert out.tolist() == [[0, 255], [127, 127]]
+    img[0, 1] = 255                        # occ + free in one block
+    out = np.asarray(G.downsample_gray(img))
+    assert out[0, 0] == 0                  # occupied still wins
+
+
+def test_tile_store_delta_and_pyramid():
+    cfg = ServingConfig(tile_cells=64, pyramid_levels=3)
+    state = {"rev": 0, "img": np.full((256, 256), 127, np.uint8)}
+    store = TileStore(cfg, "grid", lambda: state["rev"],
+                      lambda: (state["rev"], state["img"], None))
+    store.refresh()
+    rev, entries, meta = store.tiles_since(-1)
+    # 4x4 level 0 + 2x2 level 1 + 1 level 2.
+    assert rev == 0 and len(entries) == 16 + 4 + 1
+    assert [lv["size_cells"] for lv in meta["levels"]] == [256, 128, 64]
+
+    # One touched region -> one tile per level, nothing else re-sent.
+    state["img"] = state["img"].copy()
+    state["img"][10:20, 70:80] = 0         # level-0 tile (0, 1)
+    state["rev"] = 7
+    store.refresh()
+    rev, entries, _ = store.tiles_since(0)
+    assert rev == 7
+    assert [(e["level"], e["ty"], e["tx"]) for e in entries] == \
+        [(0, 0, 1), (1, 0, 0), (2, 0, 0)]
+    assert all(e["revision"] == 7 for e in entries)
+
+    # Revision bump with identical content: hash dedupe, no new tiles.
+    state["rev"] = 9
+    store.refresh()
+    _, entries, _ = store.tiles_since(7)
+    assert entries == []
+    assert store.stats()["n_tiles_clean_skipped"] > 0
+
+
+def test_tile_hashes_rectangular():
+    import jax.numpy as jnp
+    from jax_mapping.ops import grid as G
+    img = np.zeros((128, 256), np.uint8)
+    h = np.asarray(G.tile_hashes(jnp.asarray(img), 64))
+    assert h.shape == (2, 4, 2)
+
+
+def test_voxel_store_gated_on_square_geometry():
+    """A rectangular (or tile-indivisible) voxel grid must leave
+    /voxel-tiles dark (no store -> 404), never 500 per request."""
+    from jax_mapping.config import VoxelConfig
+    from jax_mapping.serving.tiles import MapServing
+    cfg = ServingConfig(tile_cells=64)
+    assert MapServing._voxel_servable(
+        cfg, VoxelConfig(size_x_cells=128, size_y_cells=128))
+    assert not MapServing._voxel_servable(
+        cfg, VoxelConfig(size_x_cells=256, size_y_cells=128))
+    assert not MapServing._voxel_servable(
+        cfg, VoxelConfig(size_x_cells=96, size_y_cells=96))
+
+
+def test_event_channel_drop_counter_survives_disconnect():
+    """The exported drop counter is Prometheus-monotonic: a slow
+    client's drops fold into the channel total when it disconnects
+    instead of vanishing with its queue."""
+    ch = EventChannel(depth=1)
+    sub = ch.subscribe()
+    for rev in range(4):
+        ch.emit({"revision": rev})
+    assert ch.n_dropped_total() == 3
+    ch.unsubscribe(sub)
+    assert ch.n_dropped_total() == 3
+
+
+def test_event_channel_drop_to_latest():
+    ch = EventChannel(depth=2)
+    sub = ch.subscribe()
+    for rev in range(5):
+        ch.emit({"revision": rev})
+    assert sub.pending() == 2
+    assert sub.n_dropped == 3
+    # Oldest dropped: the two NEWEST events survive.
+    assert sub.next(0.1)["revision"] == 3
+    assert sub.next(0.1)["revision"] == 4
+    assert sub.next(0.05) is None          # bounded wait, no event
+    ch.unsubscribe(sub)
+    assert ch.n_clients() == 0
+
+
+# ------------------------------------------------------------------ stack
+
+@pytest.fixture(scope="module")
+def stack(tiny_cfg):
+    world = W.plank_course(96, tiny_cfg.grid.resolution_m, n_planks=4,
+                           seed=3)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=2, http_port=0,
+                          realtime=False)
+    st.brain.start_exploring()
+    st.run_steps(20)
+    st.mapper.publish_map()
+    yield st
+    st.shutdown()
+
+
+def _expected_gray(st):
+    from jax_mapping.ops import grid as G
+    return np.asarray(G.to_gray(st.cfg.grid, st.mapper.merged_grid()))
+
+
+def test_delta_reconstruction_bit_equality(stack):
+    """THE delta-correctness proof: initial snapshot + applied tile
+    deltas == the mapper's live grid, bit for bit."""
+    base = f"http://127.0.0.1:{stack.api.port}"
+    client = DeltaMapClient(base)
+    client.poll()                          # full snapshot
+    assert client.revision >= 0 and client.n_tiles_applied > 0
+    for _ in range(4):                     # steady exploration + deltas
+        stack.run_steps(10)
+        client.poll()
+    stack.run_steps(5)
+    client.poll()                          # final sync, stack quiescent
+    expect = _expected_gray(stack)
+    assert np.array_equal(client.image(0), expect)
+    # The mapper's patch-extent dirty marks were a true superset of
+    # every hash-detected change (the hint never missed).
+    assert stack.api.serving.map_store.stats()["n_hint_missed"] == 0
+
+
+def test_pyramid_levels_consistent(stack):
+    """Overview tiles must be the deterministic downsample of level 0
+    (a zoomed-out client sees the same world, coarser)."""
+    from jax_mapping.ops import grid as G
+    base = f"http://127.0.0.1:{stack.api.port}"
+    client = DeltaMapClient(base)
+    client.poll()
+    lvl1 = np.asarray(G.downsample_gray(client.image(0)))
+    assert np.array_equal(client.image(1), lvl1)
+    lvl2 = np.asarray(G.downsample_gray(lvl1))
+    assert np.array_equal(client.image(2), lvl2)
+
+
+def test_tiles_etag_304_and_client_dedupe(stack):
+    base = f"http://127.0.0.1:{stack.api.port}"
+    client = DeltaMapClient(base)
+    client.poll()
+    # No steps in between: the replayed ETag answers 304, zero body.
+    before = client.bytes_received
+    body = client.poll()
+    assert body.get("not_modified") is True
+    assert client.n_not_modified == 1
+    assert client.bytes_received == before
+
+
+def test_map_image_etag_304(stack):
+    stack.mapper.publish_map()
+    base = f"http://127.0.0.1:{stack.api.port}"
+    with urllib.request.urlopen(f"{base}/map-image", timeout=5) as r:
+        etag = r.headers["ETag"]
+        assert len(r.read()) > 0
+    req = urllib.request.Request(f"{base}/map-image",
+                                 headers={"If-None-Match": etag})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 304
+    assert ei.value.read() == b""
+    # A stale tag still gets the full body.
+    req = urllib.request.Request(f"{base}/map-image",
+                                 headers={"If-None-Match": 'W/"map-0"'})
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert len(r.read()) > 0
+
+
+def test_map_events_long_poll(stack):
+    base = f"http://127.0.0.1:{stack.api.port}"
+    rev = stack.mapper.serving_revision()
+    # Already-advanced revision answers immediately.
+    with urllib.request.urlopen(
+            f"{base}/map-events?mode=poll&since=-1", timeout=5) as r:
+        body = json.loads(r.read())
+    assert body["revision"] == rev and not body["timed_out"]
+    # Waiting poll released by a revision advance.
+    out = {}
+
+    def waiter():
+        with urllib.request.urlopen(
+                f"{base}/map-events?mode=poll&since={rev}&wait_s=5",
+                timeout=10) as r:
+            out.update(json.loads(r.read()))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    stack.run_steps(5)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert out["revision"] > rev and not out["timed_out"]
+
+
+def test_map_events_sse_stream(stack):
+    base = f"http://127.0.0.1:{stack.api.port}"
+    since = stack.mapper.serving_revision()
+    revisions = []
+
+    def reader():
+        req = urllib.request.Request(
+            f"{base}/map-events?since={since}&timeout_s=4")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            for line in r:
+                if line.startswith(b"data:"):
+                    revisions.append(
+                        json.loads(line[5:].decode())["revision"])
+                if len(revisions) >= 2:
+                    break
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for _ in range(4):
+        time.sleep(0.1)
+        stack.run_steps(5)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert len(revisions) >= 2
+    assert revisions == sorted(revisions)          # monotonic stream
+    assert all(r > since for r in revisions)
+
+
+def test_metrics_routes_and_latency_histogram(stack):
+    base = f"http://127.0.0.1:{stack.api.port}"
+    urllib.request.urlopen(f"{base}/status", timeout=5).read()
+    with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert 'jax_mapping_http_requests_by_route_total{route="/status"}' \
+        in text
+    assert 'jax_mapping_http_request_seconds_bucket{le="+Inf"}' in text
+    assert "jax_mapping_http_request_seconds_count" in text
+    assert "jax_mapping_serving_grid_revision" in text
+    assert "jax_mapping_serving_events_total" in text
+    # Histogram consistency: +Inf cumulative count == _count.
+    inf = count = None
+    for line in text.splitlines():
+        if line.startswith('jax_mapping_http_request_seconds_bucket'
+                           '{le="+Inf"}'):
+            inf = int(line.split()[-1])
+        if line.startswith("jax_mapping_http_request_seconds_count"):
+            count = int(line.split()[-1])
+    assert inf == count and count > 0
+
+
+def test_request_counters_thread_safe(stack):
+    """500 requests across 10 threads count exactly 500 (the
+    unsynchronized `n_requests += 1` of the pre-serving handler lost
+    increments under this exact load)."""
+    base = f"http://127.0.0.1:{stack.api.port}"
+    before = stack.api.route_requests.get("/frontiers", 0)
+
+    def worker():
+        for _ in range(50):
+            urllib.request.urlopen(f"{base}/frontiers", timeout=10).read()
+
+    threads = [threading.Thread(target=worker) for _ in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert stack.api.route_requests.get("/frontiers", 0) == before + 500
+
+
+def test_concurrent_hammer_no_stale_tiles(stack):
+    """8+ threads mixing /map-image, /tiles?since= and /map-events
+    while the stack explores: every delta client's revision stays
+    monotonic with no stale tile (DeltaMapClient raises otherwise),
+    event queues stay bounded, and polling keeps a cache hit-rate > 0."""
+    base = f"http://127.0.0.1:{stack.api.port}"
+    stop = threading.Event()
+    errors = []
+
+    def delta_worker():
+        try:
+            client = DeltaMapClient(base)
+            while not stop.is_set():
+                client.poll()
+                stop.wait(0.03)
+        except Exception as e:             # noqa: BLE001
+            errors.append(f"delta: {type(e).__name__}: {e}")
+
+    def png_worker():
+        try:
+            while not stop.is_set():
+                with urllib.request.urlopen(f"{base}/map-image",
+                                            timeout=10) as r:
+                    r.read()
+                stop.wait(0.03)
+        except Exception as e:             # noqa: BLE001
+            errors.append(f"png: {type(e).__name__}: {e}")
+
+    def events_worker():
+        try:
+            while not stop.is_set():
+                with urllib.request.urlopen(
+                        f"{base}/map-events?mode=poll&since=-1&wait_s=1",
+                        timeout=10) as r:
+                    json.loads(r.read())
+        except Exception as e:             # noqa: BLE001
+            errors.append(f"events: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=delta_worker) for _ in range(3)] \
+        + [threading.Thread(target=png_worker) for _ in range(3)] \
+        + [threading.Thread(target=events_worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for _ in range(10):
+        stack.run_steps(5)
+        stack.mapper.publish_map()
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not [t for t in threads if t.is_alive()]
+    assert errors == []
+    # Every /map-events queue stayed within its configured bound.
+    depth = stack.cfg.serving.event_queue_depth
+    ch = stack.api.serving.events
+    assert all(s.pending() <= depth for s in list(ch._subs))
+    # Polling kept the PNG cache warm.
+    assert stack.api.png_cache_hits.get("map", 0) > 0
+    # Dirty hints stayed a superset of hash-detected changes throughout.
+    assert stack.api.serving.map_store.stats()["n_hint_missed"] == 0
+
+
+# ------------------------------------------------------ disabled / voxel
+
+def test_serving_disabled_is_bit_identical_and_dark(tiny_cfg):
+    """ServingConfig(enabled=False): /tiles and /map-events answer 404,
+    no revision tracking runs, and the resulting MAP is bit-identical
+    to a serving-enabled run of the same seed — serving observes the
+    mapper, it never perturbs it."""
+    world = W.empty_arena(96, tiny_cfg.grid.resolution_m)
+    cfg_off = dataclasses.replace(
+        tiny_cfg,
+        serving=dataclasses.replace(tiny_cfg.serving, enabled=False))
+    grids = {}
+    for key, cfg in (("on", tiny_cfg), ("off", cfg_off)):
+        st = launch_sim_stack(cfg, world, n_robots=1, http_port=0,
+                              realtime=False, seed=11)
+        try:
+            st.brain.start_exploring()
+            st.run_steps(25)
+            grids[key] = np.asarray(st.mapper.merged_grid())
+            if key == "off":
+                assert st.api.serving is None
+                assert st.mapper.map_revision == 0
+                base = f"http://127.0.0.1:{st.api.port}"
+                for route in ("/tiles", "/map-events?mode=poll",
+                              "/voxel-tiles"):
+                    with pytest.raises(urllib.error.HTTPError) as ei:
+                        urllib.request.urlopen(base + route, timeout=5)
+                    assert ei.value.code == 404
+            else:
+                assert st.mapper.map_revision > 0
+        finally:
+            st.shutdown()
+    assert np.array_equal(grids["on"], grids["off"])
+
+
+def test_voxel_height_tiles_ride_the_same_store(tiny_cfg):
+    """The 3D pipeline's height map serves through the identical
+    TileStore + delta protocol on /voxel-tiles."""
+    world = W.plank_course(96, tiny_cfg.grid.resolution_m, n_planks=4,
+                           seed=5)
+    st = launch_sim_stack(tiny_cfg, world, n_robots=1, http_port=0,
+                          realtime=False, depth_cam=True)
+    try:
+        st.brain.start_exploring()
+        st.run_steps(15)
+        base = f"http://127.0.0.1:{st.api.port}"
+        client = DeltaMapClient(base, route="/voxel-tiles")
+        client.poll()
+        st.run_steps(10)
+        client.poll()
+        client.poll()                      # quiescent final sync
+        _rev, expect = st.voxel_mapper.serving_snapshot()
+        assert np.array_equal(client.image(0), expect)
+        assert client.meta["map"] == "voxel-height"
+    finally:
+        st.shutdown()
+
+
+# ------------------------------------------------------------- benchmark
+
+def test_loadgen_smoke(tiny_cfg):
+    """Tier-1-safe smoke of the serving benchmark: tiny grid, a few
+    seconds, asserts the harness runs clean end-to-end and that the
+    delta path is strictly cheaper than whole-PNG polling even at toy
+    scale (the committed BENCH_SERVING artifact records the >= 10x
+    production-shape figure; test_serving_benchmark_reduction below is
+    the slow gate on it)."""
+    from jax_mapping.serving.loadgen import run_serving_benchmark
+    r = run_serving_benchmark(cfg=tiny_cfg, n_clients=4, duration_s=2.5,
+                              warmup_steps=20, world_cells=80,
+                              n_planks=4, n_robots=1)
+    assert r["whole_png_polling"]["errors"] == []
+    assert r["tiled_delta"]["errors"] == []
+    assert r["whole_png_polling"]["polls"] > 0
+    assert r["tiled_delta"]["polls"] > 0
+    assert r["bytes_reduction_factor"] is not None
+    assert r["bytes_reduction_factor"] > 1.0
+    assert r["png_cache_hit_rate"] > 0
+
+
+@pytest.mark.slow
+def test_serving_benchmark_reduction_10x():
+    """The acceptance gate at benchmark shape: >= 10x fewer bytes per
+    client than whole-PNG polling during steady exploration."""
+    from jax_mapping.serving.loadgen import run_serving_benchmark
+    r = run_serving_benchmark(duration_s=12.0)
+    assert r["whole_png_polling"]["errors"] == []
+    assert r["tiled_delta"]["errors"] == []
+    assert r["bytes_reduction_factor"] >= 10.0
