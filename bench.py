@@ -79,11 +79,20 @@ _RESULT = {
     # the probe or the production-shape run rejected the kernel).
     "costfield_path": None,
     "sections_completed": [],
+    # Budget-aware scheduling (r06): sections that did NOT run, keyed to
+    # why — starvation is a recorded fact, not a silent absence
+    # (BENCH_r05 silently skipped fleet_tick_* and plan).
+    "sections_skipped": {},
     # Host/toolchain identity: round-over-round comparisons are only
     # meaningful when the JSON says what produced the number (VERDICT r4).
     "provenance": None,
 }
 _EMITTED = threading.Event()
+
+
+def _skip_section(key: str, why: str) -> None:
+    _RESULT["sections_skipped"][key] = why
+    print(f"bench: skipping {key} ({why})", file=sys.stderr, flush=True)
 
 
 def _emit_and_exit(code: int = 0) -> None:
@@ -175,7 +184,11 @@ def main() -> None:
         if suite == "serving":
             _serving_main()
             return
-        print(f"bench: unknown suite {suite!r} (available: serving)",
+        if suite == "match":
+            _match_main()
+            return
+        print(f"bench: unknown suite {suite!r} "
+              "(available: serving, match)",
               file=sys.stderr, flush=True)
         sys.exit(2)
     if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") != "1" \
@@ -197,6 +210,165 @@ def main() -> None:
         import traceback
         traceback.print_exc(file=sys.stderr)
     _emit_and_exit(0)
+
+
+def _match_main() -> None:
+    """`bench.py --suite match` — the scan-matcher micro-suite: the
+    SAME production-config match workload timed through the exhaustive
+    sweep (`MatcherConfig.pruned=False`, the pre-pruning pipeline) and
+    the branch-and-bound path, plus the host-driven cached pyramid
+    path's steady-state hit rate. Prints exactly ONE JSON line; `--out
+    FILE` additionally writes it to FILE (the BENCH_MATCH_r* artifact).
+
+    Runs on whatever backend the main bench would use (same bounded
+    probe + virtual-CPU fallback + watchdog): the comparison is
+    same-host by construction — both paths share the grid, the scan,
+    and the chain-timing methodology."""
+    if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") != "1" \
+            and not _probe_backend():
+        print("bench[match]: backend probe failed; falling back to "
+              "virtual CPU", file=sys.stderr, flush=True)
+        os.execvpe(sys.executable, [sys.executable] + sys.argv,
+                   _scrub_cpu_env())
+    result = {"metric": "scan_match_p50_ms", "suite": "match",
+              "exhaustive_p50_ms": None, "pruned_p50_ms": None,
+              "speedup": None, "pyramid_cache_hit_rate": None,
+              "pyramid_build_ms": None, "devices": "unknown",
+              "sections_completed": [], "provenance": None}
+    emitted = threading.Event()
+
+    def emit(code: int = 0) -> None:
+        if not emitted.is_set():
+            emitted.set()
+            print(json.dumps(result), flush=True)
+            if "--out" in sys.argv:
+                i = sys.argv.index("--out")
+                if i + 1 < len(sys.argv):
+                    try:
+                        with open(sys.argv[i + 1], "w") as f:
+                            f.write(json.dumps(result) + "\n")
+                    except OSError:
+                        pass
+        os._exit(code)
+
+    watchdog = threading.Timer(max(_remaining(), 1.0), emit)
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        _match_run(result)
+    except Exception:
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+    emit(0)
+
+
+def _match_run(result: dict) -> None:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from jax_mapping.config import SlamConfig
+    from jax_mapping.ops import grid as G
+    from jax_mapping.ops import pyramid as PYR
+    from jax_mapping.ops import scan_match as M
+
+    cfg = SlamConfig()
+    g, s = cfg.grid, cfg.scan
+    dev = jax.devices()[0]
+    on_cpu = dev.platform == "cpu"
+    result["devices"] = f"{len(jax.devices())}x {dev.platform}"
+    try:
+        load1 = round(os.getloadavg()[0], 1)
+    except OSError:
+        load1 = None
+    result["provenance"] = {
+        "cpu_count": os.cpu_count(), "loadavg_1m": load1,
+        "jax": jax.__version__,
+        "python": ".".join(map(str, sys.version_info[:3]))}
+
+    # Same bench world as the main suite's matcher section: 256 scans
+    # along a 0.4 m loop fused into the production 4096^2 grid.
+    B = 256
+    rng = np.random.default_rng(0)
+    t = np.linspace(0, 2 * math.pi, B, endpoint=False)
+    poses = np.stack([0.4 * np.cos(t), 0.4 * np.sin(t),
+                      t + math.pi / 2], axis=1).astype(np.float32)
+    ranges = rng.uniform(1.0, 10.0, (B, s.padded_beams)).astype(np.float32)
+    ranges[:, s.n_beams:] = 0.0
+    ranges[rng.random((B, s.padded_beams)) < 0.05] = 0.0
+    ranges_d = jax.device_put(jnp.asarray(ranges), dev)
+    poses_d = jax.device_put(jnp.asarray(poses), dev)
+    grid_arr = jax.jit(lambda: G.fuse_scans_window(
+        g, s, G.empty_grid(g), ranges_d, poses_d))()
+    jax.block_until_ready(grid_arr)
+    # More repetitions than the main suite: this JSON line's headline is
+    # a RATIO of two chains, and single-sample medians on a loaded CPU
+    # host swing +-30% (measured) — enough to fake or hide the speedup.
+    k1, k2, reps = (1, 3, 4) if on_cpu else (2, 10, 5)
+
+    def match_chain_factory(m_cfg):
+        def match_chain():
+            def run_g(gr0, k):
+                def body(_, p):
+                    r = M.match(g, s, m_cfg, gr0, ranges_d[0], p)
+                    return r.pose
+                p = jax.lax.fori_loop(
+                    0, k, body, jnp.zeros(3, jnp.float32) + 0.01)
+                return p.sum()
+            jitted = jax.jit(run_g)
+            return lambda k: float(jitted(grid_arr, jnp.int32(k)))
+        return match_chain
+
+    for key, m_cfg in (
+            ("pruned_p50_ms",
+             dataclasses.replace(cfg.matcher, pruned=True)),
+            ("exhaustive_p50_ms",
+             dataclasses.replace(cfg.matcher, pruned=False))):
+        if _remaining() < 60.0:
+            print(f"bench[match]: skipping {key} "
+                  f"({_remaining():.0f}s left)", file=sys.stderr,
+                  flush=True)
+            continue
+        p50 = _chain_time(match_chain_factory(m_cfg), k1, k2, reps)
+        result[key] = round(p50 * 1e3, 2)
+        result["sections_completed"].append(key)
+        print(f"bench[match]: {key} = {result[key]}",
+              file=sys.stderr, flush=True)
+    if result["exhaustive_p50_ms"] and result["pruned_p50_ms"]:
+        result["speedup"] = round(
+            result["exhaustive_p50_ms"] / result["pruned_p50_ms"], 2)
+
+    # Steady-state cached path: repeated host-driven matches against an
+    # unchanged map region (the relocalizer workload) — everything after
+    # the first attempt must hit the pyramid cache.
+    if _remaining() > 30.0:
+        m_pr = dataclasses.replace(cfg.matcher, pruned=True)
+        stride, n_steps = M.window_params(g, m_pr)
+        lv = M.bnb_num_levels(m_pr, n_steps)
+        guess = jnp.zeros(3, jnp.float32) + 0.01
+        origin = G.patch_origin(g, guess[:2])
+        cache = PYR.PyramidCache()
+        revision = 7                      # frozen map: revision constant
+        n_attempts = 8
+        build_ms = None
+        for a in range(n_attempts):
+            t0 = time.perf_counter()
+            levels = cache.get(
+                ("bench", int(origin[0]), int(origin[1])), revision,
+                lambda: PYR.build_match_pyramid(g, m_pr, lv, grid_arr,
+                                                origin))
+            jax.block_until_ready(levels[-1])
+            if a == 0:
+                build_ms = round((time.perf_counter() - t0) * 1e3, 2)
+            res = M.match_with_pyramid(g, s, m_pr, lv, levels, origin,
+                                       ranges_d[0], guess)
+            jax.block_until_ready(res.pose)
+        snap = cache.snapshot()
+        result["pyramid_build_ms"] = build_ms
+        result["pyramid_cache_hit_rate"] = round(snap["hit_rate"], 3)
+        result["pyramid_cache"] = snap
+        result["sections_completed"].append("pyramid_cache")
 
 
 def _costfield_xla_fallback() -> None:
@@ -523,7 +695,12 @@ def _run() -> None:
         else:
             raise
 
-    # ---- frontier recompute p50 at 64 robots, both cost modes -----------
+    # ---- section scheduling (r06, budget-aware) -------------------------
+    # BENCH_r05 starved fleet_tick_* and plan outright: the fixed order
+    # ran both frontier modes + voxel before them, and on a slow host the
+    # budget was gone. Sections now run in PRIORITY order — one data
+    # point per subsystem before any subsystem's second data point — and
+    # every skip is recorded in `sections_skipped` with its reason.
     import dataclasses
     robot_poses = jax.device_put(jnp.asarray(
         np.stack([rng.uniform(-50, 50, 64), rng.uniform(-50, 50, 64),
@@ -550,16 +727,7 @@ def _run() -> None:
             return lambda k: float(jitted(grid_arr, jnp.int32(k)))
         return frontier_chain
 
-    # Product default first (obstacle-aware BFS — the advertised capability),
-    # cheap Euclidean mode second; each section is skipped, not fatal, when
-    # the remaining budget is too thin (the watchdog emits what completed).
-    for key, aware, min_budget in (
-            ("frontier_p50_ms_64robots", True, 60.0),
-            ("frontier_euclid_p50_ms_64robots", False, 30.0)):
-        if _remaining() < min_budget:
-            print(f"bench: skipping {key} ({_remaining():.0f}s left)",
-                  file=sys.stderr, flush=True)
-            continue
+    def run_frontier(key: str, aware: bool) -> None:
         fcfg = dataclasses.replace(cfg.frontier, obstacle_aware=aware)
         try:
             p50 = _chain_time(frontier_chain_factory(fcfg), k1, k2, reps)
@@ -600,11 +768,13 @@ def _run() -> None:
     # ---- matcher + full slam_step at production config ------------------
     # The per-key-scan costs: what slam_toolbox pays at 10 Hz
     # (slam_config.yaml:24-38). Chained through the refined pose / carried
-    # state so iterations are data-dependent.
+    # state so iterations are data-dependent. `match_p50_ms` measures the
+    # product-default matcher (branch-and-bound since r06; `--suite
+    # match` carries the exhaustive-vs-pruned comparison).
     from jax_mapping.models import slam as SM
     from jax_mapping.ops import scan_match as M
 
-    if _remaining() > 90.0:
+    def run_match() -> None:
         def match_chain():
             def run_g(gr0, k):
                 def body(_, p):
@@ -622,11 +792,8 @@ def _run() -> None:
         except Exception:
             import traceback
             traceback.print_exc(file=sys.stderr)
-    else:
-        print(f"bench: skipping match ({_remaining():.0f}s left)",
-              file=sys.stderr, flush=True)
 
-    if _remaining() > 90.0:
+    def run_slam_step() -> None:
         state0 = SM.init_state(cfg)
         # Wheel speed sized so EVERY iteration passes the 0.1 m key-scan
         # gate (0.12 m per 0.1 s step): the metric is the per-KEY-scan
@@ -654,9 +821,6 @@ def _run() -> None:
         except Exception:
             import traceback
             traceback.print_exc(file=sys.stderr)
-    else:
-        print(f"bench: skipping slam_step ({_remaining():.0f}s left)",
-              file=sys.stderr, flush=True)
 
     # ---- full closed-loop fleet tick, 8 AND 64 robots, production grid --
     # sense (simulated LD06 raycast against a ground-truth world) ->
@@ -670,25 +834,20 @@ def _run() -> None:
     # actual scans.
     from jax_mapping.models import fleet as FL
     from jax_mapping.sim import world as W
-    world_d = None                      # built lazily on first timed config
-    for n_robots, key, min_budget in (
-            (8, "fleet_tick_p50_ms_8robots", 150.0),
-            (64, "fleet_tick_p50_ms_64robots", 150.0)):
-        if _remaining() < min_budget:
-            print(f"bench: skipping {key} ({_remaining():.0f}s left)",
-                  file=sys.stderr, flush=True)
-            continue
+    fleet_world = {}                    # built lazily on first timed config
+
+    def run_fleet(n_robots: int, key: str) -> None:
         if on_cpu and n_robots > 8:
             # The 64-robot production tick exists to answer a TPU budget
             # question; on the virtual-CPU fallback it would only eat the
             # watchdog deadline the remaining sections need.
-            print(f"bench: skipping {key} on CPU fallback",
-                  file=sys.stderr, flush=True)
-            continue
-        if world_d is None:
+            _skip_section(key, "cpu fallback")
+            return
+        if "w" not in fleet_world:
             world = W.plank_course(g.size_cells, g.resolution_m,
                                    n_planks=40, seed=0)
-            world_d = jax.device_put(jnp.asarray(world), dev)
+            fleet_world["w"] = jax.device_put(jnp.asarray(world), dev)
+        world_d = fleet_world["w"]
         cfg_n = dataclasses.replace(
             cfg, fleet=dataclasses.replace(cfg.fleet, n_robots=n_robots))
         fstate0 = FL.init_fleet_state(cfg_n, jax.random.PRNGKey(0))
@@ -722,7 +881,7 @@ def _run() -> None:
     # region; the renderer is not part of the fusion cost a deployment
     # pays. `voxel_path` records the engine fuse_depths dispatched to
     # (the Pallas kernel on TPU, ops/voxel_kernel.py; XLA elsewhere).
-    if _remaining() > 90.0:
+    def run_voxel() -> None:
         from jax_mapping.ops import voxel as VX
         from jax_mapping.sim import depthcam as DCam
         from jax_mapping.sim import world as SimW
@@ -764,7 +923,12 @@ def _run() -> None:
         # voxel_kernel.window_delta replaces the B-step fold with one
         # aligned read-modify-write). Kernel engine only: interpret-mode
         # pallas off-TPU is pathologically slow at production shapes.
-        if VX._use_pallas(vox, cam) and _remaining() > 60.0:
+        if not VX._use_pallas(vox, cam):
+            _skip_section("voxel_window", "no pallas voxel engine")
+        elif _remaining() < 60.0:
+            _skip_section("voxel_window",
+                          f"{_remaining():.0f}s left < 60s floor")
+        else:
             from jax_mapping.ops import voxel_kernel as VKK
             wt = np.linspace(0, 0.5, VB).astype(np.float32)
             wposes_d = jax.device_put(jnp.asarray(np.stack(
@@ -795,9 +959,6 @@ def _run() -> None:
             except Exception:
                 import traceback
                 traceback.print_exc(file=sys.stderr)
-    else:
-        print(f"bench: skipping voxel ({_remaining():.0f}s left)",
-              file=sys.stderr, flush=True)
 
     # ---- global planner: replan latency at production scale --------------
     # The round-5 navigation capability (ops/planner.py): goal-seeded
@@ -805,7 +966,7 @@ def _run() -> None:
     # descent, one jit. Budget: PlannerConfig.period_s (= 1 s) per replan;
     # the p50 must sit far under it for the planner to ride the mapper's
     # cadence without stealing the hot path's device time.
-    if _remaining() > 150.0:
+    def run_plan() -> None:
         from jax_mapping.ops import planner as PL
         pcfg = cfg.planner
         nlo = g.size_cells
@@ -846,9 +1007,35 @@ def _run() -> None:
         except Exception:
             import traceback
             traceback.print_exc(file=sys.stderr)
-    else:
-        print(f"bench: skipping plan ({_remaining():.0f}s left)",
-              file=sys.stderr, flush=True)
+
+    # ---- the schedule ----------------------------------------------------
+    # Priority = one data point per subsystem before any subsystem's
+    # second: hot-path metrics (match, slam_step), then the per-subsystem
+    # first points (frontier obstacle-aware, fleet@8, plan, voxel), then
+    # the second points (frontier euclid, fleet@64; voxel_window rides
+    # inside run_voxel with its own floor). Floors are the historical
+    # worst-case compile+measure costs; a section that does not fit is
+    # recorded in `sections_skipped`, never silently dropped.
+    sections = (
+        ("match", 90.0, run_match),
+        ("slam_step", 90.0, run_slam_step),
+        ("frontier_p50_ms_64robots", 60.0,
+         lambda: run_frontier("frontier_p50_ms_64robots", True)),
+        ("fleet_tick_8", 150.0,
+         lambda: run_fleet(8, "fleet_tick_p50_ms_8robots")),
+        ("plan", 150.0, run_plan),
+        ("voxel", 90.0, run_voxel),
+        ("frontier_euclid_p50_ms_64robots", 30.0,
+         lambda: run_frontier("frontier_euclid_p50_ms_64robots", False)),
+        ("fleet_tick_64", 150.0,
+         lambda: run_fleet(64, "fleet_tick_p50_ms_64robots")),
+    )
+    for key, min_budget, fn in sections:
+        if _remaining() < min_budget:
+            _skip_section(
+                key, f"{_remaining():.0f}s left < {min_budget:.0f}s floor")
+            continue
+        fn()
 
 
 if __name__ == "__main__":

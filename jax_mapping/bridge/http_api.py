@@ -350,6 +350,10 @@ class MapApiServer:
                 # operator's one-glance health check.
                 body["n_scans_fused"] = self.mapper.n_scans_fused
                 body["n_loops_closed"] = self.mapper.n_loops_closed
+                if hasattr(self.mapper, "match_stats"):
+                    # Branch-and-bound matcher work accounting (last
+                    # key match's candidate count + prune ratio).
+                    body["match"] = self.mapper.match_stats()
                 calib = self.mapper.calibration()
                 if calib is not None:
                     # Live odometry-scale re-measurement of the
@@ -901,6 +905,20 @@ class MapApiServer:
                 f"jax_mapping_supervisor_checkpoints_total "
                 f"{sup['checkpoints']}",
             ]
+        if self.mapper is not None and hasattr(self.mapper, "match_stats"):
+            # Branch-and-bound matcher work accounting (SlamDiag
+            # match_candidates/prune_ratio): evaluations the last key
+            # match scored per robot, and the fraction pruned off the
+            # exhaustive sweep.
+            ms = self.mapper.match_stats()
+            lines += ["# TYPE jax_mapping_match_candidates gauge"]
+            lines += [
+                f'jax_mapping_match_candidates{{robot="{i}"}} {c}'
+                for i, c in enumerate(ms["candidates"])]
+            lines += ["# TYPE jax_mapping_match_prune_ratio gauge"]
+            lines += [
+                f'jax_mapping_match_prune_ratio{{robot="{i}"}} {r}'
+                for i, r in enumerate(ms["prune_ratio"])]
         if self.recovery is not None:
             rec = self.recovery.snapshot()
             wd = rec["watchdog"]
@@ -927,6 +945,24 @@ class MapApiServer:
                 f"jax_mapping_recovery_blacklisted_total "
                 f"{rec['blacklist']['n_blacklisted']}",
             ]
+            pc = rec["relocalization"].get("pyramid_cache")
+            if pc is not None:
+                # Revision-keyed pyramid cache feeding the pruned
+                # wide-window relocalizer (ops/pyramid.PyramidCache).
+                lines += [
+                    "# TYPE jax_mapping_match_pyramid_cache_hits_total"
+                    " counter",
+                    f"jax_mapping_match_pyramid_cache_hits_total "
+                    f"{pc['n_hits']}",
+                    "# TYPE jax_mapping_match_pyramid_cache_misses_total"
+                    " counter",
+                    f"jax_mapping_match_pyramid_cache_misses_total "
+                    f"{pc['n_misses']}",
+                    "# TYPE jax_mapping_match_pyramid_cache_hit_rate"
+                    " gauge",
+                    f"jax_mapping_match_pyramid_cache_hit_rate "
+                    f"{pc['hit_rate']:.4f}",
+                ]
         # Request-serving telemetry: per-route counters + the latency
         # histogram, snapshotted under the stats lock so the exposition
         # is internally consistent (bucket counts sum to _count).

@@ -147,6 +147,13 @@ class MapperNode(Node):
         #: nests it the same way — one acquisition order, no cycle.
         self._dirty_lock = threading.Lock()
         self._dirty_tiles: Optional[np.ndarray] = None
+        #: Per-tile LAST-DIRTY revision (same tile grid as the serving
+        #: mask, never cleared): `region_revision` reduces it over a cell
+        #: rectangle — the pruned matcher's pyramid-cache invalidation
+        #: key (ops/pyramid.PyramidCache). Guarded by `_dirty_lock` with
+        #: the mask; None when serving (and thus revision tracking) is
+        #: off.
+        self._tile_rev: Optional[np.ndarray] = None
         if self._serving_enabled:
             if cfg.grid.size_cells % cfg.serving.tile_cells:
                 raise ValueError(
@@ -155,6 +162,11 @@ class MapperNode(Node):
                     f"{cfg.grid.size_cells}")
             nt = cfg.grid.size_cells // cfg.serving.tile_cells
             self._dirty_tiles = np.zeros((nt, nt), bool)
+            self._tile_rev = np.zeros((nt, nt), np.int64)
+        #: Last key-scan match work accounting per robot (SlamDiag
+        #: match_candidates/match_prune_ratio) — /metrics gauges.
+        self._match_candidates = [0] * n_robots
+        self._match_prune_ratio = [0.0] * n_robots
         #: Revision listeners (the serving event channel): called with
         #: the new revision from the tick thread, OUTSIDE every mapper
         #: lock — fan-out must never run under _state_lock (lint B2).
@@ -261,6 +273,7 @@ class MapperNode(Node):
         c1 = min(nt - 1, max(0, int((col + half) // t)))
         with self._dirty_lock:
             self._dirty_tiles[r0:r1 + 1, c0:c1 + 1] = True
+            self._tile_rev[r0:r1 + 1, c0:c1 + 1] = self.map_revision
 
     def _mark_dirty_all(self) -> None:
         """Whole-map mutation (closure ring re-fuse, restore, prior
@@ -268,6 +281,26 @@ class MapperNode(Node):
         if self._dirty_tiles is not None:
             with self._dirty_lock:
                 self._dirty_tiles[:] = True
+                self._tile_rev[:] = self.map_revision
+
+    def region_revision(self, row0: int, col0: int,
+                        span_cells: int) -> Optional[int]:
+        """Newest `map_revision` whose mutation marked any serving tile
+        intersecting the cell rectangle [row0, row0+span) x
+        [col0, col0+span) — the pyramid cache's freshness key: equal
+        revision = nothing touched the region since the pyramid was
+        built. None when revision tracking is off (serving disabled);
+        callers must then rebuild."""
+        if self._tile_rev is None:
+            return None
+        t = self.cfg.serving.tile_cells
+        nt = self._tile_rev.shape[0]
+        r0 = min(nt - 1, max(0, row0 // t))
+        r1 = min(nt - 1, max(0, (row0 + span_cells - 1) // t))
+        c0 = min(nt - 1, max(0, col0 // t))
+        c1 = min(nt - 1, max(0, (col0 + span_cells - 1) // t))
+        with self._dirty_lock:
+            return int(self._tile_rev[r0:r1 + 1, c0:c1 + 1].max())
 
     def serving_revision(self) -> int:
         """Current map revision — lock-free read (the /status counter
@@ -523,13 +556,24 @@ class MapperNode(Node):
                 self._scan_q[i].clear()
 
         for i, items in enumerate(work):
-            if items and self._diverged(i):
+            if not items:
+                continue
+            if self._diverged(i):
                 # Quarantine rung: this robot's estimator is declared
                 # lost — its evidence buffers (never fuses) and every
                 # tick attempts a wide-window relocalization with the
-                # freshest scan; a verified re-anchor re-admits it.
-                self._quarantine_and_relocalize(i, items)
+                # freshest scan. Only that one scan crosses to the
+                # device (uploading the whole batch here would waste
+                # N-1 rows of transfer every tick of the quarantine).
+                self._quarantine_and_relocalize(
+                    i, items, self._upload_scan_ranges(items[-1:])[0])
                 continue
+            # ONE host->device transfer per robot per tick: every queued
+            # scan padded and stacked host-side, shipped together; the
+            # window/single steps slice device rows off it. Per-scan
+            # `jnp.asarray` paid N-1 extra round trips per tick at fleet
+            # scale.
+            ranges_dev = self._upload_scan_ranges(items)
             W = max(2, self.cfg.fleet.batch_scans)
             k = 0
             while k < len(items):
@@ -543,12 +587,14 @@ class MapperNode(Node):
                     self._quarantine_items(i, items[k:])
                     break
                 if len(items) - k >= W:
-                    self._step_window(i, items[k:k + W])
+                    self._step_window(i, items[k:k + W],
+                                      ranges_dev[k:k + W])
                     k += W
                 else:
-                    self._step_single(i, *items[k])
+                    self._step_single(i, items[k][0], items[k][1],
+                                      ranges_dev[k])
                     k += 1
-            if items and not self._diverged(i):
+            if not self._diverged(i):
                 # A step above may have DECLARED divergence: freezing
                 # the correction TF at the last healthy step beats
                 # re-asserting the diverged estimate.
@@ -562,10 +608,17 @@ class MapperNode(Node):
              "rejected_stale": self.n_scans_rejected_stale,
              "loops_closed": self.n_loops_closed})
 
-    def _step_window(self, i: int, items: List) -> None:
+    def _upload_scan_ranges(self, items: List):
+        """One robot's queued scans, padded and stacked host-side, as a
+        single (N, padded_beams) device transfer (tick's batched-upload
+        contract)."""
+        arr = np.stack([self._pad_ranges(s) for s, _ in items])
+        M.counters.inc("mapper.scan_upload_batches")
+        return self._jnp.asarray(arr)
+
+    def _step_window(self, i: int, items: List, ranges_w) -> None:
         jnp = self._jnp
         W = len(items)
-        ranges_w = np.stack([self._pad_ranges(s) for s, _ in items])
         # Snapshot generation BEFORE _odom_motion touches _prev_paired: a
         # restore landing between the two would otherwise pass the
         # _finish_step guard with _prev_paired holding a pre-restore
@@ -579,13 +632,15 @@ class MapperNode(Node):
         state = self.states[i]._replace(grid=base_grid)
         with M.stages.stage("mapper.slam_step_window"):
             state, diag = self._S.slam_step_window(
-                self.cfg, state, jnp.asarray(ranges_w),
+                self.cfg, state, ranges_w,
                 jnp.asarray(wheels_w), jnp.asarray(dts_w))
             matched = bool(diag.matched)
             closed = bool(diag.loop_closed)
             agreement = float(diag.window_agreement)
             if matched:
                 self._last_cov[i] = np.asarray(diag.cov, np.float32)
+            if bool(diag.key_added):
+                self._note_match_stats(i, diag)
         if self.cfg.resilience.enabled and \
                 agreement < self.cfg.resilience.window_agreement_reject:
             self._reject_low_agreement(i, items)
@@ -610,9 +665,23 @@ class MapperNode(Node):
             self.n_low_agreement_windows += 1
             M.counters.inc("mapper.low_agreement_windows")
 
-    def _step_single(self, i: int, scan: LaserScan, od: Odometry) -> None:
+    def _note_match_stats(self, i: int, diag) -> None:
+        """Key-step matcher work gauges (SlamDiag match_candidates /
+        match_prune_ratio -> /metrics); rides the fetches the stage
+        timer already forces."""
+        self._match_candidates[i] = int(diag.match_candidates)
+        self._match_prune_ratio[i] = round(
+            float(diag.match_prune_ratio), 4)
+
+    def match_stats(self) -> dict:
+        """Per-robot matcher work accounting for /status and /metrics
+        (lock-free reads, the /status counter convention)."""
+        return {"candidates": list(self._match_candidates),
+                "prune_ratio": list(self._match_prune_ratio)}
+
+    def _step_single(self, i: int, scan: LaserScan, od: Odometry,
+                     ranges) -> None:
         jnp = self._jnp
-        ranges = self._pad_ranges(scan)
         # Generation snapshot before the _odom_motion side effect — see
         # _step_window.
         with self._state_lock:
@@ -622,7 +691,7 @@ class MapperNode(Node):
         state = self.states[i]._replace(grid=base_grid)
         with M.stages.stage("mapper.slam_step"):
             state, diag = self._S.slam_step(
-                self.cfg, state, jnp.asarray(ranges),
+                self.cfg, state, ranges,
                 jnp.float32(wl), jnp.float32(wr), jnp.float32(dt))
             # Dispatch is async; the host-side fetches force execution
             # so the stage measures the device step, not the enqueue.
@@ -631,6 +700,8 @@ class MapperNode(Node):
             agreement = float(diag.window_agreement)
             if matched:
                 self._last_cov[i] = np.asarray(diag.cov, np.float32)
+            if bool(diag.key_added):
+                self._note_match_stats(i, diag)
         if self.cfg.resilience.enabled and \
                 agreement < self.cfg.resilience.window_agreement_reject:
             # Same do-no-harm floor as _step_window: the single-scan
@@ -739,20 +810,30 @@ class MapperNode(Node):
         self.n_scans_quarantined += len(items)
         M.counters.inc("mapper.scans_quarantined", len(items))
 
-    def _quarantine_and_relocalize(self, i: int, items: List) -> None:
+    def _quarantine_and_relocalize(self, i: int, items: List,
+                                   ranges) -> None:
         """One quarantine tick for robot i: buffer the evidence, then
         attempt relocalization with the freshest scan against the live
         shared map (clean by construction — this robot's garbage was
         never fused). A verified re-anchor re-admits the robot through
-        the SetInitialPose path semantics (fresh chain, kept map)."""
+        the SetInitialPose path semantics (fresh chain, kept map).
+        `ranges` is the freshest scan's device row from the tick's
+        batched upload; `region_revision` keys the relocalizer's pyramid
+        cache so a steady-state attempt reuses its pyramids."""
         self._quarantine_items(i, items)
         scan, _od = items[-1]
-        ranges = self._pad_ranges(scan)
         with self._state_lock:
             grid = self.shared_grid
+            # Captured WITH the grid: the relocalizer refuses to cache a
+            # pyramid whose region revision is newer than this (a
+            # restore landing after the snapshot must not stamp a
+            # pyramid built from the old grid as current).
+            base_rev = self.map_revision
             guess = np.asarray(self.states[i].pose, np.float32)
         pose = self._recovery.relocalizer.attempt_for(
-            i, self.cfg, grid, ranges, guess)
+            i, self.cfg, grid, ranges, guess,
+            region_rev_fn=self.region_revision,
+            grid_revision=base_rev if self._serving_enabled else None)
         M.counters.inc("mapper.relocalization_attempts")
         if pose is None:
             return

@@ -167,6 +167,32 @@ class MatcherConfig:
     # TPU-only: off-TPU the matcher ignores it (XLA CPU has no fast bf16
     # conv path and runs orders of magnitude slower than f32).
     coarse_bf16: bool = True
+    # Branch-and-bound coarse stage (ops/scan_match module docstring):
+    # score the whole window on a max-pyramid's coarsest level (each
+    # coarse cell upper-bounds its children, so pruning is admissible),
+    # keep the top-K candidate branches per level, descend to exact
+    # leaf scores — same argmax contract as the f32 exhaustive sweep at
+    # a small fraction of the candidate evaluations (on TPU, coarse_bf16
+    # rounding can flip near-tie coarse winners of the EXHAUSTIVE path
+    # relative to f32; the pruned path always scores f32). False = the
+    # bit-exact exhaustive sweep (the pre-pruning pipeline). Windows too
+    # small to build a pyramid over fall through automatically.
+    pruned: bool = True
+    # Candidate branches kept per DOWNSAMPLED pyramid level. The winner
+    # survives as long as its ancestors rank inside the top-K upper
+    # bounds at every level; 64 holds argmax parity across the
+    # property-test worlds with ~10-40x fewer coarse evaluations at
+    # production windows.
+    bnb_topk: int = 64
+    # Branches entering the final FULL-RESOLUTION leaf round — the only
+    # round whose candidate evaluations touch whole P^2 patches, so it
+    # dominates the descent's memory traffic. By level 1 the dual-
+    # pyramid bounds are tight (2x2-leaf blocks at half resolution);
+    # a narrower funnel there is the cheap/safe trade.
+    bnb_leaf_topk: int = 16
+    # Pyramid depth above full resolution; 0 = auto (deepest level whose
+    # top grid keeps >= 3 nodes per axis, capped at 6).
+    bnb_levels: int = 0
     # Gating: only match when moved enough (slam_config.yaml:37-38).
     min_travel_m: float = 0.1
     min_heading_rad: float = 0.1

@@ -57,6 +57,13 @@ class SlamDiag(NamedTuple):
     # step's match; zeros when no match ran (non-key step). The bridge
     # publishes it with /pose (slam_toolbox's PoseWithCovariance).
     cov: Array           # (3,) [var_x m^2, var_y m^2, var_th rad^2]
+    # Matcher work accounting (MatchResult.n_candidates/prune_ratio):
+    # coarse-stage candidate evaluations this step's match scored and the
+    # fraction the branch-and-bound stage pruned off the exhaustive
+    # sweep; zeros on non-key steps. The mapper exports them as
+    # jax_mapping_match_* gauges.
+    match_candidates: Array   # () int32
+    match_prune_ratio: Array  # () float32
 
 
 def init_state(cfg: SlamConfig, pose0=None) -> SlamState:
@@ -189,7 +196,9 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
                             key_added=jnp.bool_(False),
                             loop_closed=jnp.bool_(False),
                             window_agreement=jnp.float32(1.0),
-                            cov=res.cov)
+                            cov=res.cov,
+                            match_candidates=res.n_candidates,
+                            match_prune_ratio=res.prune_ratio)
             return st2, diag
 
         # Pre-fusion map agreement at the chosen pose — the same health
@@ -272,7 +281,9 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
                         n_keyscans=st.n_keyscans + 1)
         diag = SlamDiag(matched=res.accepted, response=res.response,
                         key_added=jnp.bool_(True), loop_closed=closed,
-                        window_agreement=agreement, cov=res.cov)
+                        window_agreement=agreement, cov=res.cov,
+                        match_candidates=res.n_candidates,
+                        match_prune_ratio=res.prune_ratio)
         return st2, diag
 
     def skip_branch(st: SlamState):
@@ -281,7 +292,9 @@ def slam_step(cfg: SlamConfig, state: SlamState, ranges: Array,
                         key_added=jnp.bool_(False),
                         loop_closed=jnp.bool_(False),
                         window_agreement=jnp.float32(1.0),
-                        cov=jnp.zeros(3, jnp.float32))
+                        cov=jnp.zeros(3, jnp.float32),
+                        match_candidates=jnp.int32(0),
+                        match_prune_ratio=jnp.float32(0.0))
         return st2, diag
 
     return jax.lax.cond(is_key, key_branch, skip_branch, state)

@@ -1,1 +1,3 @@
-"""Pure-JAX device kernels: grid fusion, scan matching, frontiers, pose graph."""
+"""Pure-JAX device kernels: grid fusion, scan matching (exhaustive +
+branch-and-bound pruned paths with the revision-keyed pyramid cache),
+frontiers, pose graph."""

@@ -1,0 +1,134 @@
+"""Revision-keyed likelihood-pyramid cache for the pruned scan matcher.
+
+The branch-and-bound matcher (ops/scan_match, `MatcherConfig.pruned`)
+descends a max-pyramid of the likelihood field. Inside a jitted SLAM step
+the pyramid is rebuilt in-graph — cheap next to the sweep it replaces —
+but the HOST-driven repeated-match workloads (the recovery relocalizer
+hammering the same map region every tick, loop-verification sweeps from
+bench harnesses) rebuild the identical pyramid over and over against a
+map that did not change underneath them.
+
+`PyramidCache` keys a built pyramid on (region key, region revision):
+the region key names WHERE the pyramid reads (a patch origin on a given
+grid view), the revision says WHEN that area last changed. The mapper
+supplies revisions from its serving-side dirty-tile bookkeeping
+(`MapperNode.region_revision`: the monotonic `map_revision` recorded
+per serving tile at mark time), so a fusion on the far side of the map
+does NOT invalidate a relocalizing robot's pyramid — only mutations
+whose patch extents touched the region do. A `None` revision means "no
+revision source" (serving disabled, standalone tests): the entry is
+rebuilt every time rather than ever serving stale data.
+
+Entries are whole pyramids (tuples of device arrays): re-pooling happens
+at region granularity — the likelihood smear crosses tile borders, so a
+sub-region re-pool would need halo bookkeeping the hash-diff already
+makes unnecessary (a clean region is reused wholesale; a dirty one is
+one jitted rebuild).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional, Tuple
+
+import jax
+
+from jax_mapping.config import GridConfig, MatcherConfig
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import scan_match as M
+
+Array = jax.Array
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def build_match_pyramid(grid_cfg: GridConfig, m_cfg: MatcherConfig,
+                        n_levels: int, grid_arr: Array,
+                        origin_rc: Array) -> Tuple[Array, ...]:
+    """Grid view + patch origin -> the pruned matcher's pyramid, one
+    jitted dispatch: patch slice, likelihood field, max-pyramid levels
+    (ops/scan_match.build_levels). The cached counterpart of the
+    in-graph build `match` does per call."""
+    patch = jax.lax.dynamic_slice(
+        grid_arr, (origin_rc[0], origin_rc[1]),
+        (grid_cfg.patch_cells, grid_cfg.patch_cells))
+    field = M.likelihood_field(grid_cfg, m_cfg, patch)
+    stride, n_steps = M.window_params(grid_cfg, m_cfg)
+    return M.build_levels(field, n_steps, stride, n_levels)
+
+
+def patch_origin_host(grid_cfg: GridConfig, xy) -> Tuple[int, int]:
+    """`ops/grid.patch_origin` fetched to host ints — the cache-key form
+    (origins are alignment-snapped, so nearby guesses share keys)."""
+    import numpy as np
+    o = np.asarray(G.patch_origin(grid_cfg, jax.numpy.asarray(
+        np.asarray(xy, np.float32))))
+    return int(o[0]), int(o[1])
+
+
+class PyramidCache:
+    """Bounded LRU of built pyramids keyed on (region, revision).
+
+    Thread-safety: lookups and installs serialize on a leaf lock; the
+    BUILD runs outside it (a device dispatch under a host lock is the
+    exact stall the B2 lint exists to catch). Two threads racing the
+    same cold key both build — harmless (last install wins; the cache is
+    an optimisation, never a correctness surface).
+    """
+
+    def __init__(self, max_entries: int = 8):
+        self._lock = threading.Lock()
+        #: key -> (revision, pyramid levels tuple), LRU order.
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.max_entries = max_entries
+        self.n_hits = 0
+        self.n_misses = 0
+        self.n_invalidations = 0
+
+    def get(self, key: tuple, revision: Optional[int],
+            build: Callable[[], Tuple[Array, ...]]) -> Tuple[Array, ...]:
+        """The cached pyramid for `key` at `revision`, building on miss.
+
+        A hit requires the stored revision to EQUAL the requested one —
+        a dirty region (newer revision) rebuilds, and a clean region
+        (same revision) is reused no matter how far the global
+        `map_revision` advanced elsewhere. `revision=None` always
+        rebuilds and never stores (no revision source = no way to know
+        the entry is still current)."""
+        stale = False
+        if revision is not None:
+            with self._lock:
+                ent = self._entries.get(key)
+                if ent is not None:
+                    if ent[0] == revision:
+                        self._entries.move_to_end(key)
+                        self.n_hits += 1
+                        return ent[1]
+                    stale = True
+        levels = build()
+        with self._lock:
+            self.n_misses += 1
+            if stale:
+                self.n_invalidations += 1
+            if revision is not None:
+                self._entries[key] = (revision, levels)
+                self._entries.move_to_end(key)
+                while len(self._entries) > self.max_entries:
+                    self._entries.popitem(last=False)
+        return levels
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = self.n_hits + self.n_misses
+            return {
+                "n_entries": len(self._entries),
+                "n_hits": self.n_hits,
+                "n_misses": self.n_misses,
+                "n_invalidations": self.n_invalidations,
+                "hit_rate": (self.n_hits / total) if total else 0.0,
+            }

@@ -25,6 +25,28 @@ matcher is dense passes over static shapes with zero gathers:
 Coarse pass over the full window at grid resolution, fine angle pass, then
 sub-cell translation pass. Everything jits; no data-dependent shapes
 (SURVEY.md §7 hard parts).
+
+Branch-and-bound coarse stage (`MatcherConfig.pruned`, the default): the
+exhaustive coarse sweep scores EVERY (angle, shift) candidate even though
+almost all of them are nowhere near the winner. The pruned path is the
+classic coarse-to-fine branch-and-bound acceleration of correlative
+matching (the FPGA 2D-LiDAR-SLAM formulation; Cartographer's real-time
+loop closure uses the same bound): precompute a multi-resolution
+max-pyramid of the likelihood field where level-l cell x holds
+max_{0<=d<2^l} field[x + stride*d] per axis — so a level-l score is an
+ADMISSIBLE upper bound on every leaf score in its 2^l x 2^l shift block —
+score the whole window at the top level in one strided MXU conv, keep the
+top-K candidate branches per level, and descend to exact leaf scores at
+level 0. Identical argmax to the f32 exhaustive sweep whenever the true
+winner's ancestors stay inside the top-K frontier (property-tested across
+random worlds; on TPU the exhaustive path's own `coarse_bf16` rounding
+can flip near-tie coarse winners relative to f32 — the pruned path
+always scores f32, so the parity contract is against the f32 sweep);
+`pruned=False` is the bit-exact exhaustive path. The
+whole refinement runs in ONE jitted dispatch — no host syncs between
+levels — and the host-driven cached entry points (`pyramid_coarse_scores`
+/ `pyramid_refine`, fed by `ops/pyramid.PyramidCache`) donate the coarse
+score buffer into the refinement dispatch.
 """
 
 from __future__ import annotations
@@ -51,13 +73,23 @@ class MatchResult(NamedTuple):
     step). It is the correlation-surface covariance Karto/slam_toolbox
     publish with their poses (Olson 2009's formulation): a sharp single
     peak reports tight variance (floored at the coarse quantisation), a
-    ridge reports wide variance along the ridge axis.
+    ridge reports wide variance along the ridge axis. On the pruned path
+    the x/y surface is the winner-angle level-1 block surface (admissible
+    upper bounds at 2-leaf granularity — a ridge stays a ridge) and the
+    floor widens to the block size; theta reads the top-level per-angle
+    bound maxima.
     """
     pose: Array          # (3,) refined [x, y, yaw]
     response: Array      # () fine-stage response in [0, 1]
     coarse_response: Array  # () coarse-stage response in [0, 1]
     accepted: Array      # () bool: response >= matcher.min_response
     cov: Array           # (3,) diag [var_x m^2, var_y m^2, var_th rad^2]
+    # Coarse-stage work accounting (SlamDiag / bench gauges): candidate
+    # evaluations actually scored, and the fraction of the exhaustive
+    # A x (2n+1)^2 sweep that branch-and-bound pruned away (0.0 on the
+    # exhaustive path). Both are trace-time constants per config.
+    n_candidates: Array  # () int32
+    prune_ratio: Array   # () float32 in [0, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -169,30 +201,256 @@ def _conv_scores(field: Array, rasters: Array, mass_ref: Array,
     by its own mass would hand candidates whose hit band is clipped by the
     patch edge a smaller denominator and a quietly inflated score. With a
     shared denominator, clipping can only lower a response — conservative.
+    """
+    pad = n_steps * stride
+    fpad = jnp.pad(field, pad).astype(compute_dtype)
+    return _conv_scores_grid(fpad, rasters, mass_ref, 2 * n_steps + 1,
+                             stride)
+
+
+def _conv_scores_grid(fpad: Array, rasters: Array, mass_ref: Array,
+                      n_out: int, stride: int) -> Array:
+    """Strided-window correlation core over an ALREADY-padded field:
+    resp[a, my, mx] = <raster_a, fpad[my*stride : my*stride+P,
+    mx*stride : mx*stride+P]> / mass_ref. `_conv_scores` realises the
+    classic symmetric window with it; the branch-and-bound top level
+    calls it directly on the pyramid's coarsest array with
+    stride = base_stride * 2^L (same padding, far fewer windows).
 
     Lowering: phrased as a 1D conv whose CHANNEL axis is the patch rows
     and whose batch axis is the y-shift (one sliced window of the padded
-    field per sy). The natural 2D form — C_in=1 input against (A, 1, P, P)
+    field per my). The natural 2D form — C_in=1 input against (A, 1, P, P)
     kernels — makes XLA stage the whole P^2 contraction through an
     implicit im2col at C=1 and ran 3.7x slower at the production 640-patch
     shape (7.5 -> 2.0 ms coarse, 2.0 -> 0.24 ms fine, measured on v5e);
     with rows as channels the contraction is a clean (A, P*P) x (P*P, nx)
-    matmul per sy on the MXU. out[sy, a, sx] = sum_{r,c}
-    fpad[sy*stride + r, sx*stride + c] * raster[a, r, c] — identical
+    matmul per my on the MXU. out[my, a, mx] = sum_{r,c}
+    fpad[my*stride + r, mx*stride + c] * raster[a, r, c] — identical
     (unflipped-kernel) correlation semantics either way.
     """
-    pad = n_steps * stride
     A, P, _ = rasters.shape
-    fpad = jnp.pad(field, pad).astype(compute_dtype)
-    ny = 2 * n_steps + 1
+    compute_dtype = fpad.dtype
     windows = jax.vmap(lambda so: jax.lax.dynamic_slice(
-        fpad, (so, 0), (P, P + 2 * pad)))(
-            jnp.arange(ny) * stride)                # (ny, P, P+2p)
+        fpad, (so, 0), (P, fpad.shape[1])))(
+            jnp.arange(n_out) * stride)             # (n_out, P, P+2p)
     out = jax.lax.conv_general_dilated(
         windows, rasters.astype(compute_dtype), window_strides=(stride,),
         padding="VALID", dimension_numbers=("NCW", "OIW", "NCW"),
-        preferred_element_type=jnp.float32)         # (ny, A, nx)
+        preferred_element_type=jnp.float32)         # (n_out, A, n_out)
     return jnp.transpose(out, (1, 0, 2)) / mass_ref
+
+
+# ---------------------------------------------------------------------------
+# Branch-and-bound coarse stage (MatcherConfig.pruned)
+# ---------------------------------------------------------------------------
+
+def window_params(grid_cfg: GridConfig,
+                  m_cfg: MatcherConfig) -> tuple[int, int]:
+    """(stride_cells, n_steps): the coarse window's leaf grid — shifts at
+    `stride` cells, leaf index j in [-n_steps, n_steps]. ONE derivation
+    for the exhaustive sweep, the pruned matcher, and the pyramid
+    builders (a drifted copy would silently mis-key the cache)."""
+    stride = max(1, int(round(m_cfg.coarse_step_m / grid_cfg.resolution_m)))
+    n_steps = max(1, int(round(m_cfg.search_half_extent_m
+                               / (stride * grid_cfg.resolution_m))))
+    return stride, n_steps
+
+
+def bnb_num_levels(m_cfg: MatcherConfig, n_steps: int) -> int:
+    """Pyramid depth above level 0 for a (2*n_steps+1)-leaf window:
+    `bnb_levels` when set, else the deepest level whose top grid still
+    holds >= 3 nodes per axis (fewer and the top pass stops pruning;
+    capped at 6 — beyond that the window would be absurd). 0 means the
+    window is too small to prune — callers fall back to the exhaustive
+    sweep, which at that size costs the same."""
+    nw = 2 * n_steps + 1
+    lv = m_cfg.bnb_levels
+    if lv <= 0:
+        lv = 0
+        while lv < 6 and -(-nw // (2 ** (lv + 1))) >= 3:
+            lv += 1
+    while lv > 0 and -(-nw // (2 ** lv)) < 2:
+        lv -= 1                  # explicit override deeper than the window
+    return lv
+
+
+def _block_reduce(x: Array, q: int, op: str) -> Array:
+    """q x q block max/sum downsample, zero-padding ragged edges (safe
+    both ways: the field is non-negative, so padding cannot LOWER a max
+    bound, and zero raster cells add nothing to a sum)."""
+    if q == 1:
+        return x
+    h, w = x.shape[-2], x.shape[-1]
+    ph, pw = (-h) % q, (-w) % q
+    if ph or pw:
+        cfg = [(0, 0)] * (x.ndim - 2) + [(0, ph), (0, pw)]
+        x = jnp.pad(x, cfg)
+    shp = x.shape[:-2] + ((h + ph) // q, q, (w + pw) // q, q)
+    blk = x.reshape(shp)
+    return blk.max(axis=(-3, -1)) if op == "max" else \
+        blk.sum(axis=(-3, -1))
+
+
+def build_levels(field: Array, n_steps: int, stride: int,
+                 n_levels: int) -> tuple[Array, ...]:
+    """Likelihood field -> admissible multi-resolution max-pyramid.
+
+    Internally, full-resolution sliding maxima are built first:
+
+        F_0[x] = pad(field)[x]           (pad = n_steps * stride)
+        F_l[x] = max_{0 <= d < 2^l} pad(field)[x + stride * d]
+                 (per axis; positions past the array read as 0)
+
+    so a level-l score upper-bounds EVERY leaf score in its 2^l x 2^l
+    shift block. The RETURNED tuple is (F_0, D_1, ..., D_L) where
+    D_l = blockmax_{2^l}(F_l) — each level 2^l x COARSER per axis. The
+    dual coarsening (max-pooled field scored against SUM-pooled rasters,
+    `_raster_sums`) keeps the bound admissible while a level-l candidate
+    evaluation touches (P/2^l)^2 cells instead of P^2 — the
+    multi-resolution map pyramid of the FPGA 2D-LiDAR-SLAM formulation:
+
+        sum_r raster[r] * field[r + s]
+          <= sum_R (sum_{r in R} raster[r]) * max_{r in R} F_l[r + s0]
+           = sum_R rastersum_l[R] * D_l[R + s0/2^l]
+
+    for any leaf shift s in the level-l block starting at s0 (s0 and
+    every level-l candidate offset are multiples of 2^l by
+    construction). Zero-fill past the edge only covers shift positions
+    outside the search window (masked invalid during refinement), and
+    the field is non-negative, so it cannot inflate a valid bound."""
+    pad = n_steps * stride
+    full = jnp.pad(field, pad)
+    levels = [full]
+    for lv in range(1, n_levels + 1):
+        s = stride * (2 ** (lv - 1))
+        prev = full
+        rows = jnp.concatenate(
+            [prev[s:, :], jnp.zeros((s, prev.shape[1]), prev.dtype)],
+            axis=0)
+        m = jnp.maximum(prev, rows)
+        cols = jnp.concatenate(
+            [m[:, s:], jnp.zeros((m.shape[0], s), m.dtype)], axis=1)
+        full = jnp.maximum(m, cols)
+        levels.append(_block_reduce(full, 2 ** lv, "max"))
+    return tuple(levels)
+
+
+def _raster_sums(rasters: Array, n_levels: int) -> list:
+    """Per-level 2^l x 2^l block-SUM pools of the raster batch — the
+    dual of the field max-pyramid (build_levels docstring). Index 0 is
+    the full-resolution batch."""
+    return [rasters] + [_block_reduce(rasters, 2 ** lv, "sum")
+                        for lv in range(1, n_levels + 1)]
+
+
+def _axis_min_off(i0: Array, lv: int, n_steps: int) -> Array:
+    """Per-axis minimum |leaf offset| (in leaf steps) over a level-lv
+    node starting at leaf index i0 — the admissible distance for the
+    node's distance-penalty upper bound (the leaf closest to the
+    odometric prior). At lv=0 this is the exact per-leaf offset."""
+    nw = 2 * n_steps + 1
+    i1 = jnp.minimum(i0 + (2 ** lv) - 1, nw - 1)
+    lo = i0 - n_steps
+    hi = i1 - n_steps
+    return jnp.where((lo <= 0) & (hi >= 0), 0,
+                     jnp.minimum(jnp.abs(lo), jnp.abs(hi)))
+
+
+def _bnb_scores(lvl: Array, rasters: Array, a_idx: Array, oy: Array,
+                ox: Array, mass_ref: Array) -> Array:
+    """Candidate-batch scores <raster[a_k], lvl[oy_k : oy_k+P,
+    ox_k : ox_k+P]> / mass_ref in ONE dispatchable op: a lax.map over
+    fixed-size chunks, each chunk a vmapped slice-gather + einsum — peak
+    memory is chunk x P^2 regardless of K, and nothing in the loop
+    touches the host."""
+    P = rasters.shape[1]
+    K = a_idx.shape[0]
+    C = 8 if K % 8 == 0 else 4        # child batches are multiples of 4
+
+    def chunk(args):
+        a, y, x = args
+        sl = jax.vmap(lambda yy, xx: jax.lax.dynamic_slice(
+            lvl, (yy, xx), (P, P)))(y, x)
+        ra = jnp.take(rasters, a, axis=0)
+        return jnp.einsum("kij,kij->k", sl, ra)
+
+    out = jax.lax.map(chunk, (a_idx.reshape(-1, C), oy.reshape(-1, C),
+                              ox.reshape(-1, C)))
+    return out.reshape(-1) / mass_ref
+
+
+def _bnb_winner(m_cfg: MatcherConfig, levels: tuple, resp_top: Array,
+                rasters_c: Array, mass_ref: Array, dth_c: Array,
+                n_steps: int, stride: int, step_m: float,
+                n_levels: int
+                ) -> tuple[Array, Array, Array, Array, int]:
+    """Branch-and-bound descent from the top-level score surface to the
+    exact leaf winner: (angle index, leaf iy, leaf ix, the winner's
+    exact leaf response, n_scored).
+
+    Candidates are (angle, leaf-block) nodes ranked by their admissible
+    upper bound x the penalty upper bound (`_axis_min_off`); each round
+    expands the kept top-K into its 4 children one level down and
+    re-ranks. Level-0 scores are exact, so the final selection replicates
+    the exhaustive sweep's penalty-weighted argmax — including its
+    first-flat-index tie-break over (angle, sy, sx). Static shapes
+    throughout; the whole descent lives inside one jit (no host syncs)."""
+    A = dth_c.shape[0]
+    nw = 2 * n_steps + 1
+    M = resp_top.shape[1]
+    pen_a = _pen_angle(m_cfg, dth_c)                        # (A,)
+    iy0 = jnp.arange(M, dtype=jnp.int32) * (2 ** n_levels)
+    mo = _axis_min_off(iy0, n_levels, n_steps).astype(jnp.float32) * step_m
+    pen_d = _pen_dist(m_cfg, mo[:, None] ** 2 + mo[None, :] ** 2)  # (M, M)
+    rank = resp_top * pen_d[None] * pen_a[:, None, None]
+    K = min(m_cfg.bnb_topk, A * M * M)
+    _, flat = jax.lax.top_k(rank.reshape(-1), K)
+    a = (flat // (M * M)).astype(jnp.int32)
+    rem = flat % (M * M)
+    iy = (rem // M).astype(jnp.int32) * (2 ** n_levels)
+    ix = (rem % M).astype(jnp.int32) * (2 ** n_levels)
+    n_scored = A * M * M
+
+    rsums = _raster_sums(rasters_c, n_levels - 1)
+    for lv in range(n_levels - 1, -1, -1):
+        off = 2 ** lv
+        ca = jnp.tile(a, 4)
+        ciy = jnp.tile(iy, 4) + jnp.repeat(
+            jnp.asarray([0, 0, off, off], jnp.int32), K)
+        cix = jnp.tile(ix, 4) + jnp.repeat(
+            jnp.asarray([0, off, 0, off], jnp.int32), K)
+        valid = (ciy < nw) & (cix < nw)
+        # Level lv >= 1 scores on the 2^lv-downsampled dual pyramid
+        # (1/4^lv the cells per candidate); level 0 scores exact leaves
+        # at full resolution. Valid candidates' offsets are multiples of
+        # 2^lv by construction; invalid ones may slice out of bounds,
+        # where dynamic_slice clamps and the -1 mask discards them.
+        scores = _bnb_scores(levels[lv], rsums[lv], ca,
+                             (ciy // off) * stride,
+                             (cix // off) * stride, mass_ref)
+        my = _axis_min_off(ciy, lv, n_steps).astype(jnp.float32) * step_m
+        mx = _axis_min_off(cix, lv, n_steps).astype(jnp.float32) * step_m
+        pen = _pen_dist(m_cfg, my * my + mx * mx) * pen_a[ca]
+        rank = jnp.where(valid, scores * pen, jnp.float32(-1.0))
+        n_scored += 4 * K
+        if lv > 0:
+            # Funnel: full breadth while candidates are cheap
+            # (downsampled), `bnb_leaf_topk` into the full-resolution
+            # leaf round whose evaluations dominate memory traffic.
+            K = min(m_cfg.bnb_leaf_topk if lv == 1 else m_cfg.bnb_topk,
+                    4 * K)
+            _, idx = jax.lax.top_k(rank, K)
+            a, iy, ix = ca[idx], ciy[idx], cix[idx]
+        else:
+            # Exact leaves: penalty-weighted argmax with the exhaustive
+            # sweep's first-flat-index tie-break over (a, sy, sx).
+            best = rank.max()
+            flat_leaf = ca * (nw * nw) + ciy * nw + cix
+            sel = jnp.where(rank == best, flat_leaf,
+                            jnp.int32(A * nw * nw))
+            w = jnp.argmin(sel)
+            a, iy, ix, resp = ca[w], ciy[w], cix[w], scores[w]
+    return a, iy, ix, resp, n_scored
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
@@ -214,17 +472,37 @@ def match(grid_cfg: GridConfig, scan_cfg: ScanConfig, m_cfg: MatcherConfig,
 
     Returns the refined pose; `accepted` mirrors the reference's response
     gating (callers fall back to the odometry guess when not accepted).
+
+    `m_cfg.pruned` (the default) runs the branch-and-bound coarse stage
+    instead of the exhaustive sweep — same argmax contract, a small
+    fraction of the candidate evaluations (module docstring); windows too
+    small to prune fall through to the exhaustive path, and
+    `pruned=False` is the bit-exact pre-pruning pipeline.
     """
-    res = grid_cfg.resolution_m
     origin = G.patch_origin(grid_cfg, guess_pose[:2])
     patch = jax.lax.dynamic_slice(
         grid_arr, (origin[0], origin[1]),
         (grid_cfg.patch_cells, grid_cfg.patch_cells))
     field = likelihood_field(grid_cfg, m_cfg, patch)
+    stride, n_steps = window_params(grid_cfg, m_cfg)
+    n_levels = bnb_num_levels(m_cfg, n_steps) if m_cfg.pruned else 0
+    if n_levels > 0:
+        levels = build_levels(field, n_steps, stride, n_levels)
+        return _match_bnb(grid_cfg, scan_cfg, m_cfg, levels, origin,
+                          ranges, guess_pose, n_levels)
+    return _match_exhaustive(grid_cfg, scan_cfg, m_cfg, field, origin,
+                             ranges, guess_pose)
 
+
+def _match_exhaustive(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                      m_cfg: MatcherConfig, field: Array, origin: Array,
+                      ranges: Array, guess_pose: Array) -> MatchResult:
+    """The pre-pruning three-pass pipeline, bit-for-bit (the
+    `MatcherConfig.pruned=False` contract and the parity oracle for the
+    branch-and-bound path)."""
+    res = grid_cfg.resolution_m
     # --- coarse pass: all angles x all strided-cell shifts --------------
-    stride = max(1, int(round(m_cfg.coarse_step_m / res)))
-    n_steps = max(1, int(round(m_cfg.search_half_extent_m / (stride * res))))
+    stride, n_steps = window_params(grid_cfg, m_cfg)
     dth_c = _angle_grid(m_cfg.coarse_angle_half_rad,
                         m_cfg.coarse_angle_step_rad)
     A_c = dth_c.shape[0]
@@ -263,6 +541,57 @@ def match(grid_cfg: GridConfig, scan_cfg: ScanConfig, m_cfg: MatcherConfig,
     # Shift in metres ((sy, sx) strided steps; row = y, col = x).
     shift0 = jnp.stack([(sx_c - n_steps).astype(jnp.float32) * step_m,
                         (sy_c - n_steps).astype(jnp.float32) * step_m])
+
+    pose, fine_resp = _fine_stages(grid_cfg, scan_cfg, m_cfg, field,
+                                   origin, ranges, guess_pose, mass_ref,
+                                   dth0, shift0)
+
+    # --- correlation-surface covariance (MatchResult.cov docstring) -----
+    # Computed over the COARSE surface: it spans the whole search window
+    # (the fine surface covers only +-1 coarse step, far too narrow to
+    # see a corridor's metres-long ridge). Softmax weights; temperature
+    # in response units — small enough that only the peak's basin
+    # contributes, large enough that a flat ridge keeps mass spread.
+    T = jnp.float32(0.05)
+    surf = resp_c[ai_c].astype(jnp.float32)  # (2n+1, 2n+1) xy, step_m
+    w_t = jnp.exp((surf - surf.max()) / T)
+    wx = w_t.sum(axis=0)                     # collapse y -> x axis
+    wy = w_t.sum(axis=1)
+    mx = (wx * offs).sum() / wx.sum()
+    my = (wy * offs).sum() / wy.sum()
+    var_x = (wx * (offs - mx) ** 2).sum() / wx.sum()
+    var_y = (wy * (offs - my) ** 2).sum() / wy.sum()
+    resp_a = resp_c.max(axis=(1, 2)).astype(jnp.float32)  # per coarse angle
+    w_a = jnp.exp((resp_a - resp_a.max()) / T)
+    ma = (w_a * dth_c).sum() / w_a.sum()
+    var_th = (w_a * (dth_c - ma) ** 2).sum() / w_a.sum()
+    # Never report tighter than the stage's own quantisation — and the
+    # stage HERE is the coarse one for all three axes (the theta surface
+    # is sampled at coarse_angle_step_rad; flooring it at the fine step
+    # would publish ~100x overconfident yaw variance).
+    cov = jnp.stack([
+        jnp.maximum(var_x, (step_m / 2) ** 2 / 3),
+        jnp.maximum(var_y, (step_m / 2) ** 2 / 3),
+        jnp.maximum(var_th,
+                    (m_cfg.coarse_angle_step_rad / 2) ** 2 / 3)])
+
+    return MatchResult(pose=pose, response=fine_resp,
+                       coarse_response=coarse_resp,
+                       accepted=fine_resp >= m_cfg.min_response,
+                       cov=cov,
+                       n_candidates=jnp.int32(A_c * (2 * n_steps + 1) ** 2),
+                       prune_ratio=jnp.float32(0.0))
+
+
+def _fine_stages(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                 m_cfg: MatcherConfig, field: Array, origin: Array,
+                 ranges: Array, guess_pose: Array, mass_ref: Array,
+                 dth0: Array, shift0: Array) -> tuple[Array, Array]:
+    """Fine-angle + sub-cell refinement around a coarse winner — shared
+    verbatim by the exhaustive and branch-and-bound paths, so a matching
+    coarse winner implies a bit-identical refined pose."""
+    res = grid_cfg.resolution_m
+    stride, _n_steps = window_params(grid_cfg, m_cfg)
 
     # --- fine angles around the winner, +- one coarse step --------------
     dth_f = dth0 + _angle_grid(m_cfg.coarse_angle_step_rad,
@@ -306,40 +635,208 @@ def match(grid_cfg: GridConfig, scan_cfg: ScanConfig, m_cfg: MatcherConfig,
         guess_pose[1] + shift1[1] + deltas[si, 1],
         guess_pose[2] + dth1,
     ])
+    return pose, fine_resp
 
-    # --- correlation-surface covariance (MatchResult.cov docstring) -----
-    # Computed over the COARSE surface: it spans the whole search window
-    # (the fine surface covers only +-1 coarse step, far too narrow to
-    # see a corridor's metres-long ridge). Softmax weights; temperature
-    # in response units — small enough that only the peak's basin
-    # contributes, large enough that a flat ridge keeps mass spread.
+
+def _bnb_setup(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+               m_cfg: MatcherConfig, origin: Array, ranges: Array,
+               guess_pose: Array) -> tuple[Array, Array, Array]:
+    """(dth_c, rasters_c, mass_ref): the same coarse-angle raster batch
+    and shared mass denominator the exhaustive sweep builds."""
+    dth_c = _angle_grid(m_cfg.coarse_angle_half_rad,
+                        m_cfg.coarse_angle_step_rad)
+    A_c = dth_c.shape[0]
+    poses_c = jnp.concatenate([
+        jnp.broadcast_to(guess_pose[:2], (A_c, 2)),
+        (guess_pose[2] + dth_c)[:, None]], axis=1)
+    rasters_c, mass_c = _raster_batch(grid_cfg, scan_cfg, ranges, poses_c,
+                                      origin)
+    mass_ref = jnp.maximum(jnp.max(mass_c), 1e-6)
+    return dth_c, rasters_c, mass_ref
+
+
+def _bnb_top(levels: tuple, rasters_c: Array, mass_ref: Array,
+             n_steps: int, stride: int, n_levels: int) -> Array:
+    """Top-level upper-bound surface: every (angle, 2^L-block) node of
+    the window scored as ONE strided MXU conv over the coarsest DUAL
+    pyramid level — ceil((2n+1)/2^L)^2 windows of (P/2^L)^2-cell
+    sum-pooled rasters instead of (2n+1)^2 windows of P^2 cells. Always
+    f32: a bf16 round-DOWN of an upper bound would break admissibility
+    (MatcherConfig.coarse_bf16 stays an exhaustive-path knob). Window
+    stride is `stride` in downsampled units: a 2^L-block step is
+    stride * 2^L full-resolution cells."""
+    nw = 2 * n_steps + 1
+    M = -(-nw // (2 ** n_levels))
+    rsum = _block_reduce(rasters_c, 2 ** n_levels, "sum")
+    # Ragged-edge ceil padding can leave the conv with a column or two
+    # of extra x-windows past the last node; keep the exact M x M grid.
+    return _conv_scores_grid(levels[n_levels], rsum, mass_ref, M,
+                             stride)[:, :, :M]
+
+
+def _match_bnb(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+               m_cfg: MatcherConfig, levels: tuple, origin: Array,
+               ranges: Array, guess_pose: Array,
+               n_levels: int) -> MatchResult:
+    """Branch-and-bound coarse stage + the shared fine stages."""
+    dth_c, rasters_c, mass_ref = _bnb_setup(grid_cfg, scan_cfg, m_cfg,
+                                            origin, ranges, guess_pose)
+    stride, n_steps = window_params(grid_cfg, m_cfg)
+    resp_top = _bnb_top(levels, rasters_c, mass_ref, n_steps, stride,
+                        n_levels)
+    return _bnb_finish(grid_cfg, scan_cfg, m_cfg, levels, resp_top,
+                       rasters_c, mass_ref, dth_c, origin, ranges,
+                       guess_pose, n_levels)
+
+
+def _bnb_finish(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                m_cfg: MatcherConfig, levels: tuple, resp_top: Array,
+                rasters_c: Array, mass_ref: Array, dth_c: Array,
+                origin: Array, ranges: Array, guess_pose: Array,
+                n_levels: int) -> MatchResult:
+    """Descend to the leaf winner, then refine and report like the
+    exhaustive path. `coarse_response` is the winner's EXACT leaf score
+    (the level-0 descent already computed it). The covariance surface is
+    the winner-ANGLE's level-1 dual-pyramid surface — the whole search
+    window at 2-leaf block granularity, Olson's correlation-surface
+    covariance over admissible upper bounds: a ridge stays a ridge and a
+    peak stays a peak, at 1/4 the cells of the full-resolution surface
+    (re-scoring the full surface for one angle cost more than the whole
+    descent); the quantisation floor widens to the block size
+    accordingly. Theta variance reads the top-level per-angle maxima —
+    admissible upper bounds of the exhaustive per-angle maxima, same
+    softmax shape."""
+    res = grid_cfg.resolution_m
+    stride, n_steps = window_params(grid_cfg, m_cfg)
+    nw = 2 * n_steps + 1
+    step_m = stride * res
+    A_c = dth_c.shape[0]
+    pad = n_steps * stride
+
+    ai_c, iy_b, ix_b, coarse_resp, n_scored = _bnb_winner(
+        m_cfg, levels, resp_top, rasters_c, mass_ref, dth_c, n_steps,
+        stride, step_m, n_levels)
+    field = levels[0][pad:-pad, pad:-pad]
+    dth0 = dth_c[ai_c]
+    shift0 = jnp.stack([(ix_b - n_steps).astype(jnp.float32) * step_m,
+                        (iy_b - n_steps).astype(jnp.float32) * step_m])
+
+    pose, fine_resp = _fine_stages(grid_cfg, scan_cfg, m_cfg, field,
+                                   origin, ranges, guess_pose, mass_ref,
+                                   dth0, shift0)
+
+    # Covariance: x/y softmax moments over the winner-angle level-1
+    # block surface (2-leaf granularity), theta over the top-level
+    # per-angle maxima.
     T = jnp.float32(0.05)
-    surf = resp_c[ai_c].astype(jnp.float32)  # (2n+1, 2n+1) xy, step_m
+    Mb = -(-nw // 2)                         # level-1 blocks per axis
+    r1 = _block_reduce(jnp.take(rasters_c, ai_c[None], axis=0), 2, "sum")
+    surf = _conv_scores_grid(levels[1], r1, mass_ref, Mb,
+                             stride)[0, :, :Mb].astype(jnp.float32)
+    n_scored += Mb * Mb
+    # Block-centre offsets: block m covers leaves {2m, 2m+1}.
+    offs = (jnp.arange(Mb, dtype=jnp.float32) * 2.0 + 0.5
+            - n_steps) * step_m
     w_t = jnp.exp((surf - surf.max()) / T)
-    wx = w_t.sum(axis=0)                     # collapse y -> x axis
+    wx = w_t.sum(axis=0)
     wy = w_t.sum(axis=1)
     mx = (wx * offs).sum() / wx.sum()
     my = (wy * offs).sum() / wy.sum()
     var_x = (wx * (offs - mx) ** 2).sum() / wx.sum()
     var_y = (wy * (offs - my) ** 2).sum() / wy.sum()
-    resp_a = resp_c.max(axis=(1, 2)).astype(jnp.float32)  # per coarse angle
+    resp_a = resp_top.max(axis=(1, 2)).astype(jnp.float32)
     w_a = jnp.exp((resp_a - resp_a.max()) / T)
     ma = (w_a * dth_c).sum() / w_a.sum()
     var_th = (w_a * (dth_c - ma) ** 2).sum() / w_a.sum()
-    # Never report tighter than the stage's own quantisation — and the
-    # stage HERE is the coarse one for all three axes (the theta surface
-    # is sampled at coarse_angle_step_rad; flooring it at the fine step
-    # would publish ~100x overconfident yaw variance).
     cov = jnp.stack([
-        jnp.maximum(var_x, (step_m / 2) ** 2 / 3),
-        jnp.maximum(var_y, (step_m / 2) ** 2 / 3),
+        jnp.maximum(var_x, step_m ** 2 / 3),
+        jnp.maximum(var_y, step_m ** 2 / 3),
         jnp.maximum(var_th,
                     (m_cfg.coarse_angle_step_rad / 2) ** 2 / 3)])
 
+    total = A_c * nw * nw
     return MatchResult(pose=pose, response=fine_resp,
                        coarse_response=coarse_resp,
                        accepted=fine_resp >= m_cfg.min_response,
-                       cov=cov)
+                       cov=cov,
+                       n_candidates=jnp.int32(n_scored),
+                       prune_ratio=jnp.float32(
+                           max(0.0, 1.0 - n_scored / total)))
+
+
+# ---------------------------------------------------------------------------
+# Host-driven cached entry points (ops/pyramid.PyramidCache)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def pyramid_coarse_scores(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                          m_cfg: MatcherConfig, n_levels: int,
+                          levels: tuple, origin: Array, ranges: Array,
+                          guess_pose: Array
+                          ) -> tuple[Array, Array, Array]:
+    """Stage 1 of the cached pruned match: rasterize + top-level bound
+    surface against a PREBUILT pyramid. Returns (resp_top, rasters_c,
+    mass_ref) — device-resident intermediates `pyramid_refine` consumes
+    (and donates) without a host round trip."""
+    dth_c, rasters_c, mass_ref = _bnb_setup(grid_cfg, scan_cfg, m_cfg,
+                                            origin, ranges, guess_pose)
+    del dth_c
+    stride, n_steps = window_params(grid_cfg, m_cfg)
+    resp_top = _bnb_top(levels, rasters_c, mass_ref, n_steps, stride,
+                        n_levels)
+    return resp_top, rasters_c, mass_ref
+
+
+def _pyramid_refine_impl(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                         m_cfg: MatcherConfig, n_levels: int,
+                         resp_top: Array, levels: tuple, origin: Array,
+                         ranges: Array, rasters_c: Array, mass_ref: Array,
+                         guess_pose: Array) -> MatchResult:
+    dth_c = _angle_grid(m_cfg.coarse_angle_half_rad,
+                        m_cfg.coarse_angle_step_rad)
+    return _bnb_finish(grid_cfg, scan_cfg, m_cfg, levels, resp_top,
+                       rasters_c, mass_ref, dth_c, origin, ranges,
+                       guess_pose, n_levels)
+
+
+@functools.lru_cache(maxsize=None)
+def _pyramid_refine_jit():
+    """jit of `_pyramid_refine_impl`, donating the coarse score buffer
+    and the raster batch (dead after the call; XLA reuses their backing
+    for the candidate batches). Donation is a TPU/GPU capability — the
+    CPU runtime ignores it with a warning per compile, so off-accelerator
+    the args are simply not donated (identical results). Built lazily:
+    probing the backend at import time could hang package import on a
+    wedged TPU tunnel (the conftest re-exec hazard)."""
+    donate = (4, 8) if jax.default_backend() in ("tpu", "gpu") else ()
+    return jax.jit(_pyramid_refine_impl, static_argnums=(0, 1, 2, 3),
+                   donate_argnums=donate)
+
+
+def pyramid_refine(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                   m_cfg: MatcherConfig, n_levels: int, resp_top: Array,
+                   levels: tuple, origin: Array, ranges: Array,
+                   rasters_c: Array, mass_ref: Array,
+                   guess_pose: Array) -> MatchResult:
+    """Stage 2: the whole branch-and-bound descent + fine stages as ONE
+    jitted dispatch — no host syncs between levels; on accelerators the
+    coarse score buffer and raster batch are donated
+    (`_pyramid_refine_jit`)."""
+    return _pyramid_refine_jit()(grid_cfg, scan_cfg, m_cfg, n_levels,
+                                 resp_top, levels, origin, ranges,
+                                 rasters_c, mass_ref, guess_pose)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def match_with_pyramid(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                       m_cfg: MatcherConfig, n_levels: int, levels: tuple,
+                       origin: Array, ranges: Array,
+                       guess_pose: Array) -> MatchResult:
+    """Single-dispatch pruned match against a prebuilt pyramid (the
+    convenience form of the coarse/refine split; parity-tested against
+    `match`)."""
+    return _match_bnb(grid_cfg, scan_cfg, m_cfg, levels, origin, ranges,
+                      guess_pose, n_levels)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))

@@ -22,7 +22,7 @@ from __future__ import annotations
 import functools
 import math
 import threading
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 import jax
 import numpy as np
@@ -30,7 +30,9 @@ import numpy as np
 from jax_mapping.config import RecoveryConfig, SlamConfig
 from jax_mapping.models.slam import _loop_matcher_cfg, _loop_wide_cfgs
 from jax_mapping.ops import grid as G
+from jax_mapping.ops import pyramid as PYR
 from jax_mapping.ops import scan_match as M
+from jax_mapping.utils import global_metrics as GM
 
 Array = jax.Array
 
@@ -52,6 +54,25 @@ def relocalize_match(cfg: SlamConfig, grid: Array, ranges: Array,
                    ranges, seed)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _build_wide_pyramid(cfg: SlamConfig, n_levels: int, grid: Array,
+                        origin_c: Array):
+    """Wide-stage pyramid for one coarse-patch region, ONE jitted
+    dispatch: the loop-window downsample + patch + likelihood field +
+    max-pyramid. Caching this entry is what makes steady-state
+    relocalization cheap — the 4096^2 downsample_max alone is real work
+    to repeat every tick against an unchanged region."""
+    import jax.numpy as jnp          # noqa: F401  (jit body convention)
+    g_c, m_c = _loop_wide_cfgs(cfg)
+    coarse = G.downsample_max(grid, cfg.loop.coarse_downsample)
+    patch = jax.lax.dynamic_slice(
+        coarse, (origin_c[0], origin_c[1]),
+        (g_c.patch_cells, g_c.patch_cells))
+    field = M.likelihood_field(g_c, m_c, patch)
+    stride, n_steps = M.window_params(g_c, m_c)
+    return M.build_levels(field, n_steps, stride, n_levels)
+
+
 def _wrap(a: float) -> float:
     return (a + math.pi) % (2.0 * math.pi) - math.pi
 
@@ -62,27 +83,130 @@ class Relocalizer:
     Host-side and deterministic; fed by the mapper's tick thread only,
     read by HTTP exporters (leaf lock)."""
 
-    def __init__(self, cfg: RecoveryConfig, n_robots: int):
+    def __init__(self, cfg: RecoveryConfig, n_robots: int,
+                 pyramid_cache: Optional[PYR.PyramidCache] = None):
         self.cfg = cfg
         self._lock = threading.Lock()
         #: Per-robot streak of consistent accepted candidates,
         #: newest last: list of (x, y, theta).
         self._streak: List[List[tuple]] = [[] for _ in range(n_robots)]
+        #: Revision-keyed pyramid cache (ops/pyramid.py) for the pruned
+        #: wide+fine stages: a quarantined robot re-attempts against the
+        #: same map region every tick, and the region only changes when
+        #: some OTHER robot fuses nearby — steady state is all hits.
+        self.pyramid_cache = pyramid_cache or PYR.PyramidCache()
         self.n_attempts = 0
         self.n_accepted = 0
         self.n_verified = 0
 
+    # -- pruned + cached matching ------------------------------------------
+
+    def _stage_match(self, g_cfg, scan_cfg, m_cfg, n_levels, levels,
+                     origin, ranges, guess) -> M.MatchResult:
+        """One pruned stage through the coarse/refine split, timed as
+        the `jax_mapping_stage_match_*` spans (forcing fetches end each
+        span so it measures device work, not the enqueue — the mapper
+        stage-timer convention)."""
+        with GM.stages.stage("match.coarse_score"):
+            resp_top, rasters_c, mass_ref = M.pyramid_coarse_scores(
+                g_cfg, scan_cfg, m_cfg, n_levels, levels, origin, ranges,
+                guess)
+            jax.block_until_ready(resp_top)
+        with GM.stages.stage("match.refine"):
+            res = M.pyramid_refine(g_cfg, scan_cfg, m_cfg, n_levels,
+                                   resp_top, levels, origin, ranges,
+                                   rasters_c, mass_ref, guess)
+            jax.block_until_ready(res.pose)
+        return res
+
+    def _cached_pyramid(self, key: tuple, revision: Optional[int],
+                        build: Callable) -> tuple:
+        def timed_build():
+            with GM.stages.stage("match.pyramid_build"):
+                levels = build()
+                jax.block_until_ready(levels[-1])
+            GM.counters.inc("match.pyramid_builds")
+            return levels
+        return self.pyramid_cache.get(key, revision, timed_build)
+
+    def _match_pruned(self, cfg: SlamConfig, grid, ranges, guess,
+                      region_rev_fn, grid_revision=None) -> M.MatchResult:
+        """`relocalize_match` semantics through the cached pyramids: the
+        wide basin sweep on the downsampled view, then the fine
+        full-resolution refine, each stage's pyramid keyed on its patch
+        region's revision. `region_rev_fn(row0, col0, span_cells) ->
+        Optional[int]` is the mapper's dirty-tile revision probe; None
+        (no serving/revision tracking) still prunes, just without
+        reuse. `grid_revision` is the map revision AT the caller's grid
+        snapshot: a region revision NEWER than it means a mutation
+        landed between the snapshot and the probe, and caching a
+        pyramid built from the older snapshot at the newer revision
+        would serve stale data as current forever (the
+        read-revision-BEFORE-content ordering hazard PR 4 fixed in the
+        voxel serving snapshot) — such builds are not cached."""
+        import jax.numpy as jnp
+
+        def fresh(rev):
+            if rev is None or (grid_revision is not None
+                               and rev > grid_revision):
+                return None
+            return rev
+
+        g_c, m_c = _loop_wide_cfgs(cfg)
+        f = cfg.loop.coarse_downsample
+        guess = np.asarray(guess, np.float32)
+        _, n_c = M.window_params(g_c, m_c)
+        lv_c = M.bnb_num_levels(m_c, n_c)
+        m_f = _loop_matcher_cfg(cfg)
+        _, n_f = M.window_params(cfg.grid, m_f)
+        lv_f = M.bnb_num_levels(m_f, n_f)
+        if lv_c == 0 or lv_f == 0:
+            # Window too small to prune (exotic tiny configs): the
+            # single-dispatch path already does the right thing.
+            return relocalize_match(cfg, grid, jnp.asarray(ranges),
+                                    jnp.asarray(guess))
+        oc = PYR.patch_origin_host(g_c, guess[:2])
+        rev_c = None if region_rev_fn is None else fresh(region_rev_fn(
+            oc[0] * f, oc[1] * f, g_c.patch_cells * f))
+        origin_c = jnp.asarray(np.asarray(oc, np.int32))
+        levels_c = self._cached_pyramid(
+            ("wide", oc[0], oc[1]), rev_c,
+            lambda: _build_wide_pyramid(cfg, lv_c, grid, origin_c))
+        wide = self._stage_match(g_c, cfg.scan, m_c, lv_c, levels_c,
+                                 origin_c, jnp.asarray(ranges),
+                                 jnp.asarray(guess))
+        seed = (np.asarray(wide.pose, np.float32) if bool(wide.accepted)
+                else guess)
+        of = PYR.patch_origin_host(cfg.grid, seed[:2])
+        rev_f = None if region_rev_fn is None else fresh(region_rev_fn(
+            of[0], of[1], cfg.grid.patch_cells))
+        origin_f = jnp.asarray(np.asarray(of, np.int32))
+        levels_f = self._cached_pyramid(
+            ("fine", of[0], of[1]), rev_f,
+            lambda: PYR.build_match_pyramid(cfg.grid, m_f, lv_f, grid,
+                                            origin_f))
+        return self._stage_match(cfg.grid, cfg.scan, m_f, lv_f, levels_f,
+                                 origin_f, jnp.asarray(ranges),
+                                 jnp.asarray(seed))
+
     def attempt_for(self, robot: int, cfg: SlamConfig, grid, ranges,
-                    guess) -> Optional[np.ndarray]:
+                    guess, region_rev_fn=None,
+                    grid_revision=None) -> Optional[np.ndarray]:
         """One attempt with robot `robot`'s freshest quarantined scan.
         Returns the VERIFIED re-anchor pose (3,) when the consistency
         streak completes, else None. The caller owns what happens next
-        (fresh chain at the pose, watchdog readmit, FleetHealth
-        clear)."""
+        (fresh chain at the pose, watchdog readmit, FleetHealth clear).
+        `grid_revision` = the map revision at the caller's `grid`
+        snapshot (see `_match_pruned`: guards the pyramid cache against
+        stamping a snapshot-built pyramid with a newer revision)."""
         import jax.numpy as jnp
         from jax_mapping.models.slam import scan_agreement
-        res = relocalize_match(cfg, grid, jnp.asarray(ranges),
-                               jnp.asarray(guess))
+        if cfg.matcher.pruned:
+            res = self._match_pruned(cfg, grid, ranges, guess,
+                                     region_rev_fn, grid_revision)
+        else:
+            res = relocalize_match(cfg, grid, jnp.asarray(ranges),
+                                   jnp.asarray(guess))
         accepted = bool(res.accepted)
         response = float(res.response)
         pose = np.asarray(res.pose, np.float32)
@@ -129,8 +253,10 @@ class Relocalizer:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            snap = {
                 "n_attempts": self.n_attempts,
                 "n_accepted": self.n_accepted,
                 "n_verified": self.n_verified,
             }
+        snap["pyramid_cache"] = self.pyramid_cache.snapshot()
+        return snap
