@@ -8,7 +8,11 @@ device count: vs_baseline = scans_per_sec / (50_000 * n_devices / 8).
 Also measures frontier recompute latency at 64 robots (target < 5 ms p50)
 in BOTH cost modes: `frontier_p50_ms_64robots` is the product default
 (obstacle-aware BFS costs, config.py FrontierConfig.obstacle_aware=True);
-`frontier_euclid_p50_ms_64robots` is the cheap Euclidean mode.
+`frontier_euclid_p50_ms_64robots` is the cheap Euclidean mode. The
+PUBLISH-path comparison (full recompute vs the incremental
+revision-keyed pipeline) is its own suite: `--suite frontier`
+(BENCH_FRONTIER_r*.json; host-driven per-publish methodology — not
+comparable to the chain p50s above).
 
 Round-1 lesson (VERDICT.md): the bench must emit its JSON line inside the
 driver budget no matter what the toolchain does. Three guards:
@@ -187,8 +191,11 @@ def main() -> None:
         if suite == "match":
             _match_main()
             return
+        if suite == "frontier":
+            _frontier_main()
+            return
         print(f"bench: unknown suite {suite!r} "
-              "(available: serving, match)",
+              "(available: serving, match, frontier)",
               file=sys.stderr, flush=True)
         sys.exit(2)
     if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") != "1" \
@@ -235,6 +242,15 @@ def _match_main() -> None:
               "speedup": None, "pyramid_cache_hit_rate": None,
               "pyramid_build_ms": None, "devices": "unknown",
               "sections_completed": [], "provenance": None}
+    _run_suite_guarded(result, _match_run)
+
+
+def _run_suite_guarded(result: dict, run_fn) -> None:
+    """ONE emit contract for the micro-suites (match, frontier):
+    exactly one JSON line on stdout (+ `--out FILE` copy), printed by
+    whichever fires first of normal completion, an exception, or the
+    deadline watchdog — then a hard exit. Extracted so a fix to the
+    contract cannot silently diverge between suites."""
     emitted = threading.Event()
 
     def emit(code: int = 0) -> None:
@@ -255,7 +271,7 @@ def _match_main() -> None:
     watchdog.daemon = True
     watchdog.start()
     try:
-        _match_run(result)
+        run_fn(result)
     except Exception:
         import traceback
         traceback.print_exc(file=sys.stderr)
@@ -369,6 +385,217 @@ def _match_run(result: dict) -> None:
         result["pyramid_cache_hit_rate"] = round(snap["hit_rate"], 3)
         result["pyramid_cache"] = snap
         result["sections_completed"].append("pyramid_cache")
+
+
+def _frontier_main() -> None:
+    """`bench.py --suite frontier` — full-recompute vs incremental
+    exploration-pipeline p50 at 64 robots on a production-shape (4096^2)
+    mid-mission world, over steady-state and closure-storm dirty
+    patterns, plus the publish-skip path and tile-cache hit rates.
+    Prints exactly ONE JSON line; `--out FILE` additionally writes it
+    (the BENCH_FRONTIER_r* artifact).
+
+    CPU-pinned like the serving suite: the comparison is HOST-DRIVEN by
+    construction — `publish_frontiers` is a host loop around device
+    dispatches, and the incremental pipeline's cache decisions live on
+    the host — so both sides are timed identically as per-publish wall
+    time with a block_until_ready barrier. NOT comparable to the main
+    suite's `frontier_p50_ms_64robots` chain numbers (the PR 5 gotcha:
+    XLA:CPU runs convs ~10x slower inside fori_loop chains than
+    standalone); the `methodology` field says so."""
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        from jax_mapping.utils.backend_guard import scrubbed_cpu_env
+        os.execvpe(sys.executable, [sys.executable] + sys.argv,
+                   scrubbed_cpu_env(extra_env={
+                       "JAX_PLATFORMS": "cpu",
+                       "JAX_MAPPING_BENCH_DEADLINE_S":
+                           str(max(60.0, _remaining()))}))
+    result = {
+        "metric": "frontier_publish_p50_ms_64robots", "suite": "frontier",
+        "full_p50_ms": None, "incremental_steady_p50_ms": None,
+        "incremental_skip_p50_ms": None, "closure_storm_p50_ms": None,
+        "speedup_steady": None, "speedup_storm": None,
+        "tile_cache": None, "crop": None, "n_warm_starts": None,
+        "methodology": (
+            "host-driven per-publish wall time (block_until_ready "
+            "barrier), BOTH paths — not comparable to the main suite's "
+            "fori_loop chain p50s (PR 5 gotcha: CPU convs ~10x slower "
+            "in-chain)"),
+        "sections_completed": [], "sections_skipped": {},
+        "devices": "unknown", "provenance": None}
+    _run_suite_guarded(result, _frontier_run)
+
+
+def _frontier_run(result: dict) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from jax_mapping.config import SlamConfig
+    from jax_mapping.ops import frontier as F
+    from jax_mapping.ops.frontier_incremental import (
+        IncrementalFrontierPipeline,
+    )
+
+    cfg = SlamConfig()
+    g = cfg.grid
+    fcfg = cfg.frontier
+    tile = cfg.serving.tile_cells
+    dev = jax.devices()[0]
+    result["devices"] = f"{len(jax.devices())}x {dev.platform}"
+    try:
+        load1 = round(os.getloadavg()[0], 1)
+    except OSError:
+        load1 = None
+    result["provenance"] = {
+        "cpu_count": os.cpu_count(), "loadavg_1m": load1,
+        "jax": jax.__version__,
+        "python": ".".join(map(str, sys.version_info[:3])),
+        "grid": g.size_cells, "tile_cells": tile, "n_robots": 64}
+
+    # Mid-mission world: a ~20 m observed disk (free space with wall
+    # arcs) in the 205 m production grid — the regime the active-region
+    # crop exists for — with 64 robots spread through the free interior.
+    n = g.size_cells
+    res = g.resolution_m
+    rng = np.random.default_rng(0)
+    lo = np.zeros((n, n), np.float32)
+    cy, cx = n // 2, n // 2
+    rad = int(20.0 / res)                                  # 400 cells
+    yy, xx = np.ogrid[-rad:rad, -rad:rad]
+    disk = (yy ** 2 + xx ** 2) < rad ** 2
+    lo[cy - rad:cy + rad, cx - rad:cx + rad][disk] = -2.0
+    for _ in range(24):                                    # wall segments
+        r0 = rng.integers(cy - rad + 40, cy + rad - 80)
+        c0 = rng.integers(cx - rad + 40, cx + rad - 80)
+        if rng.random() < 0.5:
+            lo[r0:r0 + 2, c0:c0 + int(rng.integers(40, 160))] = 2.0
+        else:
+            lo[r0:r0 + int(rng.integers(40, 160)), c0:c0 + 2] = 2.0
+    ox, oy = g.origin_m
+    ang = rng.uniform(0, 2 * np.pi, 64)
+    rr = rng.uniform(1.0, 16.0, 64)
+    poses = np.stack([ox + (cx + rr * np.cos(ang) / res) * res,
+                      oy + (cy + rr * np.sin(ang) / res) * res,
+                      rng.uniform(-3, 3, 64)], axis=1).astype(np.float32)
+    nt = n // tile
+    tile_rev = np.zeros((nt, nt), np.int64)
+    rev = [0]
+
+    def dirty_tiles(k: int) -> None:
+        rev[0] += 1
+        # Steady state: a couple of fusion patches near robots — mark
+        # the 2x2 tile block around a random robot, like
+        # _mark_dirty_patch's conservative extent.
+        for _ in range(k):
+            p = poses[rng.integers(64)]
+            tr = int((p[1] - oy) / res) // tile
+            tc = int((p[0] - ox) / res) // tile
+            tile_rev[max(0, tr - 1):tr + 1, max(0, tc - 1):tc + 1] = rev[0]
+
+    def jiggle() -> None:
+        poses[:, :2] += rng.normal(0, 0.02, (64, 2)).astype(np.float32)
+
+    lo_dev = jnp.asarray(lo)
+    jax.block_until_ready(lo_dev)
+
+    def timed(fn, reps, warmup=1):
+        for _ in range(warmup):
+            fn()
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) * 1e3
+
+    # ---- incremental steady-state chain (priority 1) --------------------
+    pipe = IncrementalFrontierPipeline(fcfg, g, tile)
+    pipe.compute(lo_dev, poses, tile_rev, rev[0])          # cold build
+
+    def steady_publish():
+        dirty_tiles(2)
+        jiggle()
+        out = pipe.compute(lo_dev, poses, tile_rev, rev[0])
+        assert out.recomputed
+
+    if _remaining() > 60.0:
+        p50 = timed(steady_publish, reps=15, warmup=3)
+        result["incremental_steady_p50_ms"] = round(p50, 2)
+        result["sections_completed"].append("incremental_steady")
+        result["crop"] = list(pipe.last_crop)
+        result["n_warm_starts"] = pipe.n_warm_starts
+        print(f"bench[frontier]: steady = {result['incremental_steady_p50_ms']} ms",
+              file=sys.stderr, flush=True)
+    else:
+        result["sections_skipped"]["incremental_steady"] = "deadline"
+
+    # ---- full recompute (priority 2: the speedup denominator) -----------
+    poses_dev = jnp.asarray(poses)
+
+    def full_publish():
+        fr = F.compute_frontiers(fcfg, g, lo_dev, poses_dev)
+        jax.block_until_ready(fr.assignment)
+
+    if _remaining() > 120.0:
+        p50 = timed(full_publish, reps=5, warmup=1)
+        result["full_p50_ms"] = round(p50, 2)
+        result["sections_completed"].append("full")
+        print(f"bench[frontier]: full = {result['full_p50_ms']} ms",
+              file=sys.stderr, flush=True)
+    else:
+        result["sections_skipped"]["full"] = "deadline"
+    if result["full_p50_ms"] and result["incremental_steady_p50_ms"]:
+        result["speedup_steady"] = round(
+            result["full_p50_ms"] / result["incremental_steady_p50_ms"], 2)
+
+    # ---- publish skip (priority 3) --------------------------------------
+    def skip_publish():
+        out = pipe.compute(lo_dev, poses, tile_rev, rev[0])
+        assert not out.recomputed
+
+    if _remaining() > 30.0:
+        pipe.compute(lo_dev, poses, tile_rev, rev[0])      # settle
+        p50 = timed(skip_publish, reps=10, warmup=1)
+        result["incremental_skip_p50_ms"] = round(p50, 3)
+        result["sections_completed"].append("incremental_skip")
+    else:
+        result["sections_skipped"]["incremental_skip"] = "deadline"
+
+    # ---- closure storm (priority 4: the adversarial pattern) ------------
+    # A real closure re-fuse CHANGES content: alternate between two
+    # device-resident grids differing by a wall, so every storm publish
+    # re-coarsens everything AND the blocked-mask change forces a cold
+    # field solve (revision bumps with identical content would be —
+    # correctly — detected as no-ops and reuse the carried fields).
+    lo2 = lo.copy()
+    lo2[cy + 30:cy + 32, cx - 150:cx + 150] = 2.0
+    lo2_dev = jnp.asarray(lo2)
+    jax.block_until_ready(lo2_dev)
+
+    def storm_publish():
+        rev[0] += 1
+        tile_rev[:] = rev[0]                               # all dirty
+        jiggle()
+        pipe.compute(lo2_dev if rev[0] % 2 else lo_dev, poses, tile_rev,
+                     rev[0])
+
+    if _remaining() > 90.0:
+        p50 = timed(storm_publish, reps=4, warmup=1)
+        result["closure_storm_p50_ms"] = round(p50, 2)
+        result["sections_completed"].append("closure_storm")
+        if result["full_p50_ms"]:
+            result["speedup_storm"] = round(
+                result["full_p50_ms"] / result["closure_storm_p50_ms"], 2)
+    else:
+        result["sections_skipped"]["closure_storm"] = "deadline"
+
+    snap = pipe.status()
+    result["tile_cache"] = {k: snap[k] for k in
+                            ("cache_hits", "cache_misses",
+                             "cache_hit_rate", "n_full_refreshes")}
+    result["n_warm_starts"] = pipe.n_warm_starts
+    result["n_field_reuses"] = pipe.n_field_reuses
+    result["crop"] = list(pipe.last_crop) if pipe.last_crop else None
 
 
 def _costfield_xla_fallback() -> None:

@@ -354,6 +354,13 @@ class MapApiServer:
                     # Branch-and-bound matcher work accounting (last
                     # key match's candidate count + prune ratio).
                     body["match"] = self.mapper.match_stats()
+                if hasattr(self.mapper, "frontier_stats"):
+                    fs = self.mapper.frontier_stats()
+                    if fs is not None:
+                        # Incremental frontier pipeline: crop bbox,
+                        # last recompute latency, tile-cache hit rate
+                        # (ops/frontier_incremental.py).
+                        body["frontier"] = fs
                 calib = self.mapper.calibration()
                 if calib is not None:
                     # Live odometry-scale re-measurement of the
@@ -919,6 +926,45 @@ class MapApiServer:
             lines += [
                 f'jax_mapping_match_prune_ratio{{robot="{i}"}} {r}'
                 for i, r in enumerate(ms["prune_ratio"])]
+        fs = (self.mapper.frontier_stats()
+              if self.mapper is not None
+              and hasattr(self.mapper, "frontier_stats") else None)
+        if fs is not None:
+            # Incremental frontier publish pipeline
+            # (ops/frontier_incremental.py): recompute-vs-skip split,
+            # tile coarse-mask cache traffic, live crop size.
+            lines += [
+                "# TYPE jax_mapping_frontier_recompute_total counter",
+                f"jax_mapping_frontier_recompute_total "
+                f"{fs['n_recomputes']}",
+                "# TYPE jax_mapping_frontier_skip_total counter",
+                f"jax_mapping_frontier_skip_total {fs['n_skips']}",
+                "# TYPE jax_mapping_frontier_cache_hits_total counter",
+                f"jax_mapping_frontier_cache_hits_total "
+                f"{fs['cache_hits']}",
+                "# TYPE jax_mapping_frontier_cache_misses_total counter",
+                f"jax_mapping_frontier_cache_misses_total "
+                f"{fs['cache_misses']}",
+                "# TYPE jax_mapping_frontier_crop_cells gauge",
+                f"jax_mapping_frontier_crop_cells {fs['crop_cells']}",
+            ]
+            if fs["last_recompute_ms"] is not None:
+                lines += [
+                    "# TYPE jax_mapping_frontier_recompute_ms gauge",
+                    f"jax_mapping_frontier_recompute_ms "
+                    f"{fs['last_recompute_ms']}",
+                ]
+        if self.planner is not None \
+                and hasattr(self.planner, "n_overlay_rebuilds"):
+            lines += [
+                "# TYPE jax_mapping_planner_overlay_rebuilds_total"
+                " counter",
+                f"jax_mapping_planner_overlay_rebuilds_total "
+                f"{self.planner.n_overlay_rebuilds}",
+                "# TYPE jax_mapping_planner_overlay_reuses_total counter",
+                f"jax_mapping_planner_overlay_reuses_total "
+                f"{self.planner.n_overlay_reuses}",
+            ]
         if self.recovery is not None:
             rec = self.recovery.snapshot()
             wd = rec["watchdog"]

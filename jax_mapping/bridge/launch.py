@@ -164,6 +164,7 @@ class Stack:
             self.planner.mapper = new
             if getattr(self.planner, "voxel_mapper", None) is not None:
                 new.frontier_grid_provider = self.planner._planning_grid
+                new.frontier_grid_key_provider = self.planner.overlay_key
         if self.voxel_mapper is not None:
             self.voxel_mapper.mapper = new
         if self.api is not None:
@@ -248,6 +249,10 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
             # assign frontiers whose corridors only the 3D overlay knows
             # are blocked (see mapper.publish_frontiers).
             mapper.frontier_grid_provider = planner._planning_grid
+            # The overlay's content key: lets the incremental frontier
+            # pipeline keep its tile cache across publishes where only
+            # the 2D map moved (mapper._frontier_basis).
+            mapper.frontier_grid_key_provider = planner.overlay_key
 
     supervisor = None
     if cfg.resilience.enabled:

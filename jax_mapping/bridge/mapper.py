@@ -90,7 +90,25 @@ class MapperNode(Node):
         #: Optional callable returning the log-odds grid FRONTIER
         #: ASSIGNMENT should run on (launch wires the planner's
         #: voxel-overlaid planning basis); None = the shared 2D map.
+        #: Preferred signature: provider(lo, revision) — the planner
+        #: overlays THIS node's consistent snapshot instead of taking
+        #: its own (the pose/grid pairing stays tear-free); legacy
+        #: no-arg providers still work.
         self.frontier_grid_provider = None
+        #: Companion key callable: the provider output's NON-tile-
+        #: tracked ingredient (the voxel overlay's fusion key). The
+        #: incremental frontier pipeline invalidates every cached tile
+        #: when it changes; a wired provider WITHOUT a key provider
+        #: forces a full recompute per publish (no way to know the
+        #: overlay held still).
+        self.frontier_grid_key_provider = None
+        #: Incremental publish pipeline (ops/frontier_incremental.py):
+        #: built lazily on the first publish with revision tracking
+        #: available; a geometry rejection (ValueError) latches the
+        #: full-recompute fallback so the publish path never retries a
+        #: known-bad construction.
+        self._frontier_pipeline = None
+        self._frontier_pipeline_failed = False
         self._pairer = OdomPairer(n_robots)
         #: Per-robot covariance diag of the last ACCEPTED match
         #: (models.slam SlamDiag.cov) — published with /pose, the
@@ -1188,35 +1206,124 @@ class MapperNode(Node):
             M.counters.inc("mapper.frontiers_blacklist_redirects")
         return assignment
 
+    def _frontier_basis(self, lo, rev: int):
+        """The grid frontier assignment runs on + its non-tile-tracked
+        cache key. The PLANNING grid when a provider is wired (launch:
+        the planner's voxel-overlaid basis) — the auction and the
+        waypoint descent must see the same map, or a frontier whose only
+        corridor is blocked by depth-only obstacles gets assigned
+        forever while every plan to it fails."""
+        if self.frontier_grid_provider is None:
+            return lo, None
+        try:
+            # Key BEFORE basis (the serving-snapshot ordering): an
+            # overlay advancing in between leaves new content under an
+            # older key — the next key read invalidates and heals — while
+            # the reverse order could stamp old content current forever.
+            key = None
+            if self.frontier_grid_key_provider is not None:
+                key = ("overlay", self.frontier_grid_key_provider())
+            try:
+                # rev is only a valid content key while revision
+                # tracking is live: with serving disabled map_revision
+                # is frozen at 0, and keying the planner's overlay
+                # cache on a constant would serve the FIRST publish's
+                # basis forever. None = identity-keyed fallback.
+                lo_rev = rev if self._serving_enabled else None
+                basis = self.frontier_grid_provider(lo, lo_rev)
+            except TypeError:
+                # Legacy no-arg provider (pre-snapshot contract): it
+                # reads its own basis.
+                basis = self.frontier_grid_provider()
+            if key is not None:
+                return basis, key
+            # Unkeyed provider output: a fresh sentinel per publish makes
+            # the incremental pipeline treat every tile as dirty — a full
+            # recompute, never a stale overlay served as current.
+            return basis, ("unkeyed", object())
+        except Exception:                # noqa: BLE001
+            # Provider trouble must not take down frontier publishing;
+            # the bare 2D map is the round-4 behavior.
+            import traceback
+            traceback.print_exc()
+            return lo, None
+
+    def _frontier_incremental(self):
+        """The incremental pipeline, or None (disabled config, no
+        revision tracking, or a latched geometry rejection)."""
+        if not self.cfg.frontier.incremental or self._tile_rev is None \
+                or self._frontier_pipeline_failed:
+            return None
+        if self._frontier_pipeline is None:
+            from jax_mapping.ops.frontier_incremental import \
+                IncrementalFrontierPipeline
+            try:
+                self._frontier_pipeline = IncrementalFrontierPipeline(
+                    self.cfg.frontier, self.cfg.grid,
+                    self.cfg.serving.tile_cells)
+            except ValueError as e:
+                print(f"[mapper] incremental frontier pipeline disabled "
+                      f"({e}); publishing via full recompute", flush=True)
+                self._frontier_pipeline_failed = True
+                return None
+        return self._frontier_pipeline
+
+    def frontier_stats(self) -> Optional[dict]:
+        """Incremental-pipeline observability for /status + /metrics
+        (lock-free reads, the /status counter convention); None until
+        the pipeline exists."""
+        p = self._frontier_pipeline
+        return None if p is None else p.status()
+
     def publish_frontiers(self) -> None:
         with self._state_lock:
+            # ONE consistent section for everything this publish uses:
+            # poses, grid, revision and the dirty-tile snapshot. (The
+            # historical code read poses under the lock but snapshotted
+            # merged_grid() after releasing it, so a concurrent install
+            # — restore, prior seed, another robot's step — could pair a
+            # new map with old poses.) The reassign/blacklist post-
+            # passes below reuse this same snapshot.
             poses = np.stack([np.asarray(st.pose) for st in self.states])
-        # Frontier assignment runs on the PLANNING grid when a provider
-        # is wired (launch: the planner's voxel-overlaid basis) — the
-        # auction and the waypoint descent must see the same map, or a
-        # frontier whose only corridor is blocked by depth-only
-        # obstacles gets assigned forever while every plan to it fails.
-        lo = self.merged_grid()
-        if self.frontier_grid_provider is not None:
-            try:
-                lo = self.frontier_grid_provider()
-            except Exception:                # noqa: BLE001
-                # Provider trouble must not take down frontier publishing;
-                # the bare 2D map is the round-4 behavior.
-                import traceback
-                traceback.print_exc()
-        fr = self._F.compute_frontiers(self.cfg.frontier, self.cfg.grid,
-                                       lo, self._jnp.asarray(poses))
-        targets = np.asarray(fr.targets)
-        assignment = self._reassign_dead(np.asarray(fr.assignment),
-                                         targets, poses)
+            lo = self.shared_grid
+            rev = self.map_revision
+            tile_rev = None
+            if self._tile_rev is not None:
+                with self._dirty_lock:
+                    tile_rev = self._tile_rev.copy()
+        lo, extra_key = self._frontier_basis(lo, rev)
+        pipeline = self._frontier_incremental()
+        if pipeline is not None:
+            with M.stages.stage("mapper.frontier_publish"):
+                pub = pipeline.compute(lo, poses, tile_rev, rev,
+                                       extra_key=extra_key)
+            targets = pub.targets
+            sizes = pub.sizes
+            assignment = pub.assignment
+            stamp_rev = pub.revision
+            M.counters.inc("mapper.frontier_recomputes"
+                           if pub.recomputed else "mapper.frontier_skips")
+        else:
+            fr = self._F.compute_frontiers(self.cfg.frontier,
+                                           self.cfg.grid, lo,
+                                           self._jnp.asarray(poses))
+            targets = np.asarray(fr.targets)
+            sizes = np.asarray(fr.sizes)
+            assignment = np.asarray(fr.assignment)
+            stamp_rev = rev if self._serving_enabled else -1
+            M.counters.inc("mapper.frontier_recomputes")
+        # Post-passes run FRESH even on a skipped recompute (health and
+        # blacklists move on their own clocks); they copy-on-write, so
+        # the pipeline's cached assignment is never mutated.
+        assignment = self._reassign_dead(assignment, targets, poses)
         assignment = self._apply_blacklist(assignment, targets, poses)
         hdr = Header.now("map")    # one stamp for the whole publish cycle
         self.frontiers_pub.publish(FrontierArray(
             header=hdr,
             targets_xy=targets,
-            sizes=np.asarray(fr.sizes),
-            assignment=assignment))
+            sizes=sizes,
+            assignment=assignment,
+            map_revision=int(stamp_rev)))
         self.pose_pub.publish([
             {"x": float(p[0]), "y": float(p[1]), "theta": float(p[2]),
              "stamp": hdr.stamp,

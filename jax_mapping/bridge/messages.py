@@ -249,6 +249,11 @@ class FrontierArray:
         default_factory=lambda: np.zeros(0, np.int32))
     assignment: np.ndarray = dataclasses.field(          # (R,) index into K or -1
         default_factory=lambda: np.zeros(0, np.int32))
+    #: `map_revision` the frontier set was COMPUTED at (-1 = revision
+    #: tracking off): lets consumers correlate an assignment with the
+    #: exact map content that produced it — a skipped publish re-ships
+    #: the original compute's revision, not the current one.
+    map_revision: int = -1
 
 
 @dataclasses.dataclass

@@ -89,6 +89,11 @@ class PlannerNode(Node):
         # robot's CURRENT assignment via the goal echo.
         self._frontiers = None
         self._lo_cache = None
+        #: Overlay work accounting (satellite of the incremental
+        #: frontier pipeline): reuses = keyed or identity cache hits,
+        #: rebuilds = full obstacle_slice reductions actually paid.
+        self.n_overlay_rebuilds = 0
+        self.n_overlay_reuses = 0
         self.create_subscription("/frontiers", self._frontiers_cb)
         self.fwp_pub = self.create_publisher("/frontier_waypoints")
         self.n_plans = 0
@@ -156,35 +161,70 @@ class PlannerNode(Node):
             return self.brain.robot_pose(i)[:2]
         return None
 
-    def _planning_grid(self):
+    def overlay_key(self):
+        """The voxel overlay's content key (its serving revision), or
+        None when no overlay applies — the NON-tile-tracked half of the
+        planning basis. The mapper's incremental frontier pipeline
+        invalidates its coarse-mask cache when this moves (2D-map
+        changes it can see per tile; overlay changes only through
+        this)."""
+        if self.voxel_mapper is None \
+                or not self.cfg.planner.use_voxel_obstacles:
+            return None
+        return self.voxel_mapper.serving_revision()
+
+    def _planning_grid(self, lo=None, lo_rev=None):
         """The log-odds grid plans search: the shared 2D map, overlaid
         with the 3D obstacle slice when a voxel mapper is attached.
-        Memoized on the INPUT ARRAY IDENTITIES (immutable device
-        arrays): the manual-goal plan and every frontier field in a tick
-        share one basis, the overlay (a full obstacle_slice reduction
-        over the voxel grid) reruns only when either map actually
-        changed, and a mid-tick restore invalidates naturally."""
-        lo = self.mapper.merged_grid()
+
+        Keyed on (map_revision, voxel fusion key) when revision tracking
+        is live — the manual-goal plan, every frontier field in a tick,
+        AND the mapper's frontier publish (frontier_grid_provider) share
+        one cached overlay per revision pair instead of each paying the
+        full obstacle_slice reduction; array identity remains the
+        fallback key when the mapper doesn't track revisions (serving
+        disabled). `lo`/`lo_rev` let the mapper pass its own consistent
+        snapshot so the publish's pose/grid pairing stays tear-free.
+
+        Thread-safety: runs from two executor threads (the planner's
+        tick AND the mapper's publish via frontier_grid_provider — node
+        callbacks serialize per NODE), so the cache tuple is SNAPSHOTTED
+        once; tuple assignment is atomic, and the worst interleaving is
+        one redundant overlay computation. The cache HOLDS the keyed
+        arrays (not bare id()s, whose values can be reused after
+        garbage collection), so `is` is sound."""
+        if lo is None:
+            # Revision BEFORE the grid here too (same hazard as v_rev
+            # below): an install landing between the reads must leave
+            # new content under an old key (healed by the next miss),
+            # never old content under the new key (served forever).
+            lo_rev = (self.mapper.serving_revision()
+                      if getattr(self.mapper, "_serving_enabled", False)
+                      else None)
+            lo = self.mapper.merged_grid()
         overlay = (self.voxel_mapper is not None
                    and self.cfg.planner.use_voxel_obstacles)
+        # Revision BEFORE the grid snapshot (the PR 4 voxel-snapshot
+        # ordering): a fusion landing between the two leaves newer
+        # content under an older key — healed by the next call's miss —
+        # while the reverse order would stamp OLD content with the new
+        # key and serve it as current forever.
+        v_rev = self.voxel_mapper.serving_revision() if overlay else None
         vg = self.voxel_mapper.voxel_grid() if overlay else None
-        # The cache HOLDS the keyed arrays (not bare id()s, whose values
-        # can be reused after garbage collection), so `is` is sound.
-        # SNAPSHOT the tuple once: this runs from two executor threads
-        # (the planner's own tick AND the mapper's publish_frontiers via
-        # frontier_grid_provider — node callbacks serialize per NODE),
-        # so re-reading self._lo_cache between check and return could
-        # mix two generations. Tuple assignment is atomic; the worst
-        # interleaving now is one redundant overlay computation.
+        key = (lo_rev, v_rev) if lo_rev is not None else None
         cache = self._lo_cache
-        if cache is not None and cache[0] is lo and cache[1] is vg:
-            return cache[2]
+        if cache is not None and \
+                ((key is not None and cache[0] == key)
+                 or (cache[1] is lo and cache[2] is vg)):
+            self.n_overlay_reuses += 1
+            return cache[3]
         out = lo
         if overlay:
             from jax_mapping.ops import planner as P
             out = P.overlay_voxel_obstacles(
                 self.cfg.planner, self.cfg.grid, self.cfg.voxel, lo, vg)
-        self._lo_cache = (lo, vg, out)
+            self.n_overlay_rebuilds += 1
+        self._lo_cache = (key, lo, vg, out)
         return out
 
     def _plan(self, goal, pose_xy):

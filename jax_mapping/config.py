@@ -272,6 +272,47 @@ class FrontierConfig:
     # Assignments older than this (in control-loop time) are ignored —
     # a dead mapper must not leave robots chasing stale frontiers.
     seek_ttl_s: float = 5.0
+    # ---- incremental publish pipeline (ops/frontier_incremental.py) ----
+    # Revision-keyed incremental recompute for the BRIDGE publish path
+    # (mapper.publish_frontiers): re-coarsen only serving tiles whose
+    # `_tile_rev` advanced, run label propagation / summarisation /
+    # cost-to-go on the active-region crop, warm-start cost fields from
+    # the previous publish, and skip the whole recompute when nothing
+    # changed. False = the pre-incremental publish pipeline bit-exactly
+    # (one full-grid compute_frontiers per publish). The jitted
+    # compute_frontiers / fleet-model paths are unaffected either way.
+    incremental: bool = True
+    # Safety margin around the observed-region crop, in first-level
+    # coarse cells. Parity margin: an optimal detour around observed
+    # obstacles leaves the OBSERVED bbox by at most one cell (obstacles
+    # live only in observed space), so any pad >= 2 BFS-resolution cells
+    # keeps converged cost fields identical to the full-grid solve; the
+    # extra margin keeps finite-iteration multigrid boundary effects
+    # away from targets and robots.
+    crop_pad: int = 32
+    # Publish skip: when no tile revision advanced and no robot moved
+    # more than this (metres) — nor changed BFS cell — the cached result
+    # is republished through fresh reassign/blacklist post-passes. With
+    # obstacle-aware costs the cell condition makes the skip
+    # output-exact; in Euclidean mode this bounds the assignment drift a
+    # skipped sub-threshold move could cause.
+    pose_skip_m: float = 0.05
+    # Warm-start: carry the previous publish's cost fields (offset by
+    # each robot's own previous-field value at its new cell — a valid
+    # upper bound by the triangle inequality) as the relaxation init.
+    # Only sound while no blocked cell APPEARED in the crop: min-plus
+    # relaxation never raises a value, so a stale underestimate through
+    # a newly-discovered wall could never heal — new occupancy forces a
+    # cold multigrid solve instead.
+    warm_start: bool = True
+    # Doubled-sweep budget for the warm-started relaxation: the
+    # tightening wavefront (2 cells/sweep) must cover the robots'
+    # movement since the previous solve, so moves beyond
+    # 2*warm_extra_iters - 2 BFS cells force a cold multigrid solve
+    # instead. When nothing changed at all (no occupancy flip in the
+    # crop, no robot changed cell) the pipeline reuses the carried
+    # fields EXACTLY (a 0-sweep re-mask) — the steady-state fast path.
+    warm_extra_iters: int = 4
 
 
 @_frozen

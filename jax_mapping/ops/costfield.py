@@ -261,3 +261,38 @@ def cost_fields(blocked: Array, robot_rc: Array, levels: int = 3,
     rr = jnp.clip(robot_rc[:, 0], 0, n - 1)
     cc = jnp.clip(robot_rc[:, 1], 0, n - 1)
     return d.at[jnp.arange(R), rr, cc].set(0.0)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def warm_cost_fields(blocked: Array, robot_rc: Array, prev_fields: Array,
+                     iters: int) -> Array:
+    """`cost_fields` warm-started from a previous solve's fields.
+
+    Init: each robot's previous field plus its own previous-field value
+    at the robot's NEW cell — an upper bound by the triangle inequality
+    (d_new(x) <= d(new, old) + d_old(x), and prev[new_cell] upper-bounds
+    d(new, old)), so the monotone min-plus relaxation only tightens.
+    VALIDITY IS THE CALLER'S CONTRACT: prev_fields must have been
+    computed on a blocked mask that is a SUPERSET of `blocked` (cells
+    may open, never close) — relaxation never raises a value, so an
+    underestimate through a newly-blocked cell could never heal. A
+    robot whose new cell the previous field called unreachable (_BIG
+    offset) degenerates to a fresh seed-only field: still an upper
+    bound, covering 2*iters cells around the robot.
+
+    `iters` doubled sweeps tighten a 2*iters-cell wavefront around each
+    seed; far cells keep the per-robot offset (~the robot's travel since
+    the previous solve) — a near-uniform per-robot surcharge, which the
+    greedy auction's per-robot argmin is insensitive to.
+    """
+    R = prev_fields.shape[0]
+    n = blocked.shape[0]
+    rr = jnp.clip(robot_rc[:, 0], 0, n - 1)
+    cc = jnp.clip(robot_rc[:, 1], 0, n - 1)
+    ar = jnp.arange(R)
+    off = prev_fields[ar, rr, cc]                     # (R,)
+    init = jnp.minimum(prev_fields + off[:, None, None], _BIG)
+    init = jnp.where(blocked[None], _BIG, init)
+    init = _seed(init, robot_rc, blocked, neighbours=True)
+    d = _relax_level(blocked, init, iters)
+    return d.at[ar, rr, cc].set(0.0)

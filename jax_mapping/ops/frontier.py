@@ -199,7 +199,8 @@ def label_components(cfg: FrontierConfig, mask: Array) -> Array:
 
 
 def summarize_clusters(cfg: FrontierConfig, grid_cfg: GridConfig,
-                       labels: Array) -> tuple[Array, Array, Array, Array]:
+                       labels: Array, origin_rc: Array | None = None
+                       ) -> tuple[Array, Array, Array, Array]:
     """Compress arbitrary labels into K static slots (top-K by size).
 
     Returns (centroids_world (K,2), targets_world (K,2), sizes (K,),
@@ -209,17 +210,27 @@ def summarize_clusters(cfg: FrontierConfig, grid_cfg: GridConfig,
     Segment reductions keep this dense; slots beyond the true cluster count
     have size 0 and centroid/target at _BIG.
     """
-    out = _summarize(cfg, grid_cfg, labels, weights=None, scale=1)
+    out = _summarize(cfg, grid_cfg, labels, weights=None, scale=1,
+                     origin_rc=origin_rc)
     return out[:4]
 
 
 def _summarize(cfg: FrontierConfig, grid_cfg: GridConfig, labels: Array,
-               weights, scale: int):
+               weights, scale: int, origin_rc=None):
     """Slot summarisation at an arbitrary clustering resolution.
 
     weights: optional (n, n) per-cell fine-frontier-cell counts (hierarchical
     path) — sizes and centroids weight by it so they stay in fine-cell units.
     scale: clustering cells per first-level coarse cell (cluster_downsample).
+    origin_rc: optional traced (2,) int32 offset of this labels grid's
+    [0, 0] within the full coarse grid, in FIRST-LEVEL coarse cells (the
+    active-region crop, ops/frontier_incremental.py); must be a multiple
+    of `scale`. Cell coordinates become GLOBAL before any world-metre
+    conversion so cropped targets/centroids land exactly where the
+    full-grid formula puts them; slot selection, tie-breaks and the
+    returned rep_rc stay in LOCAL cells (row-major order is preserved
+    under cropping, so every index tie-break picks the same cell).
+    None compiles the identical pre-crop graph.
     Returns (centroids, targets, sizes, slot_of_cell, rep_rc).
     """
     n = labels.shape[0]
@@ -248,6 +259,12 @@ def _summarize(cfg: FrontierConfig, grid_cfg: GridConfig, labels: Array,
 
     rows = (lin // n).astype(jnp.float32)
     cols = (lin % n).astype(jnp.float32)
+    if origin_rc is not None:
+        # Global clustering-cell coordinates: integer offsets are exact
+        # in f32 below 2^24 cells, so the summed terms match the
+        # full-grid path's values (only the reduction order differs).
+        rows = rows + (origin_rc[0] // scale).astype(jnp.float32)
+        cols = cols + (origin_rc[1] // scale).astype(jnp.float32)
     # Dense-vs-segment engine choice: the (n*n, K) one-hot membership
     # matrices are ~16 MB at the 256^2 production clustering shape but
     # 268 MB at n=1024 (the cluster_downsample=1 exact path) — gate on
@@ -325,8 +342,12 @@ def _summarize(cfg: FrontierConfig, grid_cfg: GridConfig, labels: Array,
     rep_lin = jnp.clip(rep_lin, 0, n * n - 1)
     rep_row = (rep_lin // n).astype(jnp.int32)
     rep_col = (rep_lin % n).astype(jnp.int32)
-    tx = (rep_col.astype(jnp.float32) + 0.5) * res + ox
-    ty = (rep_row.astype(jnp.float32) + 0.5) * res + oy
+    rep_row_g, rep_col_g = rep_row, rep_col
+    if origin_rc is not None:
+        rep_row_g = rep_row + origin_rc[0] // scale
+        rep_col_g = rep_col + origin_rc[1] // scale
+    tx = (rep_col_g.astype(jnp.float32) + 0.5) * res + ox
+    ty = (rep_row_g.astype(jnp.float32) + 0.5) * res + oy
     targets = jnp.where(slot_valid[:, None] & has_rep[:, None],
                         jnp.stack([tx, ty], -1), _BIG)
     rep_rc = jnp.stack([rep_row, rep_col], -1)
@@ -358,7 +379,7 @@ def _upsample(x: Array, c: int) -> Array:
 
 
 def _cluster_hierarchical(cfg: FrontierConfig, grid_cfg: GridConfig,
-                          mask: Array):
+                          mask: Array, origin_rc=None):
     """Latency-path clustering: connected components and slot summarisation
     at `cluster_downsample`x coarser resolution, sizes/centroids weighted by
     the fine frontier-cell counts, targets refined back to a real fine
@@ -376,7 +397,7 @@ def _cluster_hierarchical(cfg: FrontierConfig, grid_cfg: GridConfig,
         1, -(-cfg.label_prop_iters // c)))
     labels2 = label_components(cfg_c, mask2)
     centroids, targets2, sizes, slots2, rep_rc = _summarize(
-        cfg, grid_cfg, labels2, weights=w2, scale=c)
+        cfg, grid_cfg, labels2, weights=w2, scale=c, origin_rc=origin_rc)
 
     # Refine each slot's target from the rep coarse cell's centre to an
     # actual fine frontier cell inside it (a coarse cell centre can sit on
@@ -390,6 +411,11 @@ def _cluster_hierarchical(cfg: FrontierConfig, grid_cfg: GridConfig,
         any_fine = win.reshape(-1).any()
         fr = rc[0] * c + idx // c
         fc = rc[1] * c + idx % c
+        if origin_rc is not None:
+            # rc is crop-local (it slices the crop mask above); the
+            # world-metre conversion needs the global cell.
+            fr = fr + origin_rc[0]
+            fc = fc + origin_rc[1]
         fine = jnp.stack([(fc.astype(jnp.float32) + 0.5) * res1 + ox,
                           (fr.astype(jnp.float32) + 0.5) * res1 + oy])
         return jnp.where(any_fine, fine, fallback)
@@ -519,9 +545,33 @@ def bfs_passability(cfg: FrontierConfig, grid_cfg: GridConfig,
 
 def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
                                  free: Array, unknown: Array,
-                                 robot_poses: Array) -> FrontierResult:
+                                 robot_poses: Array, origin_rc=None,
+                                 warm_fields=None,
+                                 warm_iters: int | None = None,
+                                 return_fields: bool = False):
     """Mask-level entry point: lets a spatially-sharded caller coarsen its
-    own grid slab locally and all_gather only the coarse masks."""
+    own grid slab locally and all_gather only the coarse masks.
+
+    origin_rc: optional traced (2,) int32 — the masks are an
+    active-region CROP whose [0, 0] sits at this first-level-coarse-cell
+    offset of the full grid (ops/frontier_incremental.py). Must be a
+    multiple of cluster_downsample so the crop's pooling blocks align
+    with the full grid's. World-metre outputs (targets/centroids) come
+    out in global coordinates; mask/labels/slots stay crop-shaped.
+    warm_fields: optional (R, n_bfs, n_bfs) previous cost fields — the
+    multigrid solve is replaced by an offset warm-started relaxation
+    (costfield.warm_cost_fields; caller guarantees upper-bound validity:
+    no blocked cell appeared since the fields were computed).
+    warm_iters: static doubled-sweep budget for that relaxation (None =
+    cfg.warm_extra_iters); 0 is the EXACT-reuse fast path — valid when
+    the caller knows the blocked mask and every seed cell are unchanged
+    since the fields were solved, where the "relaxation" degenerates to
+    re-masking + re-seeding the carried fields.
+    return_fields: also return the (R, n_bfs, n_bfs) cost fields (None
+    in euclidean/exact modes) and the BFS blocked mask, for the next
+    publish's warm start and its validity check.
+    All three default to the historical single-result behavior with a
+    bit-identical trace."""
     mask = frontier_mask(free, unknown)
     c = cfg.cluster_downsample
     d = cfg.downsample
@@ -534,19 +584,31 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
                                             mask)
     if c == 1:
         labels = label_components(cfg, mask)
-        centroids, targets, sizes, slots = summarize_clusters(cfg, grid_cfg,
-                                                              labels)
-        tgt_r = jnp.clip(((targets[:, 1] - oy) / res).astype(jnp.int32),
-                         0, free.shape[0] - 1)
-        tgt_c = jnp.clip(((targets[:, 0] - ox) / res).astype(jnp.int32),
-                         0, free.shape[0] - 1)
+        centroids, targets, sizes, slots = summarize_clusters(
+            cfg, grid_cfg, labels, origin_rc=origin_rc)
+        tgt_r = ((targets[:, 1] - oy) / res).astype(jnp.int32)
+        tgt_c = ((targets[:, 0] - ox) / res).astype(jnp.int32)
+        if origin_rc is not None:
+            tgt_r = tgt_r - origin_rc[0]
+            tgt_c = tgt_c - origin_rc[1]
+        tgt_r = jnp.clip(tgt_r, 0, free.shape[0] - 1)
+        tgt_c = jnp.clip(tgt_c, 0, free.shape[0] - 1)
         bfs_scale = 1.0
     else:
         labels, slots, centroids, targets, sizes, rep_rc, _mask2 = \
-            _cluster_hierarchical(cfg, grid_cfg, mask)
+            _cluster_hierarchical(cfg, grid_cfg, mask, origin_rc=origin_rc)
         tgt_r, tgt_c = rep_rc[:, 0], rep_rc[:, 1]
         bfs_scale = float(c)
 
+    def to_bfs_rc(y, x):
+        rr = (y / bfs_res).astype(jnp.int32)
+        cc = (x / bfs_res).astype(jnp.int32)
+        if origin_rc is not None:
+            rr = rr - origin_rc[0] // c
+            cc = cc - origin_rc[1] // c
+        return rr, cc
+
+    fields = None
     if cfg.obstacle_aware:
         if cfg.exact_bfs:
             import dataclasses
@@ -554,9 +616,8 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
                 cfg, bfs_iters=max(1, -(-cfg.bfs_iters // c))))
 
             def robot_costs(pose):
-                rc = jnp.stack(
-                    [((pose[1] - oy) / bfs_res).astype(jnp.int32),
-                     ((pose[0] - ox) / bfs_res).astype(jnp.int32)])[None, :]
+                rr, cc = to_bfs_rc(pose[1] - oy, pose[0] - ox)
+                rc = jnp.stack([rr, cc])[None, :]
                 dist = cost_to_go(bfs_cfg, bfs_passable, rc,
                                   jnp.array([True]))
                 return dist[tgt_r, tgt_c] * bfs_scale
@@ -567,12 +628,17 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
             # relaxation per level with every robot's field resident in
             # VMEM — the <5 ms @ 64 robots path with obstacles kept.
             from jax_mapping.ops import costfield as CF
-            robot_rc = jnp.stack(
-                [((robot_poses[:, 1] - oy) / bfs_res).astype(jnp.int32),
-                 ((robot_poses[:, 0] - ox) / bfs_res).astype(jnp.int32)],
-                axis=1)
-            fields = CF.cost_fields(~bfs_passable, robot_rc,
-                                    cfg.mg_levels, cfg.mg_refine_iters)
+            rr, cc = to_bfs_rc(robot_poses[:, 1] - oy,
+                               robot_poses[:, 0] - ox)
+            robot_rc = jnp.stack([rr, cc], axis=1)
+            if warm_fields is not None:
+                fields = CF.warm_cost_fields(
+                    ~bfs_passable, robot_rc, warm_fields,
+                    cfg.warm_extra_iters if warm_iters is None
+                    else warm_iters)
+            else:
+                fields = CF.cost_fields(~bfs_passable, robot_rc,
+                                        cfg.mg_levels, cfg.mg_refine_iters)
             costs = fields[:, tgt_r, tgt_c] * bfs_scale   # (R, K)
         costs = jnp.minimum(costs, _BIG)
     else:
@@ -583,9 +649,12 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
         costs = jnp.minimum(costs, _BIG)
     costs = jnp.where((sizes > 0)[None, :], costs, _BIG)
     assignment = assign_frontiers(costs)
-    return FrontierResult(mask=mask, labels=labels, slots=slots,
-                          centroids=centroids, targets=targets, sizes=sizes,
-                          assignment=assignment, costs=costs)
+    result = FrontierResult(mask=mask, labels=labels, slots=slots,
+                            centroids=centroids, targets=targets,
+                            sizes=sizes, assignment=assignment, costs=costs)
+    if return_fields:
+        return result, fields, ~bfs_passable
+    return result
 
 
 # ---------------------------------------------------------------------------

@@ -281,3 +281,18 @@ def test_no_suppressions_in_recovery_or_matcher_modules():
     assert not banned, (
         "suppressions are not allowed in recovery/ or the matcher "
         f"modules: {banned}")
+
+
+def test_no_suppressions_in_exploration_modules():
+    """ISSUE 6 CI guard, extending the ISSUE 5 pattern: the incremental
+    exploration pipeline (`ops/frontier.py`, `ops/costfield.py`,
+    `ops/frontier_incremental.py`) carries ZERO baseline suppressions —
+    new hazards there must be fixed, not baselined."""
+    base = Baseline.load(default_baseline_path())
+    banned = [s for s in base.suppressions
+              if s["path"] in ("jax_mapping/ops/frontier.py",
+                               "jax_mapping/ops/costfield.py",
+                               "jax_mapping/ops/frontier_incremental.py")]
+    assert not banned, (
+        "suppressions are not allowed in the exploration-pipeline "
+        f"modules: {banned}")

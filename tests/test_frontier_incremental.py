@@ -1,0 +1,524 @@
+"""Incremental revision-keyed exploration pipeline (ISSUE 6).
+
+Parity methodology per PR 5: SEEDED randomized property tests —
+deterministic by construction — comparing the incremental pipeline's
+published triple (assignment, targets, sizes) against the full
+`compute_frontiers` recompute at every step of random dirty-tile
+sequences, pose walks and revision interleavings, in all three cost
+modes (multigrid with warm starts, exact BFS, euclidean). Plus: crop
+bucketing stays a bounded set of compiled shapes over a long mission,
+`FrontierConfig.incremental=False` is the bit-exact pre-PR publish, and
+the pose/grid snapshot tear in `publish_frontiers` stays fixed.
+"""
+
+import dataclasses
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from jax_mapping.config import FrontierConfig, GridConfig
+from jax_mapping.ops import frontier as F
+from jax_mapping.ops.frontier_incremental import IncrementalFrontierPipeline
+
+
+def _allowed_span(v):
+    """Crop spans are 2^k or 3*2^(k-1) (the 1.5x midpoint buckets)."""
+    if v & (v - 1) == 0:
+        return True
+    return v % 3 == 0 and (v // 3) & (v // 3 - 1) == 0
+
+
+def _gcfg(size=512):
+    return GridConfig(size_cells=size, patch_cells=64, max_range_m=2.0,
+                      align_rows=8, align_cols=8)
+
+
+def _fcfg(**kw):
+    base = dict(downsample=2, max_clusters=8, min_cluster_cells=2,
+                label_prop_iters=64, bfs_iters=256, crop_pad=8)
+    base.update(kw)
+    return FrontierConfig(**base)
+
+
+TILE = 64
+
+
+class WorldSim:
+    """Seeded random mission: free-space carves, occasional walls,
+    robot pose walks — every mutation marks its tiles' revisions the
+    way the mapper's `_mark_dirty_patch` does (conservatively)."""
+
+    def __init__(self, gcfg, seed, n_robots=3, walls=True):
+        self.g = gcfg
+        self.rng = np.random.default_rng(seed)
+        n = gcfg.size_cells
+        self.nt = n // TILE
+        self.lo = np.zeros((n, n), np.float32)
+        self.tile_rev = np.zeros((self.nt, self.nt), np.int64)
+        self.rev = 0
+        self.walls = walls
+        # Seed room + robots inside it.
+        self._carve(40, 40, 60, walls=False)
+        res = gcfg.resolution_m
+        ox, oy = gcfg.origin_m
+        self.poses = np.stack([
+            np.array([ox + self.rng.uniform(45, 95) * res,
+                      oy + self.rng.uniform(45, 95) * res,
+                      0.0], np.float32)
+            for _ in range(n_robots)])
+
+    def _mark(self, r, c, h, w):
+        self.rev += 1
+        t0r, t1r = r // TILE, min(self.nt - 1, (r + h) // TILE)
+        t0c, t1c = c // TILE, min(self.nt - 1, (c + w) // TILE)
+        self.tile_rev[t0r:t1r + 1, t0c:t1c + 1] = self.rev
+
+    def _carve(self, r, c, size, walls):
+        n = self.g.size_cells
+        r, c = min(r, n - size - 1), min(c, n - size - 1)
+        self.lo[r:r + size, c:c + size] = -2.0
+        if walls and self.rng.random() < 0.6:
+            wr = r + int(self.rng.integers(2, size - 4))
+            self.lo[wr:wr + 2, c:c + int(0.7 * size)] = 2.0
+        self._mark(r, c, size, size)
+
+    def step(self, grow=True):
+        """One mission step: maybe carve near the frontier, walk robots."""
+        if grow and self.rng.random() < 0.8:
+            free = np.argwhere(self.lo < 0)
+            base = free[self.rng.integers(len(free))]
+            jitter = self.rng.integers(-20, 30, 2)
+            r = int(np.clip(base[0] + jitter[0], 2,
+                            self.g.size_cells - 30))
+            c = int(np.clip(base[1] + jitter[1], 2,
+                            self.g.size_cells - 30))
+            self._carve(r, c, int(self.rng.integers(12, 26)),
+                        walls=self.walls)
+        self.poses[:, :2] += self.rng.normal(
+            0, 0.08, self.poses[:, :2].shape).astype(np.float32)
+
+
+def _assert_parity(pub, full, mode, step):
+    for name, a, b in (("sizes", pub.sizes, full.sizes),
+                       ("targets", pub.targets, full.targets),
+                       ("assignment", pub.assignment, full.assignment)):
+        np.testing.assert_array_equal(
+            a, np.asarray(b),
+            err_msg=f"{name} diverged from full recompute "
+                    f"(mode={mode}, step={step})")
+
+
+@pytest.mark.parametrize("mode,seed", [
+    # Two seeds on the product-default multigrid mode (where warm
+    # starts and field reuse live); one each on the provably-converging
+    # exact mode and the euclidean mode. The slow marker widens the
+    # matrix without charging tier-1's wall-clock budget.
+    ("mg", 0), ("mg", 1), ("exact", 0), ("euclid", 0),
+    pytest.param("exact", 1, marks=pytest.mark.slow),
+    pytest.param("euclid", 1, marks=pytest.mark.slow),
+])
+def test_incremental_matches_full_over_random_missions(mode, seed):
+    """The headline property: assignment/targets/sizes identical to the
+    full recompute at EVERY step of a random dirty-tile + pose-walk
+    mission, including warm-started and skipped steps."""
+    g = _gcfg(512)
+    fcfg = _fcfg(obstacle_aware=(mode != "euclid"),
+                 exact_bfs=(mode == "exact"))
+    sim = WorldSim(g, seed=seed, walls=(mode != "mg"))
+    pipe = IncrementalFrontierPipeline(fcfg, g, TILE)
+    for step in range(10):
+        if step:
+            # Every third step holds the world still (skip/pose-only
+            # interleavings); otherwise grow + walk.
+            sim.step(grow=(step % 3 != 0))
+        pub = pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+        full = F.compute_frontiers(fcfg, g, jnp.asarray(sim.lo),
+                                   jnp.asarray(sim.poses))
+        _assert_parity(pub, full, mode, step)
+    assert pipe.n_recomputes >= 1
+    # The mission must have exercised the tile cache (clean tiles kept).
+    assert pipe.n_tiles_clean > 0
+    if mode == "mg":
+        # walls=False keeps every refresh occupancy-growth-free, so the
+        # repeated-crop steps must ride the warm start.
+        assert pipe.n_warm_starts > 0
+
+
+def test_warm_start_invalidated_by_new_walls():
+    """A wall appearing inside the crop must force a COLD solve (the
+    upper-bound contract): min-plus relaxation never raises a value, so
+    a warm init through a newly-blocked cell could tunnel forever."""
+    g = _gcfg(512)
+    fcfg = _fcfg()
+    sim = WorldSim(g, seed=3, walls=False)
+    pipe = IncrementalFrontierPipeline(fcfg, g, TILE)
+    pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+    # Pose move with a stable crop: the carried fields ride (warm or
+    # exact reuse). A GROWING crop would invalidate the carry — only
+    # same-crop publishes may reuse fields.
+    sim.poses[0, 0] += 0.2
+    pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+    warm_before = pipe.n_warm_starts
+    assert warm_before >= 1
+    # Drop a wall across the middle of the seed room.
+    sim.lo[60:64, 45:90] = 2.0
+    sim._mark(60, 45, 4, 45)
+    pub = pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+    assert pipe.n_warm_starts == warm_before   # cold solve, not warm
+    full = F.compute_frontiers(fcfg, g, jnp.asarray(sim.lo),
+                               jnp.asarray(sim.poses))
+    _assert_parity(pub, full, "mg-wall", 2)
+
+
+def test_field_carry_invalidated_by_frontier_consumption():
+    """BFS passability keeps frontier-containing clustering blocks
+    traversable even when they also pool occupancy — so CONSUMING a
+    wall-adjacent frontier cell (unknown→free behind it, ZERO occupancy
+    change) grows the blocked mask. The field carry must go cold: a
+    reused/warm field would keep finite distances through the
+    now-blocked block, and the monotone relaxation could never raise
+    them."""
+    g = _gcfg(512)
+    fcfg = _fcfg(crop_pad=8)
+    n = g.size_cells
+    lo = np.zeros((n, n), np.float32)
+    lo[100:200, 100:200] = -2.0              # room
+    lo[100:200, 200:204] = 2.0               # east wall
+    lo[148:152, 200:204] = -2.0              # notch through the wall
+    lo[100:110, 230:240] = -2.0              # far-east patch: pins the
+    #                                          observed bbox so step 2
+    #                                          cannot change the crop
+    res = g.resolution_m
+    ox, oy = g.origin_m
+    poses = np.array([[ox + 150 * res, oy + 150 * res, 0.0],
+                      [ox + 120 * res, oy + 180 * res, 0.0]], np.float32)
+    nt = n // TILE
+    tile_rev = np.zeros((nt, nt), np.int64)
+    pipe = IncrementalFrontierPipeline(fcfg, g, TILE)
+    pipe.compute(lo, poses, tile_rev, 0)
+    # Establish a live carry: pose-only move, same crop.
+    poses[0, 0] += 0.2
+    pipe.compute(lo, poses, tile_rev, 1)
+    assert pipe.n_warm_starts == 1
+    crop_before = pipe.last_crop
+    # Consume the notch frontier: the unknown behind it becomes free.
+    # occupancy is untouched, but the notch's clustering block (which
+    # also pools wall cells) loses its frontier and flips to blocked.
+    lo[140:160, 204:230] = -2.0
+    tile_rev[140 // TILE:160 // TILE + 1,
+             204 // TILE:230 // TILE + 1] = 2
+    pub = pipe.compute(lo, poses, tile_rev, 2)
+    assert pipe.last_crop == crop_before      # crop stable: the cold
+    #                                           solve is forced by the
+    #                                           blocked growth, nothing
+    #                                           else
+    assert pipe.n_warm_starts == 1            # carry went COLD
+    full = F.compute_frontiers(fcfg, g, jnp.asarray(lo),
+                               jnp.asarray(poses))
+    _assert_parity(pub, full, "frontier-consumed", 2)
+    # Cold multigrid == the full recompute's costs exactly.
+    np.testing.assert_array_equal(pub.costs, np.asarray(full.costs))
+
+
+def test_publish_skip_and_pose_threshold():
+    """No revision advance + sub-threshold pose move = cached republish
+    (same stamped revision, recomputed=False); crossing pose_skip_m
+    recomputes."""
+    g = _gcfg(512)
+    fcfg = _fcfg(pose_skip_m=0.05)
+    sim = WorldSim(g, seed=4, walls=False)
+    pipe = IncrementalFrontierPipeline(fcfg, g, TILE)
+    # Park robots on coarse-cell CENTRES: the skip demands an unchanged
+    # BFS cell, so the sub-threshold jiggle must not straddle a border.
+    res_c = g.resolution_m * fcfg.downsample
+    ox, oy = g.origin_m
+    sim.poses[:, 0] = (np.floor((sim.poses[:, 0] - ox) / res_c) + 0.5) \
+        * res_c + ox
+    sim.poses[:, 1] = (np.floor((sim.poses[:, 1] - oy) / res_c) + 0.5) \
+        * res_c + oy
+    p1 = pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+    assert p1.recomputed
+    sim.poses[:, :2] += 0.01                  # sub-threshold, same cells
+    p2 = pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev + 7)
+    assert not p2.recomputed
+    assert p2.revision == p1.revision          # computed-at stamp
+    np.testing.assert_array_equal(p1.assignment, p2.assignment)
+    sim.poses[0, 0] += 0.5                     # past the threshold
+    p3 = pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+    assert p3.recomputed
+    assert pipe.n_skips == 1
+
+
+def test_extra_key_change_invalidates_all_tiles():
+    """A voxel-overlay key change means the basis changed in ways tile
+    revisions cannot see: every tile must re-coarsen."""
+    g = _gcfg(256)
+    fcfg = _fcfg()
+    sim = WorldSim(g, seed=5, walls=False)
+    pipe = IncrementalFrontierPipeline(fcfg, g, TILE)
+    pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev, extra_key="a")
+    misses = pipe.n_tiles_refreshed
+    pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev, extra_key="a")
+    assert pipe.n_tiles_refreshed == misses    # clean reuse (skip)
+    sim.poses[0, 0] += 1.0                     # defeat the publish skip
+    pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev, extra_key="b")
+    assert pipe.n_tiles_refreshed == misses + sim.nt ** 2
+
+
+def test_crop_bucketing_bounded_shapes_over_long_mission():
+    """Compiled-shape churn is BOUNDED: a long growing mission may only
+    ever compile power-of-two crop spans and power-of-two refresh
+    buckets — log-many shapes, not one per bbox."""
+    g = _gcfg(512)
+    fcfg = _fcfg(obstacle_aware=False)         # cheap: shape churn test
+    sim = WorldSim(g, seed=6, walls=False)
+    pipe = IncrementalFrontierPipeline(fcfg, g, TILE)
+    for step in range(30):
+        sim.step()
+        pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+    spans = {s[1] for s in pipe.compiled_shapes if s[0] == "crop"}
+    buckets = {s[1] for s in pipe.compiled_shapes
+               if s[0] == "refresh" and s[1] != "full"}
+    n_coarse = g.size_cells // fcfg.downsample
+    assert all(_allowed_span(v) for v in spans)
+    assert all(v & (v - 1) == 0 for v in buckets)
+    assert all(v <= n_coarse for v in spans)
+    # ~2*log2 spans (x cold/warm variants) + log2 refresh buckets + the
+    # full-refresh path: logarithmic, never one shape per bbox.
+    assert len(pipe.compiled_shapes) <= 24
+    # The mission actually grew: the crop moved off the minimum bucket.
+    assert max(spans) > min(spans) or len(spans) == 1
+
+
+def test_crop_origin_alignment_and_snapping():
+    """Crop origins snap to the clustering x multigrid pooling period so
+    cropped pooling blocks align with the full grid's (the parity
+    precondition), and spans divide evenly."""
+    g = _gcfg(512)
+    fcfg = _fcfg()
+    sim = WorldSim(g, seed=7, walls=False)
+    pipe = IncrementalFrontierPipeline(fcfg, g, TILE)
+    snap = fcfg.cluster_downsample * (1 << (fcfg.mg_levels - 1))
+    for step in range(6):
+        sim.step()
+        pub = pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+        r0, c0, span = pub.crop_rc
+        assert r0 % snap == 0 and c0 % snap == 0
+        assert span % snap == 0 and _allowed_span(span)
+
+
+def test_pipeline_rejects_bad_geometry():
+    g = _gcfg(512)
+    with pytest.raises(ValueError):
+        IncrementalFrontierPipeline(_fcfg(), g, 60)       # tile ∤ grid
+    with pytest.raises(ValueError):
+        IncrementalFrontierPipeline(_fcfg(cluster_downsample=3), g, TILE)
+
+
+def test_coarse_mask_cache_matches_full_coarsen():
+    """The persistent tile-cached masks equal a from-scratch coarsen of
+    the live grid after any dirty pattern — the stage-A exactness the
+    downstream parity rests on."""
+    g = _gcfg(256)
+    fcfg = _fcfg()
+    sim = WorldSim(g, seed=8)
+    pipe = IncrementalFrontierPipeline(fcfg, g, TILE)
+    for step in range(6):
+        sim.step()
+        sim.poses[0, 0] += 0.2                 # defeat skip
+        pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+        free, occ, unknown = map(np.asarray, pipe.coarse_masks())
+        f2, o2, u2 = map(np.asarray, F.coarsen(fcfg, g,
+                                               jnp.asarray(sim.lo)))
+        np.testing.assert_array_equal(free, f2)
+        np.testing.assert_array_equal(occ, o2)
+        np.testing.assert_array_equal(unknown, u2)
+
+
+# ---------------------------------------------------------------- bridge
+
+def _mk_mapper(tiny_cfg, incremental=True, n_robots=2):
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.mapper import MapperNode
+    cfg = dataclasses.replace(
+        tiny_cfg, frontier=dataclasses.replace(
+            tiny_cfg.frontier, incremental=incremental))
+    bus = Bus()
+    return MapperNode(cfg, bus, n_robots=n_robots), bus, cfg
+
+
+def _seed_map(mapper, cfg):
+    n = cfg.grid.size_cells
+    lo = np.zeros((n, n), np.float32)
+    lo[60:180, 60:180] = -2.0
+    lo[110:114, 60:150] = 2.0
+    mapper.seed_map_prior(lo)
+    return lo
+
+
+def _last_frontiers(bus):
+    out = []
+    bus.subscribe("/frontiers", callback=out.append)
+    return out
+
+
+def test_mapper_incremental_false_is_pre_pr_publish(tiny_cfg):
+    """incremental=False: the publish path never builds a pipeline and
+    the published triple is EXACTLY one full-grid compute_frontiers of
+    the snapshot (the pre-PR behavior, bit-for-bit)."""
+    mapper, bus, cfg = _mk_mapper(tiny_cfg, incremental=False)
+    lo = _seed_map(mapper, cfg)
+    got = _last_frontiers(bus)
+    mapper.publish_frontiers()
+    assert mapper._frontier_pipeline is None
+    poses = np.stack([np.asarray(st.pose) for st in mapper.states])
+    fr = F.compute_frontiers(cfg.frontier, cfg.grid, jnp.asarray(lo),
+                             jnp.asarray(poses))
+    msg = got[-1]
+    np.testing.assert_array_equal(msg.targets_xy, np.asarray(fr.targets))
+    np.testing.assert_array_equal(msg.sizes, np.asarray(fr.sizes))
+    np.testing.assert_array_equal(msg.assignment,
+                                  np.asarray(fr.assignment))
+
+
+def test_mapper_incremental_publish_matches_full_and_stamps_revision(
+        tiny_cfg):
+    """The incremental publish equals the full recompute of the same
+    snapshot and stamps the map_revision it was computed at; a skipped
+    republish re-ships the original stamp even after the revision
+    advances out-of-band."""
+    mapper, bus, cfg = _mk_mapper(tiny_cfg, incremental=True)
+    lo = _seed_map(mapper, cfg)
+    got = _last_frontiers(bus)
+    mapper.publish_frontiers()
+    assert mapper._frontier_pipeline is not None
+    rev0 = mapper.map_revision
+    poses = np.stack([np.asarray(st.pose) for st in mapper.states])
+    fr = F.compute_frontiers(cfg.frontier, cfg.grid, jnp.asarray(lo),
+                             jnp.asarray(poses))
+    msg = got[-1]
+    np.testing.assert_array_equal(msg.targets_xy, np.asarray(fr.targets))
+    np.testing.assert_array_equal(msg.sizes, np.asarray(fr.sizes))
+    np.testing.assert_array_equal(msg.assignment,
+                                  np.asarray(fr.assignment))
+    assert msg.map_revision == rev0
+    # Skip path: bump the revision WITHOUT touching tiles (no dirty
+    # marks) — the republish still carries the computed-at stamp.
+    mapper.map_revision += 5
+    mapper.publish_frontiers()
+    assert got[-1].map_revision == rev0
+    assert mapper._frontier_pipeline.n_skips == 1
+
+
+def test_publish_snapshot_tear_fixed(tiny_cfg):
+    """ISSUE 6 satellite: poses and grid must come from ONE lock
+    section. The historical code re-read the grid via merged_grid()
+    AFTER releasing the pose lock, so a concurrent install could pair a
+    new map with old poses — publish_frontiers must not call
+    merged_grid() at all, and a revision bump landing mid-publish must
+    not leak into the stamped revision."""
+    mapper, bus, cfg = _mk_mapper(tiny_cfg, incremental=True)
+    _seed_map(mapper, cfg)
+    got = _last_frontiers(bus)
+    called = []
+    orig = mapper.merged_grid
+    mapper.merged_grid = lambda: (called.append(1), orig())[1]
+    rev0 = mapper.map_revision
+    pipe = mapper._frontier_incremental()
+    orig_compute = pipe.compute
+
+    def racing_compute(*a, **kw):
+        # A concurrent install lands mid-publish: the already-taken
+        # snapshot must win.
+        mapper.map_revision += 1
+        return orig_compute(*a, **kw)
+
+    pipe.compute = racing_compute
+    try:
+        mapper.publish_frontiers()
+    finally:
+        pipe.compute = orig_compute
+        mapper.merged_grid = orig
+    assert not called, "publish_frontiers re-read the grid outside " \
+                       "its consistent snapshot section"
+    assert got[-1].map_revision == rev0
+
+
+def test_publish_concurrent_prior_seed_hammer(tiny_cfg):
+    """Publishes racing seed_map_prior installs never crash and never
+    publish a revision newer than the grid they computed on (smoke for
+    the one-lock snapshot)."""
+    mapper, bus, cfg = _mk_mapper(tiny_cfg, incremental=True)
+    lo = _seed_map(mapper, cfg)
+    got = _last_frontiers(bus)
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            mapper.seed_map_prior(lo)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        for _ in range(10):
+            mapper.publish_frontiers()
+    finally:
+        stop.set()
+        t.join()
+    assert len(got) == 10
+    assert all(m.map_revision <= mapper.map_revision for m in got)
+
+
+def test_planner_overlay_cache_keyed_on_revisions(tiny_cfg):
+    """Satellite: the planning basis is keyed on (map_revision, voxel
+    fusion key) — repeated calls at unchanged keys reuse the cached
+    overlay; either key advancing rebuilds."""
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.mapper import MapperNode
+    from jax_mapping.bridge.planner import PlannerNode
+
+    class FakeVoxel:
+        def __init__(self, cfg):
+            from jax_mapping.ops.voxel import empty_voxel_grid
+            self._g = empty_voxel_grid(cfg.voxel)
+            self.rev = 0
+
+        def voxel_grid(self):
+            return self._g
+
+        def serving_revision(self):
+            return self.rev
+
+        def fuse(self):
+            # A real fusion: new (immutable) array + revision bump.
+            self._g = self._g + 0.0
+            self.rev += 1
+
+    cfg = tiny_cfg
+    bus = Bus()
+    mapper = MapperNode(cfg, bus, n_robots=1)
+    voxel = FakeVoxel(cfg)
+    planner = PlannerNode(cfg, bus, mapper, voxel_mapper=voxel)
+    if planner.voxel_mapper is None:
+        pytest.skip("voxel/grid resolution mismatch in tiny config")
+    g1 = planner._planning_grid()
+    g2 = planner._planning_grid()
+    assert g2 is g1
+    assert planner.n_overlay_rebuilds == 1
+    assert planner.n_overlay_reuses >= 1
+    # A voxel fusion (new array + key) -> rebuild.
+    voxel.fuse()
+    planner._planning_grid()
+    assert planner.n_overlay_rebuilds == 2
+    # Map revision advances (content mutation) -> rebuild.
+    _seed_map(mapper, cfg)
+    planner._planning_grid()
+    assert planner.n_overlay_rebuilds == 3
+    # The mapper-passed-snapshot form shares the same cache.
+    lo = mapper.merged_grid()
+    out = planner._planning_grid(lo, mapper.serving_revision())
+    assert out is planner._lo_cache[3]
+    assert planner.overlay_key() == voxel.rev
