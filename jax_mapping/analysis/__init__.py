@@ -15,9 +15,19 @@ disciplines that ordinary linters know nothing about:
 
 `core` holds the checker framework (Finding, baseline, driver),
 `jax_hazards` the A-family checkers, `lock_discipline` the B-family,
-`lockwatch` a runtime lock-order recorder that validates the static
-graph against a live stack, and `cli` the `jax-mapping-lint` console
-entry point. The repo gates itself in tier-1 via
+and the C family encodes the hazard classes review caught in PRs 4-6:
+`revision_order` (C1 revision-before-content for lock-free stamped
+snapshots), `snapshot_tear` (C2 correlated state across separate lock
+regions, driven by the `protection` lock-protection map),
+`device_views` (C3 mutation of read-only np.asarray device views) and
+`shape_churn` (C4 unbucketed runtime sizes at jit boundaries).
+
+The dynamic tier: `lockwatch` records runtime lock ORDER, `racewatch`
+applies Eraser's lockset refinement to the protection-map fields on a
+live stack, and `compilebudget` pins per-function jit compile counts
+against the committed `compile_budget.json` ratchet. `cli` is the
+`jax-mapping-lint` console entry point (also `python -m
+jax_mapping.analysis`). The repo gates itself in tier-1 via
 `tests/test_analysis_selfcheck.py`: the full analyzer over
 `jax_mapping/` must report zero non-baselined findings.
 """

@@ -1,0 +1,11 @@
+"""`python -m jax_mapping.analysis` — the lint CLI as a module entry
+point, for environments that run the package from a checkout without
+installed console scripts (CI containers, notebooks). Identical
+arguments and exit-code contract as `jax-mapping-lint` (see cli.py)."""
+
+import sys
+
+from jax_mapping.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,11 +6,27 @@
     jax-mapping-lint --write-baseline jax_mapping/  # accept current
                                                   # findings (ratchet)
     jax-mapping-lint --format json jax_mapping/   # machine-readable
+    jax-mapping-lint --format github jax_mapping/ # CI annotations
 
-Exit codes: 0 clean (all findings baselined), 1 new findings, 2 usage
-or parse error. The tier-1 gate (`tests/test_analysis_selfcheck.py`)
-is exactly "exit code 0 over `jax_mapping/` with the committed
-baseline".
+Also invocable as `python -m jax_mapping.analysis` (the module entry
+point mirrors the console script for environments without installed
+scripts).
+
+Exit-code contract (stable; CI consumers branch on it):
+
+    0  clean — every finding baselined (or none at all)
+    1  findings — at least one NON-baselined finding was reported
+    2  internal/usage error — bad flags, unreadable paths, syntax
+       errors in analyzed sources, corrupt baseline; NEVER used for
+       findings, so a pipeline can distinguish "the code is dirty"
+       from "the linter could not run"
+
+`--format github` emits one `::error file=...,line=...::message`
+workflow-command annotation per non-baselined finding (GitHub renders
+them inline on the PR diff), followed by the usual summary on stderr.
+
+The tier-1 gate (`tests/test_analysis_selfcheck.py`) is exactly "exit
+code 0 over `jax_mapping/` with the committed baseline".
 """
 
 from __future__ import annotations
@@ -43,7 +59,8 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="accept all current findings into the baseline "
                         "file and exit 0")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text")
     p.add_argument("--checker", action="append", default=None,
                    metavar="ID", help="run only these checker ids "
                    "(repeatable), e.g. --checker B1-lock-order")
@@ -85,6 +102,19 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
+    # --write-baseline merge preflight BEFORE the (expensive) analysis:
+    # a corrupt existing baseline must refuse immediately, not after
+    # seconds of checker work it will then throw away.
+    existing = None
+    if args.write_baseline and os.path.exists(baseline_path):
+        try:
+            existing = Baseline.load(baseline_path).suppressions
+        except (OSError, ValueError) as e:
+            print(f"jax-mapping-lint: baseline {baseline_path}: {e} "
+                  "— refusing to overwrite what cannot be merged",
+                  file=sys.stderr)
+            return 2
+
     res = analyze_modules(modules, baseline, checkers)
 
     if args.write_baseline:
@@ -94,16 +124,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # otherwise `--write-baseline --checker B1-lock-order` would
         # silently delete every A-family suppression.
         notes, keep = {}, []
-        if os.path.exists(baseline_path):
+        if existing is not None:
             ids = {c.id for c in checkers}
             analyzed = {m.path for m in modules}
-            try:
-                existing = Baseline.load(baseline_path).suppressions
-            except (OSError, ValueError) as e:
-                print(f"jax-mapping-lint: baseline {baseline_path}: {e} "
-                      "— refusing to overwrite what cannot be merged",
-                      file=sys.stderr)
-                return 2
             # An entry may be dropped (trusted to re-appear as a
             # finding if still valid) only when this run could have
             # re-observed it: its checker ran, its file was analyzed,
@@ -125,6 +148,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"wrote {len(res.all_findings) + len(keep)} "
               f"suppression(s) to {baseline_path}")
         return 0
+
+    if args.format == "github":
+        # GitHub workflow commands: one annotation per finding, pinned
+        # to file+line so the PR diff shows it inline. Newlines and
+        # the %/CR/LF command metacharacters are escaped per the
+        # workflow-command spec; the summary goes to stderr so stdout
+        # stays machine-consumable.
+        def esc(s: str) -> str:
+            return (s.replace("%", "%25").replace("\r", "%0D")
+                    .replace("\n", "%0A"))
+
+        for f in res.findings:
+            level = "error" if f.severity == "error" else "warning"
+            print(f"::{level} file={esc(f.path)},line={f.line},"
+                  f"title={esc(f.checker)}::{esc(f.message)}")
+        print(f"{res.n_files} files: {len(res.findings)} new "
+              f"finding(s), {len(res.baselined)} baselined",
+              file=sys.stderr)
+        return 1 if res.findings else 0
 
     if args.format == "json":
         print(json.dumps({

@@ -1,0 +1,224 @@
+"""Runtime jit-recompile budget — the dynamic half of C4.
+
+The static C4 checker catches *syntactic* shape churn (unbucketed
+slices and static args at jit call sites); whatever it cannot see —
+list-stacked batches, thread-timing-dependent shapes, config drift —
+shows up at runtime as jit cache entries. This module pins them: every
+jitted function in the package reports its compiled-variant count
+(`PjitFunction._cache_size()`) after a canonical deterministic
+scenario, and the committed `analysis/compile_budget.json` is the
+ratchet — the same contract as `baseline.json`:
+
+* the gate (`tests/test_analysis_selfcheck.py`) runs the scenario in a
+  fresh subprocess (cold caches) and fails if any function compiled
+  MORE variants than budgeted — a recompile regression;
+* a budget entry whose function no longer exists or no longer compiles
+  is *stale* and fails the gate — the budget only ratchets down;
+* entries above 1 variant carry a `note` explaining which shapes are
+  expected (pow2 buckets, window-vs-single paths) — growth without a
+  justification cannot land.
+
+`python -m jax_mapping.analysis.compilebudget --measure` prints the
+counts, `--write-budget` regenerates the file (preserving notes),
+`--check` is the gate (exit 0 clean / 1 violations / 2 error). The
+scenario parameters live in `config.AnalysisConfig` so the committed
+budget is reproducible by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def default_budget_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "compile_budget.json")
+
+
+# -- measurement -------------------------------------------------------------
+
+def snapshot_cache_sizes(prefix: str = "jax_mapping") -> Dict[str, int]:
+    """Compiled-variant count per jitted function currently imported
+    under `prefix`, keyed by the DEFINING module + name (stable across
+    from-import aliases; deduped by object identity)."""
+    sizes: Dict[str, int] = {}
+    seen: set = set()
+    for mod_name, mod in sorted(sys.modules.items()):
+        if mod is None or not mod_name.startswith(prefix):
+            continue
+        for attr in sorted(vars(mod)):
+            fn = vars(mod)[attr]
+            cache_size = getattr(fn, "_cache_size", None)
+            if not callable(cache_size) or id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            owner = getattr(fn, "__module__", mod_name) or mod_name
+            name = getattr(fn, "__name__", attr) or attr
+            if not owner.startswith(prefix):
+                owner = mod_name        # lambdas / wrapped externals
+            try:
+                sizes[f"{owner}.{name}"] = int(cache_size())
+            except Exception:           # noqa: BLE001 — introspection only
+                continue
+    return sizes
+
+
+def measure_scenario(analysis_cfg=None) -> Dict[str, int]:
+    """Run the canonical deterministic scenario and snapshot compile
+    counts. MUST run with cold jit caches (a fresh process) for the
+    numbers to mean anything — the gate enforces that by
+    subprocessing; calling it mid-process returns whatever the process
+    already compiled on top."""
+    from jax_mapping.config import AnalysisConfig, tiny_config
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.sim import world as W
+
+    a = analysis_cfg or AnalysisConfig()
+    cfg = tiny_config(n_robots=a.budget_n_robots)
+    world = W.plank_course(a.budget_world_cells, cfg.grid.resolution_m,
+                           n_planks=4, seed=a.budget_seed)
+    st = launch_sim_stack(cfg, world, n_robots=a.budget_n_robots,
+                          http_port=0, realtime=False,
+                          seed=a.budget_seed)
+    try:
+        st.brain.start_exploring()
+        st.run_steps(a.budget_steps)
+        st.mapper.publish_map()
+        # Serving-side compiles (tile hashing, gray conversion, pyramid
+        # downsample) run on refresh, normally from the HTTP plane; two
+        # refreshes exercise both the first-install and the diff path
+        # (verified to add no compiles beyond the first — the counts
+        # are shape-driven, not content-driven).
+        if st.api is not None and st.api.serving is not None \
+                and st.api.serving.map_store is not None:
+            st.api.serving.map_store.refresh()
+            st.api.serving.map_store.refresh()
+    finally:
+        st.shutdown()
+    return {k: v for k, v in snapshot_cache_sizes().items() if v > 0}
+
+
+# -- the budget --------------------------------------------------------------
+
+class Budget:
+    def __init__(self, entries: List[dict]):
+        self.entries = list(entries)
+        self.by_name = {e["name"]: e for e in self.entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Budget":
+        with open(path) as f:
+            data = json.load(f)
+        if data.get("version") != 1:
+            raise ValueError(f"{path}: unsupported budget version "
+                             f"{data.get('version')!r}")
+        return cls(data.get("budgets", []))
+
+    def check(self, measured: Dict[str, int]
+              ) -> Tuple[List[str], List[str], List[str]]:
+        """(over_budget, unknown, stale) violation descriptions."""
+        over, unknown = [], []
+        for name, count in sorted(measured.items()):
+            e = self.by_name.get(name)
+            if e is None:
+                unknown.append(
+                    f"{name}: compiled {count} variant(s) but has no "
+                    "budget entry — run --write-budget and justify any "
+                    "entry above 1 with a note")
+            elif count > e["max"]:
+                over.append(
+                    f"{name}: {count} compiled variant(s) exceeds "
+                    f"budget {e['max']} — recompile regression (bucket "
+                    "the offending shape, or raise the budget WITH a "
+                    "note in compile_budget.json)")
+        stale = [
+            f"{e['name']}: budgeted {e['max']} but never compiled in "
+            "the canonical scenario — stale entry, ratchet it out"
+            for e in self.entries if e["name"] not in measured]
+        return over, unknown, stale
+
+    @staticmethod
+    def dump(measured: Dict[str, int], path: str,
+             notes: Optional[Dict[str, str]] = None) -> None:
+        entries = []
+        for name in sorted(measured):
+            e = {"name": name, "max": measured[name]}
+            note = (notes or {}).get(name)
+            if note:
+                e["note"] = note
+            entries.append(e)
+        with open(path, "w") as f:
+            json.dump({"version": 1, "budgets": entries}, f, indent=1)
+            f.write("\n")
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m jax_mapping.analysis.compilebudget",
+        description="jit recompile-budget tracker (ratcheted like "
+                    "analysis/baseline.json)")
+    p.add_argument("--budget", default=None, metavar="JSON")
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--measure", action="store_true",
+                   help="run the canonical scenario, print counts")
+    g.add_argument("--write-budget", action="store_true",
+                   help="regenerate the budget file (notes preserved)")
+    g.add_argument("--check", action="store_true",
+                   help="gate: exit 0 clean / 1 violations / 2 error")
+    args = p.parse_args(argv)
+    path = args.budget or default_budget_path()
+
+    # Budget-file preflight BEFORE the ~30 s scenario (the same
+    # fail-fast contract the lint CLI keeps for its baseline): a
+    # missing/corrupt budget must refuse immediately, not after a full
+    # stack drive it will then discard.
+    budget = None
+    notes: Dict[str, str] = {}
+    if args.check:
+        try:
+            budget = Budget.load(path)
+        except (OSError, ValueError) as e:
+            print(f"compilebudget: {e}", file=sys.stderr)
+            return 2
+    elif args.write_budget and os.path.exists(path):
+        try:
+            notes = {e["name"]: e["note"]
+                     for e in Budget.load(path).entries if e.get("note")}
+        except (OSError, ValueError) as e:
+            print(f"compilebudget: {path}: {e} — refusing to "
+                  "overwrite what cannot be merged", file=sys.stderr)
+            return 2
+
+    try:
+        # The stack logs bring-up lines to stdout; push them to stderr
+        # so --measure's stdout is exactly one JSON document.
+        import contextlib
+        with contextlib.redirect_stdout(sys.stderr):
+            measured = measure_scenario()
+    except Exception as e:              # noqa: BLE001
+        print(f"compilebudget: scenario failed: {e}", file=sys.stderr)
+        return 2
+
+    if args.measure:
+        print(json.dumps(measured, indent=1, sort_keys=True))
+        return 0
+
+    if args.write_budget:
+        Budget.dump(measured, path, notes=notes)
+        print(f"wrote {len(measured)} budget(s) to {path}")
+        return 0
+
+    over, unknown, stale = budget.check(measured)
+    for line in over + unknown + stale:
+        print(line)
+    return 1 if (over or unknown or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -235,10 +235,13 @@ def default_baseline_path() -> str:
 # -- driver ------------------------------------------------------------------
 
 def all_checkers() -> List:
-    """The registered checker passes, in report order. The A family
-    shares one jit-registry build and the B family one class-walk per
-    module set (`_SharedRegistry` / `_SharedWalk`)."""
-    from jax_mapping.analysis import jax_hazards, lock_discipline
+    """The registered checker passes, in report order. The A family and
+    the C checkers that need the jit registry share one registry build,
+    the B family one class-walk per module set (`_SharedRegistry` /
+    `_SharedWalk`)."""
+    from jax_mapping.analysis import (device_views, jax_hazards,
+                                      lock_discipline, revision_order,
+                                      shape_churn, snapshot_tear)
     registry = jax_hazards._SharedRegistry()
     walk = lock_discipline._SharedWalk()
     return [jax_hazards.HostSyncChecker(registry),
@@ -247,7 +250,11 @@ def all_checkers() -> List:
             jax_hazards.ImpureJitChecker(registry),
             lock_discipline.LockOrderChecker(walk),
             lock_discipline.CallbackUnderLockChecker(walk),
-            lock_discipline.UnguardedWriteChecker(walk)]
+            lock_discipline.UnguardedWriteChecker(walk),
+            revision_order.RevisionOrderChecker(),
+            snapshot_tear.SnapshotTearChecker(),
+            device_views.DeviceViewMutationChecker(registry),
+            shape_churn.ShapeChurnChecker(registry)]
 
 
 def analyze_modules(modules: Sequence[SourceModule],

@@ -1,0 +1,137 @@
+"""The lock-protection map: which lock guards which correlated state.
+
+The B-family checkers *derive* lock/state relations from syntax (any
+attr touched under ``with self.<lock>``). That is the right default for
+"is this write guarded at all" (B3), but two hazard families need more
+than derivation can give:
+
+* **Snapshot tears (C2)** are about *correlation*: ``poses`` and
+  ``grid`` are each individually guarded, yet reading them in two
+  separate lock regions produces a pose/grid pairing no writer ever
+  created (the ``publish_frontiers`` tear fixed in PR 6). Which fields
+  form one consistent snapshot is a *design fact*, not a syntactic one
+  — so it is declared here, reviewed like code.
+* **The dynamic race detector (racewatch)** implements Eraser's lockset
+  refinement, which needs to know which fields are *supposed* to be
+  lock-protected shared state (fields deliberately read lock-free by
+  the /status counter convention must not be watched — Eraser would
+  correctly empty their candidate lockset and incorrectly call it a
+  bug).
+
+One map feeds both: a :class:`LockGroup` names a class, the lock
+attribute, and the set of instance fields that form one correlated
+snapshot under it. `REPO_PROTECTION` is the committed map for this
+repo's bridge/serving classes; checkers and racewatch default to it
+but accept a custom list so fixture tests declare their own.
+
+Curation rules (enforced by tests/test_analysis_selfcheck.py):
+
+* every named class must exist in the package and own the named lock;
+* every named field must be assigned somewhere in that class;
+* fields read lock-free BY DESIGN (monotonic counters: `map_revision`
+  via `serving_revision()`, `n_images_fused`, tick counters) are listed
+  in `lockfree_ok`, NOT in `fields` — the C2 checker still treats their
+  *in-region* reads as part of the snapshot, but racewatch must not
+  watch them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Sequence
+
+
+@dataclass(frozen=True)
+class LockGroup:
+    """One correlated-snapshot declaration.
+
+    `cls`: class name (matches `ClassInfo.name`).
+    `lock_attr`: the instance lock attribute guarding the group.
+    `fields`: instance attributes forming ONE consistent snapshot —
+        reading two of them in two separate atomic sections is a tear,
+        and EVERY access must hold the lock (racewatch instruments
+        exactly these).
+    `lockfree_ok`: attributes in the same consistency story whose
+        design sanctions lock-free accesses — monotonic counters read
+        by the /status convention, set-once references None-checked
+        before locking, single-writer fields whose owning thread reads
+        its own state bare (the baselined-B3 discipline). C2 counts
+        their in-region reads as part of the snapshot; racewatch must
+        NOT watch them (Eraser would empty their candidate lockset and
+        report the *convention*).
+    `extra_locks`: further lock attributes racewatch must instrument so
+        held-locksets are accurate (e.g. the TileStore's
+        `_refresh_lock`, under which `_install` legitimately reads hash
+        state before committing under `_lock`).
+    """
+    cls: str
+    lock_attr: str
+    fields: FrozenSet[str]
+    lockfree_ok: FrozenSet[str] = frozenset()
+    extra_locks: FrozenSet[str] = frozenset()
+
+    @property
+    def all_fields(self) -> FrozenSet[str]:
+        return self.fields | self.lockfree_ok
+
+    def watchable_fields(self) -> FrozenSet[str]:
+        """What racewatch instruments: strictly lock-guarded fields."""
+        return self.fields
+
+
+def group(cls: str, lock_attr: str, fields: Sequence[str],
+          lockfree_ok: Sequence[str] = (),
+          extra_locks: Sequence[str] = ()) -> LockGroup:
+    return LockGroup(cls=cls, lock_attr=lock_attr,
+                     fields=frozenset(fields),
+                     lockfree_ok=frozenset(lockfree_ok),
+                     extra_locks=frozenset(extra_locks))
+
+
+#: The committed map. Each entry documents a consistency contract the
+#: code comments already state in prose; a PR that changes the contract
+#: must change this map in the same diff (the selfcheck pins existence
+#: of every class/lock/field so renames can't silently orphan a row).
+REPO_PROTECTION: List[LockGroup] = [
+    # The 2D mapper's publish/serving snapshot: poses, shared grid,
+    # revision and the dirty-tile bookkeeping move together — the PR 6
+    # tear fix put all four under ONE _state_lock section. `states` is
+    # single-writer (the tick thread reads its own entries bare, the
+    # baselined-B3 `_prev_paired` discipline) and `map_revision` is a
+    # /status-convention counter: both are snapshot members for C2 but
+    # out of racewatch's scope.
+    group("MapperNode", "_state_lock",
+          ["shared_grid", "_dirty_tiles"],
+          lockfree_ok=["map_revision", "states", "_tile_rev"],
+          extra_locks=["_dirty_lock"]),
+    # The voxel mapper's grid/revision pair (the PR 4 ordering hazard)
+    # plus the keyframe ring the closure re-fuse reads with them.
+    group("VoxelMapperNode", "_lock",
+          ["grid", "_keyframes"],
+          lockfree_ok=["map_revision", "n_images_fused"]),
+    # Tile store: bytes, stamps, hash state and the store revision are
+    # installed atomically — a reader pairing tiles from one install
+    # with the revision of another would violate the no-stale-serve
+    # contract in serving/tiles.py's module docstring. `_refresh_lock`
+    # is instrumented too: `_install` legitimately reads `_hashes`
+    # under it alone (single-flighted), so without it in the lockset
+    # the candidate for `_hashes` empties spuriously.
+    group("TileStore", "_lock",
+          ["_tiles", "_hashes", "_level_sizes", "revision"],
+          extra_locks=["_refresh_lock"]),
+    # Event channel: subscriber list + the closed-subscriber drop
+    # carry-over (n_dropped_total must stay Prometheus-monotonic).
+    group("EventChannel", "_lock",
+          ["_subs", "_n_dropped_closed"]),
+    # Per-client event mailbox: queue contents and the closed flag.
+    group("EventSubscription", "_lock",
+          ["_queue", "_closed"]),
+]
+
+
+def groups_by_class(protection: Sequence[LockGroup] = None
+                    ) -> Dict[str, List[LockGroup]]:
+    out: Dict[str, List[LockGroup]] = {}
+    for g in (REPO_PROTECTION if protection is None else protection):
+        out.setdefault(g.cls, []).append(g)
+    return out

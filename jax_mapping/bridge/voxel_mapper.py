@@ -356,11 +356,17 @@ class VoxelMapperNode(Node):
             return self.grid
 
     def height_map(self) -> np.ndarray:
-        return np.asarray(self._V.height_map(self.cfg.voxel,
-                                             self.voxel_grid()))
+        # np.array, not np.asarray: asarray of a device array is a
+        # zero-copy READ-ONLY view (lint C3), and this is the public
+        # 2.5D export — consumers masking/annotating it in place would
+        # hit "assignment destination is read-only" on their first
+        # write (or worse, alias the live device buffer).
+        return np.array(self._V.height_map(self.cfg.voxel,
+                                           self.voxel_grid()))
 
     def obstacle_slice(self, z_min_m: float, z_max_m: float) -> np.ndarray:
-        return np.asarray(self._V.obstacle_slice(
+        # Writable copy for the same C3 reason as height_map.
+        return np.array(self._V.obstacle_slice(
             self.cfg.voxel, self.voxel_grid(), z_min_m, z_max_m))
 
     # -- serving surface (serving/tiles.py) ----------------------------------

@@ -644,6 +644,23 @@ class ServingConfig:
 
 
 @_frozen
+class AnalysisConfig:
+    """Canonical scenario for the jit recompile-budget tracker
+    (`analysis/compilebudget.py`): a deterministic tiny-config stack
+    drive whose per-function compiled-variant counts are pinned by the
+    committed `analysis/compile_budget.json` ratchet. The parameters
+    live HERE — not as constants in the tracker — so the committed
+    budget names its provenance and a scenario change is a reviewed
+    config diff, never an incidental edit. Not part of `SlamConfig`:
+    this configures the *measurement*, not the stack."""
+
+    budget_n_robots: int = 2
+    budget_world_cells: int = 96      # plank_course arena edge
+    budget_steps: int = 16            # exploration steps driven
+    budget_seed: int = 3
+
+
+@_frozen
 class FleetConfig:
     """Multi-robot scaling (BASELINE.json configs 4-5: 8-64 simulated Thymios)."""
 

@@ -49,6 +49,65 @@ def rng():
     return np.random.default_rng(42)
 
 
+# -- tier-1 failure-set guard (ISSUE 7) --------------------------------------
+#
+# `tests/known_failures.json` pins the PRE-EXISTING tier-1 failure set
+# (jax.shard_map AttributeError on this jax version + flaky/threshold —
+# verified identical since seed). Every run compares its failures
+# against the pin and prints an explicit diff section, so the set
+# cannot grow *silently*: a new failure is named as NEW (not lost in
+# the expected red count), and a pinned failure that now passes is
+# named as ratchetable. Subset runs only compare among tests that
+# actually ran.
+
+_KNOWN_FAILURES_PATH = os.path.join(os.path.dirname(__file__),
+                                    "known_failures.json")
+_guard_state = {"ran": set(), "failed": set()}
+
+
+def _known_failures():
+    import json
+    try:
+        with open(_KNOWN_FAILURES_PATH) as f:
+            return set(json.load(f)["failures"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def pytest_runtest_logreport(report):
+    # "ran" = the test actually executed (call phase) or its setup
+    # FAILED. Setup SKIPS are neither: counting them would report a
+    # skipped pinned failure as FIXED and invite ratcheting out a
+    # still-valid pin.
+    if report.when == "call" or (report.when == "setup" and report.failed):
+        _guard_state["ran"].add(report.nodeid)
+    if report.failed:
+        _guard_state["failed"].add(report.nodeid)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    known = _known_failures()
+    if known is None:
+        return
+    ran, failed = _guard_state["ran"], _guard_state["failed"]
+    new = sorted(failed - known)
+    fixed = sorted((known & ran) - failed)
+    tr = terminalreporter
+    if new or fixed:
+        tr.section("tier-1 failure-set guard (tests/known_failures.json)")
+    if new:
+        tr.write_line(f"{len(new)} NEW failure(s) beyond the pinned "
+                      "pre-existing set — these are regressions, not "
+                      "the known jax.shard_map/threshold set:")
+        for nodeid in new:
+            tr.write_line(f"  NEW  {nodeid}")
+    if fixed:
+        tr.write_line(f"{len(fixed)} pinned failure(s) now pass — "
+                      "ratchet tests/known_failures.json down:")
+        for nodeid in fixed:
+            tr.write_line(f"  FIXED {nodeid}")
+
+
 @pytest.fixture(scope="session")
 def tiny_cfg():
     from jax_mapping.config import tiny_config

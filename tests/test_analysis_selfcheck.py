@@ -170,7 +170,9 @@ def test_checker_ids_are_unique_and_complete():
     assert len(ids) == len(set(ids))
     assert set(ids) == {"A1-host-sync", "A2-jit-hygiene", "A3-dtype-drift",
                         "A4-impure-jit", "B1-lock-order",
-                        "B2-callback-lock", "B3-unguarded-write"}
+                        "B2-callback-lock", "B3-unguarded-write",
+                        "C1-revision-order", "C2-snapshot-tear",
+                        "C3-device-view", "C4-shape-churn"}
 
 
 # ---------------------------------------- static graph vs live stack
@@ -296,3 +298,158 @@ def test_no_suppressions_in_exploration_modules():
     assert not banned, (
         "suppressions are not allowed in the exploration-pipeline "
         f"modules: {banned}")
+
+
+# ---------------------------------------- ISSUE 7: hazard-lint v2 gates
+
+#: The grandfathered bridge/ suppression keys at the time the ISSUE 7
+#: zero-suppression extension landed — all sanctioned device->host
+#: boundary sites (A1) or documented single-writer counters (B3). This
+#: set may SHRINK, never grow: a new bridge/ finding is fixed in-tree.
+_BRIDGE_GRANDFATHERED = {
+    ("A1-host-sync", "jax_mapping/bridge/brain.py"),
+    ("B3-unguarded-write", "jax_mapping/bridge/brain.py"),
+    ("B3-unguarded-write", "jax_mapping/bridge/mapper.py"),
+    ("A1-host-sync", "jax_mapping/bridge/planner.py"),
+}
+
+
+def test_c_family_findings_are_fixed_never_baselined():
+    """The ISSUE 7 contract: every C1-C4 finding repo-wide is fixed in
+    the tree — the baseline may not carry a single one."""
+    base = Baseline.load(default_baseline_path())
+    banned = [s for s in base.suppressions
+              if s["checker"].startswith("C")]
+    assert not banned, f"C-family suppressions are forbidden: {banned}"
+
+
+def test_no_suppressions_in_serving_or_analysis_modules():
+    """Zero-suppression tier extended to serving/ (and analysis/ may
+    obviously not suppress itself)."""
+    base = Baseline.load(default_baseline_path())
+    banned = [s for s in base.suppressions
+              if s["path"].startswith(("jax_mapping/serving/",
+                                       "jax_mapping/analysis/"))]
+    assert not banned, (
+        f"suppressions are not allowed in serving/ or analysis/: "
+        f"{banned}")
+
+
+def test_bridge_suppression_set_is_pinned():
+    """bridge/ keeps only its grandfathered (checker, path) pairs; any
+    NEW bridge hazard must be fixed, not baselined."""
+    base = Baseline.load(default_baseline_path())
+    current = {(s["checker"], s["path"]) for s in base.suppressions
+               if s["path"].startswith("jax_mapping/bridge/")}
+    grew = current - _BRIDGE_GRANDFATHERED
+    assert not grew, (
+        "bridge/ suppressions grew beyond the grandfathered set — fix "
+        f"the new sites in-tree instead: {sorted(grew)}")
+
+
+def test_protection_map_matches_code(package_modules):
+    """Every lock-protection declaration names a real class, its real
+    lock attributes, and fields actually assigned in that class — a
+    rename cannot silently orphan a row (and with it C2 + racewatch
+    coverage)."""
+    from jax_mapping.analysis import astutil
+    from jax_mapping.analysis.protection import REPO_PROTECTION
+
+    classes = {}
+    for mod in package_modules:
+        for cls in astutil.collect_classes(mod):
+            classes[cls.name] = cls
+    for grp in REPO_PROTECTION:
+        cls = classes.get(grp.cls)
+        assert cls is not None, f"protection map names missing class " \
+                                f"{grp.cls}"
+        assert grp.lock_attr in cls.lock_attrs, \
+            f"{grp.cls} does not own lock {grp.lock_attr}"
+        for extra in grp.extra_locks:
+            assert extra in cls.lock_attrs, \
+                f"{grp.cls} does not own extra lock {extra}"
+        assigned = set()
+        import ast as _ast
+        for meth in cls.methods.values():
+            for node in _ast.walk(meth):
+                if isinstance(node, _ast.Attribute) \
+                        and isinstance(node.ctx, _ast.Store):
+                    attr = astutil._self_attr(node)
+                    if attr:
+                        assigned.add(attr)
+        missing = grp.all_fields - assigned
+        assert not missing, \
+            f"{grp.cls} never assigns declared field(s) {missing}"
+
+
+def test_cli_github_format_annotations(capsys):
+    """`--format github` emits ::error/::warning workflow commands per
+    NON-baselined finding and keeps the exit-code contract (clean repo
+    with baseline -> no annotations, exit 0; --no-baseline re-exposes
+    the accepted sites as annotations, exit 1)."""
+    from jax_mapping.analysis.cli import main
+
+    assert main(["--format", "github"]) == 0
+    out = capsys.readouterr().out
+    assert "::error" not in out and "::warning" not in out
+
+    # Scoped to one checker: the annotation format is checker-agnostic
+    # and a single-family pass keeps this test off tier-1's hot path.
+    assert main(["--format", "github", "--no-baseline",
+                 "--checker", "A1-host-sync"]) == 1
+    out = capsys.readouterr().out
+    assert "::warning file=jax_mapping/bridge/" in out
+    assert ",line=" in out and ",title=A1-host-sync" in out
+
+
+def test_module_entry_point_runs():
+    """`python -m jax_mapping.analysis` mirrors the console script."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "jax_mapping.analysis",
+         "--list-checkers"],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    assert "C1-revision-order" in r.stdout
+
+
+# ---------------------------------------- recompile-budget ratchet
+
+def test_compile_budget_entries_above_one_carry_notes():
+    """`compile_budget.json` mirrors baseline.json's rules: any entry
+    allowing MORE than one compiled variant documents which shapes are
+    expected — growth without a justification cannot land."""
+    from jax_mapping.analysis.compilebudget import (Budget,
+                                                    default_budget_path)
+
+    budget = Budget.load(default_budget_path())
+    assert budget.entries, "committed budget is empty"
+    noteless = [e["name"] for e in budget.entries
+                if e["max"] > 1 and not e.get("note")]
+    assert not noteless, (
+        f"budget entries above 1 variant without a note: {noteless}")
+    assert all(e["max"] >= 1 for e in budget.entries)
+
+
+def test_compile_budget_ratchet_on_canonical_scenario():
+    """THE recompile-budget gate: a FRESH process (cold jit caches)
+    runs the canonical `AnalysisConfig` scenario and every jitted
+    function must compile at most its budgeted variant count — more is
+    a recompile regression, a budgeted-but-never-compiled entry is
+    stale, an unbudgeted compile needs a conscious entry. The budget
+    only ratchets down (see compilebudget.py's module docstring)."""
+    import os
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "jax_mapping.analysis.compilebudget",
+         "--check"],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, (
+        f"recompile-budget violations (exit {r.returncode}):\n"
+        f"{r.stdout}\n{r.stderr[-2000:]}")

@@ -522,3 +522,30 @@ def test_planner_overlay_cache_keyed_on_revisions(tiny_cfg):
     out = planner._planning_grid(lo, mapper.serving_revision())
     assert out is planner._lo_cache[3]
     assert planner.overlay_key() == voxel.rev
+
+
+def test_tile_observed_mask_stays_writable_after_full_refresh():
+    """Lint C3 regression (the PR 6 gotcha this checker encodes): the
+    dense-refresh path installs the device observed-flags as the host
+    mask the SPARSE path later writes into — it must be an np.array
+    copy, not a read-only np.asarray view, or the first sparse refresh
+    after a dense one raises `assignment destination is read-only`."""
+    import numpy as np
+    from jax_mapping.ops.frontier_incremental import \
+        IncrementalFrontierPipeline
+
+    # The module-default 512 grid: its compiled shapes are shared with
+    # the parity tests above, so this regression adds no fresh compiles.
+    gcfg = _gcfg()
+    fcfg = _fcfg()
+    pipe = IncrementalFrontierPipeline(fcfg, gcfg, TILE)
+    sim = WorldSim(gcfg, seed=5, n_robots=2)
+    # First publish: every tile dirty -> the DENSE full-refresh path.
+    pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+    assert pipe.n_full_refreshes >= 1
+    assert pipe._tile_observed.flags.writeable
+    # A small dirty step now takes the SPARSE path, which writes the
+    # mask in place — the line that crashed before the copy fix.
+    sim.step(grow=True)
+    pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+    assert pipe._tile_observed.flags.writeable

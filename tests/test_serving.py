@@ -484,3 +484,95 @@ def test_serving_benchmark_reduction_10x():
     assert r["whole_png_polling"]["errors"] == []
     assert r["tiled_delta"]["errors"] == []
     assert r["bytes_reduction_factor"] >= 10.0
+
+
+def test_racewatch_clean_on_live_stack_with_serving(stack):
+    """ISSUE 7 dynamic-tier gate: Eraser lockset refinement over a REAL
+    serving stack — including the fan-out and SSE/long-poll threads
+    lockwatch does not cover. The protection-map fields must end with
+    NON-empty candidate locksets (zero race reports), and the serving
+    state must actually have been exercised cross-thread (no vacuous
+    pass). Lives here (not test_analysis_selfcheck.py) to reuse this
+    module's already-launched stack — tier-1 wall-clock is budgeted
+    against the 870 s timeout.
+
+    The seeded-race counterpart (a guarded field written under the
+    WRONG lock that racewatch MUST flag) is
+    tests/test_analysis.py::test_racewatch_flags_write_under_wrong_lock.
+    """
+    from jax_mapping.analysis.protection import groups_by_class
+    from jax_mapping.analysis.racewatch import RaceWatch
+
+    by = groups_by_class()
+    base = f"http://127.0.0.1:{stack.api.port}"
+    watch = RaceWatch()
+    try:
+        watch.watch_object(stack.mapper, by["MapperNode"][0],
+                           name="mapper")
+        watch.watch_object(stack.api.serving.map_store,
+                           by["TileStore"][0], name="grid-store")
+        watch.watch_object(stack.api.serving.events,
+                           by["EventChannel"][0], name="events")
+        stop = threading.Event()
+        errors = []
+
+        def tile_poller():
+            client = DeltaMapClient(base)
+            while not stop.is_set():
+                try:
+                    client.poll()
+                except Exception as e:           # noqa: BLE001
+                    errors.append(f"poll: {e}")
+                stop.wait(0.03)      # poll cadence; don't starve the GIL
+
+        def sse_reader():
+            try:
+                req = urllib.request.Request(
+                    f"{base}/map-events?since=-1&timeout_s=3")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    for line in r:
+                        if stop.is_set():
+                            break
+                        if line.startswith(b"data:"):
+                            json.loads(line[5:].decode())
+            except Exception as e:               # noqa: BLE001
+                errors.append(f"sse: {e}")
+
+        def long_poller():
+            while not stop.is_set():
+                try:
+                    urllib.request.urlopen(
+                        f"{base}/map-events?mode=poll&since=-1&wait_s=0.2",
+                        timeout=5).read()
+                except Exception:                # noqa: BLE001
+                    pass                         # shutdown races are fine
+                stop.wait(0.03)
+
+        threads = [threading.Thread(target=tile_poller),
+                   threading.Thread(target=sse_reader),
+                   threading.Thread(target=long_poller)]
+        for t in threads:
+            t.start()
+        stack.run_steps(12)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        watch.unwatch_all()
+
+    assert not errors, errors
+    reports = watch.reports()
+    assert reports == [], "\n".join(r.message for r in reports)
+    states = watch.field_states()
+    # Not vacuous: serving state crossed threads (HTTP workers install
+    # AND read the tile cache, the tick thread fans out events) and
+    # refinement converged on the DECLARED locks.
+    tiles = states["TileStore._tiles@grid-store"]
+    assert tiles.state in ("shared", "shared-modified")
+    assert tiles.candidate and \
+        "TileStore._lock@grid-store" in tiles.candidate
+    grid = states["MapperNode.shared_grid@mapper"]
+    assert grid.state == "shared-modified"
+    assert grid.candidate == \
+        frozenset({"MapperNode._state_lock@mapper"})

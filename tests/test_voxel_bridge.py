@@ -297,3 +297,20 @@ def test_voxel_restore_survives_inflight_fuse(tiny_cfg):
     np.testing.assert_array_equal(
         np.asarray(vm.voxel_grid()), restored,
         err_msg="fuse from pre-restore state overwrote the restored map")
+
+
+def test_height_map_and_slice_exports_are_writable_copies(stack):
+    """Lint C3 regression: the public 2.5D exports must be WRITABLE
+    host copies, never read-only np.asarray views of the live device
+    grid — a consumer masking them in place would otherwise crash (or
+    alias the device buffer)."""
+    vm = stack.voxel_mapper
+    hm = vm.height_map()
+    blocked = vm.obstacle_slice(0.05, 0.45)
+    assert hm.flags.writeable
+    assert blocked.flags.writeable
+    # In-place consumer edits must not leak into the next export (the
+    # copies are genuinely per-call).
+    before = hm.copy()
+    hm[:] = -1.0
+    np.testing.assert_array_equal(vm.height_map(), before)
