@@ -102,8 +102,31 @@ REPO_PROTECTION: List[LockGroup] = [
     # out of racewatch's scope.
     group("MapperNode", "_state_lock",
           ["shared_grid", "_dirty_tiles"],
-          lockfree_ok=["map_revision", "states", "_tile_rev"],
+          lockfree_ok=["map_revision", "states", "_tile_rev",
+                       # Serving restart epoch: set once before the
+                       # replacement node serves (launch.restart_mapper),
+                       # then read-only. Decay clock: tick-thread-only
+                       # state, the _prev_paired single-writer
+                       # discipline (its grid swap runs under
+                       # _state_lock like every install).
+                       "restart_epoch", "_decay_ticks"],
           extra_locks=["_dirty_lock"]),
+    # Scripted world dynamics (scenarios/dynamics.py): the door/crowd
+    # registries and the change flag move together — FaultPlan mutators
+    # and the SimNode composer may live on different threads in
+    # realtime stacks. n_recomposes is a /status-convention counter.
+    group("WorldDynamics", "_lock",
+          ["_door_closed", "_crowds", "_dirty"],
+          lockfree_ok=["n_recomposes"]),
+    # Rendezvous merger (scenarios/rendezvous.py): the verification
+    # streak is the guarded correlated state; the published merge
+    # result is single-writer (the stack-driving thread) and set-once —
+    # post-merge readers take it bare by design, like the mapper's
+    # states.
+    group("RendezvousMerger", "_lock",
+          ["_streak", "n_attempts", "n_accepted"],
+          lockfree_ok=["transform", "merged_grid", "merged_states",
+                       "merged"]),
     # The voxel mapper's grid/revision pair (the PR 4 ordering hazard)
     # plus the keyframe ring the closure re-fuse reads with them.
     group("VoxelMapperNode", "_lock",

@@ -224,6 +224,33 @@ class MapApiServer:
         self.port = self.server.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    # -- restart surface (launch.restart_mapper) -----------------------------
+
+    def rebind_mapper(self, mapper) -> None:
+        """Swap the API onto a restarted MapperNode. The serving bundle
+        is REBUILT around the new node — its tile-store snapshot
+        closures and revision listener captured the old one, and a bare
+        attribute swap would keep serving the destroyed node's final
+        map forever. The event channel is carried over so connected
+        /map-events clients keep their streams; the new mapper's
+        `restart_epoch` (stamped into every /tiles response + ETag)
+        tells delta clients to resync full instead of treating the
+        resumed, possibly-older revision as a protocol violation."""
+        self.mapper = mapper
+        if self.serving is not None:
+            from jax_mapping.serving import MapServing
+            old = self.serving
+            self.serving = MapServing(mapper.cfg.serving, mapper=mapper,
+                                      voxel_mapper=self.voxel_mapper,
+                                      events=old.events)
+            # The voxel provider did NOT restart: carry its store over
+            # like the event channel — a fresh store would re-hash and
+            # re-encode every voxel tile for nothing (and reset its
+            # serving counters).
+            if old.voxel_store is not None:
+                self.serving.voxel_store = old.voxel_store
+            mapper.add_revision_listener(self.serving.on_map_revision)
+
     # -- bus callbacks ------------------------------------------------------
 
     def _map_cb(self, msg: OccupancyGrid) -> None:
@@ -350,6 +377,15 @@ class MapApiServer:
                 # operator's one-glance health check.
                 body["n_scans_fused"] = self.mapper.n_scans_fused
                 body["n_loops_closed"] = self.mapper.n_loops_closed
+                if getattr(self.mapper, "cfg", None) is not None \
+                        and self.mapper.cfg.decay.enabled:
+                    # Map-healing observability (scenario engine): pass
+                    # count + restart epoch, the lock-free counter
+                    # convention.
+                    body["decay"] = {
+                        "n_passes": self.mapper.n_decay_passes,
+                        "restart_epoch": self.mapper.restart_epoch,
+                    }
                 if hasattr(self.mapper, "match_stats"):
                     # Branch-and-bound matcher work accounting (last
                     # key match's candidate count + prune ratio).
@@ -712,11 +748,17 @@ class MapApiServer:
                 {"error": "since and level must be integers"}).encode()
         store.refresh()
         rev, entries, meta = store.tiles_since(since, level)
-        etag = f'W/"{source}-r{rev}"'
+        # Restart epoch in body AND ETag: a supervisor restart-resume
+        # legitimately re-serves an older revision; clients key cache
+        # validity on (epoch, revision), not revision alone — a stale
+        # pre-restart ETag can never 304 against the resumed store.
+        epoch = self.serving.epoch(source)
+        etag = f'W/"{source}-e{epoch}-r{rev}"'
         if self._etag_hit(headers, etag):
             return 304, "application/json", b"", {"ETag": etag}
         body = dict(meta)
-        body.update({"revision": rev, "since": since, "tiles": entries})
+        body.update({"revision": rev, "since": since, "epoch": epoch,
+                     "tiles": entries})
         return 200, "application/json", json.dumps(body).encode(), \
             {"ETag": etag}
 

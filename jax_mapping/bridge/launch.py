@@ -117,9 +117,11 @@ class Stack:
         from jax_mapping.io.checkpoint import save_checkpoint
         os.makedirs(os.path.dirname(self.auto_checkpoint_path),
                     exist_ok=True)
-        save_checkpoint(self.auto_checkpoint_path,
-                        self.mapper.snapshot_states(),
-                        config_json=self.cfg.to_json())
+        save_checkpoint(
+            self.auto_checkpoint_path, self.mapper.snapshot_states(),
+            config_json=self.cfg.to_json(),
+            retain_generations=self.cfg.resilience
+            .checkpoint_retain_generations)
 
     def restart_mapper(self) -> None:
         """The supervisor's mapper restarter: rebuild the MapperNode and
@@ -150,6 +152,11 @@ class Stack:
                 states = None                # no intact generation: blank
         new = MapperNode(self.cfg, self.bus, tf=self.tf, n_robots=n,
                          health=self.health, recovery=self.recovery)
+        # Serving restart epoch: the resumed node legitimately re-serves
+        # an OLDER map_revision (checkpoints lag the live map); the
+        # bumped epoch tells delta clients to drop their cache and
+        # resync full instead of raising a revision regression.
+        new.restart_epoch = old.restart_epoch + 1
         anchors = self.brain.poses.copy()
         if states is not None:
             new.restore_states(states, anchor_poses=anchors)
@@ -168,7 +175,11 @@ class Stack:
         if self.voxel_mapper is not None:
             self.voxel_mapper.mapper = new
         if self.api is not None:
-            self.api.mapper = new
+            # rebind_mapper (not a bare attribute swap): the serving
+            # tile stores and revision listener close over the mapper
+            # they were built with — leaving them on the destroyed node
+            # would serve its final map forever.
+            self.api.rebind_mapper(new)
         self._killed.discard("jax_mapper")
 
     def shutdown(self) -> None:

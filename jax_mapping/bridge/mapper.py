@@ -160,6 +160,20 @@ class MapperNode(Node):
         #: pre-serving behavior; every use gates on the flag).
         self._serving_enabled = cfg.serving.enabled
         self.map_revision = 0
+        #: Restart epoch (serving/client.py): bumped by the supervisor's
+        #: mapper restarter on the REPLACEMENT node, stamped into every
+        #: /tiles response + ETag. A resume from checkpoint legitimately
+        #: re-serves an older `map_revision`; the epoch tells delta
+        #: clients to drop their cache and resync full instead of
+        #: raising a revision-regression protocol error. Set once before
+        #: the node serves (launch.restart_mapper), read lock-free.
+        self.restart_epoch = 0
+        #: Map-healing clock (DecayConfig): mapper ticks since boot; a
+        #: decay pass runs every `decay.every_n_ticks` ticks when
+        #: enabled. Tick-thread-only state (single writer, the
+        #: `_prev_paired` discipline). enabled=False never consults it.
+        self._decay_ticks = 0
+        self.n_decay_passes = 0
         #: Leaf lock for the dirty-tile mask: markers run while holding
         #: `_state_lock` (install atomicity), the snapshot consumer
         #: nests it the same way — one acquisition order, no cycle.
@@ -618,13 +632,44 @@ class MapperNode(Node):
                 # re-asserting the diverged estimate.
                 self._publish_correction(i, *items[-1])
 
-        if any(work):
+        decayed = False
+        # Localization mode tracks against a FROZEN map — healing it
+        # away would erode the very prior the mode exists to keep.
+        if self.cfg.decay.enabled and self.cfg.mode == "mapping":
+            self._decay_ticks += 1
+            if self._decay_ticks % max(1, self.cfg.decay.every_n_ticks) \
+                    == 0:
+                self._apply_decay()
+                decayed = True
+
+        if any(work) or decayed:
             self.publish_frontiers()
         self._notify_revision_listeners()
         self._heartbeater.beat(
             {"scans_fused": self.n_scans_fused,
              "rejected_stale": self.n_scans_rejected_stale,
              "loops_closed": self.n_loops_closed})
+
+    def _apply_decay(self) -> None:
+        """One map-healing pass (DecayConfig): shrink every cell's
+        log-odds toward unknown and clamp to the evidence cap, in one
+        jitted dispatch. Runs on the tick thread BETWEEN steps, so no
+        in-flight step can race the grid swap; the revision bump + full
+        dirty mark make serving, the incremental frontier pipeline and
+        the pyramid caches all see the healed map as an ordinary
+        revision advance (no special-case invalidation anywhere)."""
+        d = self.cfg.decay
+        with self._state_lock:
+            g = self._G.decay_grid(self.shared_grid, d.factor,
+                                   d.evidence_cap)
+            self.shared_grid = g
+            for j in range(self.n_robots):
+                self.states[j] = self.states[j]._replace(grid=g)
+            if self._serving_enabled:
+                self.map_revision += 1
+                self._mark_dirty_all()
+        self.n_decay_passes += 1
+        M.counters.inc("mapper.decay_passes")
 
     def _upload_scan_ranges(self, items: List):
         """One robot's queued scans, padded and stacked host-side, as a

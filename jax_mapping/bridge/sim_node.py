@@ -88,9 +88,46 @@ class SimNode(Node):
         #: re-reporting (frozen data, fresh stamps).
         self._jam_cache = [None] * R
         self._fault_seed = seed
+        #: Scripted world dynamics (scenarios/dynamics.py), or None —
+        #: the static-world stack exactly. Written once at launch by
+        #: attach_world_dynamics, mutated through the set_door/set_crowd
+        #: boundary (FaultPlan world kinds), consumed at the top of
+        #: step() (re-upload only when geometry changed).
+        self._world_dyn = None
+        self.n_world_updates = 0
         self.n_steps = 0
         if realtime:
             self.create_timer(1.0 / rate_hz, self.step)
+
+    # -- scripted world dynamics (scenarios/; FaultPlan world kinds) ---------
+
+    def attach_world_dynamics(self, dyn) -> None:
+        """Arm a WorldDynamics: its base world must be THIS sim's world
+        (same shape; the scenario engine owns composition from here
+        on). step() re-uploads the composed bitmap when it changes."""
+        if dyn.base.shape != tuple(self.world.shape):
+            raise ValueError(
+                f"world dynamics base {dyn.base.shape} != sim world "
+                f"{tuple(self.world.shape)}")
+        self._world_dyn = dyn
+
+    def set_door(self, name: str, closed: bool) -> None:
+        """Close (or re-open) a registered door — the `door_close`
+        scenario kind's boundary."""
+        if self._world_dyn is None:
+            raise RuntimeError(
+                "no world dynamics attached (launch the stack with a "
+                "scenarios.WorldDynamics to script doors)")
+        self._world_dyn.set_door(name, closed)
+
+    def set_crowd(self, cid: int, radius_m) -> None:
+        """Activate/clear a moving crowd blob — the `crowd` scenario
+        kind's boundary (None radius = gone)."""
+        if self._world_dyn is None:
+            raise RuntimeError(
+                "no world dynamics attached (launch the stack with a "
+                "scenarios.WorldDynamics to script crowds)")
+        self._world_dyn.set_crowd(cid, radius_m)
 
     # -- adversarial sensor-fault boundary (FaultPlan setters) ---------------
 
@@ -132,6 +169,14 @@ class SimNode(Node):
         """One physics+sensor tick (call directly for faster-than-realtime
         runs; the timer drives it in realtime mode)."""
         cfg = self.cfg
+        if self._world_dyn is not None:
+            # Scripted world mutations land BEFORE this step's physics
+            # and raycast (FaultPlan fires on the same step clock), so a
+            # door closed at step k is solid in step k's scans.
+            new_world = self._world_dyn.world_if_changed(self.n_steps)
+            if new_world is not None:
+                self.world = self._jnp.asarray(new_world)
+                self.n_world_updates += 1
         targets = self._jnp.asarray(self.driver.targets().astype(np.float32))
         self.sim_state, measured = self._thymio.step_fleet(
             cfg.robot, self.sim_state, targets, 1.0 / self.rate_hz)

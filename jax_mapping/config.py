@@ -493,6 +493,13 @@ class ResilienceConfig:
     # Supervisor auto-checkpoint cadence (steps); the resume source for
     # restart-from-checkpoint. 0 disables auto-checkpointing.
     checkpoint_every_steps: int = 50
+    # Checkpoint generations kept on disk (io/checkpoint.py): 2 is the
+    # historical current + `.prev` last-good pair; larger values retain
+    # that many total generations (the extras as numbered `.genNNNNNN`
+    # files, GC'd corruption-safely oldest-first) — the lifelong-session
+    # bound that keeps a day of rotation cadence from growing the
+    # checkpoint directory without limit.
+    checkpoint_retain_generations: int = 2
     # Mapper degraded-mode gate: windows whose fused-evidence agreement
     # falls below this are REJECTED (not installed) — a garbage burst
     # from a glitching sensor must not overwrite known-good map. The
@@ -606,6 +613,50 @@ class RecoveryConfig:
 
 
 @_frozen
+class DecayConfig:
+    """Map healing for dynamic worlds (scenarios/ subsystem).
+
+    Static-world fusion treats occupancy evidence as permanent: a door
+    mapped closed saturates at `logodds_max` and needs dozens of free
+    observations to flip once it opens — in a world that CHANGES (doors,
+    crowds, rearranged furniture) the map must *heal*, not just
+    accumulate (ROG-Map / Occupancy-SLAM's robustness-to-stale-evidence
+    argument, PAPERS.md). Two knobs implement it, both applied in ONE
+    periodic on-device pass over the shared grid
+    (`ops/grid.decay_grid`, driven by the mapper's tick clock):
+
+    * multiplicative log-odds decay toward unknown (`factor` every
+      `every_n_ticks` mapper ticks) — unobserved stale evidence fades;
+    * an evidence saturation cap (`evidence_cap`) — re-observation can
+      always flip a cell within a bounded number of contradicting
+      scans, because no cell ever gets more entrenched than the cap.
+
+    The decay pass rides the ordinary revision bookkeeping (one
+    `map_revision` bump + all tiles marked dirty), so serving deltas,
+    the incremental frontier pipeline and the matcher's pyramid caches
+    all see healed regions as ordinary revision advances.
+
+    `enabled=False` is EXACT pre-decay fusion: no pass ever runs, no
+    tick counter consulted, bit-identical output (the scenario bit-
+    exactness property test pins this).
+    """
+
+    enabled: bool = False
+    # Mapper ticks between decay passes (the deterministic step clock,
+    # like every scenario cadence — wall-clock decay would make healing
+    # host-speed-dependent in faster-than-realtime runs).
+    every_n_ticks: int = 20
+    # Multiplier applied to every cell's log-odds per pass (toward 0 =
+    # unknown). 1.0 disables fading but keeps the cap.
+    factor: float = 0.92
+    # |log-odds| clamp applied by the decay pass: bounds how entrenched
+    # any evidence can get while the world is allowed to change, so a
+    # re-observed contradiction (door opened, crowd moved on) flips the
+    # cell within ~cap/|logodds_free| scans.
+    evidence_cap: float = 2.0
+
+
+@_frozen
 class ServingConfig:
     """Tiled delta map distribution (serving/ subsystem).
 
@@ -689,6 +740,7 @@ class SlamConfig:
     resilience: ResilienceConfig = ResilienceConfig()
     recovery: RecoveryConfig = RecoveryConfig()
     serving: ServingConfig = ServingConfig()
+    decay: DecayConfig = DecayConfig()
     # slam_toolbox's operating mode (slam_config.yaml:20: "mapping" —
     # the file's comment offers localization as the alternative).
     # "localization" freezes the map: key scans MATCH against it for
@@ -725,6 +777,7 @@ class SlamConfig:
             resilience=ResilienceConfig(**raw.get("resilience", {})),
             recovery=RecoveryConfig(**raw.get("recovery", {})),
             serving=ServingConfig(**raw.get("serving", {})),
+            decay=DecayConfig(**raw.get("decay", {})),
             **{k: v for k, v in raw.items()
                if k in ("mode", "map_publish_period_s",
                         "tf_publish_period_s", "domain_id")},

@@ -46,6 +46,64 @@ def previous_checkpoint_path(path: str) -> str:
     return root + ".prev" + (ext or ".npz")
 
 
+def generation_paths(path: str) -> list:
+    """Numbered retained generations for checkpoint `path`, OLDEST
+    first (index order). Only exist when saves run with
+    `retain_generations` > 2 — the lifelong-session deep history behind
+    the current + `.prev` pair."""
+    import glob as _glob
+    import re
+    root, ext = os.path.splitext(path)
+    ext = ext or ".npz"
+    pat = re.compile(re.escape(root) + r"\.gen(\d{6})" + re.escape(ext)
+                     + r"$")
+    out = []
+    for p in sorted(_glob.glob(root + ".gen??????" + ext)):
+        m = pat.match(p)
+        if m:
+            out.append((int(m.group(1)), p))
+    return [p for _, p in sorted(out)]
+
+
+def _generation_path(path: str, idx: int) -> str:
+    root, ext = os.path.splitext(path)
+    return f"{root}.gen{idx:06d}" + (ext or ".npz")
+
+
+def _next_generation_path(path: str) -> str:
+    import re
+    gens = generation_paths(path)
+    if not gens:
+        return _generation_path(path, 0)
+    last = int(re.search(r"\.gen(\d{6})", gens[-1]).group(1))
+    return _generation_path(path, last + 1)
+
+
+def _gc_generations(path: str, retain_generations: int) -> None:
+    """Delete numbered generations beyond the retention budget,
+    corruption-safely: the budget counts current + `.prev` + numbered
+    files, and when BOTH rotation slots are rotten the newest intact
+    numbered generation is spared regardless of budget — GC must never
+    delete the only resume source a corrupted pair would fall back
+    to."""
+    gens = generation_paths(path)
+    budget = max(0, retain_generations - 2)
+    doomed = gens[:len(gens) - budget] if budget else list(gens)
+    if not doomed:
+        return
+    if not (_looks_intact(path)
+            or _looks_intact(previous_checkpoint_path(path))):
+        for g in reversed(gens):
+            if _looks_intact(g):
+                doomed = [d for d in doomed if d != g]
+                break
+    for d in doomed:
+        try:
+            os.unlink(d)
+        except OSError:
+            pass                         # a racing GC already took it
+
+
 def _path_str(path) -> str:
     parts = []
     for p in path:
@@ -70,13 +128,26 @@ def _leaf_crc(arr: np.ndarray) -> int:
 
 
 def save_checkpoint(path: str, state: Any,
-                    config_json: Optional[str] = None) -> None:
+                    config_json: Optional[str] = None,
+                    retain_generations: int = 2) -> None:
     """Write `state` (any pytree of arrays/scalars) to `path` atomically.
 
     Meta carries a per-leaf CRC32 (`load_checkpoint` verifies) and any
     existing file at `path` rotates to `previous_checkpoint_path(path)`
     first — corruption on load degrades to the previous generation
-    instead of losing the map."""
+    instead of losing the map.
+
+    `retain_generations` bounds the on-disk history for lifelong
+    sessions: 2 (default) is the historical current + `.prev` pair
+    exactly; K > 2 additionally rotates the outgoing `.prev` into a
+    numbered `.genNNNNNN` slot and GCs numbered generations oldest-
+    first so at most K generations remain — corruption-safely (the
+    newest intact generation is never deleted, and only structurally
+    intact files rotate; see `_gc_generations`)."""
+    if retain_generations < 2:
+        raise ValueError(
+            f"retain_generations={retain_generations} < 2: the current "
+            "+ .prev last-good pair is the corruption-fallback floor")
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state)
     arrays = {}
     keys = []
@@ -106,8 +177,16 @@ def save_checkpoint(path: str, state: Any,
         # check — zip directory + meta member, no full-array CRC; a
         # bit-rotted-but-well-formed file can still slip through, which
         # load's per-leaf CRC then catches at resume time.)
-        os.replace(path, previous_checkpoint_path(path))
+        prev = previous_checkpoint_path(path)
+        if retain_generations > 2 and os.path.exists(prev) \
+                and _looks_intact(prev):
+            # Deep retention: the outgoing last-good generation becomes
+            # a numbered slot instead of being overwritten.
+            os.replace(prev, _next_generation_path(path))
+        os.replace(path, prev)
     os.replace(tmp, path)                   # crash-safe swap
+    if retain_generations > 2:
+        _gc_generations(path, retain_generations)
 
 
 def _looks_intact(path: str) -> bool:
@@ -182,21 +261,27 @@ def load_checkpoint_with_fallback(path: str, like: Any
     """`load_checkpoint`, degrading to the rotated last-good generation.
 
     Returns (state, config_json, used_path). A corrupt or missing
-    `path` falls back to `previous_checkpoint_path(path)`; only when
-    BOTH generations fail does the error propagate (CheckpointCorrupt
-    for corruption, FileNotFoundError when neither file exists). THE
+    `path` falls back to `previous_checkpoint_path(path)`, then down
+    the numbered retained generations newest-first; only when EVERY
+    generation fails does the error propagate (the primary's error:
+    CheckpointCorrupt for corruption, FileNotFoundError when no file
+    exists). THE
     resume path for the supervisor's restart-from-checkpoint: a mapper
     crash right after a corrupted save must still resume from the
     previous map rather than restart blank."""
-    prev = previous_checkpoint_path(path)
-    try:
-        state, cfg_json = load_checkpoint(path, like)
-        return state, cfg_json, path
-    except (CheckpointCorrupt, FileNotFoundError):
-        if not os.path.exists(prev):
-            raise
-        state, cfg_json = load_checkpoint(prev, like)
-        return state, cfg_json, prev
+    candidates = [path, previous_checkpoint_path(path)]
+    # Deep retention (retain_generations > 2 saves): numbered
+    # generations extend the fallback chain, newest first.
+    candidates += list(reversed(generation_paths(path)))
+    first_err: Optional[Exception] = None
+    for p in candidates:
+        try:
+            state, cfg_json = load_checkpoint(p, like)
+            return state, cfg_json, p
+        except (CheckpointCorrupt, FileNotFoundError) as e:
+            if first_err is None:
+                first_err = e
+    raise first_err
 
 
 def voxel_sidecar_path(path: str) -> str:

@@ -440,6 +440,19 @@ def fuse_scans_window_checked(grid_cfg: GridConfig, scan_cfg: ScanConfig,
         (grid_arr, ranges_b, poses_b))
 
 
+@jax.jit
+def decay_grid(grid_arr: Array, factor: Array, cap: Array) -> Array:
+    """One map-healing pass for dynamic worlds (DecayConfig semantics):
+    every cell's log-odds shrinks toward 0 (unknown) by `factor` and is
+    clamped to ±`cap` — stale evidence fades, and no cell is ever so
+    entrenched that re-observation can't flip it within ~cap/|free|
+    contradicting scans. Both knobs traced (one compile regardless of
+    config values); the caller owns revision bookkeeping."""
+    f = jnp.float32(factor)
+    c = jnp.float32(cap)
+    return jnp.clip(grid_arr * f, -c, c)
+
+
 def merge_delta(grid_cfg: GridConfig, grid_arr: Array, delta_full: Array) -> Array:
     """Apply a full-size delta (e.g. the psum of a fleet's deltas)."""
     return jnp.clip(grid_arr + delta_full, grid_cfg.logodds_min,

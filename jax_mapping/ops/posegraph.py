@@ -120,6 +120,27 @@ def odometry_edge(g: PoseGraph, i: Array, j: Array,
     return add_edge(g, i, j, meas, w)
 
 
+def anchor_tip(g: PoseGraph, pose: Array, weight_t: float = 200.0,
+               weight_th: float = 400.0) -> PoseGraph:
+    """External-assertion anchor on the graph tip — the rendezvous-merge
+    alignment edge (scenarios/rendezvous.py): constrain the newest pose
+    toward an externally VERIFIED `pose` by re-measuring the
+    (tip-1 → tip) hop against it at loop-closure-grade weights (the
+    models/fleet cross-robot anchor idiom, factored into a reusable
+    op). `optimize` then pulls the tip onto the verified pose with the
+    rest of the chain following elastically. The weights clear the
+    `thin_keyframes` strong-edge threshold, so the anchor survives ring
+    thinning like any loop edge. Host-orchestrated cold path (concrete
+    index); no-op on graphs with < 2 poses — nothing to hang the edge
+    on."""
+    q = int(g.n_poses)
+    if q < 2:
+        return g
+    meas = pose_between(g.poses[q - 2], jnp.asarray(pose, jnp.float32))
+    w = jnp.array([weight_t, weight_t, weight_th], jnp.float32)
+    return add_edge(g, q - 2, q - 1, meas, w)
+
+
 # ---------------------------------------------------------------------------
 # Keyframe thinning: unbounded trajectories in a fixed-capacity ring
 # ---------------------------------------------------------------------------

@@ -64,6 +64,24 @@ robot), so same-seed chaos runs stay bit-identical.
                         hostile reflector).
     scan_jam            ranges frozen at the jam-onset reading, stamps
                         stay fresh — a wedged sensor that looks alive.
+
+WORLD kinds (ISSUE 8, scenario engine): the world ITSELF changes —
+nothing is faulty, but evidence the mapper fused honestly goes stale
+and must heal (DecayConfig semantics). Injected at the SimNode's world-
+dynamics boundary (`SimNode.set_door`/`set_crowd`, which delegate to a
+`scenarios.WorldDynamics` attached at launch); both compose by the same
+refcount/worst-of rules as every other windowed kind, and two same-seed
+runs mutate the world bit-identically.
+
+    door_close          fill door rectangle `name` (registered with the
+                        WorldDynamics) with wall for the window;
+                        overlapping windows on one door refcount — the
+                        first to clear must not re-open a door another
+                        window still holds shut.
+    crowd               a moving occupied blob (seeded deterministic
+                        orbit) of radius `value` metres; `robot` is the
+                        crowd id (its path seed). Overlapping windows
+                        on one crowd id run the WORST (largest) radius.
 """
 
 from __future__ import annotations
@@ -78,10 +96,14 @@ SENSOR_KINDS = frozenset({
     "wheel_slip", "lidar_miscal", "ghost_returns", "scan_jam",
 })
 
+#: Dynamic-world scenario kinds (SimNode world-dynamics boundary;
+#: the decaying mapper's healing path is their target).
+WORLD_KINDS = frozenset({"door_close", "crowd"})
+
 KINDS = frozenset({
     "lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
     "kill_node", "kill_robot", "rejoin_robot", "corrupt_checkpoint",
-}) | SENSOR_KINDS
+}) | SENSOR_KINDS | WORLD_KINDS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,6 +140,15 @@ class FaultEvent:
                 f"{self.kind} needs a nonzero value (the angular offset "
                 "in rad / the ghosted beam fraction) — 0.0 injects "
                 "nothing")
+        if self.kind == "door_close" and not self.name:
+            raise ValueError(
+                "door_close needs name = a door registered with the "
+                "stack's WorldDynamics (an unnamed close is a no-op a "
+                "scenario would silently 'pass' with)")
+        if self.kind == "crowd" and self.value <= 0.0:
+            raise ValueError(
+                "crowd needs value > 0: the blob radius in metres "
+                "(0.0 stamps nothing)")
 
 
 class FaultPlan:
@@ -156,6 +187,12 @@ class FaultPlan:
         #: running the WORST active value, the identity baseline returns
         #: when the last window clears.
         self._sensor: Dict[tuple, list] = {}
+        #: door name -> held-closure refcount (the partition pattern:
+        #: last window out re-opens the door).
+        self._door_refs: Dict[str, int] = {}
+        #: crowd id -> active radii (the sensor pattern: the sim runs
+        #: the WORST = largest active blob, gone when none remain).
+        self._crowd: Dict[int, list] = {}
 
     # -- boundary helpers ----------------------------------------------------
 
@@ -230,9 +267,48 @@ class FaultPlan:
         elif kind == "scan_jam":
             sim.set_scan_jam(robot, bool(active))
 
+    # -- world-kind holds (scenarios/dynamics.py boundary) -------------------
+
+    def _hold_door(self, sim, name: str) -> None:
+        self._door_refs[name] = self._door_refs.get(name, 0) + 1
+        sim.set_door(name, True)
+
+    def _release_door(self, sim, name: str) -> None:
+        n = self._door_refs.get(name, 1) - 1
+        self._door_refs[name] = max(0, n)
+        if n <= 0:
+            sim.set_door(name, False)        # last window out re-opens
+
+    def _apply_crowd(self, sim, cid: int,
+                     radius: Optional[float]) -> None:
+        """Add (radius) or remove (None; caller popped the list) one
+        active crowd window for `cid`; the sim runs the WORST (largest)
+        active blob, none when the last window clears."""
+        active = self._crowd.setdefault(cid, [])
+        if radius is not None:
+            active.append(radius)
+        sim.set_crowd(cid, max(active) if active else None)
+
     def _fire(self, stack, ev: FaultEvent, step: int) -> None:
         bus = stack.bus
-        if ev.kind in SENSOR_KINDS:
+        if ev.kind == "door_close":
+            self._hold_door(stack.sim, ev.name)
+            self._note(step, f"door_close {ev.name}")
+            if ev.duration:
+                def _reopen(name=ev.name):
+                    self._release_door(stack.sim, name)
+                self._clears.append((step + ev.duration, _reopen,
+                                     f"door_close {ev.name}"))
+        elif ev.kind == "crowd":
+            self._apply_crowd(stack.sim, ev.robot, ev.value)
+            self._note(step, f"crowd {ev.robot} r={ev.value}m")
+            if ev.duration:
+                def _clear_crowd(cid=ev.robot, value=ev.value):
+                    self._crowd[cid].remove(value)
+                    self._apply_crowd(stack.sim, cid, None)
+                self._clears.append((step + ev.duration, _clear_crowd,
+                                     f"crowd {ev.robot}"))
+        elif ev.kind in SENSOR_KINDS:
             self._apply_sensor(stack, ev.kind, ev.robot, ev.value)
             self._note(step, f"{ev.kind} robot{ev.robot}={ev.value}")
             if ev.duration:
@@ -320,7 +396,7 @@ class FaultPlan:
         return [f"step {s}: {d}" for s, d in self.log]
 
 
-def _fault_resource(kind: str, robot: int) -> tuple:
+def _fault_resource(kind: str, robot: int, name: str = "") -> tuple:
     """The resource a fault window occupies, for overlap rejection:
     two windows on one resource would need refcount composition at
     APPLY time (hand-written plans may still do that deliberately);
@@ -333,13 +409,17 @@ def _fault_resource(kind: str, robot: int) -> tuple:
         return ("odom", robot)
     if kind == "driver_offline":
         return ("driver",)
+    if kind == "door_close":
+        return ("door", name)
+    if kind == "crowd":
+        return ("crowd", robot)          # robot field = crowd id
     return ("bus", kind)                 # bus_drop / bus_reorder
 
 
 def _sample_value(rng: random.Random, kind: str) -> float:
     """Kind-appropriate magnitudes: bus weather as before; wheel slip a
     1.15-1.5x odometry bias; miscal 0.05-0.3 rad (sign sampled);
-    ghosts on 10-40% of beams."""
+    ghosts on 10-40% of beams; crowd blobs 0.15-0.4 m radius."""
     if kind.startswith("bus_"):
         return round(rng.uniform(0.2, 0.7), 3)
     if kind == "wheel_slip":
@@ -348,11 +428,14 @@ def _sample_value(rng: random.Random, kind: str) -> float:
         return round(rng.choice((-1.0, 1.0)) * rng.uniform(0.05, 0.3), 3)
     if kind == "ghost_returns":
         return round(rng.uniform(0.1, 0.4), 3)
+    if kind == "crowd":
+        return round(rng.uniform(0.15, 0.4), 3)
     return 0.0
 
 
 def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
-                n_robots: int = 1) -> FaultPlan:
+                n_robots: int = 1, door_names=(),
+                n_crowds: int = 0) -> FaultPlan:
     """Generate a reproducible schedule: `seed` fully determines the
     fault mix, placement, and durations (fuzz-style soak variety with
     CI-replayable failures). Samples the adversarial sensor kinds
@@ -361,10 +444,22 @@ def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
     bounded) — generated chaos keeps each fault's effect attributable.
     Short missions can saturate every resource before n_faults place;
     the dropped count is exposed as `plan.generation_shortfall`, never
-    silently swallowed."""
+    silently swallowed.
+
+    Dynamic-world kinds join the pool only when the stack can run them:
+    `door_names` (the doors registered with its WorldDynamics) admits
+    `door_close` windows (one door = one resource), `n_crowds` > 0
+    admits `crowd` windows with kind-appropriate blob radii (one crowd
+    id = one resource). Default arguments reproduce the pre-scenario
+    sampler bit-for-bit."""
     rng = random.Random(seed)
     kinds = ["lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
              "wheel_slip", "lidar_miscal", "ghost_returns", "scan_jam"]
+    door_names = list(door_names)
+    if door_names:
+        kinds.append("door_close")
+    if n_crowds > 0:
+        kinds.append("crowd")
     events: List[FaultEvent] = []
     occupied: List[tuple] = []           # (resource, start, end)
     shortfall = 0
@@ -373,8 +468,10 @@ def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
             kind = rng.choice(kinds)
             step = rng.randrange(1, max(2, mission_steps - 10))
             duration = rng.randrange(3, 12)
-            robot = rng.randrange(n_robots)
-            res = _fault_resource(kind, robot)
+            robot = rng.randrange(n_crowds) if kind == "crowd" \
+                else rng.randrange(n_robots)
+            name = rng.choice(door_names) if kind == "door_close" else ""
+            res = _fault_resource(kind, robot, name)
             end = step + duration
             if any(r == res and step <= e and s <= end
                    for r, s, e in occupied):
@@ -382,7 +479,7 @@ def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
             occupied.append((res, step, end))
             events.append(FaultEvent(
                 step=step, kind=kind, robot=robot, duration=duration,
-                value=_sample_value(rng, kind)))
+                value=_sample_value(rng, kind), name=name))
             break
         else:
             shortfall += 1               # every resource window taken

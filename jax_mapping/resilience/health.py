@@ -127,6 +127,26 @@ class FleetHealth:
         with self._lock:
             self._estimator_diverged[robot] = diverged
 
+    def absorb(self, other: "FleetHealth") -> None:
+        """Rendezvous merge (scenarios/rendezvous.py): fold another
+        fleet's registry into this one — joined robot i becomes robot
+        `n_robots + i`, entering at its current ladder state with fresh
+        scan grace on THIS fleet's clock (its old fleet's tick base is
+        meaningless here). Reads `other` through its public snapshot
+        BEFORE taking our lock — FleetHealth is a leaf; two leaf locks
+        must never nest."""
+        states = other.robot_states()
+        snap = other.snapshot()
+        with self._lock:
+            base = self.n_robots
+            self.n_robots += len(states)
+            self._last_scan_tick += [self._tick] * len(states)
+            self._robot_state += states
+            self._estimator_diverged += list(snap["estimator_diverged"])
+            for i, s in enumerate(states):
+                self.transitions.append(
+                    (self._tick, f"robot{base + i}", "absorbed", s))
+
     def note_driver(self, state: str) -> None:
         assert state in (DRIVER_OK, DRIVER_OFFLINE, DRIVER_RECOVERING)
         with self._lock:

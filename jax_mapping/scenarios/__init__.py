@@ -1,0 +1,58 @@
+"""Scenario engine: dynamic worlds, rendezvous fleet merges, lifelong
+missions (ISSUE 8 / ROADMAP item 5).
+
+The resilience/recovery stack made the system fault-tolerant; this
+package makes it WORLD-tolerant. Scenarios are scripted the same way
+faults are — seeded, windowed, refcount-composed FaultPlan events —
+so a dynamic world is just more chaos on the same deterministic step
+clock:
+
+* `dynamics.WorldDynamics` — scripted mutable ground truth (doors that
+  open/close, seeded moving crowd blobs), injected through the
+  `door_close`/`crowd` FaultPlan kinds at the SimNode boundary; the
+  decaying mapper (DecayConfig) heals the stale evidence they leave.
+* `rendezvous.RendezvousMerger` — two independently-seeded fleets with
+  unknown relative origin detect map overlap via the wide-window
+  cross-fleet sweep, verify the implied rigid transform by streak, and
+  merge grids + pose graphs into one shared world.
+* `lifelong` — deterministic day-long soak driving: door cycles, crowd
+  churn, supervisor mapper restarts with bounded checkpoint retention,
+  one MissionReport to assert on.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from jax_mapping.config import SlamConfig
+from jax_mapping.scenarios.dynamics import (                  # noqa: F401
+    DoorSpec, WorldDynamics, doors_from_dicts,
+)
+from jax_mapping.scenarios.lifelong import (                  # noqa: F401
+    MissionReport, day_plan, run_lifelong_mission,
+)
+from jax_mapping.scenarios.rendezvous import (                # noqa: F401
+    RendezvousMerger, merge_fleets, merged_frontier_assignment,
+    se2_apply, se2_from_pair, transform_state,
+)
+
+
+def launch_scenario_stack(cfg: SlamConfig, world: np.ndarray,
+                          doors=(), world_res_m: Optional[float] = None,
+                          seed: int = 0, **launch_kwargs):
+    """`launch_sim_stack` with the world made scriptable: builds the
+    stack, then arms a `WorldDynamics` over the SAME world bitmap with
+    the given door registry (dicts from the world generators or
+    DoorSpecs) and the launch seed (crowd paths derive from it). With
+    no events ever fired the composed world equals the base world —
+    the scenario wiring is bit-inert (the scenario bit-exactness
+    property test pins this)."""
+    from jax_mapping.bridge.launch import launch_sim_stack
+    st = launch_sim_stack(cfg, world, world_res_m=world_res_m,
+                          seed=seed, **launch_kwargs)
+    dyn = WorldDynamics(world, st.sim.world_res_m,
+                        doors=doors_from_dicts(doors), seed=seed)
+    st.sim.attach_world_dynamics(dyn)
+    return st

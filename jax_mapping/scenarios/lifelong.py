@@ -1,0 +1,126 @@
+"""Lifelong missions: sim-accelerated day-long soaks under continuous
+chaos.
+
+A lifelong session is not a longer mission — it is a mission where
+EVERYTHING cycles: doors open and close (`door_close` windows), crowds
+pass through (`crowd` windows), the mapper dies and resumes from
+checkpoint (supervisor restarts, bounded generation retention), and the
+map must keep healing (DecayConfig) instead of fossilizing its first
+hour. This module is the deterministic driver for such sessions: one
+seeded scenario+chaos schedule (`day_plan`), one launch wrapper that
+arms the world dynamics (`launch_scenario_stack`, in the package init),
+and one mission runner returning the artifacts soak gates assert on
+(`run_lifelong_mission`). Two same-seed missions are bit-identical —
+the FaultPlan determinism contract extended to the world itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from jax_mapping.config import SlamConfig
+from jax_mapping.resilience.faultplan import FaultEvent, FaultPlan
+
+
+def day_plan(mission_steps: int, door_names: Sequence[str],
+             n_crowds: int = 1, door_cycle: int = 60,
+             crowd_cycle: int = 90, kill_steps: Sequence[int] = (),
+             start: int = 10) -> List[FaultEvent]:
+    """A deterministic 'day': every door cycles closed/open on a
+    staggered `door_cycle` cadence, crowds churn through on
+    `crowd_cycle`, and the mapper is killed at each of `kill_steps`
+    (the supervisor restarts it from checkpoint). Pure scheduling —
+    no RNG; the FaultPlan seed only matters if callers append
+    random_plan events on top."""
+    events: List[FaultEvent] = []
+    for k, name in enumerate(door_names):
+        first = start + k * (door_cycle // max(1, len(door_names)))
+        for t in range(first, max(first + 1, mission_steps - 5),
+                       door_cycle):
+            events.append(FaultEvent(step=t, kind="door_close",
+                                     name=name,
+                                     duration=door_cycle // 2))
+    for c in range(n_crowds):
+        first = start + 15 + c * (crowd_cycle // max(1, n_crowds))
+        for t in range(first, max(first + 1, mission_steps - 5),
+                       crowd_cycle):
+            events.append(FaultEvent(step=t, kind="crowd", robot=c,
+                                     duration=crowd_cycle // 3,
+                                     value=0.25))
+    for t in kill_steps:
+        events.append(FaultEvent(step=int(t), kind="kill_node",
+                                 name="jax_mapper"))
+    return events
+
+
+@dataclasses.dataclass
+class MissionReport:
+    """What a soak gate asserts on — everything host-side numpy."""
+
+    grid: np.ndarray                 # final shared log-odds map
+    plan_log: List[tuple]            # the FaultPlan's (step, desc) log
+    n_mapper_restarts: int
+    n_scans_fused: int
+    n_decay_passes: int
+    n_world_updates: int
+    map_revision: int
+    restart_epoch: int
+    checkpoint_files: List[str]      # basenames in the checkpoint dir
+    health_transitions: List[tuple]
+
+    def known_cells(self, thresh: float = 0.5) -> int:
+        return int((np.abs(self.grid) > thresh).sum())
+
+
+def run_lifelong_mission(cfg: SlamConfig, world: np.ndarray, doors,
+                         events: Sequence[FaultEvent], steps: int,
+                         seed: int, checkpoint_dir: Optional[str],
+                         n_robots: int = 2) -> MissionReport:
+    """Drive one deterministic lifelong mission end-to-end and report.
+
+    Boots the scenario stack (world dynamics armed, supervisor +
+    checkpoint cadence when `checkpoint_dir` is given), attaches the
+    schedule as ONE FaultPlan (world kinds and process chaos are the
+    same mechanism), runs `steps`, and collects the assertion surface.
+    Determinism anchor: same (cfg, world, doors, events, seed, steps)
+    → bit-identical report.grid and plan_log."""
+    from jax_mapping.scenarios import launch_scenario_stack
+    st = launch_scenario_stack(cfg, world, doors=doors,
+                               n_robots=n_robots, realtime=False,
+                               seed=seed, checkpoint_dir=checkpoint_dir)
+    try:
+        st.brain.start_exploring()
+        st.brain.reconnect_period_s = 0.0
+        plan = FaultPlan(list(events), seed=seed)
+        st.attach_fault_plan(plan)
+        st.run_steps(steps)
+        # Revision BEFORE content (the C1 ordering doctrine): a stamp
+        # read after the grid could pair new content with an older
+        # revision's successor and misreport the mission's final state.
+        final_revision = st.mapper.map_revision
+        grid = np.array(np.asarray(st.mapper.merged_grid()), copy=True)
+        files = []
+        if checkpoint_dir:
+            files = sorted(os.path.basename(p) for p in
+                           glob.glob(os.path.join(checkpoint_dir, "*")))
+        return MissionReport(
+            grid=grid,
+            plan_log=list(plan.log),
+            n_mapper_restarts=(st.supervisor.n_restarts("jax_mapper")
+                               if st.supervisor is not None else 0),
+            n_scans_fused=st.mapper.n_scans_fused,
+            n_decay_passes=st.mapper.n_decay_passes,
+            n_world_updates=st.sim.n_world_updates,
+            map_revision=final_revision,
+            restart_epoch=st.mapper.restart_epoch,
+            checkpoint_files=files,
+            health_transitions=(list(st.health.transitions)
+                                if st.health is not None else []),
+        )
+    finally:
+        st.shutdown()

@@ -1,0 +1,289 @@
+"""Rendezvous fleet merges: two worlds become one.
+
+Two independently-launched fleets share no map, no pose frame, and no
+knowledge of their relative origin — the deployment reality ISSUE 8
+names (fleets start separately and must merge). This module detects
+inter-fleet overlap and merges the fleets' worlds:
+
+1. **Detection** — fleet B's freshest key scan is matched against fleet
+   A's live shared grid through the same wide-window machinery loop
+   closure and the relocalizer use (`relocalize_match`), seeded at
+   fleet A's robot and graph poses (the cross-robot sweep idiom: the
+   true pose, if the fleets overlap at all, lies in A's explored
+   region). One accepted match is a basin, not an anchor — corridor
+   aliases look legitimate — so acceptance is STREAK-verified: the
+   implied inter-fleet transform must agree across
+   `consecutive` consecutive attempts within the consistency radii
+   (the Relocalizer's verification doctrine applied to a TRANSFORM
+   instead of a pose).
+
+2. **Alignment** — the verified match fixes the rigid SE(2) transform
+   T with `T ⊕ pose_B = pose_A`; every B state (current pose, key
+   chain, graph poses) maps through T.
+
+3. **Merge** — one re-fusion at aligned poses: B's key-scan rings fuse
+   into A's live grid at their transformed graph poses
+   (`ops/grid.fuse_scans_masked`, the closure-repair idiom), the
+   matched robot's graph gets an `ops/posegraph.anchor_tip` edge at the
+   verified pose + one optimize pass, and the merged state list spans
+   both fleets aliasing ONE shared grid — frontier assignment and
+   FleetHealth (`absorb`) take the joined robots from there.
+
+Host-orchestrated cold path, deterministic: no RNG anywhere, so two
+same-seed missions merge at the same step with the same transform.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from jax_mapping.config import SlamConfig
+from jax_mapping.ops import frontier as F
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import posegraph as PG
+from jax_mapping.recovery.relocalize import relocalize_match
+from jax_mapping.utils import global_metrics as GM
+
+
+# ---------------------------------------------------------------------------
+# Host-side SE(2) (the merge is a cold path; numpy keeps it debuggable)
+# ---------------------------------------------------------------------------
+
+def _wrap(a):
+    return (a + math.pi) % (2.0 * math.pi) - math.pi
+
+
+def se2_apply(T: np.ndarray, poses: np.ndarray) -> np.ndarray:
+    """Apply transform T (3,) to poses (..., 3): frame-B coordinates
+    become frame-A coordinates."""
+    T = np.asarray(T, np.float32)
+    p = np.asarray(poses, np.float32)
+    c, s = math.cos(float(T[2])), math.sin(float(T[2]))
+    out = np.empty_like(p)
+    out[..., 0] = T[0] + c * p[..., 0] - s * p[..., 1]
+    out[..., 1] = T[1] + s * p[..., 0] + c * p[..., 1]
+    out[..., 2] = _wrap(p[..., 2] + T[2])
+    return out
+
+
+def se2_from_pair(pose_a: np.ndarray, pose_b: np.ndarray) -> np.ndarray:
+    """The rigid T with `se2_apply(T, pose_b) == pose_a` — the
+    inter-fleet transform implied by one verified match (pose_a = the
+    matched pose in A's frame, pose_b = the same physical pose in B's
+    belief frame)."""
+    a = np.asarray(pose_a, np.float64)
+    b = np.asarray(pose_b, np.float64)
+    th = _wrap(float(a[2]) - float(b[2]))
+    c, s = math.cos(th), math.sin(th)
+    tx = float(a[0]) - (c * float(b[0]) - s * float(b[1]))
+    ty = float(a[1]) - (s * float(b[0]) + c * float(b[1]))
+    return np.asarray([tx, ty, th], np.float32)
+
+
+def transform_state(st, T: np.ndarray):
+    """One SlamState expressed in frame A: current pose, key anchor and
+    the whole graph map through T. The grid field is untouched — the
+    caller re-fuses into the merged grid (aliasing is the mapper's
+    job)."""
+    import jax.numpy as jnp
+    pose = jnp.asarray(se2_apply(T, np.asarray(st.pose, np.float32)))
+    lkp = jnp.asarray(se2_apply(T, np.asarray(st.last_key_pose,
+                                              np.float32)))
+    gposes = jnp.asarray(se2_apply(T, np.asarray(st.graph.poses,
+                                                 np.float32)))
+    return st._replace(pose=pose, last_key_pose=lkp,
+                       graph=st.graph._replace(poses=gposes))
+
+
+def merge_fleets(cfg: SlamConfig, states_a: Sequence, states_b: Sequence,
+                 T: np.ndarray,
+                 anchor: Optional[Tuple[int, np.ndarray]] = None):
+    """One shared world from two fleets: returns (merged_grid,
+    merged_states) with B's states transformed by T, the matched B
+    robot's graph anchored (+optimized) at the verified pose, and B's
+    key-scan rings re-fused into A's live grid at the aligned poses.
+    Every returned state aliases the merged grid — the shared-map
+    contract a post-merge mapper expects."""
+    moved = [transform_state(st, T) for st in states_b]
+    if anchor is not None:
+        j, verified_pose = anchor
+        g2 = PG.anchor_tip(moved[j].graph, verified_pose)
+        g2 = PG.optimize(cfg.loop, g2)
+        moved[j] = moved[j]._replace(graph=g2)
+    cap = cfg.loop.max_poses
+    grid = states_a[0].grid
+    for st in moved:
+        grid = G.fuse_scans_masked(
+            cfg.grid, cfg.scan, grid, st.scan_ring,
+            st.graph.poses[:cap], st.graph.pose_valid[:cap])
+    merged = [st._replace(grid=grid) for st in list(states_a) + moved]
+    return grid, merged
+
+
+def merged_frontier_assignment(cfg: SlamConfig, grid, states):
+    """Frontier auction over the MERGED fleet: one compute_frontiers
+    on the shared grid with every robot's pose — the joined robots
+    compete for frontiers like they always belonged."""
+    import jax.numpy as jnp
+    poses = jnp.stack([st.pose for st in states])
+    return F.compute_frontiers(cfg.frontier, cfg.grid, grid, poses)
+
+
+# ---------------------------------------------------------------------------
+# Detection: the cross-fleet overlap watcher
+# ---------------------------------------------------------------------------
+
+class RendezvousMerger:
+    """Watches two live mappers for inter-fleet overlap; merges on a
+    verified streak.
+
+    Call `poll()` from the thread driving both stacks (the deterministic
+    `run_steps` driver — the same clocking contract as FaultPlan): each
+    poll costs one wide-match sweep; `every_n_polls` thins that to a
+    cadence. After `merged` flips True, `merged_grid`/`merged_states`/
+    `transform` hold the shared world. `_lock` guards the streak and
+    the published result for HTTP-style readers; the sweep itself runs
+    outside it (no device work under a lock)."""
+
+    def __init__(self, cfg: SlamConfig, mapper_a, mapper_b,
+                 min_response: float = 0.35, consecutive: int = 2,
+                 consistency_m: float = 0.3,
+                 consistency_rad: float = 0.3, max_seeds: int = 8,
+                 min_keyscans: int = 3):
+        self.cfg = cfg
+        self.mapper_a = mapper_a
+        self.mapper_b = mapper_b
+        self.min_response = min_response
+        self.consecutive = consecutive
+        self.consistency_m = consistency_m
+        self.consistency_rad = consistency_rad
+        self.max_seeds = max_seeds
+        self.min_keyscans = min_keyscans
+        self._lock = threading.Lock()
+        #: Verified-streak of implied transforms (3,) float32.
+        self._streak: List[np.ndarray] = []
+        self.transform: Optional[np.ndarray] = None
+        self.merged_grid = None
+        self.merged_states: Optional[List] = None
+        self.n_attempts = 0
+        self.n_accepted = 0
+        self.merged = False
+
+    # -- sweep ingredients ---------------------------------------------------
+
+    def _seeds(self) -> np.ndarray:
+        """Candidate poses in A's frame the wide match sweeps from:
+        every A robot's live pose plus an even subsample of A's valid
+        graph poses (the explored region's skeleton), capped at
+        `max_seeds`."""
+        seeds = [np.asarray(st.pose, np.float32)
+                 for st in self.mapper_a.states]
+        for i in range(self.mapper_a.n_robots):
+            _gen, poses, valid, n, _k = self.mapper_a.graph_snapshot(i)
+            idx = np.nonzero(valid[:n])[0]
+            if len(idx):
+                take = max(1, len(idx) // max(1, self.max_seeds))
+                seeds.extend(poses[idx[::take]])
+        seeds = np.asarray(seeds, np.float32).reshape(-1, 3)
+        return seeds[:self.max_seeds]
+
+    def _probe(self):
+        """Fleet B's freshest verified key (scan, pose-in-B) pair, or
+        None before enough chain exists: the ring slot AT the graph tip
+        — the scan was recorded at exactly that pose."""
+        best = None
+        for j in range(self.mapper_b.n_robots):
+            st = self.mapper_b.states[j]
+            n = int(st.graph.n_poses)
+            if n >= self.min_keyscans and (best is None or n > best[0]):
+                best = (n, j, st)
+        if best is None:
+            return None
+        n, j, st = best
+        ranges = np.asarray(st.scan_ring[n - 1], np.float32)
+        pose_b = np.asarray(st.graph.poses[n - 1], np.float32)
+        if not ranges.any():
+            return None                  # empty ring slot (padding)
+        return j, ranges, pose_b
+
+    # -- the per-cadence attempt --------------------------------------------
+
+    def poll(self) -> bool:
+        """One overlap attempt; returns the merged flag. Idempotent
+        after the merge (the shared world is built once)."""
+        if self.merged:
+            return True
+        probe = self._probe()
+        if probe is None:
+            return False
+        j, ranges, pose_b = probe
+        import jax.numpy as jnp
+        grid_a = self.mapper_a.merged_grid()
+        ranges_j = jnp.asarray(ranges)
+        best_pose, best_resp = None, -1.0
+        with GM.stages.stage("rendezvous.sweep"):
+            for seed in self._seeds():
+                res = relocalize_match(self.cfg, grid_a, ranges_j,
+                                       jnp.asarray(seed))
+                if bool(res.accepted):
+                    r = float(res.response)
+                    if r > best_resp:
+                        best_resp = r
+                        best_pose = np.asarray(res.pose, np.float32)
+        GM.counters.inc("rendezvous.attempts")
+        if best_pose is None or best_resp < self.min_response:
+            with self._lock:
+                self.n_attempts += 1
+                self._streak.clear()
+            return False
+        T = se2_from_pair(best_pose, pose_b)
+        with self._lock:
+            self.n_attempts += 1
+            self.n_accepted += 1
+            if self._streak:
+                t0 = self._streak[0]
+                if (math.hypot(float(T[0] - t0[0]), float(T[1] - t0[1]))
+                        > self.consistency_m
+                        or abs(_wrap(float(T[2] - t0[2])))
+                        > self.consistency_rad):
+                    # Different basin than the streak head: restart the
+                    # streak from THIS candidate (the Relocalizer rule).
+                    self._streak.clear()
+            self._streak.append(T)
+            if len(self._streak) < self.consecutive:
+                return False
+            verified = self._streak[-1]
+            self._streak.clear()
+        self._finish_merge(j, verified,
+                           np.asarray(best_pose, np.float32))
+        return True
+
+    def _finish_merge(self, j: int, T: np.ndarray,
+                      verified_pose: np.ndarray) -> None:
+        """Build the shared world (outside `_lock`: fusion is device
+        work) and publish it atomically."""
+        grid, states = merge_fleets(
+            self.cfg, list(self.mapper_a.states),
+            list(self.mapper_b.states), T, anchor=(j, verified_pose))
+        with self._lock:
+            self.transform = T
+            self.merged_grid = grid
+            self.merged_states = states
+            self.merged = True
+        GM.counters.inc("rendezvous.merges")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "merged": self.merged,
+                "n_attempts": self.n_attempts,
+                "n_accepted": self.n_accepted,
+                "streak": len(self._streak),
+                "transform": (None if self.transform is None
+                              else [round(float(v), 4)
+                                    for v in self.transform]),
+            }

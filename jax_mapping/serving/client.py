@@ -38,9 +38,15 @@ class DeltaMapClient:
         #: level -> (size, size) uint8 mosaic (unknown-127 before the
         #: first covering tile arrives; the first poll covers all).
         self.mosaics: Dict[int, np.ndarray] = {}
+        #: Server restart epoch (None until the first response): a
+        #: supervisor restart-resume legitimately re-serves an OLDER
+        #: revision under a bumped epoch — the client drops its cache
+        #: and resyncs full instead of raising RevisionRegression.
+        self.epoch: Optional[int] = None
         self.n_polls = 0
         self.n_not_modified = 0
         self.n_tiles_applied = 0
+        self.n_epoch_resyncs = 0
         self.bytes_received = 0
         self.snapshot_bytes = 0       # first (full) poll's body size
         self._etag: Optional[str] = None
@@ -78,11 +84,38 @@ class DeltaMapClient:
         self.bytes_received += len(raw)
         if first:
             self.snapshot_bytes = len(raw)
+        if self._note_epoch(body):
+            # Restart epoch advanced: this body is a delta against a
+            # serving generation we no longer share. Cache dropped;
+            # refetch the full snapshot under the new epoch (the reset
+            # put since back to -1, so this recursion terminates).
+            return self.poll(level)
         self.apply(body)
         return body
 
+    def _note_epoch(self, body: dict) -> bool:
+        """Track the server's restart epoch; on an advance, drop every
+        cached artifact (mosaics, revision, ETag) and report True —
+        the caller must resync full. The mapper restart-resume case:
+        revision regression under a NEW epoch is protocol-legal."""
+        ep = int(body.get("epoch", 0))
+        if self.epoch is None:
+            self.epoch = ep
+            return False
+        if ep == self.epoch:
+            return False
+        self.epoch = ep
+        self.revision = -1
+        self.mosaics = {}
+        self.meta = {}
+        self._etag = None
+        self.n_epoch_resyncs += 1
+        return True
+
     def apply(self, body: dict) -> None:
-        """Apply one /tiles response; raises on any staleness."""
+        """Apply one /tiles response; raises on any staleness. Epoch
+        handling lives in poll(); direct apply() callers are expected
+        to feed one epoch's bodies."""
         rev = int(body["revision"])
         if rev < self.revision:
             raise RevisionRegression(

@@ -218,10 +218,17 @@ class MapServing:
     OUTSIDE the mapper's state lock) and the channel fans it out to
     every `/map-events` client queue."""
 
-    def __init__(self, cfg: ServingConfig, mapper=None, voxel_mapper=None):
+    def __init__(self, cfg: ServingConfig, mapper=None, voxel_mapper=None,
+                 events=None):
         from jax_mapping.serving.events import EventChannel
         self.cfg = cfg
-        self.events = EventChannel(cfg.event_queue_depth)
+        #: `events` carry-over: a mapper restart rebuilds this bundle
+        #: around the new node (http_api.rebind_mapper) but must keep
+        #: the live EventChannel — connected /map-events clients ride
+        #: across the restart and simply see the resumed revisions.
+        self.events = events if events is not None \
+            else EventChannel(cfg.event_queue_depth)
+        self.mapper = mapper
         self.map_store: Optional[TileStore] = None
         self.voxel_store: Optional[TileStore] = None
         if mapper is not None:
@@ -270,6 +277,17 @@ class MapServing:
         every mapper lock (the lint B2 contract); fans a small event to
         the bounded per-client queues."""
         self.events.emit({"map": "grid", "revision": int(rev)})
+
+    def epoch(self, source: str) -> int:
+        """The serving restart epoch stamped into /tiles responses: the
+        grid surface follows the mapper's `restart_epoch` (bumped by
+        the supervisor's restarter on the replacement node); surfaces
+        without restart machinery stay at 0. Clients treat an epoch
+        advance as 'drop cache, resync full' — the legitimate way a
+        resumed mapper re-serves an older revision."""
+        if source == "grid" and self.mapper is not None:
+            return int(getattr(self.mapper, "restart_epoch", 0))
+        return 0
 
     def store(self, source: str) -> Optional[TileStore]:
         return self.map_store if source == "grid" else \

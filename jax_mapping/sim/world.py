@@ -53,19 +53,79 @@ def plank_course(size_cells: int, resolution_m: float, n_planks: int = 12,
     return w
 
 
-def rooms_world(size_cells: int, resolution_m: float,
-                seed: int = 1) -> np.ndarray:
-    """Arena split into rooms with door gaps — loop-closure friendly."""
+def arena_with_door(size_cells: int, resolution_m: float,
+                    wall_frac: float = 0.62,
+                    door_m: float = 0.5) -> tuple:
+    """Arena split by one vertical wall with a centred door gap; the
+    scripted-scenario workhorse (scenarios/dynamics.py): the door sits
+    in direct line of sight of centre-spawned robots, so a closed →
+    mapped → re-opened cycle is re-observed without luck.
+
+    Returns (world, doors): `world` has the door OPEN (a gap in the
+    wall); each door is a dict {name, r0, r1, c0, c1} naming the
+    half-open cell rectangle a `door_close` scenario event fills with
+    wall. The dict form keeps this module scenario-agnostic —
+    `scenarios.dynamics.DoorSpec` consumes it."""
+    w = empty_arena(size_cells, resolution_m)
+    res = resolution_m
+    door = max(3, int(door_m / res))
+    thick = 2
+    col = int(size_cells * wall_frac)
+    w[:, col:col + thick] = True
+    r0 = size_cells // 2 - door // 2
+    w[r0:r0 + door, col:col + thick] = False
+    doors = [{"name": "door0", "r0": r0, "r1": r0 + door,
+              "c0": col, "c1": col + thick}]
+    return w, doors
+
+
+def rooms_with_doors(size_cells: int, resolution_m: float,
+                     seed: int = 1) -> tuple:
+    """`rooms_world` that also REPORTS its door gaps: returns
+    (world, doors) with one named rectangle per gap (dict form, see
+    `arena_with_door`) so a scenario script can close and re-open the
+    exact doors the generator carved."""
     rng = np.random.default_rng(seed)
     w = empty_arena(size_cells, resolution_m)
     res = resolution_m
     door = max(3, int(0.5 / res))
-    for frac in (0.33, 0.66):
+    doors = []
+    for k, frac in enumerate((0.33, 0.66)):
         pos = int(size_cells * frac)
-        gap = rng.integers(door, size_cells - 2 * door)
+        gap = int(rng.integers(door, size_cells - 2 * door))
         w[pos:pos + 2, :] = True
         w[pos:pos + 2, gap:gap + door] = False
-        gap = rng.integers(door, size_cells - 2 * door)
+        doors.append({"name": f"door_h{k}", "r0": pos, "r1": pos + 2,
+                      "c0": gap, "c1": gap + door})
+        gap = int(rng.integers(door, size_cells - 2 * door))
         w[:, pos:pos + 2] = True
         w[gap:gap + door, pos:pos + 2] = False
-    return w
+        doors.append({"name": f"door_v{k}", "r0": gap, "r1": gap + door,
+                      "c0": pos, "c1": pos + 2})
+    return w, doors
+
+
+def stamp_disc(world: np.ndarray, row: float, col: float,
+               radius_cells: float) -> np.ndarray:
+    """Stamp a filled occupied disc (a crowd blob) into `world` IN
+    PLACE, clipped to the extent; returns `world`. Cheap bounding-box
+    mask — the crowd path recomputes every step."""
+    nr, nc = world.shape
+    r0 = max(0, int(row - radius_cells) - 1)
+    r1 = min(nr, int(row + radius_cells) + 2)
+    c0 = max(0, int(col - radius_cells) - 1)
+    c1 = min(nc, int(col + radius_cells) + 2)
+    if r1 <= r0 or c1 <= c0:
+        return world
+    rr = np.arange(r0, r1, dtype=np.float32)[:, None] - row
+    cc = np.arange(c0, c1, dtype=np.float32)[None, :] - col
+    world[r0:r1, c0:c1] |= (rr * rr + cc * cc) <= radius_cells ** 2
+    return world
+
+
+def rooms_world(size_cells: int, resolution_m: float,
+                seed: int = 1) -> np.ndarray:
+    """Arena split into rooms with door gaps — loop-closure friendly.
+    Same world `rooms_with_doors` builds (identical RNG draws), minus
+    the door report."""
+    return rooms_with_doors(size_cells, resolution_m, seed)[0]

@@ -106,9 +106,64 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
                       "ratchet tests/known_failures.json down:")
         for nodeid in fixed:
             tr.write_line(f"  FIXED {nodeid}")
+    if _budget_state["overrun"] is not None:
+        elapsed, wall_s = _budget_state["overrun"]
+        tr.section("tier-1 wall-clock budget (tests/tier1_budget.json)")
+        tr.write_line(
+            f"non-slow suite took {elapsed:.0f}s > committed budget "
+            f"{wall_s:.0f}s — mark new soaks `slow` or piggyback on a "
+            "shared module-scoped stack (see ISSUE 8 satellite); "
+            "JAX_MAPPING_NO_TIME_BUDGET=1 to bypass locally")
 
 
 @pytest.fixture(scope="session")
 def tiny_cfg():
     from jax_mapping.config import tiny_config
     return tiny_config()
+
+
+# -- tier-1 wall-clock budget guard (ISSUE 8) --------------------------------
+#
+# The tier-1 harness kills the suite at a hard timeout; a suite that
+# creeps up to it dies as an opaque SIGKILL with no named culprit.
+# `tests/tier1_budget.json` commits a wall-clock budget UNDER that
+# timeout; a full non-slow run (>= min_tests executed — subset runs and
+# `-m slow` runs never trip it) that exceeds the budget fails loudly at
+# session end, naming the overrun while the logs still exist. New
+# long-running tests must either fit the budget (piggyback on a shared
+# module-scoped stack, the PR 7 pattern) or be marked `slow`.
+# JAX_MAPPING_NO_TIME_BUDGET=1 is the local-dev escape hatch.
+
+_BUDGET_PATH = os.path.join(os.path.dirname(__file__),
+                            "tier1_budget.json")
+_budget_state = {"t0": None, "overrun": None}
+
+
+def _load_budget():
+    import json
+    try:
+        with open(_BUDGET_PATH) as f:
+            b = json.load(f)
+        return float(b["wall_s"]), int(b["min_tests"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def pytest_sessionstart(session):
+    import time
+    _budget_state["t0"] = time.monotonic()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import time
+    if os.environ.get("JAX_MAPPING_NO_TIME_BUDGET") \
+            or _budget_state["t0"] is None:
+        return
+    budget = _load_budget()
+    if budget is None:
+        return
+    wall_s, min_tests = budget
+    elapsed = time.monotonic() - _budget_state["t0"]
+    if len(_guard_state["ran"]) >= min_tests and elapsed > wall_s:
+        _budget_state["overrun"] = (elapsed, wall_s)
+        session.exitstatus = 1

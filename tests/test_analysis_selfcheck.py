@@ -347,6 +347,22 @@ def test_bridge_suppression_set_is_pinned():
         f"the new sites in-tree instead: {sorted(grew)}")
 
 
+def test_no_suppressions_in_scenarios_modules():
+    """ISSUE 8 CI guard, extending the zero-suppression tier: the
+    scenario engine (`jax_mapping/scenarios/`) and the decay op's home
+    (`ops/grid.py` — currently clean) carry ZERO baseline suppressions
+    — new hazards in the dynamic-world machinery must be fixed, not
+    baselined. (The mapper's decay path rides the separate pinned
+    bridge/ grandfathered set, which may shrink but never grow.)"""
+    base = Baseline.load(default_baseline_path())
+    banned = [s for s in base.suppressions
+              if s["path"].startswith("jax_mapping/scenarios/")
+              or s["path"] == "jax_mapping/ops/grid.py"]
+    assert not banned, (
+        "suppressions are not allowed in scenarios/ or ops/grid.py: "
+        f"{banned}")
+
+
 def test_protection_map_matches_code(package_modules):
     """Every lock-protection declaration names a real class, its real
     lock attributes, and fields actually assigned in that class — a

@@ -1,0 +1,719 @@
+"""Scenario engine (ISSUE 8): dynamic worlds, map healing, rendezvous
+merges, lifelong missions.
+
+Tier-1 keeps ONE module-scoped scenario mission (the PR 7 shared-stack
+pattern — every smoke assertion reads its artifacts instead of
+launching its own stack) plus pure-unit coverage; the heavyweights
+(rendezvous fleet merge, lifelong soak, bit-inertness property sweep)
+are `slow`.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from jax_mapping.config import DecayConfig, tiny_config
+from jax_mapping.resilience.faultplan import (
+    FaultEvent, FaultPlan, KINDS, WORLD_KINDS, random_plan,
+)
+from jax_mapping.scenarios import (
+    DoorSpec, WorldDynamics, day_plan, launch_scenario_stack,
+    merge_fleets, merged_frontier_assignment, run_lifelong_mission,
+    se2_apply, se2_from_pair, transform_state,
+)
+from jax_mapping.sim import world as W
+
+
+# ------------------------------------------------------------- unit: dynamics
+
+def test_world_dynamics_compose_and_restore():
+    world, doors = W.arena_with_door(96, 0.05)
+    dyn = WorldDynamics(world, 0.05, doors=doors, seed=3)
+    d = doors[0]
+    base = dyn.world_at(0)
+    assert np.array_equal(base, world)
+    dyn.set_door("door0", True)
+    closed = dyn.world_at(1)
+    assert closed[d["r0"]:d["r1"], d["c0"]:d["c1"]].all()
+    dyn.set_door("door0", False)
+    assert np.array_equal(dyn.world_at(2), world)
+    # Crowds: deterministic orbit, blob present while active, gone after.
+    dyn.set_crowd(0, 0.25)
+    c5 = dyn.crowd_center(0, 5)
+    assert dyn.crowd_center(0, 5) == c5          # pure in (seed, cid, t)
+    assert dyn.crowd_center(0, 6) != c5          # and it MOVES
+    assert dyn.world_at(5).sum() > world.sum()
+    dyn.set_crowd(0, None)
+    assert np.array_equal(dyn.world_at(7), world)
+    # The hot-path gate: one recompose after a toggle, quiet afterward.
+    assert dyn.world_if_changed(8) is None
+    dyn.set_door("door0", True)
+    assert dyn.world_if_changed(9) is not None
+    assert dyn.world_if_changed(10) is None      # no crowd, no toggle
+    dyn.set_crowd(1, 0.2)
+    assert dyn.world_if_changed(11) is not None  # crowds move every step
+    assert dyn.world_if_changed(12) is not None
+
+
+def test_world_dynamics_rejects_bad_registrations():
+    world, _ = W.arena_with_door(96, 0.05)
+    with pytest.raises(ValueError):
+        WorldDynamics(world, 0.05, doors=[{"name": "d", "r0": 5, "r1": 5,
+                                           "c0": 0, "c1": 2}])
+    with pytest.raises(ValueError):
+        WorldDynamics(world, 0.05,
+                      doors=[{"name": "d", "r0": 0, "r1": 200,
+                              "c0": 0, "c1": 2}])
+    dyn = WorldDynamics(world, 0.05,
+                        doors=[DoorSpec("d", 1, 3, 1, 3)])
+    with pytest.raises(ValueError):
+        dyn.set_door("nope", True)
+
+
+def test_rooms_with_doors_reports_real_gaps():
+    world, doors = W.rooms_with_doors(96, 0.05, seed=1)
+    assert np.array_equal(W.rooms_world(96, 0.05, seed=1), world)
+    assert len(doors) == 4
+    for d in doors:
+        gap = world[d["r0"]:d["r1"], d["c0"]:d["c1"]]
+        # Mostly open: a LATER crossing wall may clip a gap's edge (the
+        # generator's historical behavior, kept bit-identical), but the
+        # reported rectangle must be a real opening.
+        assert (~gap).mean() >= 0.5
+
+
+# ------------------------------------------- unit: FaultPlan world kinds
+
+class _FakeSim:
+    """Records the set_door/set_crowd boundary like a SimNode would."""
+
+    def __init__(self, dyn):
+        self.dyn = dyn
+
+    def set_door(self, name, closed):
+        self.dyn.set_door(name, closed)
+
+    def set_crowd(self, cid, radius):
+        self.dyn.set_crowd(cid, radius)
+
+
+class _FakeStack:
+    def __init__(self, dyn):
+        self.sim = _FakeSim(dyn)
+        self.bus = None
+
+
+def _dyn():
+    world, doors = W.arena_with_door(96, 0.05)
+    return WorldDynamics(world, 0.05, doors=doors, seed=0)
+
+
+def test_world_kinds_registered_and_validated():
+    assert WORLD_KINDS <= KINDS
+    with pytest.raises(ValueError):
+        FaultEvent(step=1, kind="door_close")        # needs a door name
+    with pytest.raises(ValueError):
+        FaultEvent(step=1, kind="crowd")             # needs a radius
+
+
+def test_overlapping_door_windows_refcount():
+    """Two overlapping door_close windows compose: the first clear must
+    NOT re-open a door the second window still holds shut — the
+    partition refcount rule applied to the world."""
+    dyn = _dyn()
+    stack = _FakeStack(dyn)
+    plan = FaultPlan([
+        FaultEvent(step=2, kind="door_close", name="door0", duration=6),
+        FaultEvent(step=4, kind="door_close", name="door0", duration=10),
+    ])
+    for t in range(20):
+        plan.apply(stack, t)
+        closed = dyn.snapshot()["doors"].get("door0", False)
+        if 2 <= t < 14:
+            assert closed, f"door open at t={t} inside a held window"
+        elif t >= 14:
+            assert not closed, f"door closed at t={t} after last clear"
+    assert plan.done()
+
+
+def test_overlapping_crowd_windows_run_worst_radius():
+    dyn = _dyn()
+    stack = _FakeStack(dyn)
+    plan = FaultPlan([
+        FaultEvent(step=1, kind="crowd", robot=0, duration=8, value=0.2),
+        FaultEvent(step=3, kind="crowd", robot=0, duration=2, value=0.4),
+    ])
+    radii = {}
+    for t in range(14):
+        plan.apply(stack, t)
+        radii[t] = dyn.snapshot()["crowds"].get(0)
+    assert radii[1] == 0.2
+    assert radii[3] == 0.4                       # worst active wins
+    assert radii[5] == 0.2                       # big window cleared
+    assert radii[9] is None                      # all clear
+    assert plan.done()
+
+
+def test_random_plan_samples_world_kinds_with_sane_magnitudes():
+    doors = ["door0", "door1"]
+    seen = set()
+    for seed in range(12):
+        plan = random_plan(200, n_faults=10, seed=seed, n_robots=2,
+                           door_names=doors, n_crowds=2)
+        occupied = []
+        for e in plan.events:
+            seen.add(e.kind)
+            if e.kind == "door_close":
+                assert e.name in doors
+            if e.kind == "crowd":
+                assert 0.15 <= e.value <= 0.4
+                assert e.robot in (0, 1)
+        # Same-resource overlap rejection still holds with the new kinds.
+        from jax_mapping.resilience.faultplan import _fault_resource
+        for e in plan.events:
+            res = _fault_resource(e.kind, e.robot, e.name)
+            span = (res, e.step, e.step + e.duration)
+            for r, s, t in occupied:
+                if r == res:
+                    assert not (e.step <= t and s <= span[2]), \
+                        f"overlap on {res}"
+            occupied.append(span)
+        # Determinism: the schedule is a pure function of the seed.
+        twin = random_plan(200, n_faults=10, seed=seed, n_robots=2,
+                           door_names=doors, n_crowds=2)
+        assert twin.events == plan.events
+    assert "door_close" in seen and "crowd" in seen
+
+
+def test_random_plan_default_args_exclude_world_kinds():
+    """Callers that never registered doors/crowds get the historical
+    sampler exactly (no world kind can fire against a stack with no
+    WorldDynamics attached)."""
+    for seed in range(6):
+        plan = random_plan(120, n_faults=8, seed=seed, n_robots=2)
+        assert all(e.kind not in WORLD_KINDS for e in plan.events)
+
+
+# ---------------------------------------------------- unit: decay op
+
+def test_decay_grid_shrinks_and_caps():
+    import jax.numpy as jnp
+    from jax_mapping.ops import grid as G
+    g = jnp.asarray(np.asarray([[4.0, -4.0], [0.5, 0.0]], np.float32))
+    out = np.asarray(G.decay_grid(g, 0.9, 2.0))
+    np.testing.assert_allclose(out, [[2.0, -2.0], [0.45, 0.0]],
+                               rtol=1e-6)
+    # factor 1.0 + a loose cap = identity (the knobs are independent).
+    out2 = np.asarray(G.decay_grid(g, 1.0, 4.0))
+    np.testing.assert_array_equal(out2, np.asarray(g))
+
+
+# ---------------------------------------------------- unit: rendezvous math
+
+def test_se2_round_trip_and_pair_recovery(rng):
+    for _ in range(20):
+        T = rng.uniform(-2, 2, 3).astype(np.float32)
+        p = rng.uniform(-3, 3, 3).astype(np.float32)
+        q = se2_apply(T, p)
+        T2 = se2_from_pair(q, p)
+        np.testing.assert_allclose(T2[:2], T[:2], atol=1e-5)
+        dth = (T2[2] - T[2] + np.pi) % (2 * np.pi) - np.pi
+        assert abs(dth) < 1e-5
+
+
+def test_transform_state_and_merge_fleets():
+    """Merge math on synthetic fleets: B's states transformed by T end
+    up in A's frame, every merged state aliases ONE grid, and the
+    matched robot's graph carries the anchor edge at loop-grade
+    weight."""
+    import jax.numpy as jnp
+    from jax_mapping.models import slam as S
+    from jax_mapping.ops import posegraph as PG
+
+    cfg = tiny_config()
+    T = np.asarray([0.5, -0.25, 0.4], np.float32)
+    sa = [S.init_state(cfg, pose0=jnp.asarray([0.1 * i, 0.0, 0.0]))
+          for i in range(2)]
+    sb = []
+    for i in range(2):
+        st = S.init_state(cfg, pose0=jnp.asarray([0.0, 0.1 * i, 0.2]))
+        g = st.graph
+        for k in range(3):
+            g = PG.add_pose(g, jnp.asarray([0.1 * k, 0.1 * i, 0.2],
+                                           jnp.float32))
+        sb.append(st._replace(graph=g))
+
+    moved = transform_state(sb[0], T)
+    np.testing.assert_allclose(
+        np.asarray(moved.pose), se2_apply(T, np.asarray(sb[0].pose)),
+        atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(moved.graph.poses[:3]),
+        se2_apply(T, np.asarray(sb[0].graph.poses[:3])), atol=1e-5)
+
+    verified = se2_apply(T, np.asarray(sb[1].graph.poses[2]))
+    grid, merged = merge_fleets(cfg, sa, sb, T, anchor=(1, verified))
+    assert len(merged) == 4
+    for st in merged:
+        assert st.grid is grid                   # one shared world
+    # The anchor edge landed on the matched robot's graph tip at a
+    # weight that clears the thin_keyframes strong-edge threshold.
+    g1 = merged[3].graph
+    n_edges = int(g1.n_edges)
+    assert n_edges >= 1
+    assert float(g1.edge_weight[n_edges - 1, 2]) > 100.0
+
+
+def test_anchor_tip_noop_on_short_graphs():
+    from jax_mapping.models import slam as S
+    from jax_mapping.ops import posegraph as PG
+    cfg = tiny_config()
+    g = S.init_state(cfg).graph
+    assert int(PG.anchor_tip(g, np.zeros(3, np.float32)).n_edges) == 0
+
+
+# ---------------------------------------------------- unit: checkpoint GC
+
+def _save(path, val, retain):
+    from jax_mapping.io.checkpoint import save_checkpoint
+    save_checkpoint(path, {"v": np.full(4, val)},
+                    retain_generations=retain)
+
+
+def test_checkpoint_generation_retention_bounded(tmp_path):
+    """A day of rotation cadence stays bounded: K total generations on
+    disk, newest-first fallback order, default K=2 byte-identical to
+    the historical current + .prev pair."""
+    from jax_mapping.io.checkpoint import (
+        generation_paths, load_checkpoint_with_fallback)
+    p = str(tmp_path / "auto.npz")
+    for i in range(40):                          # "day-long" cadence
+        _save(p, i, retain=4)
+        assert len(os.listdir(tmp_path)) <= 4
+    gens = generation_paths(p)
+    assert len(gens) == 2
+    st, _, used = load_checkpoint_with_fallback(
+        p, {"v": np.zeros(4, np.int64)})
+    assert used == p and st["v"][0] == 39
+    # Default retain=2: exactly the historical pair, no numbered files.
+    q = str(tmp_path / "plain.npz")
+    for i in range(6):
+        _save(q, i, retain=2)
+    names = sorted(n for n in os.listdir(tmp_path) if "plain" in n)
+    assert names == ["plain.npz", "plain.prev.npz"]
+    with pytest.raises(ValueError):
+        _save(q, 0, retain=1)
+
+
+def test_checkpoint_gc_never_deletes_newest_intact_generation(tmp_path):
+    """Corruption-safety: with current AND .prev rotten, the newest
+    intact numbered generation survives GC and the fallback chain
+    resumes from it."""
+    from jax_mapping.io.checkpoint import (
+        generation_paths, load_checkpoint_with_fallback,
+        previous_checkpoint_path)
+    p = str(tmp_path / "auto.npz")
+    for i in range(8):
+        _save(p, i, retain=4)
+    for f in (p, previous_checkpoint_path(p)):
+        with open(f, "r+b") as fh:
+            fh.truncate(12)                      # power-loss rot
+    st, _, used = load_checkpoint_with_fallback(
+        p, {"v": np.zeros(4, np.int64)})
+    assert ".gen" in used and st["v"][0] == 5
+    # Another save GCs — but must spare that only-intact generation.
+    _save(p, 99, retain=4)
+    assert any(".gen" in g for g in generation_paths(p))
+    st, _, _ = load_checkpoint_with_fallback(
+        p, {"v": np.zeros(4, np.int64)})
+    assert st["v"][0] == 99
+
+
+# ---------------------------------------------------- unit: client epoch
+
+def test_delta_client_epoch_resync_vs_regression():
+    """Within one epoch a revision regression is still a protocol
+    error; an epoch advance resets the cache for a full resync
+    instead."""
+    from jax_mapping.serving.client import (DeltaMapClient,
+                                            RevisionRegression)
+    c = DeltaMapClient("http://x")
+    assert not c._note_epoch({"epoch": 0})       # first sighting adopts
+    c.revision = 10
+    c.mosaics = {0: np.zeros((4, 4), np.uint8)}
+    with pytest.raises(RevisionRegression):
+        c.apply({"revision": 3, "since": 10, "tiles": [],
+                 "tile_cells": 4, "levels": []})
+    assert c._note_epoch({"epoch": 1})           # restart: resync, not raise
+    assert c.revision == -1 and not c.mosaics and c.n_epoch_resyncs == 1
+    assert not c._note_epoch({"epoch": 1})
+
+
+# =================================================== the shared mission
+
+#: One module-scoped scenario mission (PR 7 shared-stack budget
+#: pattern): a door closes and is mapped, re-opens and heals under
+#: decay, a crowd passes through, the mapper is killed and supervisor-
+#: resumed mid-mission, and a delta client polls across all of it.
+_DOOR_CLOSE_AT, _DOOR_STEPS = 4, 16
+_KILL_AT = 48
+_MISSION_STEPS = 72
+
+
+@pytest.fixture(scope="module")
+def scenario_mission(tmp_path_factory):
+    import jax.numpy as jnp
+    from jax_mapping.ops import frontier as F
+    from jax_mapping.ops import grid as G
+    from jax_mapping.serving.client import DeltaMapClient
+
+    cfg = tiny_config().replace(
+        decay=DecayConfig(enabled=True, every_n_ticks=8, factor=0.9,
+                          evidence_cap=1.5))
+    world, doors = W.arena_with_door(96, cfg.grid.resolution_m)
+    td = str(tmp_path_factory.mktemp("scenario_ckpt"))
+    st = launch_scenario_stack(cfg, world, doors=doors, n_robots=2,
+                               realtime=False, seed=0, http_port=0,
+                               checkpoint_dir=td)
+    st.brain.start_exploring()
+    st.brain.reconnect_period_s = 0.0
+    plan = FaultPlan([
+        FaultEvent(step=_DOOR_CLOSE_AT, kind="door_close", name="door0",
+                   duration=_DOOR_STEPS),
+        FaultEvent(step=26, kind="crowd", robot=0, duration=10,
+                   value=0.25),
+        FaultEvent(step=_KILL_AT, kind="kill_node", name="jax_mapper"),
+    ], seed=0)
+    st.attach_fault_plan(plan)
+
+    d = doors[0]
+    off = (cfg.grid.size_cells - world.shape[0]) // 2
+    rect = (d["r0"] + off, d["r1"] + off, d["c0"] + off, d["c1"] + off)
+
+    client = DeltaMapClient(f"http://127.0.0.1:{st.api.port}")
+    st.run_steps(_DOOR_CLOSE_AT + _DOOR_STEPS - 2)   # door still closed
+    client.poll()
+    pre_restart_epoch = client.epoch
+    grid_closed = np.array(np.asarray(st.mapper.merged_grid()),
+                           copy=True)
+    st.run_steps(_MISSION_STEPS - (_DOOR_CLOSE_AT + _DOOR_STEPS - 2))
+    grid_end = np.array(np.asarray(st.mapper.merged_grid()), copy=True)
+    client.poll()
+    revision_at_final_poll = st.mapper.serving_revision()
+
+    # Final served surface + a consistent incremental-pipeline probe.
+    gray_end = np.asarray(G.to_gray(cfg.grid, st.mapper.merged_grid()))
+    m = st.mapper
+    with m._state_lock:
+        poses = np.stack([np.asarray(s.pose) for s in m.states])
+        lo = m.shared_grid
+        rev = m.map_revision
+        tile_rev = m._tile_rev.copy()
+    pipe = m._frontier_incremental()
+    pub = None if pipe is None else pipe.compute(lo, poses, tile_rev,
+                                                 rev)
+    fr_full = F.compute_frontiers(cfg.frontier, cfg.grid, lo,
+                                  jnp.asarray(poses))
+
+    # Racewatch over the scenario engine's lock (ISSUE 8 satellite):
+    # a side thread hammers the door/snapshot boundary while the step
+    # thread composes worlds — Eraser refinement must converge every
+    # watched WorldDynamics field on the DECLARED lock with zero
+    # reports. Runs AFTER every quantitative artifact is captured, so
+    # the nondeterministic toggling cannot perturb the assertions.
+    import threading
+    from jax_mapping.analysis.protection import groups_by_class
+    from jax_mapping.analysis.racewatch import RaceWatch
+    dyn = st.sim._world_dyn
+    watch = RaceWatch()
+    try:
+        watch.watch_object(dyn, groups_by_class()["WorldDynamics"][0],
+                           name="dyn")
+        stop = threading.Event()
+
+        def toggler():
+            flip = True
+            while not stop.is_set():
+                st.sim.set_door("door0", flip)
+                flip = not flip
+                dyn.snapshot()
+                stop.wait(0.002)
+
+        t = threading.Thread(target=toggler)
+        t.start()
+        st.run_steps(6)
+        stop.set()
+        t.join(timeout=10)
+    finally:
+        watch.unwatch_all()
+    st.sim.set_door("door0", False)
+    race_reports = watch.reports()
+    race_states = watch.field_states()
+
+    art = {
+        "cfg": cfg, "stack": st, "plan": plan, "rect": rect,
+        "grid_closed": grid_closed, "grid_end": grid_end,
+        "client": client, "pre_restart_epoch": pre_restart_epoch,
+        "revision_at_final_poll": revision_at_final_poll,
+        "gray_end": gray_end, "pub": pub,
+        "full_targets": np.asarray(fr_full.targets),
+        "full_assignment": np.asarray(fr_full.assignment),
+        "ckpt_dir": td,
+        "race_reports": race_reports, "race_states": race_states,
+    }
+    yield art
+    st.shutdown()
+
+
+def test_scenario_door_maps_closed_then_heals(scenario_mission):
+    """The healed-wall acceptance: the closed door is MAPPED (occupied
+    cells inside the gap rectangle), and after re-opening the interior
+    of the gap ends free — stale wall healed by decay +
+    re-observation. Edge rows abutting the real wall may keep the hit-
+    tolerance blur; the interior may not."""
+    a = scenario_mission
+    g = a["cfg"].grid
+    r0, r1, c0, c1 = a["rect"]
+    closed = a["grid_closed"][r0:r1, c0:c1]
+    end = a["grid_end"][r0:r1, c0:c1]
+    assert (closed > g.occ_threshold).sum() >= 5, \
+        "closed door never got mapped"
+    interior = end[2:-2]
+    assert (interior > g.occ_threshold).sum() == 0, \
+        f"unhealed interior cells:\n{interior}"
+    assert (interior < g.free_threshold).sum() >= interior.size // 2, \
+        "healed door should read FREE, not just unknown"
+    assert a["stack"].mapper.n_decay_passes > 0
+    assert a["stack"].sim.n_world_updates > 0
+
+
+def test_scenario_heal_propagates_to_delta_clients(scenario_mission):
+    """No cache staleness in the serving path: the polling client's
+    reconstructed mosaic equals the served gray of the final healed
+    grid bit-for-bit, across a mid-mission mapper restart."""
+    a = scenario_mission
+    client = a["client"]
+    np.testing.assert_array_equal(client.image(0), a["gray_end"])
+    r0, r1, c0, c1 = a["rect"]
+    assert (client.image(0)[r0 + 2:r1 - 2, c0:c1] != 0).all(), \
+        "client still shows the stale closed door as occupied"
+
+
+def test_scenario_heal_propagates_to_frontier_pipeline(scenario_mission):
+    """No cache staleness in the incremental frontier pipeline: its
+    revision-keyed recompute over the healed map matches the full
+    recompute exactly (targets AND assignment)."""
+    a = scenario_mission
+    assert a["pub"] is not None, "incremental pipeline never built"
+    np.testing.assert_array_equal(a["pub"].targets, a["full_targets"])
+    np.testing.assert_array_equal(a["pub"].assignment,
+                                  a["full_assignment"])
+
+
+def test_scenario_client_epoch_resync_across_restart(scenario_mission):
+    """The satellite regression: a supervisor mapper-kill + resume
+    re-serves an older revision under a bumped epoch; the client
+    resyncs full instead of raising RevisionRegression."""
+    a = scenario_mission
+    st = a["stack"]
+    assert st.supervisor.n_restarts("jax_mapper") == 1
+    assert st.mapper.restart_epoch == 1
+    client = a["client"]
+    assert a["pre_restart_epoch"] == 0
+    assert client.epoch == 1
+    assert client.n_epoch_resyncs == 1
+    assert client.revision == a["revision_at_final_poll"]
+
+
+def test_scenario_plan_log_is_the_script(scenario_mission):
+    a = scenario_mission
+    descs = [d for _, d in a["plan"].log]
+    assert descs == [
+        "door_close door0",
+        "clear: door_close door0",
+        "crowd 0 r=0.25m",
+        "clear: crowd 0",
+        "kill_node jax_mapper",
+    ]
+
+
+def test_scenario_racewatch_clean_on_world_dynamics(scenario_mission):
+    """Dynamic-tier lock gate for the scenario engine: cross-thread
+    door toggling + world composition end with zero race reports and
+    the change flag's candidate lockset converged on the declared
+    WorldDynamics._lock."""
+    a = scenario_mission
+    assert a["race_reports"] == [], \
+        "\n".join(r.message for r in a["race_reports"])
+    dirty = a["race_states"]["WorldDynamics._dirty@dyn"]
+    assert dirty.state == "shared-modified"
+    assert "WorldDynamics._lock@dyn" in dirty.candidate
+
+
+# =========================================================== slow gates
+
+@pytest.mark.slow
+def test_scenario_wiring_is_bit_inert_when_disabled(tmp_path):
+    """The bit-exactness acceptance, property-style over seeds: decay
+    disabled + a WorldDynamics armed but never fired reproduces the
+    plain stack EXACTLY — fusion output, frontier targets, serving
+    tile hashes."""
+    import jax.numpy as jnp
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.ops import frontier as F
+    from jax_mapping.ops import grid as G
+
+    cfg = tiny_config()
+    assert not cfg.decay.enabled                 # the shipped default
+    for seed in (0, 3):
+        world, doors = W.rooms_with_doors(96, cfg.grid.resolution_m,
+                                          seed=1)
+
+        def drive(scenario):
+            if scenario:
+                st = launch_scenario_stack(cfg, world, doors=doors,
+                                           n_robots=2, realtime=False,
+                                           seed=seed)
+            else:
+                st = launch_sim_stack(cfg, world, n_robots=2,
+                                      realtime=False, seed=seed)
+            st.brain.start_exploring()
+            st.run_steps(40)
+            lo = np.array(np.asarray(st.mapper.merged_grid()),
+                          copy=True)
+            poses = np.stack([np.asarray(s.pose)
+                              for s in st.mapper.states])
+            fr = F.compute_frontiers(cfg.frontier, cfg.grid,
+                                     jnp.asarray(lo),
+                                     jnp.asarray(poses))
+            hashes = np.asarray(G.tile_hashes(
+                G.to_gray(cfg.grid, jnp.asarray(lo)),
+                cfg.serving.tile_cells))
+            targets = np.asarray(fr.targets)
+            st.shutdown()
+            return lo, targets, hashes
+
+        lo_a, tg_a, h_a = drive(False)
+        lo_b, tg_b, h_b = drive(True)
+        np.testing.assert_array_equal(lo_a, lo_b)
+        np.testing.assert_array_equal(tg_a, tg_b)
+        np.testing.assert_array_equal(h_a, h_b)
+
+
+@pytest.mark.slow
+def test_rendezvous_two_fleets_merge_into_one_world():
+    """The rendezvous acceptance: two independently-seeded 2-robot
+    fleets with a HIDDEN relative transform detect overlap via the
+    cross-fleet sweep, verify the implied transform by streak, and the
+    merged world agrees with a jointly-started 4-robot oracle on >= 90%
+    of commonly-decided cells — with frontier assignment spanning the
+    merged fleet."""
+    import jax.numpy as jnp
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.scenarios import RendezvousMerger
+
+    cfg = tiny_config()
+    world = W.plank_course(96, cfg.grid.resolution_m, n_planks=5,
+                           seed=11)
+    sa = launch_sim_stack(cfg, world, n_robots=2, realtime=False, seed=0)
+    sa.brain.start_exploring()
+    sb = launch_sim_stack(cfg, world, n_robots=2, realtime=False, seed=1)
+    # The hidden truth: fleet B physically starts offset+rotated, but
+    # its belief frame still says "we started at the spawn poses".
+    T_true = np.asarray([0.9, -0.7, 0.6], np.float32)
+    truth = se2_apply(T_true, np.asarray(sb.sim.sim_state.poses))
+    sb.sim.sim_state = sb.sim.sim_state._replace(
+        poses=jnp.asarray(truth))
+    sb.brain.start_exploring()
+
+    merger = RendezvousMerger(cfg, sa.mapper, sb.mapper, max_seeds=8)
+    merged_at = None
+    for seg in range(30):
+        sa.run_steps(10)
+        sb.run_steps(10)
+        if merger.poll():
+            merged_at = (seg + 1) * 10
+            break
+    assert merged_at is not None, \
+        f"fleets never merged: {merger.snapshot()}"
+
+    T = merger.transform
+    assert np.hypot(*(T[:2] - T_true[:2])) < 0.3
+    assert abs((T[2] - T_true[2] + np.pi) % (2 * np.pi) - np.pi) < 0.3
+
+    # Oracle: a jointly-started 4-robot fleet, same mission length.
+    so = launch_sim_stack(cfg, world, n_robots=4, realtime=False, seed=2)
+    so.brain.start_exploring()
+    so.run_steps(merged_at)
+    g_o = np.asarray(so.mapper.merged_grid())
+    g_m = np.asarray(merger.merged_grid)
+    both = (np.abs(g_m) > 0.5) & (np.abs(g_o) > 0.5)
+    assert both.sum() > 1000
+    agree = float((np.sign(g_m[both]) == np.sign(g_o[both])).mean())
+    assert agree >= 0.90, f"post-merge sign agreement {agree:.3f}"
+
+    fr = merged_frontier_assignment(cfg, merger.merged_grid,
+                                    merger.merged_states)
+    assign = np.asarray(fr.assignment)
+    assert len(assign) == 4
+    assert (assign[2:] >= 0).any(), \
+        "joined fleet's robots got no frontier work"
+
+    # FleetHealth absorbs the joined robots.
+    sa.health.absorb(sb.health)
+    assert sa.health.n_robots == 4
+    assert len(sa.health.robot_states()) == 4
+
+    so.shutdown()
+    sa.shutdown()
+    sb.shutdown()
+
+
+@pytest.mark.slow
+def test_lifelong_soak_day_mission_under_continuous_chaos(tmp_path):
+    """The lifelong acceptance: a sim-accelerated long session under a
+    seeded scenario+chaos plan — door cycles, crowd churn, decay churn,
+    two supervisor-driven mapper restarts with checkpoint resume and
+    bounded generation retention — finishes with coverage >= 55% and
+    sign-agreement >= 90% vs the fault-free twin, and two same-seed
+    missions are bit-identical including decay state."""
+    import dataclasses
+    cfg = tiny_config()
+    cfg = cfg.replace(
+        decay=DecayConfig(enabled=True, every_n_ticks=10, factor=0.93,
+                          evidence_cap=2.0),
+        resilience=dataclasses.replace(
+            cfg.resilience, checkpoint_retain_generations=4))
+    world, doors = W.arena_with_door(96, cfg.grid.resolution_m)
+    steps = 240
+    events = day_plan(steps, [d["name"] for d in doors], n_crowds=1,
+                      door_cycle=70, crowd_cycle=90,
+                      kill_steps=(100, 180))
+
+    rep = run_lifelong_mission(cfg, world, doors, events, steps, seed=0,
+                               checkpoint_dir=str(tmp_path / "a"))
+    assert rep.n_mapper_restarts == 2
+    assert rep.restart_epoch == 2
+    assert rep.n_decay_passes > 0
+    assert rep.n_world_updates > 0
+    # Bounded retention: the directory holds at most K generations.
+    assert 0 < len(rep.checkpoint_files) <= 4, rep.checkpoint_files
+
+    # Fault-free twin (no scenario events, same decay config).
+    rep0 = run_lifelong_mission(cfg, world, doors, [], steps, seed=0,
+                                checkpoint_dir=str(tmp_path / "b"))
+    known, known0 = rep.known_cells(), rep0.known_cells()
+    assert known0 > 1000
+    assert known / known0 >= 0.55, f"coverage {known / known0:.2f}"
+    both = (np.abs(rep.grid) > 0.5) & (np.abs(rep0.grid) > 0.5)
+    agree = float((np.sign(rep.grid[both])
+                   == np.sign(rep0.grid[both])).mean())
+    assert agree >= 0.90, f"sign agreement {agree:.3f}"
+
+    # Determinism: same seed, same schedule -> bit-identical world,
+    # decay state included (the grid IS the decay state).
+    rep2 = run_lifelong_mission(cfg, world, doors, events, steps, seed=0,
+                                checkpoint_dir=str(tmp_path / "c"))
+    assert rep2.plan_log == rep.plan_log
+    np.testing.assert_array_equal(rep2.grid, rep.grid)
