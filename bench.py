@@ -194,8 +194,11 @@ def main() -> None:
         if suite == "frontier":
             _frontier_main()
             return
+        if suite == "obs":
+            _obs_main()
+            return
         print(f"bench: unknown suite {suite!r} "
-              "(available: serving, match, frontier)",
+              "(available: serving, match, frontier, obs)",
               file=sys.stderr, flush=True)
         sys.exit(2)
     if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") != "1" \
@@ -596,6 +599,118 @@ def _frontier_run(result: dict) -> None:
     result["n_warm_starts"] = pipe.n_warm_starts
     result["n_field_reuses"] = pipe.n_field_reuses
     result["crop"] = list(pipe.last_crop) if pipe.last_crop else None
+
+
+def _obs_main() -> None:
+    """`bench.py --suite obs` — tracing overhead on the mapper-tick hot
+    path (ISSUE 9 acceptance: `ObsConfig(enabled=True)` adds < 5% to
+    mapper-tick p50). Two `launch_sim_stack` missions, same seed and
+    world, obs off then on; every tick's duration is sampled from the
+    `mapper.tick` StageTimer sum delta around `run_steps(1)` — the
+    SAME measurement surface both ways (the stage wraps the tick body
+    whether or not a Tracer exists). Plus span-primitive microbenches
+    (emit / on_publish cost). Prints exactly ONE JSON line; `--out
+    FILE` additionally writes it (the BENCH_OBS_r* artifact).
+
+    CPU-pinned like the serving/frontier suites: the number is a HOST
+    overhead ratio by construction — tracing is host-side bookkeeping
+    (blake2b ids + a locked deque append), nothing lands on the
+    device, so the denominator backend only scales both sides."""
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        from jax_mapping.utils.backend_guard import scrubbed_cpu_env
+        os.execvpe(sys.executable, [sys.executable] + sys.argv,
+                   scrubbed_cpu_env(extra_env={
+                       "JAX_PLATFORMS": "cpu",
+                       "JAX_MAPPING_BENCH_DEADLINE_S":
+                           str(max(60.0, _remaining()))}))
+    result = {
+        "metric": "mapper_tick_p50_obs_overhead_pct", "suite": "obs",
+        "tick_p50_ms_obs_off": None, "tick_p50_ms_obs_on": None,
+        "overhead_pct": None, "overhead_p90_pct": None,
+        "spans_per_tick": None, "span_emit_us": None,
+        "publish_derive_us": None,
+        "methodology": (
+            "per-tick wall time from the mapper.tick StageTimer sum "
+            "delta around run_steps(1), same-seed same-world missions "
+            "obs off vs on, host-driven on virtual CPU (tracing is "
+            "host-side bookkeeping; the device backend scales both "
+            "sides equally)"),
+        "sections_completed": [], "sections_skipped": {},
+        "devices": "unknown", "provenance": None}
+    _run_suite_guarded(result, _obs_run)
+
+
+def _obs_run(result: dict) -> None:
+    import jax
+
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.config import ObsConfig, tiny_config
+    from jax_mapping.sim import world as W
+    from jax_mapping.utils import global_metrics
+
+    dev = jax.devices()[0]
+    result["devices"] = f"{len(jax.devices())}x {dev.platform}"
+    try:
+        load1 = round(os.getloadavg()[0], 1)
+    except OSError:
+        load1 = None
+    result["provenance"] = {
+        "cpu_count": os.cpu_count(), "loadavg_1m": load1,
+        "jax": jax.__version__,
+        "python": ".".join(map(str, sys.version_info[:3])),
+        "n_robots": 2, "warmup_steps": 12, "measured_steps": 72}
+
+    cfg0 = tiny_config()
+    world, _ = W.rooms_with_doors(96, cfg0.grid.resolution_m, seed=1)
+    WARM, REPS = 12, 72
+
+    def drive(obs_on):
+        cfg = cfg0.replace(obs=ObsConfig(enabled=obs_on))
+        st = launch_sim_stack(cfg, world, n_robots=2, realtime=False,
+                              seed=0)
+        st.brain.start_exploring()
+        st.run_steps(WARM)                       # jit compiles settle
+        ticks_ms = []
+        for _ in range(REPS):
+            before = global_metrics.stages.snapshot().get(
+                "mapper.tick", {"sum_ms": 0.0})["sum_ms"]
+            st.run_steps(1)
+            after = global_metrics.stages.snapshot()["mapper.tick"]
+            ticks_ms.append(after["sum_ms"] - before)
+        n_spans = st.tracer.last_seq() if st.tracer is not None else 0
+        st.shutdown()
+        return np.asarray(ticks_ms), n_spans
+
+    off_ms, _ = drive(False)
+    result["sections_completed"].append("obs_off")
+    on_ms, n_spans = drive(True)
+    result["sections_completed"].append("obs_on")
+    p50_off = float(np.percentile(off_ms, 50))
+    p50_on = float(np.percentile(on_ms, 50))
+    result["tick_p50_ms_obs_off"] = round(p50_off, 3)
+    result["tick_p50_ms_obs_on"] = round(p50_on, 3)
+    result["overhead_pct"] = round((p50_on / p50_off - 1.0) * 100, 2)
+    result["overhead_p90_pct"] = round(
+        (float(np.percentile(on_ms, 90))
+         / float(np.percentile(off_ms, 90)) - 1.0) * 100, 2)
+    result["spans_per_tick"] = round(n_spans / (WARM + REPS), 1)
+
+    # Span-primitive microbenches: the per-event cost tracing adds to
+    # any instrumented path (blake2b id + locked ring append).
+    from jax_mapping.obs import Tracer
+    tr = Tracer(seed=0)
+    N = 20000
+    t0 = time.perf_counter()
+    for k in range(N):
+        tr.emit("bench.span", key=k)
+    result["span_emit_us"] = round(
+        (time.perf_counter() - t0) / N * 1e6, 3)
+    t0 = time.perf_counter()
+    for _ in range(N):
+        tr.on_publish("/bench")
+    result["publish_derive_us"] = round(
+        (time.perf_counter() - t0) / N * 1e6, 3)
+    result["sections_completed"].append("primitives")
 
 
 def _costfield_xla_fallback() -> None:

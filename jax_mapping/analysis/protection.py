@@ -149,6 +149,27 @@ REPO_PROTECTION: List[LockGroup] = [
     # Per-client event mailbox: queue contents and the closed flag.
     group("EventSubscription", "_lock",
           ["_queue", "_closed"]),
+    # Causal tracer (obs/trace.py): the span ring, the ever-recorded
+    # counter (also the per-span `seq` stamp `/trace?since=` filters
+    # on) and the per-scope sequence table mutate together — spans are
+    # emitted from the bus delivery, mapper tick, brain tick AND HTTP
+    # handler threads at once, which is exactly the cross-thread
+    # emission the obs racewatch gate hammers (tests/test_obs.py).
+    group("Tracer", "_lock",
+          ["_spans", "n_spans", "_seq"]),
+    # Flight recorder (obs/recorder.py): event ring + counter move
+    # together under `_lock`; the dump bookkeeping is read lock-free by
+    # design (MissionReport links `dumps` basenames post-mission, the
+    # /status counter convention), and the configure() targets are
+    # re-pointed between stacks but always under the lock.
+    group("FlightRecorder", "_lock",
+          ["_ring", "n_events", "_dump_dir", "_tracer", "_dump_seq"],
+          lockfree_ok=["n_dumps", "dumps"]),
+    # Declarative /metrics registry (obs/registry.py): the source list
+    # is append-only under `_lock`; render() snapshots it there, then
+    # collects outside (no foreign collector code under our lock).
+    group("MetricsRegistry", "_lock",
+          ["_sources"]),
 ]
 
 

@@ -183,6 +183,7 @@ class RaceWatch:
         held = frozenset(self._held())
         tid = threading.get_ident()
         key = (id(obj), attr)
+        new_report = None
         with self._mu:
             st = self._fields.get(key)
             if st is None:
@@ -217,6 +218,31 @@ class RaceWatch:
                     "no single lock protects every access "
                     f"({st.n_reads} reads / {st.n_writes} writes "
                     "observed); the field races")
+                new_report = st.name
+        if new_report is not None:
+            # Postmortem trigger (ISSUE 9): a race report is exactly
+            # the moment the flight recorder's recent transitions
+            # explain — record + dump OUTSIDE `_mu`, and BOTH on a
+            # one-shot thread, never inline: _record_access fires
+            # mid-attribute-access, i.e. while the racing thread may
+            # still hold the watched object's own lock — and the
+            # watched object may BE the global flight_recorder or the
+            # Tracer attached to it (both have protection.py groups),
+            # so an inline record()/dump() would re-take that
+            # non-reentrant lock and self-deadlock (inline I/O under a
+            # foreign caller lock would also break the B2 rule). The
+            # thread sequences record before dump, so the dump's
+            # snapshot still contains the report event. At most once
+            # per field, so never hot.
+            from jax_mapping.obs.recorder import flight_recorder
+
+            def _postmortem(field=new_report):
+                flight_recorder.record("racewatch_report", field=field)
+                flight_recorder.dump(f"racewatch_{field}")
+
+            threading.Thread(target=_postmortem,
+                             name=f"racewatch-dump-{new_report}",
+                             daemon=True).start()
 
     # -- results -------------------------------------------------------------
 

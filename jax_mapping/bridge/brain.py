@@ -41,6 +41,7 @@ from jax_mapping.bridge.node import Node
 from jax_mapping.bridge.qos import qos_sensor_data
 from jax_mapping.bridge.tf import TfTree
 from jax_mapping.config import SlamConfig, sign_extend_16bit
+from jax_mapping.utils import global_metrics as M
 from jax_mapping.models.explorer import frontier_policy
 from jax_mapping.ops.odometry import rk2_step, wheel_velocities
 from jax_mapping.resilience.health import (
@@ -114,6 +115,7 @@ class ThymioBrain(Node):
         self.link_up = False
         self._last_reconnect_probe = -1e9
         self.n_ticks = 0
+        self._tick_no = 0
         self.n_io_errors = 0
         self._latest_scans: List[Optional[LaserScan]] = [None] * n_robots
         self._last_cmd_vel: Optional[Twist] = None
@@ -526,6 +528,20 @@ class ThymioBrain(Node):
             self.driver[i][LEDS_TOP] = [32, 0, 0]       # red: degraded
 
     def update_loop(self) -> None:
+        # Causal tracing (obs/): one `brain.tick` span per control tick
+        # when armed, so motor/odometry publishes made here chain under
+        # the tick that commanded them; a stage timer either way (the
+        # control loop's latency histogram on /metrics).
+        self._tick_no += 1
+        tracer = getattr(self.bus, "tracer", None)
+        with M.stages.stage("brain.tick"):
+            if tracer is not None:
+                with tracer.span("brain.tick", key=self._tick_no):
+                    self._update_loop_body()
+            else:
+                self._update_loop_body()
+
+    def _update_loop_body(self) -> None:
         cfg = self.cfg
         now = time.monotonic()
         if self._health is not None:

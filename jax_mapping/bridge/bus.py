@@ -25,7 +25,15 @@ from jax_mapping.bridge.qos import Durability, QoSProfile, Reliability, \
 
 
 class Subscription:
-    """A bounded mailbox attached to one topic."""
+    """A bounded mailbox attached to one topic.
+
+    When the bus carries a Tracer (ObsConfig.enabled), a parallel
+    context deque shadows the message queue in LOCKSTEP — every append,
+    overflow-drop and pop mutates both under the one mailbox lock — so
+    the causal `TraceContext` of each sample survives queueing and is
+    re-established around callback delivery. With no tracer the shadow
+    queue is never constructed: the pre-obs hot path, bit-exact.
+    """
 
     def __init__(self, bus: "Bus", topic: str, qos: QoSProfile,
                  callback: Optional[Callable[[Any], None]] = None):
@@ -34,6 +42,18 @@ class Subscription:
         self.qos = qos
         self.callback = callback
         self._queue: collections.deque = collections.deque(maxlen=None)
+        #: Trace-context shadow queue (tracing only; None otherwise).
+        self._ctxq: Optional[collections.deque] = \
+            collections.deque(maxlen=None) if bus.tracer is not None \
+            else None
+        #: Context of the most recent take() — a convenience for
+        #: single-threaded mailbox consumers (poll loop reads it right
+        #: after its take). The bus's own delivery path does NOT read
+        #: it: concurrent publishers to one topic each run the
+        #: take-then-deliver sequence, so delivery carries the context
+        #: through `_take_with_ctx`'s return value instead of this
+        #: shared field.
+        self.taken_ctx = None
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
@@ -41,7 +61,7 @@ class Subscription:
         self.n_dropped = 0
         self._closed = False
 
-    def _offer(self, msg: Any) -> None:
+    def _offer(self, msg: Any, ctx=None) -> None:
         """Called by the bus on publish. Best-Effort drops oldest on
         overflow; Reliable blocks the publisher until there is room."""
         with self._lock:
@@ -50,6 +70,8 @@ class Subscription:
             if len(self._queue) >= self.qos.depth:
                 if self.qos.reliability is Reliability.BEST_EFFORT:
                     self._queue.popleft()
+                    if self._ctxq is not None and self._ctxq:
+                        self._ctxq.popleft()
                     self.n_dropped += 1
                 else:
                     while len(self._queue) >= self.qos.depth \
@@ -58,32 +80,49 @@ class Subscription:
                             # Deadlock breaker: a reliable reader that has
                             # stalled for 5 s forfeits its oldest sample.
                             self._queue.popleft()
+                            if self._ctxq is not None and self._ctxq:
+                                self._ctxq.popleft()
                             self.n_dropped += 1
                             break
             self._queue.append(msg)
+            if self._ctxq is not None:
+                self._ctxq.append(ctx)
             self.n_received += 1
             self._not_empty.notify()
 
     def take(self, timeout: Optional[float] = None) -> Optional[Any]:
         """Pop the oldest pending sample, or None on timeout."""
+        return self._take_with_ctx(timeout)[0]
+
+    def _take_with_ctx(self, timeout: Optional[float] = None) -> Tuple:
+        """take() that also returns the sample's TraceContext (None
+        when tracing is off) — both popped under ONE lock hold, so the
+        pairing survives concurrent takers (the bus delivery path's
+        contract; `taken_ctx` alone would race)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while not self._queue:
                 if deadline is None or self._closed:
-                    return None
+                    return None, None
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return None
+                    return None, None
                 self._not_empty.wait(timeout=remaining)
             msg = self._queue.popleft()
+            ctx = None
+            if self._ctxq is not None:
+                ctx = self._ctxq.popleft() if self._ctxq else None
+                self.taken_ctx = ctx
             self._not_full.notify()
-            return msg
+            return msg, ctx
 
     def take_all(self) -> List[Any]:
         """Drain everything pending — the batcher's bulk read."""
         with self._lock:
             msgs = list(self._queue)
             self._queue.clear()
+            if self._ctxq is not None:
+                self._ctxq.clear()
             self._not_full.notify_all()
             return msgs
 
@@ -123,10 +162,17 @@ class Bus:
     """
 
     def __init__(self, domain_id: int = 42, drop_prob: float = 0.0,
-                 reorder_prob: float = 0.0, seed: int = 0):
+                 reorder_prob: float = 0.0, seed: int = 0, tracer=None):
         self.domain_id = domain_id
         self.drop_prob = drop_prob
         self.reorder_prob = reorder_prob
+        #: Causal tracing (obs/trace.Tracer) or None. Fixed at
+        #: construction: every publish derives a deterministic
+        #: TraceContext (root ids from (seed, topic, seq)) that rides
+        #: the subscription mailboxes and wraps callback delivery.
+        #: None = the pre-obs hot path, not a single extra branch taken
+        #: per message (ObsConfig.enabled=False bit-exactness).
+        self.tracer = tracer
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._subs: Dict[str, List[Subscription]] = {}
@@ -137,6 +183,10 @@ class Bus:
         #: everything, unlike the probabilistic Best-Effort weather.
         self._partitioned: set = set()
         self.n_partition_dropped = 0
+        #: Closed subscriptions' received/dropped totals folded in per
+        #: topic, so the /metrics bus counters stay Prometheus-monotonic
+        #: across subscriber churn (the EventChannel carry-over rule).
+        self._retired_stats: Dict[str, Dict[str, int]] = {}
 
     # -- fault injection (resilience/faultplan.py boundaries) ---------------
 
@@ -186,18 +236,51 @@ class Bus:
                 and qos.durability is Durability.TRANSIENT_LOCAL:
             sub._offer(latched)
             if sub.callback is not None:
-                m = sub.take()
+                m, ctx = sub._take_with_ctx()
                 if m is not None:
-                    sub.callback(m)
+                    self._deliver(sub, m, ctx)
         return sub
 
     def topics(self) -> List[str]:
         with self._lock:
             return sorted(self._subs.keys() | self._latched.keys())
 
+    def subscription_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-TOPIC subscription health, aggregated over that topic's
+        subscriptions: live queue depth, samples received, samples
+        dropped (overflow + loss weather) — the drop counters that were
+        recorded but invisible before the `/metrics` bus families.
+        Each mailbox is sampled under its own lock (consistent per-sub;
+        the cross-sub aggregate is a snapshot like every /status
+        read)."""
+        with self._lock:
+            by_topic = {t: list(subs) for t, subs in self._subs.items()}
+            retired = {t: dict(v) for t, v in self._retired_stats.items()}
+        out: Dict[str, Dict[str, int]] = {}
+        for topic in sorted(by_topic.keys() | retired.keys()):
+            base = retired.get(topic, {})
+            agg = {"subscriptions": 0, "queue_depth": 0,
+                   "n_received": base.get("n_received", 0),
+                   "n_dropped": base.get("n_dropped", 0)}
+            for sub in by_topic.get(topic, ()):
+                with sub._lock:
+                    agg["subscriptions"] += 1
+                    agg["queue_depth"] += len(sub._queue)
+                    agg["n_received"] += sub.n_received
+                    agg["n_dropped"] += sub.n_dropped
+            out[topic] = agg
+        return out
+
     # -- delivery -----------------------------------------------------------
 
     def _dispatch(self, topic: str, msg: Any, pub_qos: QoSProfile) -> None:
+        # Causal tracing: derive this publish's context BEFORE delivery
+        # (root ids deterministic from (seed, topic, seq); a publish
+        # inside a traced callback chains as a child). The context is a
+        # side-channel — the message object is never touched — and it
+        # rides the reorder hold / mailbox queues next to its sample.
+        ctx = self.tracer.on_publish(topic) if self.tracer is not None \
+            else None
         # One lock acquisition covers the latch write and the subscriber
         # snapshot, so a subscriber joining mid-publish cannot receive the
         # sample twice (once from the latch, once from the snapshot).
@@ -211,7 +294,7 @@ class Bus:
                 self._latched[topic] = msg
             subs = list(self._subs.get(topic, ()))
         for sub in subs:
-            delivery = [msg]
+            delivery = [(msg, ctx)]
             if sub.qos.reliability is Reliability.BEST_EFFORT:
                 with self._lock:
                     if self._rng.random() < self.drop_prob:
@@ -221,23 +304,46 @@ class Bus:
                     if self._rng.random() < self.reorder_prob:
                         # Hold this sample; release it after the next one.
                         held = self._reorder_hold.pop(key, None)
-                        self._reorder_hold[key] = msg
+                        self._reorder_hold[key] = (msg, ctx)
                         if held is None:
                             continue
                         delivery = [held]
                     else:
                         held = self._reorder_hold.pop(key, None)
                         if held is not None:
-                            delivery = [msg, held]   # swapped order
-            for m in delivery:
-                sub._offer(m)
+                            # swapped order
+                            delivery = [(msg, ctx), held]
+            for m, c in delivery:
+                sub._offer(m, c)
                 if sub.callback is not None:
-                    taken = sub.take()
+                    taken, taken_ctx = sub._take_with_ctx()
                     if taken is not None:
-                        sub.callback(taken)
+                        self._deliver(sub, taken, taken_ctx)
+
+    def _deliver(self, sub: Subscription, msg: Any, ctx=None) -> None:
+        """Invoke a subscription callback with the sample's causal
+        context current (thread-local) for the duration — how a
+        subscriber's own publishes and captured contexts (e.g. the
+        mapper's per-scan context) chain back to the publish that
+        caused them. `ctx` is the context popped WITH the sample
+        (`_take_with_ctx`), never the shared `taken_ctx` field —
+        concurrent publishers to one topic would race that field
+        between take and delivery and misattribute causal chains."""
+        if self.tracer is not None and ctx is not None:
+            with self.tracer.use(ctx):
+                sub.callback(msg)
+        else:
+            sub.callback(msg)
 
     def _remove_subscription(self, sub: Subscription) -> None:
         with self._lock:
             lst = self._subs.get(sub.topic)
             if lst and sub in lst:
                 lst.remove(sub)
+                # Fold the departing mailbox's totals into the retired
+                # carry (monotone /metrics counters across churn). The
+                # sub is closed: its counters are final.
+                agg = self._retired_stats.setdefault(
+                    sub.topic, {"n_received": 0, "n_dropped": 0})
+                agg["n_received"] += sub.n_received
+                agg["n_dropped"] += sub.n_dropped

@@ -154,6 +154,12 @@ class MapApiServer:
                                       voxel_mapper=voxel_mapper)
             mapper.add_revision_listener(self.serving.on_map_revision)
 
+        #: The /metrics exposition, declared once (obs/registry.py):
+        #: collectors close over this server and read live state at
+        #: render time; registration order is the historical document
+        #: order (byte-compatible refactor of the hand-built assembly).
+        self._registry = self._build_metrics_registry()
+
         bus.subscribe("/map", qos_map, callback=self._map_cb)
         bus.subscribe("/frontiers", callback=self._frontiers_cb)
 
@@ -270,7 +276,7 @@ class MapApiServer:
         "/", "/start", "/stop", "/status", "/map-image", "/voxel-image",
         "/frontiers", "/metrics", "/save", "/load", "/goal",
         "/goal/cancel", "/save-map", "/tiles", "/voxel-tiles",
-        "/map-events"))
+        "/map-events", "/trace"))
 
     def _record_request(self, path: str, elapsed_s: float,
                         status: int = 200) -> None:
@@ -336,7 +342,24 @@ class MapApiServer:
     def handle(self, path: str, method: str = "GET",
                headers=None) -> Tuple:
         """Route a request; returns (status, content-type, body) or
-        (status, content-type, body, extra-headers-dict)."""
+        (status, content-type, body, extra-headers-dict). When causal
+        tracing is armed the whole handler runs inside an `http:<route>`
+        span, so goal publishes and checkpoint mutations made from HTTP
+        chain under the request that caused them."""
+        tracer = getattr(self.bus, "tracer", None)
+        if tracer is None:
+            return self._handle(path, method, headers)
+        route = path.split("?")[0].rstrip("/") or "/"
+        if route not in self._KNOWN_ROUTES:
+            # Collapse like _record_request does: the tracer keys its
+            # per-(parent, topic) seq table by span name, so raw
+            # client-controlled paths would grow it without bound.
+            route = "other"
+        with tracer.span(f"http:{route}"):
+            return self._handle(path, method, headers)
+
+    def _handle(self, path: str, method: str = "GET",
+                headers=None) -> Tuple:
         route = path.split("?")[0].rstrip("/") or "/"
         dead = self._dead_node_guard(route)
         if dead is not None:
@@ -433,6 +456,8 @@ class MapApiServer:
             return self._frontiers()
         if route == "/metrics":
             return 200, "text/plain", self._metrics().encode()
+        if route == "/trace":
+            return self._trace(path)
         if route in ("/save", "/load"):
             # Mutations are POST-only (ADVICE r3): GET /load from a link
             # prefetcher would silently replace the running map.
@@ -889,6 +914,31 @@ class MapApiServer:
             self._png_cache[name] = (data, time.monotonic(), key)
         return data
 
+    def _trace(self, path: str) -> Tuple[int, str, bytes]:
+        """GET /trace?since=<seq> — the tracer's span ring as Chrome-
+        trace/Perfetto events, incrementally: only spans whose monotone
+        `seq` stamp exceeds `since` (omitted/0 = everything still in
+        the ring), plus `next` to pass as the following poll's `since`
+        — a poller tails the live trace without re-downloading the
+        ring. 404 when tracing is off (`ObsConfig.enabled=False`), the
+        /tiles-when-serving-disabled convention."""
+        tracer = getattr(self.bus, "tracer", None)
+        if tracer is None:
+            return 404, "application/json", json.dumps(
+                {"error": "tracing disabled (ObsConfig.enabled=False)"}
+            ).encode()
+        from jax_mapping.obs.export import chrome_events
+        q = parse_qs(urlparse(path).query)
+        try:
+            since = int(q.get("since", ["0"])[0])
+        except (ValueError, IndexError):
+            return 400, "application/json", json.dumps(
+                {"error": "since must be an integer span seq"}).encode()
+        spans = tracer.spans_since(since)
+        return 200, "application/json", json.dumps(
+            {"traceEvents": chrome_events(spans),
+             "next": spans[-1]["seq"] if spans else since}).encode()
+
     def _frontiers(self) -> Tuple[int, str, bytes]:
         with self._lock:
             fr = self._latest_frontiers
@@ -903,247 +953,352 @@ class MapApiServer:
         return 200, "application/json", json.dumps(body).encode()
 
     def _metrics(self) -> str:
-        lines = [
-            "# TYPE jax_mapping_http_requests_total counter",
-            f"jax_mapping_http_requests_total {self.n_requests}",
-            "# TYPE jax_mapping_png_cache_hits_total counter",
-            f"jax_mapping_png_cache_hits_total {self.n_png_cache_hits}",
-        ]
-        if self.brain is not None:
+        return self._registry.render()
+
+    def _build_metrics_registry(self):
+        """Declare the `/metrics` exposition (obs/registry.py).
+
+        Registration order IS exposition order and every value keeps
+        its historical format string, so the registry reproduces the
+        hand-assembled pre-obs document BYTE-for-byte for every family
+        that existed before it (pinned by tests/test_obs.py +
+        test_scenarios.py) — dashboards and scrape configs survive the
+        refactor. New families (bus subscription health, stage-latency
+        histograms, obs counters) append after the historical tail.
+        Collectors returning None omit their family — the conditional-
+        subsystem pattern (`if self.brain is not None: lines += ...`)
+        as data."""
+        from jax_mapping.obs.registry import (Family, MetricsRegistry,
+                                              histogram_samples,
+                                              summary_samples)
+        reg = MetricsRegistry()
+        reg.family("jax_mapping_http_requests_total", "counter",
+                   lambda: [("", str(self.n_requests))])
+        reg.family("jax_mapping_png_cache_hits_total", "counter",
+                   lambda: [("", str(self.n_png_cache_hits))])
+
+        def brain_families():
+            if self.brain is None:
+                return None
             st = self.brain.status(lock_timeout_s=self.lock_timeout_s)
-            lines += [
-                "# TYPE jax_mapping_brain_ticks_total counter",
-                f"jax_mapping_brain_ticks_total {st.get('ticks', 0)}",
-                "# TYPE jax_mapping_brain_io_errors_total counter",
-                f"jax_mapping_brain_io_errors_total {st.get('io_errors', 0)}",
-                "# TYPE jax_mapping_brain_connected gauge",
-                f"jax_mapping_brain_connected "
-                f"{int(bool(st.get('connected')))}",
-            ]
-        if self.health is not None:
-            # Degraded-mode ladder as gauges: ok=0 no_lidar=1 dead=2 per
-            # robot (estimator_diverged=3 — a distinct severity, not a
-            # silence rung), driver ok=0 offline=1 recovering=2 —
+            return (
+                Family("jax_mapping_brain_ticks_total", "counter",
+                       (("", str(st.get("ticks", 0))),)),
+                Family("jax_mapping_brain_io_errors_total", "counter",
+                       (("", str(st.get("io_errors", 0))),)),
+                Family("jax_mapping_brain_connected", "gauge",
+                       (("", str(int(bool(st.get("connected"))))),)),
+            )
+        reg.add_source(brain_families)
+
+        def health_families():
+            if self.health is None:
+                return None
+            # Degraded-mode ladder as gauges: ok=0 no_lidar=1 dead=2
+            # per robot (estimator_diverged=3 — a distinct severity,
+            # not a silence rung), driver ok=0 offline=1 recovering=2 —
             # thresholdable without string parsing.
             snap = self.health.snapshot()
             rank = {"ok": 0, "no_lidar": 1, "dead": 2,
                     "estimator_diverged": 3,
                     "offline": 1, "recovering": 2}
-            lines += ["# TYPE jax_mapping_health_robot_state gauge"]
-            lines += [
-                f'jax_mapping_health_robot_state{{robot="{i}"}} '
-                f"{rank.get(s, 0)}"
-                for i, s in enumerate(snap["robots"])]
-            lines += [
-                "# TYPE jax_mapping_health_driver_state gauge",
-                f"jax_mapping_health_driver_state "
-                f"{rank.get(snap['driver'], 0)}",
-                "# TYPE jax_mapping_health_transitions_total counter",
-                f"jax_mapping_health_transitions_total "
-                f"{snap['n_transitions']}",
-            ]
-        if self.supervisor is not None:
+            return (
+                Family("jax_mapping_health_robot_state", "gauge",
+                       tuple((f'{{robot="{i}"}}', str(rank.get(s, 0)))
+                             for i, s in enumerate(snap["robots"]))),
+                Family("jax_mapping_health_driver_state", "gauge",
+                       (("", str(rank.get(snap["driver"], 0))),)),
+                Family("jax_mapping_health_transitions_total", "counter",
+                       (("", str(snap["n_transitions"])),)),
+            )
+        reg.add_source(health_families)
+
+        def supervisor_families():
+            if self.supervisor is None:
+                return None
             sup = self.supervisor.status()
-            lines += [
-                "# TYPE jax_mapping_supervisor_dead_nodes gauge",
-                f"jax_mapping_supervisor_dead_nodes {len(sup['dead'])}",
-                "# TYPE jax_mapping_supervisor_restarts_total counter",
-                f"jax_mapping_supervisor_restarts_total "
-                f"{sum(sup['restarts'].values())}",
-                "# TYPE jax_mapping_supervisor_checkpoints_total counter",
-                f"jax_mapping_supervisor_checkpoints_total "
-                f"{sup['checkpoints']}",
-            ]
-        if self.mapper is not None and hasattr(self.mapper, "match_stats"):
+            return (
+                Family("jax_mapping_supervisor_dead_nodes", "gauge",
+                       (("", str(len(sup["dead"]))),)),
+                Family("jax_mapping_supervisor_restarts_total", "counter",
+                       (("", str(sum(sup["restarts"].values()))),)),
+                Family("jax_mapping_supervisor_checkpoints_total",
+                       "counter", (("", str(sup["checkpoints"])),)),
+            )
+        reg.add_source(supervisor_families)
+
+        def match_families():
             # Branch-and-bound matcher work accounting (SlamDiag
             # match_candidates/prune_ratio): evaluations the last key
             # match scored per robot, and the fraction pruned off the
             # exhaustive sweep.
+            if self.mapper is None \
+                    or not hasattr(self.mapper, "match_stats"):
+                return None
             ms = self.mapper.match_stats()
-            lines += ["# TYPE jax_mapping_match_candidates gauge"]
-            lines += [
-                f'jax_mapping_match_candidates{{robot="{i}"}} {c}'
-                for i, c in enumerate(ms["candidates"])]
-            lines += ["# TYPE jax_mapping_match_prune_ratio gauge"]
-            lines += [
-                f'jax_mapping_match_prune_ratio{{robot="{i}"}} {r}'
-                for i, r in enumerate(ms["prune_ratio"])]
-        fs = (self.mapper.frontier_stats()
-              if self.mapper is not None
-              and hasattr(self.mapper, "frontier_stats") else None)
-        if fs is not None:
+            return (
+                Family("jax_mapping_match_candidates", "gauge",
+                       tuple((f'{{robot="{i}"}}', str(c))
+                             for i, c in enumerate(ms["candidates"]))),
+                Family("jax_mapping_match_prune_ratio", "gauge",
+                       tuple((f'{{robot="{i}"}}', str(r))
+                             for i, r in enumerate(ms["prune_ratio"]))),
+            )
+        reg.add_source(match_families)
+
+        def frontier_families():
             # Incremental frontier publish pipeline
             # (ops/frontier_incremental.py): recompute-vs-skip split,
             # tile coarse-mask cache traffic, live crop size.
-            lines += [
-                "# TYPE jax_mapping_frontier_recompute_total counter",
-                f"jax_mapping_frontier_recompute_total "
-                f"{fs['n_recomputes']}",
-                "# TYPE jax_mapping_frontier_skip_total counter",
-                f"jax_mapping_frontier_skip_total {fs['n_skips']}",
-                "# TYPE jax_mapping_frontier_cache_hits_total counter",
-                f"jax_mapping_frontier_cache_hits_total "
-                f"{fs['cache_hits']}",
-                "# TYPE jax_mapping_frontier_cache_misses_total counter",
-                f"jax_mapping_frontier_cache_misses_total "
-                f"{fs['cache_misses']}",
-                "# TYPE jax_mapping_frontier_crop_cells gauge",
-                f"jax_mapping_frontier_crop_cells {fs['crop_cells']}",
+            fs = (self.mapper.frontier_stats()
+                  if self.mapper is not None
+                  and hasattr(self.mapper, "frontier_stats") else None)
+            if fs is None:
+                return None
+            fams = [
+                Family("jax_mapping_frontier_recompute_total", "counter",
+                       (("", str(fs["n_recomputes"])),)),
+                Family("jax_mapping_frontier_skip_total", "counter",
+                       (("", str(fs["n_skips"])),)),
+                Family("jax_mapping_frontier_cache_hits_total", "counter",
+                       (("", str(fs["cache_hits"])),)),
+                Family("jax_mapping_frontier_cache_misses_total",
+                       "counter", (("", str(fs["cache_misses"])),)),
+                Family("jax_mapping_frontier_crop_cells", "gauge",
+                       (("", str(fs["crop_cells"])),)),
             ]
             if fs["last_recompute_ms"] is not None:
-                lines += [
-                    "# TYPE jax_mapping_frontier_recompute_ms gauge",
-                    f"jax_mapping_frontier_recompute_ms "
-                    f"{fs['last_recompute_ms']}",
-                ]
-        if self.planner is not None \
-                and hasattr(self.planner, "n_overlay_rebuilds"):
-            lines += [
-                "# TYPE jax_mapping_planner_overlay_rebuilds_total"
-                " counter",
-                f"jax_mapping_planner_overlay_rebuilds_total "
-                f"{self.planner.n_overlay_rebuilds}",
-                "# TYPE jax_mapping_planner_overlay_reuses_total counter",
-                f"jax_mapping_planner_overlay_reuses_total "
-                f"{self.planner.n_overlay_reuses}",
-            ]
-        if self.recovery is not None:
+                fams.append(Family("jax_mapping_frontier_recompute_ms",
+                                   "gauge",
+                                   (("", str(fs["last_recompute_ms"])),)))
+            return fams
+        reg.add_source(frontier_families)
+
+        def planner_families():
+            if self.planner is None \
+                    or not hasattr(self.planner, "n_overlay_rebuilds"):
+                return None
+            return (
+                Family("jax_mapping_planner_overlay_rebuilds_total",
+                       "counter",
+                       (("", str(self.planner.n_overlay_rebuilds)),)),
+                Family("jax_mapping_planner_overlay_reuses_total",
+                       "counter",
+                       (("", str(self.planner.n_overlay_reuses)),)),
+            )
+        reg.add_source(planner_families)
+
+        def recovery_families():
+            if self.recovery is None:
+                return None
             rec = self.recovery.snapshot()
             wd = rec["watchdog"]
-            lines += ["# TYPE jax_mapping_recovery_estimator_score gauge"]
-            lines += [
-                f'jax_mapping_recovery_estimator_score{{robot="{i}"}} {s}'
-                for i, s in enumerate(wd["scores"])]
-            lines += [
-                "# TYPE jax_mapping_recovery_diverge_events_total counter",
-                f"jax_mapping_recovery_diverge_events_total "
-                f"{wd['n_diverge_events']}",
-                "# TYPE jax_mapping_recovery_readmits_total counter",
-                f"jax_mapping_recovery_readmits_total {wd['n_readmits']}",
-                "# TYPE jax_mapping_recovery_reloc_attempts_total counter",
-                f"jax_mapping_recovery_reloc_attempts_total "
-                f"{rec['relocalization']['n_attempts']}",
-                "# TYPE jax_mapping_recovery_reloc_verified_total counter",
-                f"jax_mapping_recovery_reloc_verified_total "
-                f"{rec['relocalization']['n_verified']}",
-                "# TYPE jax_mapping_recovery_stuck_detections_total counter",
-                f"jax_mapping_recovery_stuck_detections_total "
-                f"{rec['antistuck']['n_stuck_detections']}",
-                "# TYPE jax_mapping_recovery_blacklisted_total counter",
-                f"jax_mapping_recovery_blacklisted_total "
-                f"{rec['blacklist']['n_blacklisted']}",
+            fams = [
+                Family("jax_mapping_recovery_estimator_score", "gauge",
+                       tuple((f'{{robot="{i}"}}', str(s))
+                             for i, s in enumerate(wd["scores"]))),
+                Family("jax_mapping_recovery_diverge_events_total",
+                       "counter", (("", str(wd["n_diverge_events"])),)),
+                Family("jax_mapping_recovery_readmits_total", "counter",
+                       (("", str(wd["n_readmits"])),)),
+                Family("jax_mapping_recovery_reloc_attempts_total",
+                       "counter",
+                       (("", str(rec["relocalization"]["n_attempts"])),)),
+                Family("jax_mapping_recovery_reloc_verified_total",
+                       "counter",
+                       (("", str(rec["relocalization"]["n_verified"])),)),
+                Family("jax_mapping_recovery_stuck_detections_total",
+                       "counter",
+                       (("", str(rec["antistuck"]["n_stuck_detections"])),
+                        )),
+                Family("jax_mapping_recovery_blacklisted_total",
+                       "counter",
+                       (("", str(rec["blacklist"]["n_blacklisted"])),)),
             ]
             pc = rec["relocalization"].get("pyramid_cache")
             if pc is not None:
                 # Revision-keyed pyramid cache feeding the pruned
                 # wide-window relocalizer (ops/pyramid.PyramidCache).
-                lines += [
-                    "# TYPE jax_mapping_match_pyramid_cache_hits_total"
-                    " counter",
-                    f"jax_mapping_match_pyramid_cache_hits_total "
-                    f"{pc['n_hits']}",
-                    "# TYPE jax_mapping_match_pyramid_cache_misses_total"
-                    " counter",
-                    f"jax_mapping_match_pyramid_cache_misses_total "
-                    f"{pc['n_misses']}",
-                    "# TYPE jax_mapping_match_pyramid_cache_hit_rate"
-                    " gauge",
-                    f"jax_mapping_match_pyramid_cache_hit_rate "
-                    f"{pc['hit_rate']:.4f}",
+                fams += [
+                    Family("jax_mapping_match_pyramid_cache_hits_total",
+                           "counter", (("", str(pc["n_hits"])),)),
+                    Family("jax_mapping_match_pyramid_cache_misses_total",
+                           "counter", (("", str(pc["n_misses"])),)),
+                    Family("jax_mapping_match_pyramid_cache_hit_rate",
+                           "gauge", (("", f"{pc['hit_rate']:.4f}"),)),
                 ]
-        # Request-serving telemetry: per-route counters + the latency
-        # histogram, snapshotted under the stats lock so the exposition
-        # is internally consistent (bucket counts sum to _count).
-        with self._stats_lock:
-            routes = dict(self.route_requests)
-            lat_counts = list(self._lat_counts)
-            lat_sum = self._lat_sum_s
-            lat_n = self._lat_n
-            n_304 = self.n_304_responses
-            n_degraded = self.n_degraded_responses
-        lines += ["# TYPE jax_mapping_http_requests_by_route_total counter"]
-        lines += [
-            f'jax_mapping_http_requests_by_route_total{{route="{r}"}} {n}'
-            for r, n in sorted(routes.items())]
-        lines += ["# TYPE jax_mapping_http_request_seconds histogram"]
-        cum = 0
-        for le, n in zip(self._lat_buckets_s, lat_counts):
-            cum += n
-            lines += [f'jax_mapping_http_request_seconds_bucket'
-                      f'{{le="{le}"}} {cum}']
-        lines += [
-            f'jax_mapping_http_request_seconds_bucket{{le="+Inf"}} '
-            f"{cum + lat_counts[-1]}",
-            f"jax_mapping_http_request_seconds_sum {lat_sum:.6f}",
-            f"jax_mapping_http_request_seconds_count {lat_n}",
-            "# TYPE jax_mapping_http_not_modified_total counter",
-            f"jax_mapping_http_not_modified_total {n_304}",
-        ]
-        if self.serving is not None:
+            return fams
+        reg.add_source(recovery_families)
+
+        def http_stats_families():
+            # Request-serving telemetry: per-route counters + the
+            # latency histogram, snapshotted under the stats lock ONCE
+            # so the exposition is internally consistent (bucket counts
+            # sum to _count).
+            with self._stats_lock:
+                routes = dict(self.route_requests)
+                lat_counts = list(self._lat_counts)
+                lat_sum = self._lat_sum_s
+                lat_n = self._lat_n
+                n_304 = self.n_304_responses
+            return (
+                Family("jax_mapping_http_requests_by_route_total",
+                       "counter",
+                       tuple((f'{{route="{r}"}}', str(n))
+                             for r, n in sorted(routes.items()))),
+                Family("jax_mapping_http_request_seconds", "histogram",
+                       tuple(histogram_samples(
+                           self._lat_buckets_s, lat_counts, lat_sum,
+                           lat_n))),
+                Family("jax_mapping_http_not_modified_total", "counter",
+                       (("", str(n_304)),)),
+            )
+        reg.add_source(http_stats_families)
+
+        def serving_families():
+            if self.serving is None:
+                return None
             # Tile-store + event-channel health: hit-rates and
             # backpressure drops for the delta-serving path.
             sstats = self.serving.stats()
+            fams = []
             for src in ("grid", "voxel"):
                 st = sstats.get(src)
                 if st is None:
                     continue
-                lines += [
-                    f"# TYPE jax_mapping_serving_{src}_revision gauge",
-                    f"jax_mapping_serving_{src}_revision {st['revision']}",
-                    f"# TYPE jax_mapping_serving_{src}_tiles_encoded_total"
-                    " counter",
-                    f"jax_mapping_serving_{src}_tiles_encoded_total "
-                    f"{st['n_tiles_encoded']}",
-                    f"# TYPE jax_mapping_serving_{src}_tiles_clean_total"
-                    " counter",
-                    f"jax_mapping_serving_{src}_tiles_clean_total "
-                    f"{st['n_tiles_clean_skipped']}",
-                    f"# TYPE jax_mapping_serving_{src}_hint_missed_total"
-                    " counter",
-                    f"jax_mapping_serving_{src}_hint_missed_total "
-                    f"{st['n_hint_missed']}",
+                fams += [
+                    Family(f"jax_mapping_serving_{src}_revision", "gauge",
+                           (("", str(st["revision"])),)),
+                    Family(f"jax_mapping_serving_{src}_tiles_encoded"
+                           "_total", "counter",
+                           (("", str(st["n_tiles_encoded"])),)),
+                    Family(f"jax_mapping_serving_{src}_tiles_clean"
+                           "_total", "counter",
+                           (("", str(st["n_tiles_clean_skipped"])),)),
+                    Family(f"jax_mapping_serving_{src}_hint_missed"
+                           "_total", "counter",
+                           (("", str(st["n_hint_missed"])),)),
                 ]
             ev = sstats["events"]
-            lines += [
-                "# TYPE jax_mapping_serving_event_clients gauge",
-                f"jax_mapping_serving_event_clients {ev['n_clients']}",
-                "# TYPE jax_mapping_serving_events_total counter",
-                f"jax_mapping_serving_events_total {ev['n_events']}",
-                "# TYPE jax_mapping_serving_events_dropped_total counter",
-                f"jax_mapping_serving_events_dropped_total "
-                f"{ev['n_dropped']}",
+            fams += [
+                Family("jax_mapping_serving_event_clients", "gauge",
+                       (("", str(ev["n_clients"])),)),
+                Family("jax_mapping_serving_events_total", "counter",
+                       (("", str(ev["n_events"])),)),
+                Family("jax_mapping_serving_events_dropped_total",
+                       "counter", (("", str(ev["n_dropped"])),)),
             ]
-        lines += [
-            "# TYPE jax_mapping_http_degraded_responses_total counter",
-            f"jax_mapping_http_degraded_responses_total "
-            f"{n_degraded}",
-            "# TYPE jax_mapping_bus_partition_dropped_total counter",
-            f"jax_mapping_bus_partition_dropped_total "
-            f"{self.bus.n_partition_dropped}",
-        ]
-        # Process-wide registry (utils/profiling.py): event counters and
-        # per-stage timings fed by the mapper/brain loops.
-        from jax_mapping.utils import global_metrics
-        snap = global_metrics.snapshot()
-        for name, val in sorted(snap["counters"].items()):
-            metric = "jax_mapping_" + name.replace(".", "_") + "_total"
-            lines += [f"# TYPE {metric} counter", f"{metric} {val}"]
-        for name, st_ in sorted(snap["stages"].items()):
-            base = "jax_mapping_stage_" + name.replace(".", "_")
-            # Valid exposition: the summary family carries only _sum/_count;
-            # derived series are their own gauges.
-            lines += [
-                f"# TYPE {base}_ms summary",
-                f"{base}_ms_count {st_['count']}",
-                f"{base}_ms_sum {st_['sum_ms']:.3f}",
-                f"# TYPE {base}_ms_mean gauge",
-                f"{base}_ms_mean {st_['mean_ms']:.3f}",
-                f"# TYPE {base}_ms_ewma gauge",
-                f"{base}_ms_ewma {st_['ewma_ms']:.3f}",
-                f"# TYPE {base}_ms_max gauge",
-                f"{base}_ms_max {st_['max_ms']:.3f}",
+            return fams
+        reg.add_source(serving_families)
+
+        def degraded_samples():
+            with self._stats_lock:
+                return [("", str(self.n_degraded_responses))]
+        reg.family("jax_mapping_http_degraded_responses_total", "counter",
+                   degraded_samples)
+        reg.family("jax_mapping_bus_partition_dropped_total", "counter",
+                   lambda: [("", str(self.bus.n_partition_dropped))])
+
+        def global_counter_families():
+            # Process-wide registry (utils/profiling.py): event counters
+            # fed by the mapper/brain loops.
+            from jax_mapping.utils import global_metrics
+            return tuple(
+                Family("jax_mapping_" + name.replace(".", "_") + "_total",
+                       "counter", (("", str(val)),))
+                for name, val in
+                sorted(global_metrics.counters.snapshot().items()))
+        reg.add_source(global_counter_families)
+
+        def stage_families():
+            # Valid exposition: the summary family carries only
+            # _sum/_count; derived series are their own gauges.
+            from jax_mapping.utils import global_metrics
+            fams = []
+            for name, st_ in sorted(
+                    global_metrics.stages.snapshot().items()):
+                base = "jax_mapping_stage_" + name.replace(".", "_")
+                fams += [
+                    Family(f"{base}_ms", "summary",
+                           tuple(summary_samples(st_["count"],
+                                                 st_["sum_ms"]))),
+                    Family(f"{base}_ms_mean", "gauge",
+                           (("", f"{st_['mean_ms']:.3f}"),)),
+                    Family(f"{base}_ms_ewma", "gauge",
+                           (("", f"{st_['ewma_ms']:.3f}"),)),
+                    Family(f"{base}_ms_max", "gauge",
+                           (("", f"{st_['max_ms']:.3f}"),)),
+                ]
+            return fams
+        reg.add_source(stage_families)
+
+        # ---- new FAMILY SOURCES (obs tier) register after the
+        # historical ones, so their families render after the
+        # historical tail. (Names/types/formats of historical families
+        # are byte-compatible; the stage block above is NOT a frozen
+        # prefix — it renders sorted over whatever stages have been
+        # recorded, and the new always-on stages (brain.tick, ...)
+        # interleave into that sort, exactly as a newly-recorded stage
+        # always did pre-obs.) ----------------------------------------
+
+        def bus_families():
+            # Per-subscription bus health by topic (ISSUE 9 satellite):
+            # the drop counters bridge/bus.py always recorded but never
+            # exported, plus live queue depth — a silently lossy or
+            # backed-up topic becomes a dashboard fact.
+            stats = self.bus.subscription_stats()
+            if not stats:
+                return None
+            return (
+                Family("jax_mapping_bus_subscription_queue_depth",
+                       "gauge",
+                       tuple((f'{{topic="{t}"}}', str(s["queue_depth"]))
+                             for t, s in stats.items())),
+                Family("jax_mapping_bus_subscription_received_total",
+                       "counter",
+                       tuple((f'{{topic="{t}"}}', str(s["n_received"]))
+                             for t, s in stats.items())),
+                Family("jax_mapping_bus_subscription_dropped_total",
+                       "counter",
+                       tuple((f'{{topic="{t}"}}', str(s["n_dropped"]))
+                             for t, s in stats.items())),
+            )
+        reg.add_source(bus_families)
+
+        def stage_histogram_families():
+            # Fixed log-bucket latency histograms per stage
+            # (utils/profiling.HIST_EDGES_S): mapper tick, match, fuse,
+            # publish_frontiers, serving snapshot, ... — every stage
+            # gets the same bucket grid so runs compare bucket-for-
+            # bucket.
+            from jax_mapping.utils import global_metrics
+            return tuple(
+                Family("jax_mapping_stage_" + name.replace(".", "_")
+                       + "_seconds", "histogram",
+                       tuple(histogram_samples(
+                           h["edges_s"], h["buckets"], h["sum_s"],
+                           h["count"])))
+                for name, h in sorted(
+                    global_metrics.stages.histograms().items()))
+        reg.add_source(stage_histogram_families)
+
+        def obs_families():
+            from jax_mapping.obs.recorder import flight_recorder
+            rs = flight_recorder.stats()
+            fams = [
+                Family("jax_mapping_obs_recorder_events_total", "counter",
+                       (("", str(rs["n_events"])),)),
+                Family("jax_mapping_obs_recorder_dumps_total", "counter",
+                       (("", str(rs["n_dumps"])),)),
             ]
-        return "\n".join(lines) + "\n"
+            tracer = getattr(self.bus, "tracer", None)
+            if tracer is not None:
+                fams.append(Family("jax_mapping_obs_trace_spans_total",
+                                   "counter",
+                                   (("", str(tracer.last_seq())),)))
+            return fams
+        reg.add_source(obs_families)
+        return reg
 
     # -- lifecycle ----------------------------------------------------------
 

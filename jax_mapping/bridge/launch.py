@@ -56,6 +56,10 @@ class Stack:
     supervisor: Optional[Supervisor] = None  # heartbeat watch + restarts
     recovery: Optional[object] = None        # estimator guardrails (RecoveryManager)
     fault_plan: Optional[object] = None      # attached FaultPlan, if any
+    #: Causal tracing (obs/trace.Tracer) when ObsConfig.enabled; the
+    #: same object rides bus.tracer — this field is the test/operator
+    #: handle (span export, /trace backs onto it through the bus).
+    tracer: Optional[object] = None
     #: Auto-checkpoint file the supervisor saves to / resumes the mapper
     #: from ("" = auto-checkpointing disabled; pass checkpoint_dir to
     #: launch_sim_stack to enable).
@@ -157,6 +161,10 @@ class Stack:
         # bumped epoch tells delta clients to drop their cache and
         # resync full instead of raising a revision regression.
         new.restart_epoch = old.restart_epoch + 1
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("restart_epoch", node="jax_mapper",
+                               epoch=new.restart_epoch,
+                               resumed_from_checkpoint=states is not None)
         anchors = self.brain.poses.copy()
         if states is not None:
             new.restore_states(states, anchor_poses=anchors)
@@ -204,7 +212,26 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     checkpoint_dir arms the supervisor's auto-checkpoint cadence (and
     therefore restart-from-checkpoint); None keeps the stack disk-free."""
     res = world_res_m if world_res_m is not None else cfg.grid.resolution_m
-    bus = Bus(domain_id=cfg.domain_id, drop_prob=drop_prob, seed=seed)
+    tracer = None
+    if cfg.obs.enabled:
+        # Causal tracing (obs/): deterministic trace ids derived from
+        # (this seed, topic, per-topic publish seq) — two same-seed
+        # deterministic runs emit identical streams. enabled=False
+        # constructs nothing: the bus hot path is bit-exact pre-obs.
+        from jax_mapping.obs import Tracer
+        tracer = Tracer(seed=seed, capacity=cfg.obs.trace_ring)
+    # The always-on flight recorder follows the newest stack: dumps go
+    # to a `postmortem/` subdir of its checkpoint dir (None = events
+    # only, no files; the subdir keeps MissionReport.checkpoint_files
+    # and generation GC blind to dump artifacts) and include its
+    # tracer's spans when tracing is armed.
+    from jax_mapping.obs.recorder import flight_recorder
+    flight_recorder.configure(
+        dump_dir=(os.path.join(checkpoint_dir, "postmortem")
+                  if checkpoint_dir else None),
+        tracer=tracer, capacity=cfg.obs.recorder_ring)
+    bus = Bus(domain_id=cfg.domain_id, drop_prob=drop_prob, seed=seed,
+              tracer=tracer)
     tf = TfTree()
     for i in range(n_robots):
         ns = robot_ns(i, n_robots)
@@ -293,7 +320,8 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     stack = Stack(cfg=cfg, bus=bus, tf=tf, driver=driver, sim=sim,
                   brain=brain, mapper=mapper, api=api, executor=executor,
                   voxel_mapper=voxel_mapper, planner=planner,
-                  health=health, supervisor=supervisor, recovery=recovery)
+                  health=health, supervisor=supervisor, recovery=recovery,
+                  tracer=tracer)
     if supervisor is not None:
         # Registration needs the Stack (restarter + checkpointer close
         # over it), so it happens after construction. The brain has no

@@ -66,6 +66,15 @@ class MapperNode(Node):
         self.n_robots = n_robots
         self._S, self._F, self._G, self._jnp = S, F, G, jnp
 
+        #: Causal tracing (obs/): the bus's Tracer or None. Set BEFORE
+        #: any subscription exists — `_scan_cb` captures the delivery
+        #: context per scan so a fused scan's span chain reaches back
+        #: to its sim publish. None = pre-obs behavior exactly.
+        self._tracer = getattr(bus, "tracer", None)
+        self._tick_no = 0
+        #: Per-robot monotone fuse-span keys (deterministic — see
+        #: _emit_fuse_spans).
+        self._fuse_no = [0] * n_robots
         self._state_lock = threading.Lock()
         # One grid for the fleet; every state's .grid aliases it.
         self.shared_grid = G.empty_grid(cfg.grid)
@@ -129,7 +138,9 @@ class MapperNode(Node):
         #: ACCUMULATED correction in one step's d_slam — only
         #: matched-after-matched steps are clean samples.
         self._prev_matched = [False] * n_robots
-        self._scan_q: List[List[LaserScan]] = [[] for _ in range(n_robots)]
+        #: Per-robot queued (scan, TraceContext|None) pairs (see
+        #: _scan_cb; the context is None whenever tracing is off).
+        self._scan_q: List[List[tuple]] = [[] for _ in range(n_robots)]
         self._prev_paired: List[Optional[Odometry]] = [None] * n_robots
         #: Shared degraded-mode registry (resilience/health.py) — read
         #: for the dead-robot frontier reassignment; None = pre-
@@ -204,6 +215,7 @@ class MapperNode(Node):
         #: lock — fan-out must never run under _state_lock (lint B2).
         self._revision_listeners: List = []
         self._last_notified_revision = 0
+        self._last_recorded_revision = 0
         self.n_scans_fused = 0
         self.n_scans_dropped_unpaired = 0
         self.n_scans_rejected_stale = 0
@@ -364,11 +376,24 @@ class MapperNode(Node):
     def _notify_revision_listeners(self) -> None:
         """Tick-thread fan-out of revision advances — deliberately
         outside `_state_lock` (lint B2: no foreign code under a lock);
-        a listener landing one tick late is fine, a deadlock is not."""
-        if not self._serving_enabled or not self._revision_listeners:
+        a listener landing one tick late is fine, a deadlock is not.
+        The flight recorder logs the advance at the same coalesced
+        per-tick granularity (obs/recorder.py: the map_revision bump as
+        a structured transition, not just a counter)."""
+        if not self._serving_enabled:
             return
         rev = self.map_revision
-        if rev == self._last_notified_revision:
+        if rev != self._last_recorded_revision:
+            self._last_recorded_revision = rev
+            from jax_mapping.obs.recorder import flight_recorder
+            flight_recorder.record("map_revision", revision=rev)
+        # Listener stamp advances ONLY on an actual delivery: during a
+        # supervisor restart the new mapper ticks before rebind_mapper
+        # re-registers the serving listener, and a stamp taken in that
+        # window would swallow the first post-registration notify (the
+        # /map-events nudge for that revision would never fire).
+        if rev == self._last_notified_revision or \
+                not self._revision_listeners:
             return
         self._last_notified_revision = rev
         for fn in list(self._revision_listeners):
@@ -492,8 +517,14 @@ class MapperNode(Node):
     # -- topic callbacks -----------------------------------------------------
 
     def _scan_cb(self, i: int, msg: LaserScan) -> None:
+        # Queue entries are (scan, delivery TraceContext|None) pairs:
+        # the bus made the publish context current for this callback,
+        # and capturing it HERE (not at tick time) is what lets the
+        # fuse span of a scan that waited in the queue still chain to
+        # the publish that produced it.
+        ctx = self._tracer.current() if self._tracer is not None else None
         with self._state_lock:
-            self._scan_q[i].append(msg)
+            self._scan_q[i].append((msg, ctx))
 
     def _odom_cb(self, i: int, msg: Odometry) -> None:
         with self._state_lock:
@@ -555,13 +586,28 @@ class MapperNode(Node):
         Full windows of `fleet.batch_scans` queued scans go through
         `slam_step_window` (the shared-patch throughput path: one grid
         read-modify-write per window); the remainder steps scan-by-scan.
+
+        Observability wrapper: the whole tick is one `mapper.tick`
+        stage (latency histogram on /metrics) and — when tracing is on
+        — one span, so everything the tick publishes (frontiers, pose,
+        TF, heartbeat) chains under it unless a scan's own delivery
+        context outranks it (`_emit_fuse_spans`).
         """
+        self._tick_no += 1
+        with M.stages.stage("mapper.tick"):
+            if self._tracer is not None:
+                with self._tracer.span("mapper.tick", key=self._tick_no):
+                    self._tick_body()
+            else:
+                self._tick_body()
+
+    def _tick_body(self) -> None:
         jnp = self._jnp
         with self._state_lock:
             work: List[List] = [[] for _ in range(self.n_robots)]
             for i in range(self.n_robots):
-                for scan in sorted(self._scan_q[i],
-                                   key=lambda s: s.header.stamp):
+                for scan, ctx in sorted(self._scan_q[i],
+                                        key=lambda e: e[0].header.stamp):
                     if self.cfg.resilience.enabled and \
                             scan.header.stamp < \
                             self._last_accepted_stamp[i]:
@@ -584,7 +630,7 @@ class MapperNode(Node):
                     # forward, or good reordered scans arriving next
                     # tick would be discarded against a watermark no
                     # fused evidence ever set.
-                    work[i].append((scan, od))
+                    work[i].append((scan, od, ctx))
                 self._scan_q[i].clear()
 
         for i, items in enumerate(work):
@@ -623,14 +669,13 @@ class MapperNode(Node):
                                       ranges_dev[k:k + W])
                     k += W
                 else:
-                    self._step_single(i, items[k][0], items[k][1],
-                                      ranges_dev[k])
+                    self._step_single(i, items[k], ranges_dev[k])
                     k += 1
             if not self._diverged(i):
                 # A step above may have DECLARED divergence: freezing
                 # the correction TF at the last healthy step beats
                 # re-asserting the diverged estimate.
-                self._publish_correction(i, *items[-1])
+                self._publish_correction(i, items[-1][0], items[-1][1])
 
         decayed = False
         # Localization mode tracks against a FROZEN map — healing it
@@ -670,14 +715,36 @@ class MapperNode(Node):
                 self._mark_dirty_all()
         self.n_decay_passes += 1
         M.counters.inc("mapper.decay_passes")
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("decay_pass", n=self.n_decay_passes,
+                               tick=self._decay_ticks)
 
     def _upload_scan_ranges(self, items: List):
         """One robot's queued scans, padded and stacked host-side, as a
         single (N, padded_beams) device transfer (tick's batched-upload
         contract)."""
-        arr = np.stack([self._pad_ranges(s) for s, _ in items])
+        arr = np.stack([self._pad_ranges(it[0]) for it in items])
         M.counters.inc("mapper.scan_upload_batches")
         return self._jnp.asarray(arr)
+
+    def _emit_fuse_spans(self, i: int, items: List) -> None:
+        """One instant `mapper.fuse` span per INSTALLED scan, parented
+        on the scan's bus-delivery context — the causal edge the
+        trace-propagation gate asserts (sim publish -> queue -> fuse).
+        A scan with no captured context (latched delivery, tracing
+        armed mid-run) falls back to the ambient tick span. The span
+        key is a per-robot monotone fuse counter, NOT the scan stamp:
+        stamps are `time.monotonic()` wall clock, and a wall value in
+        the id derivation would break the two-same-seed-runs
+        stream-identity contract."""
+        tr = self._tracer
+        if tr is None:
+            return
+        for it in items:
+            ctx = it[2] if len(it) > 2 else None
+            self._fuse_no[i] += 1
+            tr.emit("mapper.fuse", parent=ctx,
+                    key=(i, self._fuse_no[i]))
 
     def _step_window(self, i: int, items: List, ranges_w) -> None:
         jnp = self._jnp
@@ -689,7 +756,7 @@ class MapperNode(Node):
         with self._state_lock:
             base_grid = self.shared_grid
             base_gen = self._state_gen[i]
-        motion = [self._odom_motion(i, od) for _, od in items]
+        motion = [self._odom_motion(i, it[1]) for it in items]
         wheels_w = np.asarray([[m[0], m[1]] for m in motion], np.float32)
         dts_w = np.asarray([m[2] for m in motion], np.float32)
         state = self.states[i]._replace(grid=base_grid)
@@ -719,6 +786,7 @@ class MapperNode(Node):
                                       items[-1][0].header.stamp)
         if not installed:
             return
+        self._emit_fuse_spans(i, items)
         self.n_windows_fused += 1
         M.counters.inc("mapper.windows_fused")
         # Surface the leading scans' health (they fuse with no match
@@ -742,8 +810,8 @@ class MapperNode(Node):
         return {"candidates": list(self._match_candidates),
                 "prune_ratio": list(self._match_prune_ratio)}
 
-    def _step_single(self, i: int, scan: LaserScan, od: Odometry,
-                     ranges) -> None:
+    def _step_single(self, i: int, item: tuple, ranges) -> None:
+        scan, od = item[0], item[1]
         jnp = self._jnp
         # Generation snapshot before the _odom_motion side effect — see
         # _step_window.
@@ -774,16 +842,17 @@ class MapperNode(Node):
             # steps report a neutral 1.0 — they add no evidence).
             # enabled=False restores pre-resilience fusion exactly (the
             # baseline-comparison contract of the flag).
-            self._reject_low_agreement(i, [(scan, od)])
+            self._reject_low_agreement(i, [item])
             return
         if self._observe_watchdog(i, matched, bool(diag.key_added),
                                   agreement, window=False,
                                   ranges=ranges, grid=base_grid,
                                   pose=state.pose):
-            self._quarantine_items(i, [(scan, od)])
+            self._quarantine_items(i, [item])
             return
-        self._finish_step(i, state, od, 1, matched, closed, base_grid,
-                          base_gen, scan.header.stamp)
+        if self._finish_step(i, state, od, 1, matched, closed, base_grid,
+                             base_gen, scan.header.stamp):
+            self._emit_fuse_spans(i, [item])
 
     def _reject_low_agreement(self, i: int,
                               items: Optional[List] = None) -> None:
@@ -854,11 +923,20 @@ class MapperNode(Node):
     def _declare_diverged(self, i: int) -> None:
         """ESTIMATOR_DIVERGED side effects: the fleet health ladder gets
         the rung (brain coasts the robot, auction reassigns its
-        frontier), the relocalizer's streak starts clean."""
+        frontier), the relocalizer's streak starts clean — and the
+        flight recorder dumps a postmortem (the declaration is exactly
+        the moment the preceding transitions explain)."""
         if self._health is not None:
             self._health.note_estimator(i, True)
         self._recovery.relocalizer.reset(i)
         M.counters.inc("mapper.estimator_diverged_events")
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("estimator_diverged", robot=i)
+        # Async: the declaration happens ON the tick thread — the
+        # snapshot is taken here (deterministic content) but the
+        # multi-MB json+disk write must not stall every robot's fusion
+        # at exactly the moment an estimator is struggling.
+        flight_recorder.dump_async(f"watchdog_divergence_robot{i}")
 
     def _quarantine_items(self, i: int, items: List) -> None:
         """Buffer (scan, odom) pairs instead of fusing them; bounded —
@@ -884,7 +962,7 @@ class MapperNode(Node):
         batched upload; `region_revision` keys the relocalizer's pyramid
         cache so a steady-state attempt reuses its pyramids."""
         self._quarantine_items(i, items)
-        scan, _od = items[-1]
+        scan = items[-1][0]
         with self._state_lock:
             grid = self.shared_grid
             # Captured WITH the grid: the relocalizer refuses to cache a
@@ -912,6 +990,9 @@ class MapperNode(Node):
             self._health.note_estimator(i, False)
         self.n_relocalizations += 1
         M.counters.inc("mapper.relocalizations")
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("relocalized", robot=i,
+                               n=self.n_relocalizations)
 
     def _finish_step(self, i: int, state, od: Odometry, n_scans: int,
                      matched: bool, closed: bool, base_grid,
@@ -1321,6 +1402,16 @@ class MapperNode(Node):
         return None if p is None else p.status()
 
     def publish_frontiers(self) -> None:
+        # Whole-publish latency stage (obs histogram family): covers
+        # BOTH the incremental pipeline and the full-recompute fallback
+        # plus the reassign/blacklist post-passes — the number an
+        # operator compares against the control period. The inner
+        # `mapper.frontier_publish` stage keeps timing just the
+        # incremental compute (PR 6's meaning, unchanged).
+        with M.stages.stage("mapper.publish_frontiers"):
+            self._publish_frontiers_body()
+
+    def _publish_frontiers_body(self) -> None:
         with self._state_lock:
             # ONE consistent section for everything this publish uses:
             # poses, grid, revision and the dirty-tile snapshot. (The

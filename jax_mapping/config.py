@@ -657,6 +657,38 @@ class DecayConfig:
 
 
 @_frozen
+class ObsConfig:
+    """Causal tracing + flight recorder (obs/ subsystem).
+
+    The reference has no instrumentation at all (SURVEY.md §5 "Tracing
+    / profiling: none"); these knobs parameterize the observability
+    layer: (1) `enabled` arms CAUSAL TRACING — a deterministic
+    `TraceContext` (trace ids derived from `(seed, topic, seq)`)
+    carried on every Bus publish/delivery and across mapper tick / HTTP
+    handler boundaries, with spans exported as Chrome-trace/Perfetto
+    JSON (`GET /trace?since=`, `python -m jax_mapping.obs`); two
+    same-seed `run_steps` missions emit IDENTICAL trace streams (the
+    FaultPlan determinism contract extended to telemetry). (2) the ring
+    capacities bound the span ring and the always-on flight recorder
+    (obs/recorder.py — structured load-bearing transitions, auto-dumped
+    to the checkpoint dir on supervisor restarts / watchdog divergence
+    / racewatch reports; the recorder runs regardless of `enabled`,
+    a postmortem that needs a flag flipped beforehand is not one).
+
+    `enabled=False` constructs NO tracer — bit-exact pre-obs behavior;
+    `enabled=True` is host-side bookkeeping only and must be equally
+    bit-inert (both pinned by the obs bit-inertness property test, the
+    DecayConfig/ServingConfig doctrine).
+    """
+
+    enabled: bool = False
+    #: Bounded span-ring capacity (tracing only; ~120 B/span host-side).
+    trace_ring: int = 65536
+    #: Flight-recorder event-ring capacity (always on).
+    recorder_ring: int = 4096
+
+
+@_frozen
 class ServingConfig:
     """Tiled delta map distribution (serving/ subsystem).
 
@@ -741,6 +773,7 @@ class SlamConfig:
     recovery: RecoveryConfig = RecoveryConfig()
     serving: ServingConfig = ServingConfig()
     decay: DecayConfig = DecayConfig()
+    obs: ObsConfig = ObsConfig()
     # slam_toolbox's operating mode (slam_config.yaml:20: "mapping" —
     # the file's comment offers localization as the alternative).
     # "localization" freezes the map: key scans MATCH against it for
@@ -778,6 +811,7 @@ class SlamConfig:
             recovery=RecoveryConfig(**raw.get("recovery", {})),
             serving=ServingConfig(**raw.get("serving", {})),
             decay=DecayConfig(**raw.get("decay", {})),
+            obs=ObsConfig(**raw.get("obs", {})),
             **{k: v for k, v in raw.items()
                if k in ("mode", "map_publish_period_s",
                         "tf_publish_period_s", "domain_id")},
@@ -855,8 +889,12 @@ def configs_equivalent(json_a: Optional[str], json_b: Optional[str]) -> bool:
         # `mode` is an OPERATING mode, not a state-shape parameter: a
         # checkpoint mapped in "mapping" and resumed under
         # "localization" (map a site, then localize on it) is the
-        # feature's core flow, not drift.
-        return a.replace(mode="mapping") == b.replace(mode="mapping")
+        # feature's core flow, not drift. `obs` is pure telemetry —
+        # tracing on/off changes no state shape and no bit of the map
+        # (the obs bit-inertness property test), so a checkpoint from a
+        # traced run loads into an untraced stack and vice versa.
+        return a.replace(mode="mapping", obs=ObsConfig()) \
+            == b.replace(mode="mapping", obs=ObsConfig())
     except (TypeError, ValueError, KeyError, AttributeError):
         # AttributeError: valid JSON that is not an object ('"x"', '[]')
         # reaches raw.get() — a corrupted config must refuse, not crash.

@@ -187,6 +187,13 @@ def save_checkpoint(path: str, state: Any,
     os.replace(tmp, path)                   # crash-safe swap
     if retain_generations > 2:
         _gc_generations(path, retain_generations)
+    # Flight-recorder transition (obs/): saves are load-bearing — a
+    # postmortem of a bad resume starts with "which generation was
+    # current when". Basename only: absolute tmp dirs would break the
+    # two-same-seed-runs stream-identity contract.
+    from jax_mapping.obs.recorder import flight_recorder
+    flight_recorder.record("checkpoint_save",
+                           name=os.path.basename(path))
 
 
 def _looks_intact(path: str) -> bool:
@@ -253,6 +260,9 @@ def load_checkpoint(path: str, like: Any
                 f"checkpoint leaf {key!r} shape {arr.shape} != template "
                 f"{tmpl.shape} — was the config changed?")
         new_leaves.append(jax.numpy.asarray(arr.astype(tmpl.dtype)))
+    from jax_mapping.obs.recorder import flight_recorder
+    flight_recorder.record("checkpoint_load",
+                           name=os.path.basename(path))
     return jax.tree_util.tree_unflatten(treedef, new_leaves), meta["config"]
 
 

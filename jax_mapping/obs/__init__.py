@@ -1,0 +1,45 @@
+"""Observability subsystem (ISSUE 9): causal tracing, flight recorder,
+declarative metrics registry, postmortem trace-diff.
+
+The reference judged throughput by watching RViz (SURVEY.md §5
+"Tracing / profiling: none"); this package gives the framework the
+observability layer a serving/training stack has, WITHOUT breaking the
+bit-determinism contract every prior PR defended:
+
+* `trace`    — `TraceContext`/`Tracer`: deterministic trace ids derived
+               from `(seed, topic, seq)`, carried across Bus
+               publish/delivery, mapper ticks and HTTP handlers; two
+               same-seed `run_steps` missions emit identical streams.
+               Gated by `ObsConfig.enabled` (False = no Tracer exists,
+               bit-exact pre-obs behavior).
+* `recorder` — `flight_recorder`: always-on bounded ring of structured
+               load-bearing transitions, auto-dumped to the checkpoint
+               dir on supervisor restarts, watchdog divergence and
+               racewatch reports.
+* `registry` — `MetricsRegistry`: the declarative Prometheus exposition
+               that replaced `http_api.py`'s hand-built `/metrics`
+               string (existing families byte-compatible).
+* `export`   — Chrome-trace/Perfetto JSON (also `GET /trace?since=`).
+* `diff`     — same-seed trace-diff: the first divergence point of two
+               event/span streams, for actionable determinism gates.
+
+`python -m jax_mapping.obs` is the CLI (diff two dumps, export a dump
+to a Perfetto-loadable trace). Everything is host-side stdlib — no jax
+import anywhere in the package.
+"""
+
+from jax_mapping.obs.diff import (                         # noqa: F401
+    Divergence, diff_dumps, diff_streams, normalize_events,
+)
+from jax_mapping.obs.export import (                       # noqa: F401
+    chrome_events, dump_to_chrome, write_chrome_trace,
+)
+from jax_mapping.obs.recorder import (                     # noqa: F401
+    FlightRecorder, flight_recorder,
+)
+from jax_mapping.obs.registry import (                     # noqa: F401
+    Family, MetricsRegistry, histogram_samples, summary_samples,
+)
+from jax_mapping.obs.trace import (                        # noqa: F401
+    TraceContext, Tracer, h64,
+)

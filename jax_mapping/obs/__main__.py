@@ -1,0 +1,83 @@
+"""`python -m jax_mapping.obs` — the postmortem CLI.
+
+Subcommands (pure stdlib, fast start — the analysis/__main__ precedent;
+no jax import):
+
+    diff A.json B.json     Trace-diff two flight-recorder dumps (or raw
+                           {"events": [...], "spans": [...]} documents)
+                           from two same-seed runs; prints the first
+                           divergence point per stream. Exit 0 when
+                           identical, 1 on divergence, 2 on usage.
+    export DUMP [-o OUT]   Convert a flight-recorder dump to a Chrome-
+                           trace/Perfetto JSON (default OUT:
+                           DUMP + ".trace.json").
+
+Postmortem workflow (README "Observability"): a chaos gate fails -> the
+recorder auto-dumped to the checkpoint dir -> `diff` the failing run's
+dump against a green same-seed run's to get the first divergent
+transition instead of a grid diff.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+from jax_mapping.obs.diff import diff_dumps
+from jax_mapping.obs.export import dump_to_chrome
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    return doc
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m jax_mapping.obs",
+        description="observability postmortem tools (trace-diff, "
+                    "Perfetto export)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    d = sub.add_parser("diff", help="first divergence of two same-seed "
+                                    "event/span streams")
+    d.add_argument("a")
+    d.add_argument("b")
+    e = sub.add_parser("export", help="flight-recorder dump -> Chrome-"
+                                      "trace/Perfetto JSON")
+    e.add_argument("dump")
+    e.add_argument("-o", "--out", default=None)
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as ex:
+        return 2 if ex.code not in (0, None) else 0
+
+    try:
+        if args.cmd == "diff":
+            res = diff_dumps(_load(args.a), _load(args.b))
+            for stream in ("events", "spans"):
+                div = res[stream]
+                if div is None:
+                    print(f"{stream}: identical")
+                else:
+                    print(f"{stream}: " + div.describe())
+            return 0 if res["identical"] else 1
+        if args.cmd == "export":
+            out = args.out or (args.dump + ".trace.json")
+            doc = dump_to_chrome(_load(args.dump))
+            with open(out, "w") as f:
+                json.dump(doc, f)
+            print(f"wrote {out} ({len(doc['traceEvents'])} events)")
+            return 0
+    except (OSError, ValueError, KeyError) as ex:
+        print(f"error: {ex}", file=sys.stderr)
+        return 2
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

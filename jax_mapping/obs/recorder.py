@@ -1,0 +1,209 @@
+"""Flight recorder: an always-on bounded ring of load-bearing events.
+
+Chaos postmortems used to require RE-RUNNING whole missions because the
+system's state transitions existed only as counters — `/metrics` could
+say a gate failed, never *why*. The recorder keeps the last N
+structured events (map-revision advances, restart epochs, FleetHealth
+ladder moves, FaultPlan window open/close, decay passes, rendezvous
+merge handshakes, checkpoint save/load) in one lock-guarded ring, and
+DUMPS them — plus the tracer's recent spans when one is attached — to
+the checkpoint directory when something goes wrong: a supervisor
+restart, a watchdog divergence declaration, a racewatch report. The
+dump is the first artifact to read after a failed chaos gate; two
+same-seed runs record identical streams (timestamps and absolute
+sequence numbers aside — `obs/diff.py` normalizes those away), so a
+trace-diff of two dumps names the first divergent TRANSITION, not just
+"the arrays differ".
+
+Always on (unlike tracing, which `ObsConfig.enabled` gates): recording
+is one locked deque append per *state transition* — orders of magnitude
+off the hot path — and a postmortem that needs a flag flipped
+beforehand is not a postmortem. Pure stdlib, no jax import.
+
+`flight_recorder` is the process-wide instance (the `global_metrics`
+pattern): io-, resilience- and scenario-layer code records without
+plumbing an object through every constructor; `launch_sim_stack` points
+it at the stack's checkpoint dir and tracer.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import threading
+import time
+from typing import List, Optional
+
+#: Dump-path history kept on the instance (postmortem linkage, e.g.
+#: MissionReport) — bounded so a pathological restart loop cannot grow
+#: host memory through the recorder that exists to debug it.
+_MAX_DUMP_PATHS = 64
+
+#: Dump FILES kept on disk per dump dir, newest win — the same restart
+#: loop must not fill the checkpoint volume either (each dump can be
+#: multi-MB of ring + spans; `retain_generations` bounds the sibling
+#: checkpoint files, this bounds the postmortems).
+_MAX_DUMP_FILES = 32
+
+
+class FlightRecorder:
+    """Bounded structured-event ring + fault-triggered dumps."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        #: Events ever recorded; also each event's monotone `seq` stamp.
+        self.n_events = 0
+        self.n_dumps = 0
+        #: Filename index reservation — distinct from `n_dumps` (count
+        #: of dumps that reached disk): reserved under the ring lock
+        #: BEFORE the write so two threads dumping concurrently (tick
+        #: watchdog vs supervisor restart) never share a flight_NNNN
+        #: slot and overwrite each other.
+        self._dump_seq = 0
+        #: Paths of written dumps, oldest first (bounded).
+        self.dumps: List[str] = []
+        self._dump_dir: Optional[str] = None
+        self._tracer = None
+
+    # -- wiring (launch layer) ----------------------------------------------
+
+    def configure(self, dump_dir: Optional[str] = None, tracer=None,
+                  capacity: Optional[int] = None) -> None:
+        """Point the recorder at a stack's checkpoint dir and tracer
+        (each launch re-configures; the recorder itself is process-
+        wide). `dump_dir=None` disables file dumps — events still
+        record. A capacity change rebuilds the ring, keeping the newest
+        events."""
+        with self._lock:
+            self._dump_dir = dump_dir
+            self._tracer = tracer
+            if capacity is not None and capacity != self._ring.maxlen:
+                self._ring = collections.deque(self._ring,
+                                               maxlen=capacity)
+
+    # -- recording (any thread) ----------------------------------------------
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one structured event. `fields` must be JSON-able and
+        DETERMINISTIC (step/tick/revision numbers, names — never wall
+        times or absolute paths: the same-seed stream-identity contract
+        covers everything but the auto-added `seq`/`wall_ts`)."""
+        with self._lock:
+            self.n_events += 1
+            ev = {"seq": self.n_events, "kind": kind,
+                  "wall_ts": time.time()}
+            ev.update(fields)
+            self._ring.append(ev)
+
+    # -- reading --------------------------------------------------------------
+
+    def mark(self) -> int:
+        """Current event count — pass to `events_since` to scope a run
+        (the process-wide recorder outlives any one stack)."""
+        with self._lock:
+            return self.n_events
+
+    def events_since(self, mark: int = 0) -> List[dict]:
+        with self._lock:
+            return [dict(e) for e in self._ring if e["seq"] > mark]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"n_events": self.n_events, "n_dumps": self.n_dumps,
+                    "ring_len": len(self._ring)}
+
+    # -- postmortem dumps ------------------------------------------------------
+
+    def dump(self, reason: str) -> Optional[str]:
+        """Write the ring (and the attached tracer's recent spans) to
+        `<dump_dir>/flight_<n>_<reason>.json`; returns the path, or
+        None when no dump dir is configured. Never raises — a failing
+        postmortem write must not take down the recovery path that
+        triggered it."""
+        snap = self._snapshot(reason)
+        if snap is None:
+            return None
+        return self._write(*snap)
+
+    def dump_async(self, reason: str) -> Optional[str]:
+        """`dump` with the disk work off the caller's thread: the ring
+        and span SNAPSHOT happens now (same-seed stream identity needs
+        the content pinned at the trigger, not at whenever a writer
+        thread gets scheduled), the json+file I/O runs on a one-shot
+        thread. For dump sites on a control period — the mapper tick
+        watchdog must not stall every robot's fusion behind a multi-MB
+        write at exactly the moment an estimator is struggling.
+        Returns the path the dump WILL land at (None: no dump dir)."""
+        snap = self._snapshot(reason)
+        if snap is None:
+            return None
+        payload, path = snap
+        threading.Thread(target=self._write, args=(payload, path),
+                         name="flight-recorder-dump", daemon=True).start()
+        return path
+
+    def _snapshot(self, reason: str):
+        """Capture (payload, path) at the trigger and record the
+        `postmortem_dump` transition — recorded HERE, not after the
+        write, so the event stream is identical whether the disk
+        cooperates or not (and regardless of writer-thread timing)."""
+        with self._lock:
+            dump_dir = self._dump_dir
+            tracer = self._tracer
+            events = [dict(e) for e in self._ring]
+            if dump_dir is not None:
+                n = self._dump_seq
+                self._dump_seq += 1
+        if dump_dir is None:
+            return None
+        spans = tracer.spans_since(0) if tracer is not None else []
+        safe = re.sub(r"[^A-Za-z0-9_.-]+", "_", reason)[:80]
+        path = os.path.join(dump_dir, f"flight_{n:04d}_{safe}.json")
+        # The dump is itself a load-bearing transition (path kept to a
+        # basename: absolute tmp dirs would break stream identity; the
+        # diff tool additionally ignores `path`).
+        self.record("postmortem_dump", reason=reason,
+                    path=os.path.basename(path))
+        payload = {"reason": reason, "wall_time": time.time(),
+                   "events": events, "spans": spans}
+        return payload, path
+
+    def _write(self, payload: dict, path: str) -> Optional[str]:
+        dump_dir = os.path.dirname(path)
+        try:
+            os.makedirs(dump_dir, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(payload, f)
+        except (OSError, TypeError, ValueError):
+            # TypeError/ValueError: a record() call site slipped a
+            # non-JSON field (e.g. a numpy scalar) past review — the
+            # "never raises" contract outranks losing that dump.
+            return None
+        with self._lock:
+            self.n_dumps += 1
+            self.dumps.append(path)
+            del self.dumps[:-_MAX_DUMP_PATHS]
+        self._gc_dump_files(dump_dir)
+        return path
+
+    @staticmethod
+    def _gc_dump_files(dump_dir: str) -> None:
+        """Keep the newest `_MAX_DUMP_FILES` flight_*.json on disk."""
+        try:
+            names = [f for f in os.listdir(dump_dir)
+                     if f.startswith("flight_") and f.endswith(".json")]
+            if len(names) <= _MAX_DUMP_FILES:
+                return
+            full = [os.path.join(dump_dir, f) for f in names]
+            full.sort(key=lambda p: (os.path.getmtime(p), p))
+            for p in full[:-_MAX_DUMP_FILES]:
+                os.remove(p)
+        except OSError:
+            pass
+
+
+#: The process-wide recorder (the `global_metrics` pattern).
+flight_recorder = FlightRecorder()

@@ -203,6 +203,12 @@ class FaultPlan:
 
     def _note(self, step: int, desc: str) -> None:
         self.log.append((step, desc))
+        # Every window open/clear is a load-bearing transition: the
+        # flight recorder stream interleaves the chaos script with the
+        # system's reactions, which is the whole point of a postmortem
+        # ("the gate failed two events after `door_close door0`").
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("fault", step=step, desc=desc)
 
     # -- the per-step hook ---------------------------------------------------
 

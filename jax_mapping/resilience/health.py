@@ -101,7 +101,11 @@ class FleetHealth:
 
     def note_tick(self, tick: int) -> None:
         """Advance the health clock (brain.update_loop, once per control
-        tick) and fold any staleness into the per-robot states."""
+        tick) and fold any staleness into the per-robot states. Ladder
+        moves also land in the flight recorder — recorded AFTER the
+        lock releases (leaf-lock discipline: no foreign code under
+        `_lock`, the B2 doctrine applied to our own leaf)."""
+        moved = []
         with self._lock:
             self._tick = max(self._tick, tick)
             for i in range(self.n_robots):
@@ -119,6 +123,12 @@ class FleetHealth:
                     self._robot_state[i] = new
                     self.transitions.append(
                         (self._tick, f"robot{i}", old, new))
+                    moved.append((self._tick, f"robot{i}", old, new))
+        if moved:
+            from jax_mapping.obs.recorder import flight_recorder
+            for t, name, old, new in moved:
+                flight_recorder.record("health", name=name, old=old,
+                                       new=new, tick=t)
 
     def note_estimator(self, robot: int, diverged: bool) -> None:
         """Recovery-watchdog feeder: flag (or clear) robot `robot`'s
@@ -149,11 +159,17 @@ class FleetHealth:
 
     def note_driver(self, state: str) -> None:
         assert state in (DRIVER_OK, DRIVER_OFFLINE, DRIVER_RECOVERING)
+        moved = None
         with self._lock:
             if state != self._driver:
-                self.transitions.append(
-                    (self._tick, "driver", self._driver, state))
+                moved = (self._tick, "driver", self._driver, state)
+                self.transitions.append(moved)
                 self._driver = state
+        if moved is not None:
+            from jax_mapping.obs.recorder import flight_recorder
+            flight_recorder.record("health", name="driver",
+                                   old=moved[2], new=moved[3],
+                                   tick=moved[0])
 
     # -- readers (any thread) ------------------------------------------------
 

@@ -140,6 +140,9 @@ class Supervisor(Node):
         self._restart_due[name] = now + delay
         self.events.append((now, name, "dead",
                             f"restart due in {delay} ticks"))
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("supervisor_dead", node=name, tick=now,
+                               restart_in=delay)
 
     def _attempt_restart(self, name: str, now: int) -> None:
         # Beats resumed while the restart was pending (transient stall,
@@ -174,6 +177,14 @@ class Supervisor(Node):
         self._beats[name] = (-1, now)            # fresh grace window
         self.events.append((now, name, "restart",
                             f"attempt {self._n_restarts[name]}"))
+        # Postmortem hook (ISSUE 9): the restart IS the fault-recovery
+        # moment — dump the flight recorder (ring still holds the
+        # transitions that led here) to the checkpoint dir.
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("supervisor_restart", node=name,
+                               tick=now,
+                               attempt=self._n_restarts[name])
+        flight_recorder.dump(f"supervisor_restart_{name}")
 
     # -- export ---------------------------------------------------------------
 

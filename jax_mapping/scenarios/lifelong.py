@@ -72,9 +72,30 @@ class MissionReport:
     restart_epoch: int
     checkpoint_files: List[str]      # basenames in the checkpoint dir
     health_transitions: List[tuple]
+    #: Flight-recorder postmortem dumps THIS mission wrote (basenames
+    #: in the checkpoint dir — supervisor restarts, watchdog
+    #: divergence; obs/recorder.py). The first artifact to read after
+    #: a failed soak gate: `python -m jax_mapping.obs diff` two
+    #: same-seed missions' dumps for the first divergent transition.
+    postmortem_dumps: List[str] = dataclasses.field(default_factory=list)
 
     def known_cells(self, thresh: float = 0.5) -> int:
         return int((np.abs(self.grid) > thresh).sum())
+
+
+def _mission_dumps(recorder, ev_mark: int):
+    """Basenames of the dumps THIS mission triggered, derived from the
+    recorder's `postmortem_dump` events past the mission's starting
+    event mark — NOT from `n_dumps`/`dumps`, which advance only when a
+    dump's (possibly async — mapper divergence dumps write on a
+    one-shot thread) disk write completes: a count-window would miss a
+    final-steps divergence dump still in flight and could attribute a
+    previous mission's late write to this one. Events stamp at snapshot
+    time on the triggering thread, so the window is exact; bounded by
+    the event ring (a >capacity mission loses its earliest, by
+    design)."""
+    return [e["path"] for e in recorder.events_since(ev_mark)
+            if e["kind"] == "postmortem_dump"]
 
 
 def run_lifelong_mission(cfg: SlamConfig, world: np.ndarray, doors,
@@ -89,7 +110,12 @@ def run_lifelong_mission(cfg: SlamConfig, world: np.ndarray, doors,
     same mechanism), runs `steps`, and collects the assertion surface.
     Determinism anchor: same (cfg, world, doors, events, seed, steps)
     → bit-identical report.grid and plan_log."""
+    from jax_mapping.obs.recorder import flight_recorder
     from jax_mapping.scenarios import launch_scenario_stack
+    # Event mark, not a dump count: `postmortem_dump` events stamp at
+    # snapshot time on the triggering thread, so the window stays exact
+    # when a dump's disk write is asynchronous.
+    ev_mark = flight_recorder.mark()
     st = launch_scenario_stack(cfg, world, doors=doors,
                                n_robots=n_robots, realtime=False,
                                seed=seed, checkpoint_dir=checkpoint_dir)
@@ -106,8 +132,11 @@ def run_lifelong_mission(cfg: SlamConfig, world: np.ndarray, doors,
         grid = np.array(np.asarray(st.mapper.merged_grid()), copy=True)
         files = []
         if checkpoint_dir:
+            # Files only: the flight recorder's `postmortem/` subdir
+            # (obs/) shares the checkpoint dir but is not a generation.
             files = sorted(os.path.basename(p) for p in
-                           glob.glob(os.path.join(checkpoint_dir, "*")))
+                           glob.glob(os.path.join(checkpoint_dir, "*"))
+                           if os.path.isfile(p))
         return MissionReport(
             grid=grid,
             plan_log=list(plan.log),
@@ -121,6 +150,7 @@ def run_lifelong_mission(cfg: SlamConfig, world: np.ndarray, doors,
             checkpoint_files=files,
             health_transitions=(list(st.health.transitions)
                                 if st.health is not None else []),
+            postmortem_dumps=_mission_dumps(flight_recorder, ev_mark),
         )
     finally:
         st.shutdown()

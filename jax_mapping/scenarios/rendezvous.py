@@ -254,10 +254,21 @@ class RendezvousMerger:
                     # streak from THIS candidate (the Relocalizer rule).
                     self._streak.clear()
             self._streak.append(T)
-            if len(self._streak) < self.consecutive:
-                return False
-            verified = self._streak[-1]
-            self._streak.clear()
+            streak_len = len(self._streak)
+            done = streak_len >= self.consecutive
+            if done:
+                verified = self._streak[-1]
+                self._streak.clear()
+        # Flight-recorder handshake trail, recorded AFTER the lock
+        # releases (leaf-lock discipline): each accepted attempt is one
+        # structured transition, so a postmortem of a wrong-basin merge
+        # reads the whole verification streak, not just the outcome.
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("rendezvous_accept", robot=j,
+                               streak=streak_len,
+                               response=round(best_resp, 4))
+        if not done:
+            return False
         self._finish_merge(j, verified,
                            np.asarray(best_pose, np.float32))
         return True
@@ -275,6 +286,10 @@ class RendezvousMerger:
             self.merged_states = states
             self.merged = True
         GM.counters.inc("rendezvous.merges")
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record(
+            "rendezvous_merge", robot=j,
+            transform=[round(float(v), 4) for v in T])
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -32,6 +32,7 @@ import numpy as np
 
 from jax_mapping.bridge import png as png_codec
 from jax_mapping.config import ServingConfig
+from jax_mapping.utils import global_metrics as M
 
 
 def _downsample_max_u8(img):
@@ -113,9 +114,14 @@ class TileStore:
             with self._lock:
                 if rev == self.revision:
                     return self.revision
-            rev, image, hint = self._snapshot_fn()
-            rev = int(rev)
-            self._install(rev, image, hint)
+            # The serving-snapshot latency stage (obs histograms):
+            # covers the mapper snapshot + hash/diff/re-encode — the
+            # cost a /tiles poller pays when the map moved. The cheap
+            # already-fresh peek above is deliberately outside it.
+            with M.stages.stage("serving.snapshot"):
+                rev, image, hint = self._snapshot_fn()
+                rev = int(rev)
+                self._install(rev, image, hint)
             return rev
 
     def _install(self, rev: int, image, hint: Optional[np.ndarray]) -> None:

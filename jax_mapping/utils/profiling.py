@@ -20,10 +20,11 @@ proper metrics surface).
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional, Tuple
 
 
 class Counters:
@@ -46,21 +47,36 @@ class Counters:
             return dict(self._counts)
 
 
+#: Fixed log-spaced latency bucket edges (seconds): 0.25 ms doubling up
+#: to ~8.2 s, + one overflow bucket. FIXED (not per-stage adaptive) so
+#: histograms from two runs — or two same-seed chaos twins — are
+#: directly comparable bucket-for-bucket, the FPGA-2D-LiDAR-SLAM
+#: paper's stage-level pipeline-accounting idea applied host-side.
+HIST_EDGES_S: Tuple[float, ...] = tuple(0.00025 * (2 ** k)
+                                        for k in range(16))
+
+
 class _Stage:
-    __slots__ = ("count", "total_s", "ewma_s", "max_s")
+    __slots__ = ("count", "total_s", "ewma_s", "max_s", "buckets")
 
     def __init__(self) -> None:
         self.count = 0
         self.total_s = 0.0
         self.ewma_s = 0.0
         self.max_s = 0.0
+        #: Per-bucket (non-cumulative) counts; [-1] is overflow.
+        self.buckets = [0] * (len(HIST_EDGES_S) + 1)
 
 
 class StageTimer:
     """Named wall-clock stages: `with timer.stage("fuse"): ...`.
 
     EWMA (alpha=0.1) gives a live rate estimate that survives startup
-    outliers (first-jit compile); max catches stalls.
+    outliers (first-jit compile); max catches stalls; the fixed
+    log-bucket histogram (HIST_EDGES_S) is what p50/p99 dashboards and
+    the `/metrics` `jax_mapping_stage_*_seconds` families read — an
+    EWMA alone cannot answer "what fraction of ticks missed the
+    control period".
     """
 
     def __init__(self, alpha: float = 0.1) -> None:
@@ -83,6 +99,9 @@ class StageTimer:
                 st.ewma_s = (dt if st.count == 1
                              else (1 - self.alpha) * st.ewma_s
                              + self.alpha * dt)
+                # bisect_left: first edge >= dt, i.e. `le` semantics;
+                # past the last edge lands in the overflow bucket.
+                st.buckets[bisect.bisect_left(HIST_EDGES_S, dt)] += 1
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -93,6 +112,20 @@ class StageTimer:
                     "mean_ms": 1e3 * st.total_s / max(st.count, 1),
                     "ewma_ms": 1e3 * st.ewma_s,
                     "max_ms": 1e3 * st.max_s,
+                } for name, st in self._stages.items()
+            }
+
+    def histograms(self) -> Dict[str, Dict[str, object]]:
+        """Per-stage fixed log-bucket histograms: {"edges_s": ...,
+        "buckets": per-bucket counts (last = overflow), "sum_s",
+        "count"} — the MetricsRegistry's stage-histogram source."""
+        with self._lock:
+            return {
+                name: {
+                    "edges_s": HIST_EDGES_S,
+                    "buckets": list(st.buckets),
+                    "sum_s": st.total_s,
+                    "count": st.count,
                 } for name, st in self._stages.items()
             }
 
@@ -114,14 +147,23 @@ global_metrics = Metrics()
 
 @contextlib.contextmanager
 def device_trace(log_dir: str,
-                 host_tracer_level: int = 2) -> Iterator[Optional[str]]:
+                 host_tracer_level: int = 2,
+                 create_perfetto_trace: bool = False
+                 ) -> Iterator[Optional[str]]:
     """XLA/TPU profiler trace around a block; view with TensorBoard's
     profile plugin or Perfetto. Yields the log dir, or None if the
-    profiler is unavailable (it must never take the control loop down)."""
+    profiler is unavailable (it must never take the control loop down).
+
+    `create_perfetto_trace=True` additionally writes the profiler's
+    perfetto_trace.json.gz + a ui.perfetto.dev link — the same viewer
+    `obs/export.py`'s host-side traces load into, so device and host
+    timelines come out of one toolchain. Off by default: the perfetto
+    writer blocks `stop_trace` while it serializes, which a control
+    loop must opt into."""
     import jax
     try:
-        jax.profiler.start_trace(log_dir,
-                                 create_perfetto_trace=False)
+        jax.profiler.start_trace(
+            log_dir, create_perfetto_trace=create_perfetto_trace)
         started = True
     except Exception:                               # noqa: BLE001
         started = False

@@ -363,6 +363,18 @@ def test_no_suppressions_in_scenarios_modules():
         f"{banned}")
 
 
+def test_no_suppressions_in_obs_modules():
+    """ISSUE 9 CI guard, extending the zero-suppression tier: the
+    observability subsystem (`jax_mapping/obs/`) carries ZERO baseline
+    suppressions — the layer whose job is surfacing hazards may not
+    baseline its own."""
+    base = Baseline.load(default_baseline_path())
+    banned = [s for s in base.suppressions
+              if s["path"].startswith("jax_mapping/obs/")]
+    assert not banned, (
+        f"suppressions are not allowed in obs/: {banned}")
+
+
 def test_protection_map_matches_code(package_modules):
     """Every lock-protection declaration names a real class, its real
     lock attributes, and fields actually assigned in that class — a

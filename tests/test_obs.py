@@ -1,0 +1,487 @@
+"""Observability subsystem (ISSUE 9): causal tracing, flight recorder,
+metrics registry, trace-diff, Perfetto export, postmortem CLI.
+
+Pure unit/component tier — NO stack launches (the tier-1 wall budget
+is spoken for); the end-to-end surfaces (trace propagation through a
+live mission, /metrics byte-order, recorder coverage of real
+transitions) piggyback on the shared module-scoped mission stack in
+tests/test_scenarios.py.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from jax_mapping.obs import (
+    Divergence, FlightRecorder, MetricsRegistry, Family, TraceContext,
+    Tracer, chrome_events, diff_dumps, diff_streams, dump_to_chrome,
+    h64, histogram_samples, normalize_events, summary_samples,
+)
+from jax_mapping.obs.__main__ import main as obs_main
+
+
+# ----------------------------------------------------------- trace ids
+
+def test_h64_deterministic_and_never_zero():
+    assert h64("trace", 0, "/scan", 1) == h64("trace", 0, "/scan", 1)
+    assert h64("trace", 0, "/scan", 1) != h64("trace", 0, "/scan", 2)
+    assert h64("trace", 0, "/scan", 1) != h64("trace", 1, "/scan", 1)
+    # 0 is the no-parent sentinel; an id may never collide with it.
+    assert h64() != 0
+
+
+def _drive(tracer):
+    """One scripted emission sequence: publish roots, a traced tick
+    whose inner publish chains, an explicit-parent fuse."""
+    tracer.on_publish("/robot0/scan")
+    tracer.on_publish("/robot0/scan")
+    with tracer.span("mapper.tick", key=1):
+        ctx = tracer.on_publish("/frontiers")
+        tracer.emit("mapper.fuse", parent=ctx, key=(0, 1.25))
+
+
+def test_tracer_streams_identical_across_same_seed_instances():
+    """The deterministic-id contract at the unit tier: two Tracers fed
+    the same sequence emit IDENTICAL streams (ids and all) once the
+    wall-clock fields are normalized away — what makes obs/diff.py able
+    to name a divergence point between two same-seed runs."""
+    a, b = Tracer(seed=7), Tracer(seed=7)
+    _drive(a)
+    _drive(b)
+    assert normalize_events(a.spans_since(0)) \
+        == normalize_events(b.spans_since(0))
+    # A different seed moves every root-derived id.
+    c = Tracer(seed=8)
+    _drive(c)
+    ids = {s["trace_id"] for s in a.spans_since(0)}
+    assert ids.isdisjoint({s["trace_id"] for s in c.spans_since(0)})
+
+
+def test_tracer_root_child_and_ambient_chaining():
+    tr = Tracer(seed=0)
+    root = tr.on_publish("/robot0/scan")
+    assert root.parent_span == 0
+    assert root.trace_id == h64("trace", 0, "/robot0/scan", 1)
+    # Delivery context made current -> a publish inside chains under it.
+    with tr.use(root):
+        child = tr.on_publish("/pose")
+        assert child.trace_id == root.trace_id
+        assert child.parent_span == root.span_id
+        with tr.span("mapper.tick") as tick:
+            assert tick.parent_span == root.span_id
+            inner = tr.emit("mapper.fuse")
+            assert inner.parent_span == tick.span_id
+    assert tr.current() is None                  # restored after the block
+    # Explicit parent beats the ambient context.
+    other = TraceContext(h64("t"), h64("s"), 0)
+    with tr.use(root):
+        got = tr.emit("x", parent=other)
+        assert got.trace_id == other.trace_id
+
+
+def test_tracer_use_restores_context_on_exception():
+    tr = Tracer(seed=0)
+    ctx = tr.on_publish("/a")
+    with pytest.raises(RuntimeError):
+        with tr.use(ctx):
+            raise RuntimeError("boom")
+    assert tr.current() is None
+
+
+def test_tracer_ring_bounded_and_since_filter():
+    tr = Tracer(seed=0, capacity=8)
+    for k in range(20):
+        tr.emit("e", key=k)
+    spans = tr.spans_since(0)
+    assert [s["seq"] for s in spans] == list(range(13, 21))
+    assert [s["seq"] for s in tr.spans_since(17)] == [18, 19, 20]
+    assert tr.last_seq() == 20
+    assert tr.stats() == {"n_spans": 20, "ring_len": 8}
+
+
+# ------------------------------------------------------ flight recorder
+
+def test_recorder_ring_mark_and_capacity():
+    rec = FlightRecorder(capacity=4)
+    for k in range(6):
+        rec.record("ev", k=k)
+    assert [e["k"] for e in rec.events_since(0)] == [2, 3, 4, 5]
+    m = rec.mark()
+    rec.record("late", k=6)
+    assert [e["kind"] for e in rec.events_since(m)] == ["late"]
+    # A capacity change rebuilds the ring keeping the newest events.
+    rec.configure(capacity=2)
+    assert [e["k"] for e in rec.events_since(0)] == [5, 6]
+
+
+def test_recorder_dump_roundtrip(tmp_path):
+    rec = FlightRecorder()
+    rec.record("map_revision", revision=3)
+    assert rec.dump("no_dir_configured") is None  # events-only mode
+    tr = Tracer(seed=0)
+    tr.emit("mapper.fuse")
+    rec.configure(dump_dir=str(tmp_path), tracer=tr)
+    path = rec.dump("watchdog divergence robot/0")
+    assert path is not None and path.startswith(str(tmp_path))
+    doc = json.load(open(path))
+    assert doc["reason"] == "watchdog divergence robot/0"
+    assert [e["kind"] for e in doc["events"]] == ["map_revision"]
+    assert [s["name"] for s in doc["spans"]] == ["mapper.fuse"]
+    # The dump itself lands in the ring as a transition (basename only
+    # — absolute tmp paths would break same-seed stream identity).
+    kinds = [e["kind"] for e in rec.events_since(0)]
+    assert kinds == ["map_revision", "postmortem_dump"]
+    ev = rec.events_since(0)[-1]
+    assert "/" not in ev["path"]
+    assert rec.stats()["n_dumps"] == 1 and rec.dumps == [path]
+
+
+def test_recorder_dump_never_raises(tmp_path):
+    """A failing postmortem write must not take down the recovery path
+    that triggered it — an unwritable dump dir degrades to None."""
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("file, not dir")
+    rec = FlightRecorder()
+    rec.configure(dump_dir=str(blocker / "sub"))
+    rec.record("ev")
+    assert rec.dump("doomed") is None
+    assert rec.stats()["n_dumps"] == 0
+
+
+# ----------------------------------------------------------- trace-diff
+
+def _stream(n, wall=0.0):
+    return [{"seq": k + 1, "kind": "ev", "step": k, "wall_ts": wall + k}
+            for k in range(n)]
+
+
+def test_diff_streams_identical_modulo_volatile():
+    a, b = _stream(5), _stream(5, wall=100.0)    # wall clocks differ
+    b[2]["seq"] = 99                             # absolute seqs differ
+    assert diff_streams(a, b) is None
+
+
+def test_diff_streams_names_first_divergence():
+    a, b = _stream(5), _stream(5)
+    b[3]["step"] = 42
+    div = diff_streams(a, b)
+    assert isinstance(div, Divergence) and div.index == 3
+    assert div.a["step"] == 3 and div.b["step"] == 42
+    assert "step=42" in div.describe()
+    # Length mismatch: the shorter stream "ended".
+    div = diff_streams(_stream(3), _stream(5))
+    assert div.index == 3 and div.a is None and div.b["step"] == 3
+    assert "<stream ended>" in div.describe()
+
+
+def test_diff_dumps_one_call_answer():
+    da = {"events": _stream(3), "spans": _stream(2)}
+    db = {"events": _stream(3), "spans": _stream(2)}
+    assert diff_dumps(da, db)["identical"]
+    db["spans"][1]["step"] = 9
+    res = diff_dumps(da, db)
+    assert not res["identical"]
+    assert res["events"] is None and res["spans"].index == 1
+
+
+# --------------------------------------------------------------- export
+
+def test_chrome_events_shape():
+    tr = Tracer(seed=0)
+    tr.emit("mapper.fuse")
+    (ev,) = chrome_events(tr.spans_since(0))
+    assert ev["ph"] == "X" and ev["name"] == "mapper.fuse"
+    assert ev["dur"] >= 1.0                      # instant-span floor
+    assert len(ev["args"]["trace_id"]) == 16     # 64-bit hex
+    doc = dump_to_chrome({"spans": tr.spans_since(0),
+                          "events": [{"kind": "fault", "step": 3}],
+                          "reason": "r"})
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert names == ["mapper.fuse", "fault"]
+    assert doc["traceEvents"][1]["ph"] == "i"
+
+
+def test_obs_cli_diff_and_export(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"events": _stream(4), "spans": []}))
+    b.write_text(json.dumps({"events": _stream(4), "spans": []}))
+    assert obs_main(["diff", str(a), str(b)]) == 0
+    assert "events: identical" in capsys.readouterr().out
+    ev = _stream(4)
+    ev[1]["step"] = 77
+    b.write_text(json.dumps({"events": ev, "spans": []}))
+    assert obs_main(["diff", str(a), str(b)]) == 1
+    assert "first divergence at event #1" in capsys.readouterr().out
+    assert obs_main(["export", str(a)]) == 0
+    out = json.load(open(str(a) + ".trace.json"))
+    assert len(out["traceEvents"]) == 4
+    assert obs_main(["diff", str(a)]) == 2       # usage error
+    assert obs_main(["diff", str(a), str(tmp_path / "nope.json")]) == 2
+
+
+# ------------------------------------------------------ metrics registry
+
+def test_registry_renders_exact_document():
+    """The renderer's byte contract, pinned on a fully-known registry:
+    registration order is exposition order, values pass through as
+    pre-formatted strings, histogram/summary helpers produce the
+    repo's exposition shapes exactly."""
+    reg = MetricsRegistry()
+    reg.family("jm_requests_total", "counter", lambda: [("", "7")])
+    reg.family("jm_absent", "gauge", lambda: None)   # omitted family
+    reg.family("jm_state", "gauge",
+               lambda: [('{robot="0"}', "1"), ('{robot="1"}', "2")])
+    reg.add_source(lambda: (
+        Family("jm_lat_seconds", "histogram",
+               tuple(histogram_samples((0.1, 0.2), [1, 2, 3], 0.75, 6))),
+        Family("jm_stage_ms", "summary",
+               tuple(summary_samples(4, 12.3456))),
+    ))
+    assert reg.render() == (
+        "# TYPE jm_requests_total counter\n"
+        "jm_requests_total 7\n"
+        "# TYPE jm_state gauge\n"
+        'jm_state{robot="0"} 1\n'
+        'jm_state{robot="1"} 2\n'
+        "# TYPE jm_lat_seconds histogram\n"
+        'jm_lat_seconds_bucket{le="0.1"} 1\n'
+        'jm_lat_seconds_bucket{le="0.2"} 3\n'
+        'jm_lat_seconds_bucket{le="+Inf"} 6\n'
+        "jm_lat_seconds_sum 0.750000\n"
+        "jm_lat_seconds_count 6\n"
+        "# TYPE jm_stage_ms summary\n"
+        "jm_stage_ms_count 4\n"
+        "jm_stage_ms_sum 12.346\n"
+    )
+
+
+def test_histogram_samples_cumulative_bucket_math():
+    samples = histogram_samples((0.005, 0.01), [2, 0, 5], 0.123456, 7)
+    assert samples == [
+        ('_bucket{le="0.005"}', "2"),
+        ('_bucket{le="0.01"}', "2"),
+        ('_bucket{le="+Inf"}', "7"),
+        ("_sum", "0.123456"),
+        ("_count", "7"),
+    ]
+
+
+# ------------------------------------------------- stage histograms
+
+def test_stage_timer_histograms_fixed_buckets(monkeypatch):
+    from jax_mapping.utils import profiling as P
+    t = P.StageTimer()
+    # Deterministic durations: 1 ms (== edge, le semantics -> that
+    # bucket), 3 ms, and one past the last edge -> overflow.
+    ticks = iter([0.0, 0.001, 10.0, 10.003, 20.0, 20.0 + 16.0])
+    monkeypatch.setattr(P.time, "perf_counter", lambda: next(ticks))
+    for _ in range(3):
+        with t.stage("mapper.tick"):
+            pass
+    h = t.histograms()["mapper.tick"]
+    assert h["edges_s"] == P.HIST_EDGES_S
+    assert h["count"] == 3 and sum(h["buckets"]) == 3
+    assert h["buckets"][P.HIST_EDGES_S.index(0.001)] == 1
+    import bisect
+    assert h["buckets"][bisect.bisect_left(P.HIST_EDGES_S, 0.003)] == 1
+    assert h["buckets"][-1] == 1                 # 16 s -> overflow
+    np.testing.assert_allclose(h["sum_s"], 0.001 + 0.003 + 16.0)
+
+
+# ------------------------------------------------- device_trace satellite
+
+def test_device_trace_start_failure_yields_none(monkeypatch, tmp_path):
+    """The start-failure path (previously untested): a profiler that
+    refuses to start yields None and must NOT call stop_trace — the
+    control loop proceeds untraced instead of dying."""
+    import jax
+
+    def boom(*a, **k):
+        raise RuntimeError("profiler unavailable")
+
+    stopped = []
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stopped.append(True))
+    from jax_mapping.utils.profiling import device_trace
+    with device_trace(str(tmp_path)) as d:
+        assert d is None
+    assert stopped == []
+
+
+def test_device_trace_perfetto_flag_passthrough(monkeypatch, tmp_path):
+    import jax
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace",
+        lambda log_dir, create_perfetto_trace: calls.append(
+            (log_dir, create_perfetto_trace)))
+    stopped = []
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stopped.append(True))
+    from jax_mapping.utils.profiling import device_trace
+    with device_trace(str(tmp_path)) as d:
+        assert d == str(tmp_path)
+    with device_trace(str(tmp_path), create_perfetto_trace=True) as d:
+        assert d == str(tmp_path)
+    assert [c[1] for c in calls] == [False, True]   # default stays off
+    assert stopped == [True, True]
+
+
+def test_device_trace_stop_failure_swallowed(monkeypatch, tmp_path):
+    import jax
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda *a, **k: None)
+
+    def boom():
+        raise RuntimeError("serialization exploded")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", boom)
+    from jax_mapping.utils.profiling import device_trace
+    with device_trace(str(tmp_path)) as d:       # must not raise
+        assert d == str(tmp_path)
+
+
+# ------------------------------------------------- racewatch gate (CI)
+
+def test_racewatch_gate_cross_thread_span_emission():
+    """ISSUE 9 CI satellite: hammer one Tracer and one FlightRecorder
+    from concurrent threads (bus delivery / mapper tick / HTTP handler
+    emission in miniature) under RaceWatch — Eraser refinement must
+    converge every declared field on the declared lock with ZERO
+    reports."""
+    from jax_mapping.analysis.protection import groups_by_class
+    from jax_mapping.analysis.racewatch import RaceWatch
+
+    tr = Tracer(seed=0, capacity=256)
+    rec = FlightRecorder(capacity=256)
+    watch = RaceWatch()
+    try:
+        watch.watch_object(tr, groups_by_class()["Tracer"][0],
+                           name="tracer")
+        watch.watch_object(rec, groups_by_class()["FlightRecorder"][0],
+                           name="rec")
+
+        def worker(tid):
+            for k in range(200):
+                ctx = tr.on_publish(f"/robot{tid}/scan")
+                with tr.use(ctx):
+                    with tr.span("mapper.tick", key=(tid, k)):
+                        tr.emit("mapper.fuse", key=k)
+                rec.record("map_revision", revision=k)
+                if k % 50 == 0:
+                    tr.spans_since(0)
+                    rec.events_since(0)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        watch.unwatch_all()
+    assert watch.reports() == [], \
+        "\n".join(r.message for r in watch.reports())
+    # `n_spans` is the cross-thread WRITTEN field (the deque attribute
+    # itself is only read; its mutation is the append under `_lock`) —
+    # its candidate lockset must converge on the declared Tracer lock.
+    counter = watch.field_states()["Tracer.n_spans@tracer"]
+    assert counter.state == "shared-modified"
+    assert "Tracer._lock@tracer" in counter.candidate
+
+
+# --------------------------------------------------- bus context plumbing
+
+def test_bus_carries_context_through_mailboxes():
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.qos import qos_sensor_data
+
+    tr = Tracer(seed=3)
+    bus = Bus(domain_id=1, seed=3, tracer=tr)
+    seen = []
+    bus.subscribe("/robot0/scan",
+                  callback=lambda m: seen.append(tr.current()))
+    pub = bus.publisher("/robot0/scan")
+    pub.publish({"beam": 1})
+    pub.publish({"beam": 2})
+    assert len(seen) == 2 and None not in seen
+    # The delivered context IS the publish root: deterministic id from
+    # (seed, topic, per-topic seq), parent 0.
+    assert seen[0].trace_id == h64("trace", 3, "/robot0/scan", 1)
+    assert seen[1].trace_id == h64("trace", 3, "/robot0/scan", 2)
+    assert seen[0].parent_span == 0
+    # Queue-then-take path (no callback): taken_ctx follows each take,
+    # and overflow drops keep the shadow queue in lockstep.
+    sub = bus.subscribe("/lossy", qos_sensor_data)    # depth 5
+    lossy = bus.publisher("/lossy", qos_sensor_data)
+    for k in range(8):
+        lossy.publish(k)
+    assert sub.n_dropped == 3
+    msg = sub.take(timeout=0)
+    assert msg == 3                                    # oldest surviving
+    assert sub.taken_ctx.trace_id == h64("trace", 3, "/lossy", 4)
+    assert len(sub._queue) == len(sub._ctxq)
+
+
+def test_bus_subscription_stats_aggregate_and_survive_churn():
+    from jax_mapping.bridge.bus import Bus
+
+    bus = Bus(domain_id=1)
+    s1 = bus.subscribe("/scan")
+    s2 = bus.subscribe("/scan")
+    bus.subscribe("/pose", callback=lambda m: None)
+    scan_pub = bus.publisher("/scan")
+    for k in range(3):
+        scan_pub.publish(k)
+    bus.publisher("/pose").publish(0)
+    stats = bus.subscription_stats()
+    assert stats["/scan"] == {"subscriptions": 2, "queue_depth": 6,
+                              "n_received": 6, "n_dropped": 0}
+    assert stats["/pose"]["n_received"] == 1
+    assert stats["/pose"]["queue_depth"] == 0          # drained by callback
+    # Prometheus monotonicity across churn: a closed subscription's
+    # totals fold into the topic's retired carry instead of vanishing.
+    s1.close()
+    s2.close()
+    stats = bus.subscription_stats()
+    assert stats["/scan"] == {"subscriptions": 0, "queue_depth": 0,
+                              "n_received": 6, "n_dropped": 0}
+
+
+# ----------------------------------------------------- /trace endpoint
+
+def test_trace_endpoint_gating_and_incremental_poll():
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.http_api import MapApiServer
+
+    # Tracing off: /trace answers 404 (the /tiles-when-disabled rule).
+    api = MapApiServer(Bus(domain_id=1), mapper=None, port=0)
+    status, ctype, body = api.handle("/trace")[:3]
+    assert status == 404 and b"tracing disabled" in body
+
+    tr = Tracer(seed=0)
+    bus = Bus(domain_id=1, tracer=tr)
+    api = MapApiServer(bus, mapper=None, port=0)
+    status, _, body = api.handle("/trace?since=0")[:3]
+    assert status == 200
+    doc = json.loads(body)
+    # A handler span closes AFTER its own response renders: the first
+    # poll sees an empty ring and echoes `since` back as `next`.
+    assert doc["traceEvents"] == [] and doc["next"] == 0
+    # The second poll sees the first request's `http:/trace` span.
+    doc2 = json.loads(api.handle("/trace?since=0")[2])
+    assert any(e["name"] == "http:/trace" for e in doc2["traceEvents"])
+    nxt = doc2["next"]
+    assert nxt == tr.last_seq() - 1              # in-flight span pending
+    # Incremental tail: only spans after `since` come back.
+    doc3 = json.loads(api.handle(f"/trace?since={nxt}")[2])
+    assert all(e["args"]["seq"] > nxt for e in doc3["traceEvents"])
+    assert api.handle("/trace?since=bogus")[0] == 400
+    # /metrics renders through the registry with no stack attached, and
+    # the obs tail families are present.
+    text = api.handle("/metrics")[2].decode()
+    assert "# TYPE jax_mapping_obs_recorder_events_total counter" in text
+    assert "# TYPE jax_mapping_obs_trace_spans_total counter" in text
