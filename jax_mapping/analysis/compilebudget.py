@@ -164,6 +164,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="jit recompile-budget tracker (ratcheted like "
                     "analysis/baseline.json)")
     p.add_argument("--budget", default=None, metavar="JSON")
+    p.add_argument("--ledger", action="store_true",
+                   help="with --check: run the scenario under the "
+                        "dispatch profiler (obs/devprof.py) and ALSO "
+                        "require the static XLA cost ledger to cover "
+                        "every budgeted function (FLOPs/bytes per "
+                        "compiled variant, variant counts within "
+                        "budget) — the ISSUE 10 attribution gate")
     g = p.add_mutually_exclusive_group(required=True)
     g.add_argument("--measure", action="store_true",
                    help="run the canonical scenario, print counts")
@@ -199,8 +206,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # The stack logs bring-up lines to stdout; push them to stderr
         # so --measure's stdout is exactly one JSON document.
         import contextlib
+        ledger = None
         with contextlib.redirect_stdout(sys.stderr):
-            measured = measure_scenario()
+            if args.ledger:
+                from jax_mapping.obs.ledger import run_cost_ledger
+                measured, _profiler, ledger = run_cost_ledger()
+            else:
+                measured = measure_scenario()
     except Exception as e:              # noqa: BLE001
         print(f"compilebudget: scenario failed: {e}", file=sys.stderr)
         return 2
@@ -215,9 +227,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     over, unknown, stale = budget.check(measured)
-    for line in over + unknown + stale:
+    ledger_violations = []
+    if ledger is not None:
+        ledger_violations = ledger.cross_check(path)
+    for line in over + unknown + stale + ledger_violations:
         print(line)
-    return 1 if (over or unknown or stale) else 0
+    return 1 if (over or unknown or stale or ledger_violations) else 0
 
 
 if __name__ == "__main__":

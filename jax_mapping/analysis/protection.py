@@ -170,6 +170,25 @@ REPO_PROTECTION: List[LockGroup] = [
     # collects outside (no foreign collector code under our lock).
     group("MetricsRegistry", "_lock",
           ["_sources"]),
+    # Dispatch profiler (obs/devprof.py): the per-function profile
+    # table mutates under `_lock` from every thread that dispatches a
+    # wrapped jitted function at once — mapper tick, HTTP workers
+    # (serving tile hashing), test drivers — exactly the cross-thread
+    # emission the devprof racewatch gate hammers (tests/test_obs.py).
+    # `_bindings`/`installed` are install-time state serialized by the
+    # module-level _INSTALL_LOCK (not an instance attribute, so out of
+    # racewatch's instance scope — the lockfree_ok escape documents
+    # that, it does not sanction bare mutation).
+    group("DispatchProfiler", "_lock",
+          ["_profiles"],
+          lockfree_ok=["_bindings", "installed"]),
+    # Cost ledger (obs/ledger.py): ONE keyed structure holds both the
+    # reservation (None entry, AOT compile in flight) and the finished
+    # cost entries — deliberately a single field so there is no
+    # correlated pair to tear across collect()'s two lock sections
+    # (the C2 class this layout exists to avoid).
+    group("CostLedger", "_lock",
+          ["_collected"]),
 ]
 
 

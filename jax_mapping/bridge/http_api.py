@@ -90,7 +90,7 @@ class MapApiServer:
                  extra_status: Optional[Callable[[], dict]] = None,
                  mapper=None, checkpoint_dir: str = "checkpoints",
                  voxel_mapper=None, planner=None, health=None,
-                 supervisor=None, recovery=None,
+                 supervisor=None, recovery=None, devprof=None,
                  lock_timeout_s: Optional[float] = 2.0,
                  socket_timeout_s: Optional[float] = 30.0):
         self.bus = bus
@@ -111,6 +111,17 @@ class MapApiServer:
         #: quarantine/relocalization counters, anti-stuck ladder and
         #: frontier blacklist ride along on /status and /metrics.
         self.recovery = recovery
+        #: Device-side dispatch profiler (obs/devprof.py): per-function
+        #: dispatch accounting, recompile counters, memory watermarks
+        #: and the collected-so-far cost ledger ride along on /status
+        #: (`perf`) and /metrics (`jax_mapping_device_*`). The ledger
+        #: exports what collect() already gathered — an HTTP handler
+        #: never AOT-compiles.
+        self.devprof = devprof
+        self.cost_ledger = None
+        if devprof is not None:
+            from jax_mapping.obs.ledger import CostLedger
+            self.cost_ledger = CostLedger(devprof)
         self.lock_timeout_s = lock_timeout_s
         self.n_degraded_responses = 0
         self._lock = threading.Lock()
@@ -350,6 +361,12 @@ class MapApiServer:
         if tracer is None:
             return self._handle(path, method, headers)
         route = path.split("?")[0].rstrip("/") or "/"
+        if route == "/trace":
+            # The trace poller must not trace ITSELF: a span per poll
+            # would advance the ring every request, so the /trace ETag
+            # (keyed on the span seq) could never 304 and a tailing
+            # poller would chase its own wake forever.
+            return self._handle(path, method, headers)
         if route not in self._KNOWN_ROUTES:
             # Collapse like _record_request does: the tracer keys its
             # per-(parent, topic) seq table by span name, so raw
@@ -437,6 +454,21 @@ class MapApiServer:
                     body["plan_reachable_by_robot"] = {
                         str(k): v for k, v in
                         self.planner.reachable_by_robot.items()}
+            if self.devprof is not None:
+                # Device-side performance picture (`/status.perf`):
+                # per-function dispatch attribution, live recompile
+                # counters, backend memory watermarks (None on CPU —
+                # the graceful-None contract) and the cost-ledger
+                # entries collected so far (collection is explicit:
+                # CLI / gate / tests — never an HTTP side effect).
+                body["perf"] = {
+                    "dispatch": self.devprof.snapshot(),
+                    "recompiles": self.devprof.recompiles(),
+                    "memory": self.devprof.memory_stats(),
+                    "cost_ledger": self.cost_ledger.snapshot(),
+                    "cost_ledger_uncollected":
+                        self.cost_ledger.n_uncollected(),
+                }
             if self.extra_status is not None:
                 body.update(self.extra_status())
             return 200, "application/json", json.dumps(body).encode()
@@ -457,7 +489,7 @@ class MapApiServer:
         if route == "/metrics":
             return 200, "text/plain", self._metrics().encode()
         if route == "/trace":
-            return self._trace(path)
+            return self._trace(path, headers)
         if route in ("/save", "/load"):
             # Mutations are POST-only (ADVICE r3): GET /load from a link
             # prefetcher would silently replace the running map.
@@ -914,14 +946,22 @@ class MapApiServer:
             self._png_cache[name] = (data, time.monotonic(), key)
         return data
 
-    def _trace(self, path: str) -> Tuple[int, str, bytes]:
+    def _trace(self, path: str, headers=None) -> Tuple:
         """GET /trace?since=<seq> — the tracer's span ring as Chrome-
         trace/Perfetto events, incrementally: only spans whose monotone
         `seq` stamp exceeds `since` (omitted/0 = everything still in
         the ring), plus `next` to pass as the following poll's `since`
         — a poller tails the live trace without re-downloading the
         ring. 404 when tracing is off (`ObsConfig.enabled=False`), the
-        /tiles-when-serving-disabled convention."""
+        /tiles-when-serving-disabled convention.
+
+        Conditional GETs ride the /tiles discipline: the ETag is the
+        span-ring head seq, READ BEFORE the span content (lint C1 —
+        the reverse order could stamp newer spans with an older seq and
+        a matching If-None-Match would 304 away spans the client never
+        saw), and the returned window is CAPPED at that seq so body and
+        ETag always agree; an empty window costs a 304 header, not a
+        JSON body."""
         tracer = getattr(self.bus, "tracer", None)
         if tracer is None:
             return 404, "application/json", json.dumps(
@@ -934,10 +974,16 @@ class MapApiServer:
         except (ValueError, IndexError):
             return 400, "application/json", json.dumps(
                 {"error": "since must be an integer span seq"}).encode()
-        spans = tracer.spans_since(since)
+        head = tracer.last_seq()           # revision BEFORE content (C1)
+        etag = f'W/"trace-{head}-s{since}"'
+        if self._etag_hit(headers, etag):
+            return 304, "application/json", b"", {"ETag": etag}
+        spans = [s for s in tracer.spans_since(since)
+                 if s["seq"] <= head]
         return 200, "application/json", json.dumps(
             {"traceEvents": chrome_events(spans),
-             "next": spans[-1]["seq"] if spans else since}).encode()
+             "next": spans[-1]["seq"] if spans else since}).encode(), \
+            {"ETag": etag}
 
     def _frontiers(self) -> Tuple[int, str, bytes]:
         with self._lock:
@@ -1055,7 +1101,15 @@ class MapApiServer:
                   and hasattr(self.mapper, "frontier_stats") else None)
             if fs is None:
                 return None
-            fams = [
+            # Recompute latency is NOT a hand-built gauge here any
+            # more (ISSUE 10 satellite): the pipeline records each
+            # recompute into the `frontier.recompute` stage, so it
+            # reports through the one stage mechanism — the
+            # `jax_mapping_stage_frontier_recompute_ms` summary and
+            # `..._seconds` fixed log-bucket histogram families below.
+            # `/status.frontier.last_recompute_ms` keeps the one-glance
+            # number.
+            return [
                 Family("jax_mapping_frontier_recompute_total", "counter",
                        (("", str(fs["n_recomputes"])),)),
                 Family("jax_mapping_frontier_skip_total", "counter",
@@ -1067,11 +1121,6 @@ class MapApiServer:
                 Family("jax_mapping_frontier_crop_cells", "gauge",
                        (("", str(fs["crop_cells"])),)),
             ]
-            if fs["last_recompute_ms"] is not None:
-                fams.append(Family("jax_mapping_frontier_recompute_ms",
-                                   "gauge",
-                                   (("", str(fs["last_recompute_ms"])),)))
-            return fams
         reg.add_source(frontier_families)
 
         def planner_families():
@@ -1298,6 +1347,45 @@ class MapApiServer:
                                    (("", str(tracer.last_seq())),)))
             return fams
         reg.add_source(obs_families)
+
+        def devprof_families():
+            # Device-side dispatch attribution (obs/devprof.py): call
+            # counts + blocked-on-dispatch wall-time histograms per
+            # jitted entry point (ONE family sliced by fn label, the
+            # HIST_EDGES_S grid — runs compare bucket-for-bucket),
+            # runtime recompile counters, and backend memory
+            # watermarks where the backend provides them (whole family
+            # omitted on CPU — graceful None).
+            if self.devprof is None:
+                return None
+            from jax_mapping.obs.registry import (
+                labeled_histogram_samples)
+            hists = self.devprof.histograms()
+            recs = self.devprof.recompiles()
+            fams = [
+                Family("jax_mapping_device_dispatch_total", "counter",
+                       tuple((f'{{fn="{fn}"}}', str(h["count"]))
+                             for fn, h in hists.items())),
+                Family("jax_mapping_device_dispatch_seconds",
+                       "histogram",
+                       tuple(s for fn, h in hists.items()
+                             for s in labeled_histogram_samples(
+                                 f'fn="{fn}"', h["edges_s"],
+                                 h["buckets"], h["sum_s"],
+                                 h["count"]))),
+                Family("jax_mapping_jit_recompiles_total", "counter",
+                       tuple((f'{{fn="{fn}"}}', str(n))
+                             for fn, n in recs.items())),
+            ]
+            mem = self.devprof.memory_stats()
+            if mem is not None:
+                fams.append(Family(
+                    "jax_mapping_device_memory_bytes", "gauge",
+                    tuple((f'{{device="{d}",stat="{k}"}}', str(v))
+                          for d, stats in mem.items()
+                          for k, v in sorted(stats.items()))))
+            return fams
+        reg.add_source(devprof_families)
         return reg
 
     # -- lifecycle ----------------------------------------------------------

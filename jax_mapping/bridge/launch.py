@@ -60,6 +60,11 @@ class Stack:
     #: same object rides bus.tracer — this field is the test/operator
     #: handle (span export, /trace backs onto it through the bus).
     tracer: Optional[object] = None
+    #: Dispatch profiler (obs/devprof.DispatchProfiler) when
+    #: ObsConfig.devprof.enabled — wraps the jitted entry points
+    #: process-wide; shutdown() uninstalls so a later stack can own
+    #: the wrappers.
+    devprof: Optional[object] = None
     #: Auto-checkpoint file the supervisor saves to / resumes the mapper
     #: from ("" = auto-checkpointing disabled; pass checkpoint_dir to
     #: launch_sim_stack to enable).
@@ -194,6 +199,10 @@ class Stack:
         if self.api is not None:
             self.api.shutdown()
         self.executor.shutdown()
+        if self.devprof is not None:
+            # After the HTTP plane and executor stop: no worker thread
+            # is mid-dispatch through a wrapper being unbound.
+            self.devprof.uninstall()
 
 
 def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
@@ -220,6 +229,16 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
         # constructs nothing: the bus hot path is bit-exact pre-obs.
         from jax_mapping.obs import Tracer
         tracer = Tracer(seed=seed, capacity=cfg.obs.trace_ring)
+    devprof = None
+    if cfg.obs.devprof.enabled:
+        # Device-side dispatch profiling (obs/devprof.py): wraps the
+        # jitted entry points process-wide — constructed here but
+        # INSTALLED at the end of launch, after every lazily-imported
+        # subsystem (serving, recovery) has pulled in its modules, so
+        # no entry point dodges the wrapper. enabled=False constructs
+        # nothing: the dispatch path is bit-exact pre-devprof.
+        from jax_mapping.obs.devprof import DispatchProfiler
+        devprof = DispatchProfiler(cfg.obs.devprof, tracer=tracer)
     # The always-on flight recorder follows the newest stack: dumps go
     # to a `postmortem/` subdir of its checkpoint dir (None = events
     # only, no files; the subdir keeps MissionReport.checkpoint_files
@@ -309,6 +328,7 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
                            mapper=mapper, voxel_mapper=voxel_mapper,
                            planner=planner, health=health,
                            supervisor=supervisor, recovery=recovery,
+                           devprof=devprof,
                            lock_timeout_s=cfg.resilience.http_lock_timeout_s)
         api.serve_thread()
 
@@ -317,11 +337,13 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
         ([planner] if planner is not None else []) + \
         ([supervisor] if supervisor is not None else [])
     executor = Executor(nodes)
+    if devprof is not None:
+        devprof.install()
     stack = Stack(cfg=cfg, bus=bus, tf=tf, driver=driver, sim=sim,
                   brain=brain, mapper=mapper, api=api, executor=executor,
                   voxel_mapper=voxel_mapper, planner=planner,
                   health=health, supervisor=supervisor, recovery=recovery,
-                  tracer=tracer)
+                  tracer=tracer, devprof=devprof)
     if supervisor is not None:
         # Registration needs the Stack (restarter + checkpointer close
         # over it), so it happens after construction. The brain has no

@@ -657,6 +657,53 @@ class DecayConfig:
 
 
 @_frozen
+class DevProfConfig:
+    """Device-side performance observability (obs/devprof.py +
+    obs/ledger.py).
+
+    PR 9's tracing made the HOST side legible; the device side — where
+    the TPU-native mapping math actually runs — stayed a black box: no
+    per-dispatch wall time attributed to jitted entry points, no
+    FLOPs/bytes cost accounting, no recompile telemetry. These knobs
+    arm the dispatch profiler: `enabled=True` wraps every registered
+    jitted entry point (the same `_cache_size` registry
+    `analysis/compilebudget.py` walks) in a transparent pass-through
+    that attributes blocked-on-host dispatch wall time to
+    `jax_mapping_device_*` metric families (fixed `HIST_EDGES_S`
+    log-bucket histograms, the stage-histogram doctrine), counts
+    compiled-variant growth per function
+    (`jax_mapping_jit_recompiles_total`), captures one abstract
+    arg-signature per compiled variant for the static XLA cost ledger
+    (`lowered.compile().cost_analysis()` FLOPs / bytes-accessed,
+    exported on `/status` `perf` and dumped by `python -m
+    jax_mapping.obs cost-ledger`), and exports backend memory
+    watermarks where the backend provides them
+    (`device.memory_stats()`; gracefully absent on CPU).
+
+    `enabled=False` constructs NOTHING — no wrapper exists anywhere on
+    the dispatch path, bit-exact pre-PR behavior (the
+    ObsConfig/DecayConfig doctrine); `enabled=True` is host-side
+    bookkeeping only and must be equally bit-inert (both pinned by the
+    devprof bit-inertness property test)."""
+
+    enabled: bool = False
+    #: Capture one abstract (ShapeDtypeStruct) arg signature per
+    #: compiled variant — the cost ledger's re-lowering input. Bounded
+    #: per function below.
+    capture_signatures: bool = True
+    max_signatures_per_fn: int = 8
+    #: Emit a `device:<fn>` tracer span per profiled dispatch when a
+    #: Tracer is armed. Off by default: dispatch volume would dominate
+    #: the span ring, and HTTP-thread dispatches (tile hashing under a
+    #: /tiles poll) would inject nondeterministic spans into the
+    #: same-seed stream-identity contract.
+    trace_spans: bool = False
+    #: Export `device.memory_stats()` watermark gauges on /metrics and
+    #: /status (backends without the API — CPU — export nothing).
+    memory_stats: bool = True
+
+
+@_frozen
 class ObsConfig:
     """Causal tracing + flight recorder (obs/ subsystem).
 
@@ -686,6 +733,11 @@ class ObsConfig:
     trace_ring: int = 65536
     #: Flight-recorder event-ring capacity (always on).
     recorder_ring: int = 4096
+    #: Device-side dispatch profiling + XLA cost ledger (ISSUE 10) —
+    #: its own `enabled` knob, independent of tracing: profiling the
+    #: device side must not force span-ring bookkeeping on, and vice
+    #: versa.
+    devprof: DevProfConfig = DevProfConfig()
 
 
 @_frozen
@@ -796,6 +848,13 @@ class SlamConfig:
     @staticmethod
     def from_json(text: str) -> "SlamConfig":
         raw: Dict[str, Any] = json.loads(text)
+        # ObsConfig nests DevProfConfig (the one two-level section):
+        # rebuild the inner dataclass so round-tripping a serialized
+        # config doesn't leave a bare dict where a frozen (hashable,
+        # jit-static-usable) DevProfConfig belongs.
+        obs_raw = dict(raw.get("obs", {}))
+        if isinstance(obs_raw.get("devprof"), dict):
+            obs_raw["devprof"] = DevProfConfig(**obs_raw["devprof"])
         return SlamConfig(
             grid=GridConfig(**raw.get("grid", {})),
             scan=ScanConfig(**raw.get("scan", {})),
@@ -811,7 +870,7 @@ class SlamConfig:
             recovery=RecoveryConfig(**raw.get("recovery", {})),
             serving=ServingConfig(**raw.get("serving", {})),
             decay=DecayConfig(**raw.get("decay", {})),
-            obs=ObsConfig(**raw.get("obs", {})),
+            obs=ObsConfig(**obs_raw),
             **{k: v for k, v in raw.items()
                if k in ("mode", "map_publish_period_s",
                         "tf_publish_period_s", "domain_id")},

@@ -22,14 +22,33 @@ bit-determinism contract every prior PR defended:
 * `export`   — Chrome-trace/Perfetto JSON (also `GET /trace?since=`).
 * `diff`     — same-seed trace-diff: the first divergence point of two
                event/span streams, for actionable determinism gates.
+* `devprof`  — `DispatchProfiler` (ISSUE 10): wraps the registered
+               jitted entry points (the compilebudget `_cache_size`
+               registry) to attribute dispatch wall time per function
+               (fixed log-bucket histograms on `/metrics`), count
+               runtime recompiles (`jax_mapping_jit_recompiles_total`)
+               and capture abstract signatures per compiled variant.
+               Gated by `ObsConfig.devprof.enabled` (False = no
+               wrapper exists, bit-exact).
+* `ledger`   — `CostLedger`: static XLA FLOPs/bytes-accessed per
+               compiled variant via `lowered.compile().cost_analysis()`
+               over the profiler's signatures, cross-checked against
+               `analysis/compile_budget.json`.
 
 `python -m jax_mapping.obs` is the CLI (diff two dumps, export a dump
-to a Perfetto-loadable trace). Everything is host-side stdlib — no jax
-import anywhere in the package.
+to a Perfetto-loadable trace, run the cost ledger). Importing the
+package never imports jax — devprof/ledger bind jax lazily at
+install/collect time; everything else is host-side stdlib.
 """
 
+from jax_mapping.obs.devprof import (                      # noqa: F401
+    DispatchProfiler, abstract_signature,
+)
 from jax_mapping.obs.diff import (                         # noqa: F401
     Divergence, diff_dumps, diff_streams, normalize_events,
+)
+from jax_mapping.obs.ledger import (                       # noqa: F401
+    CostLedger, run_cost_ledger,
 )
 from jax_mapping.obs.export import (                       # noqa: F401
     chrome_events, dump_to_chrome, write_chrome_trace,

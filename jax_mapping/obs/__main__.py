@@ -11,6 +11,17 @@ no jax import):
     export DUMP [-o OUT]   Convert a flight-recorder dump to a Chrome-
                            trace/Perfetto JSON (default OUT:
                            DUMP + ".trace.json").
+    cost-ledger [-o OUT]   Run the canonical compile-budget scenario
+                           with the dispatch profiler installed and
+                           print the static XLA cost ledger (FLOPs /
+                           bytes-accessed per jitted function per
+                           compiled variant) plus the compile-budget
+                           cross-check as one JSON document. The one
+                           subcommand that imports jax (and should run
+                           in a fresh process: cold caches are what
+                           make the variant counts meaningful). Exit 0
+                           clean, 1 on cross-check violations, 2 on
+                           error.
 
 Postmortem workflow (README "Observability"): a chaos gate fails -> the
 recorder auto-dumped to the checkpoint dir -> `diff` the failing run's
@@ -51,6 +62,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                       "trace/Perfetto JSON")
     e.add_argument("dump")
     e.add_argument("-o", "--out", default=None)
+    c = sub.add_parser("cost-ledger",
+                       help="static XLA FLOPs/bytes ledger over the "
+                            "canonical scenario (imports jax)")
+    c.add_argument("-o", "--out", default=None)
+    c.add_argument("--budget", default=None, metavar="JSON")
     try:
         args = p.parse_args(argv)
     except SystemExit as ex:
@@ -73,6 +89,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 json.dump(doc, f)
             print(f"wrote {out} ({len(doc['traceEvents'])} events)")
             return 0
+        if args.cmd == "cost-ledger":
+            import contextlib
+            from jax_mapping.obs.ledger import run_cost_ledger
+            # Stack bring-up chatter goes to stderr: stdout is exactly
+            # one JSON document (the compilebudget --measure contract).
+            try:
+                with contextlib.redirect_stdout(sys.stderr):
+                    measured, profiler, ledger = run_cost_ledger()
+                    violations = ledger.cross_check(args.budget)
+            except Exception as ex:                 # noqa: BLE001
+                print(f"cost-ledger: scenario failed: {ex}",
+                      file=sys.stderr)
+                return 2
+            doc = {"functions": ledger.snapshot(),
+                   "dispatch": profiler.snapshot(),
+                   "compiled_variants": measured,
+                   "cross_check": violations}
+            text = json.dumps(doc, indent=1, sort_keys=True)
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(text + "\n")
+            print(text)
+            return 1 if violations else 0
     except (OSError, ValueError, KeyError) as ex:
         print(f"error: {ex}", file=sys.stderr)
         return 2

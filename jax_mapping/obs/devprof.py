@@ -1,0 +1,386 @@
+"""Dispatch profiler: device-side performance attribution (ISSUE 10).
+
+PR 9 made the HOST side legible — causal spans, flight recorder,
+declarative `/metrics` — but the device side, where the paper's
+TPU-native mapping math actually runs, stayed a black box: nothing
+attributed wall time to the jitted entry points, nothing counted
+recompiles at runtime, nothing watched backend memory. This module
+closes that gap WITHOUT touching a single kernel:
+
+* `DispatchProfiler.install()` walks the same registry
+  `analysis/compilebudget.py` walks — every module attribute under the
+  package prefix exposing a callable `_cache_size` (the PjitFunction
+  surface) — and rebinds EVERY alias of each jitted function (module
+  attrs and from-import bindings alike resolve to module namespaces)
+  to one transparent `_ProfiledJit` wrapper.
+* Each host-level call records blocked-on-dispatch wall time into a
+  per-function fixed log-bucket histogram (`HIST_EDGES_S`, the stage-
+  histogram doctrine: two runs compare bucket-for-bucket), a call
+  counter, and — by polling `_cache_size()` — compiled-variant growth:
+  the runtime recompile telemetry the static C4 checker and the
+  cold-cache compile-budget gate cannot see (`jax_mapping_jit_
+  recompiles_total` on `/metrics`).
+* On each variant growth the wrapper captures ONE abstract signature
+  (arrays → `jax.ShapeDtypeStruct`, static/hashable args verbatim),
+  bounded per function — the re-lowering input `obs/ledger.py` feeds
+  to `lowered.compile().cost_analysis()` for the static FLOPs/bytes
+  cost ledger.
+* Calls made UNDER AN ACTIVE TRACE (a wrapped function invoked while
+  another jit traces its caller) bypass recording entirely: trace-time
+  excursions are compile cost, not dispatch cost, and counting them
+  would double-book every retrace.
+
+`DevProfConfig.enabled=False` constructs nothing — no wrapper exists
+anywhere on the dispatch path, bit-exact pre-PR behavior; enabled is
+pure host-side bookkeeping (bit-inert, property-tested). jax imports
+are lazy (install time, never module import time): importing
+`jax_mapping.obs` stays jax-free, the package contract since PR 9.
+
+Thread contract: stats mutate only under `_lock` (racewatch-gated —
+see analysis/protection.py); dispatches arrive concurrently from the
+mapper tick thread, HTTP workers (serving tile hashing) and test
+drivers. The module-level `_installed` singleton guard serializes
+install/uninstall under `_INSTALL_LOCK` — wrappers are process-global
+state, two live profilers would double-wrap.
+"""
+
+from __future__ import annotations
+
+import bisect
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from jax_mapping.utils.profiling import HIST_EDGES_S
+
+#: Process-global install guard: module-attribute rebinding is
+#: process-wide, so at most one profiler may be installed at a time.
+_INSTALL_LOCK = threading.Lock()
+_installed: Optional["DispatchProfiler"] = None
+
+_trace_state_clean = None
+
+
+def _trace_clean() -> bool:
+    """True when no jax trace is active on this thread (lazy-bound so
+    importing this module never imports jax)."""
+    global _trace_state_clean
+    if _trace_state_clean is None:
+        import jax
+        _trace_state_clean = jax.core.trace_state_clean
+    return _trace_state_clean()
+
+
+def abstract_signature(args: tuple, kwargs: dict):
+    """(args, kwargs) with every array-typed leaf replaced by a
+    `jax.ShapeDtypeStruct` — exactly what `PjitFunction.lower` accepts
+    for AOT re-lowering. Non-array leaves (frozen config dataclasses,
+    python scalars used as static args) pass through verbatim: the
+    ledger re-lowers with the same static values the live call used."""
+    import jax
+
+    def absify(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(absify, (args, kwargs))
+
+
+class _FnProfile:
+    """Per-function dispatch accounting; mutated only under the
+    profiler's `_lock`."""
+
+    __slots__ = ("name", "count", "total_s", "max_s", "buckets",
+                 "cache_size", "n_compiles", "signatures")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        #: Per-bucket (non-cumulative) counts over HIST_EDGES_S;
+        #: [-1] is overflow — the StageTimer layout, so the /metrics
+        #: exposition shares one helper.
+        self.buckets = [0] * (len(HIST_EDGES_S) + 1)
+        #: Compiled-variant high-water (`_cache_size()` last seen).
+        self.cache_size = 0
+        #: Total compile events observed while profiled (cache growth;
+        #: the first compile counts — "recompiles" in the Prometheus
+        #: family name means "compiles the warm steady state should not
+        #: be paying", and the committed budget says how many are
+        #: sanctioned).
+        self.n_compiles = 0
+        #: [(key, (abstract_args, abstract_kwargs))] — one per observed
+        #: compiled variant, bounded by DevProfConfig.
+        self.signatures: List[Tuple[str, tuple]] = []
+
+
+class _ProfiledJit:
+    """Transparent pass-through wrapper for one jitted entry point.
+
+    Everything but `__call__` forwards to the wrapped function —
+    `_cache_size`, `lower`, `__name__`, `__module__` — so registry
+    walks (compilebudget), AOT lowering and introspection behave as if
+    the wrapper were not there."""
+
+    __slots__ = ("_fn", "_prof", "_name")
+
+    def __init__(self, fn, prof: "DispatchProfiler", name: str):
+        self._fn = fn
+        self._prof = prof
+        self._name = name
+
+    def __call__(self, *args, **kwargs):
+        if not _trace_clean():
+            # Trace-time excursion (this call is being traced into a
+            # caller's jaxpr): compile cost, not dispatch cost.
+            return self._fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            return self._fn(*args, **kwargs)
+        finally:
+            self._prof._record(self, time.perf_counter() - t0,
+                               args, kwargs)
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "_fn"), item)
+
+    # `__module__`/`__doc__` land in every class dict at class-creation
+    # time, so instance lookup finds THEM instead of falling through to
+    # __getattr__ — and a wrapper reporting `jax_mapping.obs.devprof`
+    # as the wrapped function's module would corrupt the compilebudget
+    # registry's owner-qualified names while profiled. Forward them
+    # explicitly. (`__qualname__` cannot be a property — class creation
+    # requires a str — and nothing keys on it; the class's own is
+    # fine.)
+    @property
+    def __module__(self):
+        return getattr(self._fn, "__module__", None)
+
+    @property
+    def __doc__(self):
+        return getattr(self._fn, "__doc__", None)
+
+    def __repr__(self) -> str:
+        return f"<profiled {self._name}>"
+
+
+class DispatchProfiler:
+    """Wrap the package's jitted entry points; attribute dispatch wall
+    time, recompiles and cost-ledger signatures per function."""
+
+    def __init__(self, cfg=None, tracer=None):
+        if cfg is None:
+            from jax_mapping.config import DevProfConfig
+            cfg = DevProfConfig(enabled=True)
+        self.cfg = cfg
+        self.tracer = tracer
+        self._lock = threading.Lock()
+        self._profiles: Dict[str, _FnProfile] = {}
+        #: [(wrapper, [(module, attr)])] — the rebind log uninstall
+        #: replays. Mutated only during install/uninstall under
+        #: `_INSTALL_LOCK`.
+        self._bindings: List[Tuple[_ProfiledJit, list]] = []
+        self.installed = False
+
+    # -- install / uninstall -------------------------------------------------
+
+    def install(self, prefix: str = "jax_mapping") -> int:
+        """Wrap every currently-importable jitted entry point under
+        `prefix`; returns how many NEW functions were wrapped. May be
+        called again after further imports (incremental — already-
+        wrapped functions are skipped); a second live profiler is
+        refused (wrappers are process-global)."""
+        global _installed
+        with _INSTALL_LOCK:
+            if _installed is not None and _installed is not self:
+                raise RuntimeError(
+                    "another DispatchProfiler is installed — uninstall "
+                    "it first (wrappers are process-global)")
+            targets: Dict[int, Tuple[object, list]] = {}
+            for mod_name in sorted(sys.modules):
+                mod = sys.modules[mod_name]
+                if mod is None or not mod_name.startswith(prefix):
+                    continue
+                for attr in sorted(vars(mod)):
+                    fn = vars(mod)[attr]
+                    if isinstance(fn, _ProfiledJit):
+                        continue
+                    cache_size = getattr(fn, "_cache_size", None)
+                    if not callable(cache_size) or not callable(fn):
+                        continue
+                    ent = targets.setdefault(id(fn), (fn, []))
+                    ent[1].append((mod, attr))
+            for fn, sites in targets.values():
+                name = self._qualify(fn, sites, prefix)
+                wrapper = _ProfiledJit(fn, self, name)
+                for mod, attr in sites:
+                    setattr(mod, attr, wrapper)
+                self._bindings.append((wrapper, sites))
+                try:
+                    baseline = int(fn._cache_size())
+                except Exception:                   # noqa: BLE001
+                    baseline = 0
+                with self._lock:
+                    prof = self._profiles.setdefault(name,
+                                                     _FnProfile(name))
+                    # Compiles counted SINCE install: in a warm process
+                    # (tests, a long-lived operator session) the first
+                    # profiled call must not inherit every variant the
+                    # process compiled before profiling was armed.
+                    prof.cache_size = max(prof.cache_size, baseline)
+            _installed = self
+            self.installed = True
+            return len(targets)
+
+    @staticmethod
+    def _qualify(fn, sites, prefix: str) -> str:
+        """The compilebudget naming contract: defining module + name,
+        stable across from-import aliases."""
+        mod_name = sites[0][0].__name__
+        owner = getattr(fn, "__module__", mod_name) or mod_name
+        name = getattr(fn, "__name__", sites[0][1]) or sites[0][1]
+        if not owner.startswith(prefix):
+            owner = mod_name
+        return f"{owner}.{name}"
+
+    def uninstall(self) -> None:
+        """Restore the original functions at every site that still
+        holds our wrapper (a site reassigned since install is left
+        alone). Idempotent; safe to call from Stack.shutdown twice."""
+        global _installed
+        with _INSTALL_LOCK:
+            for wrapper, sites in self._bindings:
+                for mod, attr in sites:
+                    if vars(mod).get(attr) is wrapper:
+                        setattr(mod, attr, wrapper._fn)
+            self._bindings = []
+            if _installed is self:
+                _installed = None
+            self.installed = False
+
+    # -- recording (any thread) ----------------------------------------------
+
+    def _record(self, wrapper: _ProfiledJit, dt_s: float,
+                args: tuple, kwargs: dict) -> None:
+        try:
+            cache = int(wrapper._fn._cache_size())
+        except Exception:                           # noqa: BLE001
+            cache = -1
+        capture = None
+        with self._lock:
+            st = self._profiles.setdefault(wrapper._name,
+                                           _FnProfile(wrapper._name))
+            st.count += 1
+            st.total_s += dt_s
+            st.max_s = max(st.max_s, dt_s)
+            st.buckets[bisect.bisect_left(HIST_EDGES_S, dt_s)] += 1
+            if cache > st.cache_size:
+                st.n_compiles += cache - st.cache_size
+                st.cache_size = cache
+                if self.cfg.capture_signatures and \
+                        len(st.signatures) < self.cfg.max_signatures_per_fn:
+                    capture = st
+        if capture is not None:
+            # Abstraction outside the lock (tree_map over a whole
+            # SlamState costs more than a histogram bump); the append
+            # re-takes the lock and dedups — a racing twin costs one
+            # redundant abstraction, never a lost variant.
+            try:
+                sig = abstract_signature(args, kwargs)
+                key = repr(sig)
+            except Exception:                       # noqa: BLE001
+                sig = key = None          # unabstractable tree: skip
+            if sig is not None and key is not None:
+                with self._lock:
+                    if key not in [k for k, _ in capture.signatures] \
+                            and len(capture.signatures) \
+                            < self.cfg.max_signatures_per_fn:
+                        capture.signatures.append((key, sig))
+        if self.cfg.trace_spans and self.tracer is not None:
+            self.tracer.emit(f"device:{wrapper._name}")
+
+    # -- export ---------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-function dispatch accounting for `/status` `perf` (only
+        functions actually dispatched — wrapped-but-idle entries are
+        noise an operator scrolls past)."""
+        with self._lock:
+            return {
+                name: {
+                    "count": st.count,
+                    "total_ms": round(st.total_s * 1e3, 3),
+                    "mean_ms": round(st.total_s * 1e3
+                                     / max(st.count, 1), 3),
+                    "max_ms": round(st.max_s * 1e3, 3),
+                    "compiled_variants": st.cache_size,
+                    "n_compiles": st.n_compiles,
+                    "n_signatures": len(st.signatures),
+                } for name, st in sorted(self._profiles.items())
+                if st.count > 0
+            }
+
+    def histograms(self) -> Dict[str, dict]:
+        """Per-function fixed log-bucket dispatch histograms — the
+        `jax_mapping_device_dispatch_seconds` family source (StageTimer
+        layout: edges + per-bucket counts + sum + count)."""
+        with self._lock:
+            return {
+                name: {
+                    "edges_s": HIST_EDGES_S,
+                    "buckets": list(st.buckets),
+                    "sum_s": st.total_s,
+                    "count": st.count,
+                } for name, st in sorted(self._profiles.items())
+                if st.count > 0
+            }
+
+    def recompiles(self) -> Dict[str, int]:
+        """Compile events per function while profiled — the
+        `jax_mapping_jit_recompiles_total{fn=...}` source (every
+        profiled function reports, 0 included: an absent label and a
+        zero counter mean different things to a rate() query)."""
+        with self._lock:
+            return {name: st.n_compiles
+                    for name, st in sorted(self._profiles.items())}
+
+    def signatures(self) -> Dict[str, List[tuple]]:
+        """Captured abstract signatures per function (ledger input)."""
+        with self._lock:
+            return {name: [sig for _, sig in st.signatures]
+                    for name, st in self._profiles.items()
+                    if st.signatures}
+
+    def raw_fn(self, name: str):
+        """The unwrapped function for `name`, or None — the ledger
+        lowers through this so its AOT calls don't count as
+        dispatches."""
+        with _INSTALL_LOCK:
+            for wrapper, _ in self._bindings:
+                if wrapper._name == name:
+                    return wrapper._fn
+        return None
+
+    def memory_stats(self) -> Optional[Dict[str, dict]]:
+        """Backend memory watermarks per device, or None when no
+        visible backend provides `memory_stats()` (CPU) or the knob is
+        off — the graceful-None contract."""
+        if not self.cfg.memory_stats:
+            return None
+        import jax
+        out: Dict[str, dict] = {}
+        for d in jax.devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:                       # noqa: BLE001
+                ms = None
+            if not ms:
+                continue
+            out[f"{d.platform}:{d.id}"] = {
+                k: int(v) for k, v in ms.items()
+                if k in ("bytes_in_use", "peak_bytes_in_use",
+                         "bytes_limit", "largest_alloc_size")}
+        return out or None

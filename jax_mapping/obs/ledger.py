@@ -1,0 +1,236 @@
+"""Static XLA cost ledger: FLOPs / bytes-accessed per jitted function
+per compiled variant (ISSUE 10).
+
+The dispatch profiler (obs/devprof.py) says how long each entry point
+BLOCKED the host; this module says what the compiled program COSTS —
+XLA's own static cost model (`lowered.compile().cost_analysis()`),
+collected per captured abstract signature, so the perf trajectory can
+distinguish "the kernel got slower" from "the kernel got bigger" and
+the pod-scale work (ROADMAP items 3–4) can budget FLOPs before it
+budgets wall clock.
+
+Collection is EXPLICIT, never implicit: re-lowering + AOT compilation
+costs seconds per variant, so `collect()` runs from the CLI (`python
+-m jax_mapping.obs cost-ledger`), the compile-budget gate
+(`compilebudget --check --ledger`) and tests — `/status` `perf`
+exports whatever has been collected so far plus the uncollected count
+(an HTTP handler must never compile). Results cache per (function,
+signature): a second collect() is free.
+
+`cross_check()` closes the loop with `analysis/compile_budget.json`:
+every budgeted function must have ledger coverage, and the profiler's
+observed variant count must not exceed the committed budget — the
+ratchet contract, applied to the runtime-observed registry.
+
+jax imports are lazy (collect time only): importing `jax_mapping.obs`
+stays jax-free.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+def _normalize_cost(ca) -> Optional[dict]:
+    """`cost_analysis()` returns a dict (or a one-per-device list of
+    dicts) of XLA cost-model properties; keep the portable core."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    if "flops" in ca:
+        out["flops"] = float(ca["flops"])
+    if "bytes accessed" in ca:
+        out["bytes_accessed"] = float(ca["bytes accessed"])
+    for k in ("optimal_seconds", "transcendentals"):
+        if k in ca:
+            out[k] = float(ca[k])
+    return out or None
+
+
+class CostLedger:
+    """FLOPs/bytes-accessed per (jitted function, compiled variant),
+    fed by a DispatchProfiler's captured signatures."""
+
+    def __init__(self, profiler):
+        self.profiler = profiler
+        self._lock = threading.Lock()
+        #: ONE keyed structure, {fn_name: {signature_repr: entry}} —
+        #: an entry of None marks a reservation whose (slow, unlocked)
+        #: AOT compile is in flight. One field on purpose: a separate
+        #: done-set alongside an entry list would be a correlated pair
+        #: readable across two lock sections (the C2 tear class).
+        self._collected: Dict[str, Dict[str, Optional[dict]]] = {}
+
+    # -- collection (explicit, expensive) -------------------------------------
+
+    def collect(self) -> Dict[str, List[dict]]:
+        """AOT re-lower + compile every captured-but-uncollected
+        signature and record its cost analysis. Returns the full
+        ledger. Failures record an `error` entry instead of raising —
+        one unlowerable signature must not hide the other 14
+        functions' costs."""
+        sigs = self.profiler.signatures()
+        for name, variants in sorted(sigs.items()):
+            fn = self.profiler.raw_fn(name)
+            if fn is None:
+                continue
+            for sig in variants:
+                key = repr(sig)
+                with self._lock:
+                    slot = self._collected.setdefault(name, {})
+                    if key in slot:
+                        continue
+                    # Reserve (None) before the unlocked compile so a
+                    # concurrent collect never compiles the same
+                    # variant twice.
+                    slot[key] = None
+                entry = self._collect_one(fn, sig)
+                with self._lock:
+                    self._collected[name][key] = entry
+        return self.snapshot()
+
+    @staticmethod
+    def _collect_one(fn, sig) -> dict:
+        args, kwargs = sig
+        entry = {"signature": _sig_label(sig)}
+        try:
+            compiled = fn.lower(*args, **kwargs).compile()
+            cost = _normalize_cost(compiled.cost_analysis())
+            if cost is None:
+                entry["error"] = "backend returned no cost analysis"
+            else:
+                entry.update(cost)
+        except Exception as e:                      # noqa: BLE001
+            entry["error"] = f"{type(e).__name__}: {e}"
+        return entry
+
+    # -- export ----------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, List[dict]]:
+        with self._lock:
+            return {
+                name: [dict(e) for e in slots.values()
+                       if e is not None]
+                for name, slots in sorted(self._collected.items())
+                if any(e is not None for e in slots.values())}
+
+    def n_uncollected(self) -> int:
+        """Captured signatures with no FINISHED ledger entry yet
+        (in-flight reservations count — they have no data to export)."""
+        sigs = self.profiler.signatures()
+        with self._lock:
+            return sum(
+                1 for name, variants in sigs.items()
+                for sig in variants
+                if self._collected.get(name, {}).get(repr(sig)) is None)
+
+    # -- the budget cross-check -------------------------------------------------
+
+    def cross_check(self, budget_path: Optional[str] = None
+                    ) -> List[str]:
+        """Violations against `analysis/compile_budget.json`: a
+        budgeted function with no ledger coverage (never dispatched or
+        never costed — the attribution layer has a hole), a costed
+        variant count EXCEEDING the budget (runtime recompile
+        regression), or coverage without FLOPs/bytes (the backend or a
+        signature failed). Empty list = clean."""
+        from jax_mapping.analysis.compilebudget import (
+            Budget, default_budget_path)
+        budget = Budget.load(budget_path or default_budget_path())
+        entries = self.snapshot()
+        recompiles = self.profiler.recompiles()
+        out: List[str] = []
+        for e in budget.entries:
+            name = e["name"]
+            got = entries.get(name)
+            if not got:
+                out.append(f"{name}: budgeted but no cost-ledger "
+                           "coverage (never dispatched under the "
+                           "profiler, or signature capture missed it)")
+                continue
+            if len(got) > e["max"]:
+                out.append(f"{name}: {len(got)} costed variant(s) "
+                           f"exceeds budget {e['max']}")
+            bad = [v for v in got if "flops" not in v
+                   or "bytes_accessed" not in v]
+            for v in bad:
+                out.append(f"{name}: variant {v['signature']} has no "
+                           f"FLOPs/bytes ({v.get('error', 'missing')})")
+            observed = recompiles.get(name, 0)
+            if observed > e["max"]:
+                out.append(f"{name}: profiler observed {observed} "
+                           f"compile(s), budget allows {e['max']}")
+        return out
+
+
+def _sig_label(sig) -> str:
+    """Compact human-readable variant label: array leaves as
+    shape/dtype, everything else by type name."""
+    args, kwargs = sig
+
+    def leaf(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            dims = "x".join(map(str, x.shape)) or "scalar"
+            return f"{dims}:{x.dtype}"
+        return type(x).__name__
+
+    def walk(x):
+        # NamedTuple pytrees (SlamState and friends) before the plain
+        # tuple branch — they ARE tuples, and the type name is the
+        # readable part of the label.
+        if isinstance(x, tuple) and hasattr(x, "_fields"):
+            return type(x).__name__ + "(" + ",".join(
+                walk(v) for v in x) + ")"
+        if isinstance(x, (list, tuple)):
+            return "(" + ",".join(walk(v) for v in x) + ")"
+        if isinstance(x, dict):
+            return "{" + ",".join(f"{k}={walk(v)}"
+                                  for k, v in sorted(x.items())) + "}"
+        return leaf(x)
+
+    label = walk(args)
+    if kwargs:
+        label += walk(kwargs)
+    return label
+
+
+def run_cost_ledger(analysis_cfg=None):
+    """Drive the canonical compile-budget scenario with a
+    DispatchProfiler installed and return `(measured_cache_sizes,
+    profiler, ledger)` — the shared machinery behind `python -m
+    jax_mapping.obs cost-ledger` and `compilebudget --check --ledger`.
+
+    Imports every package submodule FIRST so lazily-imported jitted
+    entry points (serving, pyramid, relocalize) exist before install —
+    a function imported mid-scenario would dodge the wrapper and
+    surface as a coverage hole. Must run with cold jit caches (a fresh
+    process) for the variant counts to mean anything, the
+    compilebudget contract."""
+    import importlib
+    import pkgutil
+
+    import jax_mapping
+    for m in pkgutil.walk_packages(jax_mapping.__path__,
+                                   prefix="jax_mapping."):
+        try:
+            importlib.import_module(m.name)
+        except Exception:                           # noqa: BLE001
+            continue              # optional deps (ros adapters) absent
+
+    from jax_mapping.analysis.compilebudget import measure_scenario
+    from jax_mapping.config import DevProfConfig
+    from jax_mapping.obs.devprof import DispatchProfiler
+
+    profiler = DispatchProfiler(DevProfConfig(
+        enabled=True, max_signatures_per_fn=16))
+    profiler.install()
+    try:
+        measured = measure_scenario(analysis_cfg)
+        ledger = CostLedger(profiler)
+        ledger.collect()
+    finally:
+        profiler.uninstall()
+    return measured, profiler, ledger

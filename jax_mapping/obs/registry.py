@@ -106,3 +106,23 @@ def histogram_samples(edges, counts, total, count,
 def summary_samples(count, total, fmt: str = "{:.3f}") -> List[Sample]:
     """The repo's `_count`/`_sum` summary shape (stage `_ms` families)."""
     return [("_count", str(count)), ("_sum", fmt.format(total))]
+
+
+def labeled_histogram_samples(labels: str, edges, counts, total, count,
+                              le_fmt: Callable[[float], str] = str,
+                              sum_fmt: str = "{:.6f}") -> List[Sample]:
+    """`histogram_samples` with a fixed label set on every series —
+    ONE histogram family sliced by label (the devprof per-function
+    dispatch family: `..._bucket{fn="x",le="0.001"}`) instead of a
+    family per slice. `labels` is the pre-rendered inner label string
+    (e.g. `fn="jax_mapping.ops.grid.fuse_scans_window"`)."""
+    out: List[Sample] = []
+    cum = 0
+    for le, n in zip(edges, counts):
+        cum += n
+        out.append((f'_bucket{{{labels},le="{le_fmt(le)}"}}', str(cum)))
+    out.append((f'_bucket{{{labels},le="+Inf"}}',
+                str(cum + counts[-1])))
+    out.append((f"_sum{{{labels}}}", sum_fmt.format(total)))
+    out.append((f"_count{{{labels}}}", str(count)))
+    return out

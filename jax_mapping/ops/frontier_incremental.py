@@ -447,7 +447,15 @@ class IncrementalFrontierPipeline:
         self._last_cells = cells
         self.last_device_result = fr             # crop-shaped (tests/debug)
         self.n_recomputes += 1
-        self.last_recompute_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        dt = time.perf_counter() - t0
+        self.last_recompute_ms = round(dt * 1e3, 3)
+        # Report through the ONE stage mechanism (ISSUE 10 satellite):
+        # the `frontier.recompute` stage renders as the /metrics
+        # summary + fixed log-bucket histogram families, replacing the
+        # hand-built `jax_mapping_frontier_recompute_ms` gauge —
+        # last_recompute_ms above stays the /status one-glance number.
+        from jax_mapping.utils import global_metrics
+        global_metrics.stages.observe("frontier.recompute", dt)
         self.last_crop = crop
         return out
 

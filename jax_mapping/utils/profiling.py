@@ -90,18 +90,26 @@ class StageTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                st = self._stages.setdefault(name, _Stage())
-                st.count += 1
-                st.total_s += dt
-                st.max_s = max(st.max_s, dt)
-                st.ewma_s = (dt if st.count == 1
-                             else (1 - self.alpha) * st.ewma_s
-                             + self.alpha * dt)
-                # bisect_left: first edge >= dt, i.e. `le` semantics;
-                # past the last edge lands in the overflow bucket.
-                st.buckets[bisect.bisect_left(HIST_EDGES_S, dt)] += 1
+            self.observe(name, time.perf_counter() - t0)
+
+    def observe(self, name: str, dt_s: float) -> None:
+        """Record one already-measured duration against a stage — the
+        entry point for code that times a region itself (the
+        incremental frontier pipeline's recompute, devprof dispatch
+        attribution) but must still report through the ONE stage
+        mechanism (`/metrics` summary + fixed log-bucket histogram
+        families) instead of a hand-built gauge."""
+        with self._lock:
+            st = self._stages.setdefault(name, _Stage())
+            st.count += 1
+            st.total_s += dt_s
+            st.max_s = max(st.max_s, dt_s)
+            st.ewma_s = (dt_s if st.count == 1
+                         else (1 - self.alpha) * st.ewma_s
+                         + self.alpha * dt_s)
+            # bisect_left: first edge >= dt, i.e. `le` semantics;
+            # past the last edge lands in the overflow bucket.
+            st.buckets[bisect.bisect_left(HIST_EDGES_S, dt_s)] += 1
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:

@@ -462,6 +462,49 @@ def test_compile_budget_entries_above_one_carry_notes():
     assert all(e["max"] >= 1 for e in budget.entries)
 
 
+def test_bench_trajectory_validates():
+    """ISSUE 10 CI wiring: every committed BENCH_*.json parses and
+    passes the BenchRecord schema (`bench.py --validate`, run
+    in-process — the validator imports no jax). A record the validator
+    cannot read is a trajectory hole the --regress gate would silently
+    skip."""
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_validate", os.path.join(root, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    n, errors = bench.validate_bench_records(root)
+    assert n >= 11, f"trajectory shrank? only {n} BENCH_*.json files"
+    assert errors == [], "\n".join(errors)
+    # The regress gate has a committed trajectory to compare against.
+    assert bench.newest_committed_regress(root) is not None
+
+
+@pytest.mark.slow
+def test_cost_ledger_covers_compile_budget():
+    """ISSUE 10 acceptance (slow: the ledger AOT-recompiles every
+    captured variant, ~seconds per function): a fresh cold-cache
+    process runs the canonical scenario under the dispatch profiler
+    and the static XLA cost ledger must report FLOPs/bytes for EVERY
+    compile-budget-registered function, with variant counts within the
+    committed budget — `compilebudget --check --ledger` exits 0."""
+    import os
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "jax_mapping.analysis.compilebudget",
+         "--check", "--ledger"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, (
+        f"cost-ledger/budget violations (exit {r.returncode}):\n"
+        f"{r.stdout}\n{r.stderr[-2000:]}")
+
+
 def test_compile_budget_ratchet_on_canonical_scenario():
     """THE recompile-budget gate: a FRESH process (cold jit caches)
     runs the canonical `AnalysisConfig` scenario and every jitted

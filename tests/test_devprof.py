@@ -1,0 +1,375 @@
+"""Device-side performance observability (ISSUE 10): the dispatch
+profiler (obs/devprof.py), the static XLA cost ledger (obs/ledger.py)
+and the bench trajectory harness (bench.py schema / --validate /
+--regress).
+
+Unit/component tier — no stack launches (the tier-1 wall budget is
+spoken for); the live surfaces (dispatch attribution on a real
+mission, steady-state recompile guard, /status.perf, /metrics device
+families) piggyback on the shared module-scoped mission stack in
+tests/test_scenarios.py.
+"""
+
+import functools
+import importlib.util
+import json
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from jax_mapping.config import DevProfConfig
+from jax_mapping.obs import CostLedger, DispatchProfiler
+
+_FIXTURE_PREFIX = "devprof_fixture"
+
+
+@pytest.fixture()
+def fixture_mod():
+    """A synthetic module under its own prefix holding jitted entry
+    points (plus an alias — the from-import case), so install() can be
+    exercised without wrapping the real package."""
+    import jax
+
+    mod = types.ModuleType(_FIXTURE_PREFIX)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def scaled(k, x):
+        return x * k
+
+    @jax.jit
+    def double(x):
+        return x + x
+
+    mod.scaled = scaled
+    mod.double = double
+    mod.scaled_alias = scaled                    # from-import binding
+    mod.not_jitted = lambda x: x
+    sys.modules[_FIXTURE_PREFIX] = mod
+    try:
+        yield mod
+    finally:
+        sys.modules.pop(_FIXTURE_PREFIX, None)
+
+
+def _install(mod, **cfg_kw):
+    prof = DispatchProfiler(DevProfConfig(enabled=True, **cfg_kw))
+    n = prof.install(prefix=_FIXTURE_PREFIX)
+    return prof, n
+
+
+# ------------------------------------------------------ dispatch profiler
+
+def test_wrapper_wraps_counts_and_times(fixture_mod):
+    import jax.numpy as jnp
+
+    prof, n = _install(fixture_mod)
+    try:
+        assert n == 2                            # scaled(+alias), double
+        x = jnp.ones((8, 8))
+        fixture_mod.scaled(2, x)
+        fixture_mod.scaled_alias(2, x)           # alias -> same profile
+        fixture_mod.double(x)
+        snap = prof.snapshot()
+        sc = snap[f"{_FIXTURE_PREFIX}.scaled"]
+        assert sc["count"] == 2
+        assert sc["total_ms"] > 0 and sc["max_ms"] >= sc["mean_ms"] / 2
+        assert snap[f"{_FIXTURE_PREFIX}.double"]["count"] == 1
+        # Histograms ride the shared fixed log-bucket grid.
+        from jax_mapping.utils.profiling import HIST_EDGES_S
+        h = prof.histograms()[f"{_FIXTURE_PREFIX}.scaled"]
+        assert h["edges_s"] == HIST_EDGES_S
+        assert sum(h["buckets"]) == h["count"] == 2
+        # The un-jitted callable was left alone.
+        assert fixture_mod.not_jitted(3) == 3
+        assert not hasattr(fixture_mod.not_jitted, "_prof")
+    finally:
+        prof.uninstall()
+
+
+def test_wrapper_is_transparent(fixture_mod):
+    prof, _ = _install(fixture_mod)
+    try:
+        w = fixture_mod.scaled
+        # Introspection forwards: the compilebudget registry walk and
+        # AOT lowering see the wrapped function's own surface.
+        assert callable(w._cache_size)
+        assert w.__name__ == "scaled"
+        assert w.__module__.endswith("test_devprof")
+    finally:
+        prof.uninstall()
+
+
+def test_recompile_detection_and_signature_capture(fixture_mod):
+    import jax.numpy as jnp
+
+    prof, _ = _install(fixture_mod)
+    try:
+        fixture_mod.scaled(2, jnp.ones((8, 8)))
+        fixture_mod.scaled(2, jnp.ones((8, 8)))   # cache hit: no growth
+        fixture_mod.scaled(2, jnp.ones((4, 4)))   # second variant
+        fixture_mod.scaled(3, jnp.ones((4, 4)))   # third (static arg)
+        recs = prof.recompiles()
+        assert recs[f"{_FIXTURE_PREFIX}.scaled"] == 3
+        assert recs[f"{_FIXTURE_PREFIX}.double"] == 0
+        sigs = prof.signatures()[f"{_FIXTURE_PREFIX}.scaled"]
+        assert len(sigs) == 3
+    finally:
+        prof.uninstall()
+
+
+def test_signature_capture_is_bounded(fixture_mod):
+    import jax.numpy as jnp
+
+    prof, _ = _install(fixture_mod, max_signatures_per_fn=2)
+    try:
+        for n in range(2, 7):                    # 5 distinct variants
+            fixture_mod.scaled(n, jnp.ones((4, 4)))
+        assert prof.recompiles()[f"{_FIXTURE_PREFIX}.scaled"] == 5
+        assert len(prof.signatures()[f"{_FIXTURE_PREFIX}.scaled"]) == 2
+    finally:
+        prof.uninstall()
+
+
+def test_trace_time_calls_bypass_recording(fixture_mod):
+    """A wrapped function invoked while ANOTHER jit traces its caller
+    is compile cost, not dispatch cost — the recorder must not see
+    it."""
+    import jax
+    import jax.numpy as jnp
+
+    prof, _ = _install(fixture_mod)
+    try:
+        x = jnp.ones((8, 8))
+        fixture_mod.double(x)
+        before = prof.snapshot()[f"{_FIXTURE_PREFIX}.double"]["count"]
+
+        @jax.jit
+        def outer(x):
+            return fixture_mod.double(x) + 1
+
+        jax.block_until_ready(outer(x))          # traces through double
+        after = prof.snapshot()[f"{_FIXTURE_PREFIX}.double"]["count"]
+        assert after == before
+    finally:
+        prof.uninstall()
+
+
+def test_uninstall_restores_every_alias(fixture_mod):
+    orig = fixture_mod.scaled
+    prof, _ = _install(fixture_mod)
+    assert fixture_mod.scaled is not orig        # wrapped
+    assert fixture_mod.scaled is fixture_mod.scaled_alias
+    prof.uninstall()
+    assert fixture_mod.scaled is orig
+    assert fixture_mod.scaled_alias is orig
+    assert fixture_mod.double.__name__ == "double"
+    prof.uninstall()                             # idempotent
+
+
+def test_second_live_profiler_is_refused(fixture_mod):
+    prof, _ = _install(fixture_mod)
+    try:
+        other = DispatchProfiler(DevProfConfig(enabled=True))
+        with pytest.raises(RuntimeError, match="another"):
+            other.install(prefix=_FIXTURE_PREFIX)
+        # Re-install by the OWNER is incremental, not an error.
+        assert prof.install(prefix=_FIXTURE_PREFIX) == 0
+    finally:
+        prof.uninstall()
+
+
+def test_memory_stats_graceful_none_on_cpu(fixture_mod):
+    prof, _ = _install(fixture_mod)
+    try:
+        assert prof.memory_stats() is None       # CPU: no memory_stats
+        off = DispatchProfiler(DevProfConfig(enabled=True,
+                                             memory_stats=False))
+        assert off.memory_stats() is None        # knob off: same shape
+    finally:
+        prof.uninstall()
+
+
+# ------------------------------------------------------------ cost ledger
+
+def test_cost_ledger_reports_flops_and_bytes(fixture_mod):
+    import jax.numpy as jnp
+
+    prof, _ = _install(fixture_mod)
+    try:
+        fixture_mod.scaled(2, jnp.ones((8, 8)))
+        fixture_mod.scaled(2, jnp.ones((4, 4)))
+        ledger = CostLedger(prof)
+        assert ledger.n_uncollected() == 2
+        got = ledger.collect()
+        variants = got[f"{_FIXTURE_PREFIX}.scaled"]
+        assert len(variants) == 2
+        for v in variants:
+            assert v["flops"] > 0
+            assert v["bytes_accessed"] > 0
+            assert "8x8" in v["signature"] or "4x4" in v["signature"]
+        assert ledger.n_uncollected() == 0
+    finally:
+        prof.uninstall()
+
+
+def test_cost_ledger_collect_is_cached(fixture_mod, monkeypatch):
+    import jax.numpy as jnp
+
+    prof, _ = _install(fixture_mod)
+    try:
+        fixture_mod.double(jnp.ones((8, 8)))
+        ledger = CostLedger(prof)
+        calls = []
+        real = CostLedger._collect_one
+
+        def counting(fn, sig):
+            calls.append(1)
+            return real(fn, sig)
+
+        monkeypatch.setattr(CostLedger, "_collect_one",
+                            staticmethod(counting))
+        ledger.collect()
+        ledger.collect()                         # second pass: all cached
+        assert len(calls) == 1
+    finally:
+        prof.uninstall()
+
+
+def test_cost_ledger_cross_check_against_budget(fixture_mod, tmp_path):
+    import jax.numpy as jnp
+
+    prof, _ = _install(fixture_mod)
+    try:
+        fixture_mod.scaled(2, jnp.ones((8, 8)))
+        ledger = CostLedger(prof)
+        ledger.collect()
+        budget = tmp_path / "budget.json"
+        budget.write_text(json.dumps({"version": 1, "budgets": [
+            {"name": f"{_FIXTURE_PREFIX}.scaled", "max": 1},
+        ]}))
+        assert ledger.cross_check(str(budget)) == []
+        # A budgeted function with no coverage is a violation; so is a
+        # variant count above budget.
+        budget.write_text(json.dumps({"version": 1, "budgets": [
+            {"name": f"{_FIXTURE_PREFIX}.scaled", "max": 1},
+            {"name": f"{_FIXTURE_PREFIX}.double", "max": 1},
+        ]}))
+        (viol,) = ledger.cross_check(str(budget))
+        assert "double" in viol and "no cost-ledger coverage" in viol
+        fixture_mod.scaled(2, jnp.ones((4, 4)))
+        ledger.collect()
+        viols = ledger.cross_check(str(budget))
+        assert any("exceeds budget" in v for v in viols)
+    finally:
+        prof.uninstall()
+
+
+def test_devprof_config_json_roundtrip():
+    from jax_mapping.config import ObsConfig, SlamConfig, tiny_config
+
+    cfg = tiny_config().replace(obs=ObsConfig(
+        enabled=True,
+        devprof=DevProfConfig(enabled=True, max_signatures_per_fn=3)))
+    back = SlamConfig.from_json(cfg.to_json())
+    assert isinstance(back.obs.devprof, DevProfConfig)
+    assert back == cfg
+    # devprof defaults OFF — the shipped bit-exact default.
+    assert not tiny_config().obs.devprof.enabled
+
+
+# ------------------------------------------- bench trajectory harness
+
+@pytest.fixture(scope="module")
+def bench():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(root, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_validate_committed_trajectory_is_clean(bench):
+    """Every committed BENCH_*.json parses and passes the schema
+    (legacy records grandfathered) — the `bench.py --validate` gate."""
+    n, errors = bench.validate_bench_records()
+    assert n >= 11
+    assert errors == [], "\n".join(errors)
+
+
+def test_bench_validate_flags_bad_records(bench, tmp_path):
+    (tmp_path / "BENCH_BAD_r01.json").write_text("{not json")
+    (tmp_path / "BENCH_EMPTY_r01.json").write_text("{}")
+    (tmp_path / "BENCH_V99_r01.json").write_text(json.dumps(
+        {"bench_schema": 99, "metric": "m"}))
+    (tmp_path / "BENCH_NOMETH_r01.json").write_text(json.dumps(
+        {"bench_schema": 1, "suite": "x", "metric": "m"}))
+    # A wrapped record whose captured run FAILED is grandfathered (the
+    # trajectory recording a dead round is data); a wrapped record
+    # claiming success with no JSON line is not.
+    (tmp_path / "BENCH_DEAD_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "python bench.py", "rc": 124, "tail": "boom"}))
+    (tmp_path / "BENCH_LIAR_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "python bench.py", "rc": 0, "tail": "no json"}))
+    n, errors = bench.validate_bench_records(str(tmp_path))
+    assert n == 6
+    joined = "\n".join(errors)
+    assert "BENCH_BAD_r01.json" in joined
+    assert "BENCH_EMPTY_r01.json" in joined
+    assert "BENCH_V99_r01.json" in joined
+    assert "BENCH_NOMETH_r01.json" in joined
+    assert "BENCH_LIAR_r01.json" in joined
+    assert "BENCH_DEAD_r01.json" not in joined
+
+
+def test_bench_record_extraction_unwraps_driver_tail(bench):
+    rec, wrapped = bench.extract_bench_record(
+        {"n": 3, "cmd": "python bench.py", "rc": 0,
+         "tail": 'noise\n{"metric": "m", "value": 1}\n'})
+    assert wrapped and rec == {"metric": "m", "value": 1}
+    rec, wrapped = bench.extract_bench_record({"metric": "m"})
+    assert not wrapped and rec == {"metric": "m"}
+
+
+def test_bench_stamp_record_preserves_existing_fields(bench):
+    r = {"suite": "obs", "methodology": "mine"}
+    bench._stamp_record(r, "main", "default", reps=4)
+    assert r["suite"] == "obs" and r["methodology"] == "mine"
+    assert r["bench_schema"] == bench.BENCH_SCHEMA_VERSION
+    assert r["reps"] == 4
+
+
+def test_regress_detects_seeded_synthetic_slowdown(bench):
+    """THE regression-harness acceptance: a clean self-comparison
+    passes; a seeded synthetic slowdown injected into the workload
+    timing is detected (both the raw and reference-normalized ratios
+    clear the gate)."""
+    base = bench.run_regress_suite(reps=2)
+    ok, report = bench.compare_regress(base, base)
+    assert ok, report
+    slow_ms = max(4.0 * base["workloads"]["fuse_tiny"]["p50_ms"], 50.0)
+    slowed = bench.run_regress_suite(reps=2, synthetic_slow_ms=slow_ms)
+    ok, report = bench.compare_regress(slowed, base)
+    assert not ok, report
+    assert any("REGRESSION" in line for line in report)
+
+
+def test_regress_passes_clean_against_committed_trajectory(bench):
+    """A fresh run of the regress micro-suite on this machine clears
+    the committed BENCH_REGRESS_r* trajectory at the default gate —
+    the `bench.py --regress` exit-0 path."""
+    committed = bench.newest_committed_regress()
+    assert committed is not None, "no committed BENCH_REGRESS_r*.json"
+    fresh = bench.run_regress_suite(reps=3)
+    ok, report = bench.compare_regress(fresh, committed)
+    assert ok, "\n".join(report)
+
+
+def test_regress_refuses_incomparable_records(bench):
+    ok, report = bench.compare_regress(
+        {"workloads": {"a": {"p50_ms": 1, "ref_p50_ms": 1}}},
+        {"workloads": {"b": {"p50_ms": 1, "ref_p50_ms": 1}}})
+    assert not ok and "no comparable workloads" in report[0]

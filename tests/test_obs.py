@@ -138,6 +138,65 @@ def test_recorder_dump_roundtrip(tmp_path):
     assert rec.stats()["n_dumps"] == 1 and rec.dumps == [path]
 
 
+def test_recorder_concurrent_triggers_one_dump_each(tmp_path):
+    """ISSUE 10 satellite: two trigger threads dumping at once — a
+    supervisor restart racing a watchdog divergence — produce ONE dump
+    per trigger (distinct reserved flight_NNNN slots, never an
+    overwrite), every file parses as whole JSON (no torn writes), and
+    the on-disk dump population stays GC-bounded under a dump storm."""
+    import glob
+    import threading as _t
+
+    from jax_mapping.obs import recorder as R
+
+    rec = FlightRecorder(capacity=64)
+    rec.configure(dump_dir=str(tmp_path))
+    for k in range(8):
+        rec.record("map_revision", revision=k)
+
+    n_per_thread = 6
+    barrier = _t.Barrier(2)
+    paths = {"sup": [], "wd": []}
+
+    def trigger(name, reason, use_async):
+        barrier.wait()
+        for k in range(n_per_thread):
+            p = (rec.dump_async if use_async else rec.dump)(
+                f"{reason}_{k}")
+            paths[name].append(p)
+
+    ts = [_t.Thread(target=trigger,
+                    args=("sup", "supervisor_restart", False)),
+          _t.Thread(target=trigger,
+                    args=("wd", "watchdog_divergence", True))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    # Async writers may still be in flight: wait for all dumps to land.
+    deadline = 10.0
+    import time as _time
+    while rec.stats()["n_dumps"] < 2 * n_per_thread and deadline > 0:
+        _time.sleep(0.05)
+        deadline -= 0.05
+    all_paths = paths["sup"] + paths["wd"]
+    assert None not in all_paths
+    assert len(set(all_paths)) == 2 * n_per_thread, \
+        "two triggers shared a flight_NNNN slot"
+    assert rec.stats()["n_dumps"] == 2 * n_per_thread
+    for p in all_paths:
+        doc = json.load(open(p))                 # whole, untorn JSON
+        assert doc["reason"].startswith(("supervisor_restart",
+                                         "watchdog_divergence"))
+        assert doc["events"]
+    # Disk GC bound: storm past _MAX_DUMP_FILES, the population stays
+    # capped at the newest N.
+    for k in range(R._MAX_DUMP_FILES + 5):
+        rec.dump(f"storm_{k}")
+    on_disk = glob.glob(str(tmp_path / "flight_*.json"))
+    assert len(on_disk) <= R._MAX_DUMP_FILES
+
+
 def test_recorder_dump_never_raises(tmp_path):
     """A failing postmortem write must not take down the recovery path
     that triggered it — an unwritable dump dir degrades to None."""
@@ -393,6 +452,127 @@ def test_racewatch_gate_cross_thread_span_emission():
     assert "Tracer._lock@tracer" in counter.candidate
 
 
+def test_racewatch_gate_cross_thread_devprof_emission():
+    """ISSUE 10 satellite: hammer one DispatchProfiler's recording
+    surface from concurrent threads (mapper tick / HTTP tile-hash /
+    test-driver dispatches in miniature) under RaceWatch — the
+    declared `_lock` must converge as every watched field's lockset
+    with ZERO reports."""
+    import functools
+    import sys
+    import types
+
+    from jax_mapping.analysis.protection import groups_by_class
+    from jax_mapping.analysis.racewatch import RaceWatch
+    from jax_mapping.config import DevProfConfig
+    from jax_mapping.obs import DispatchProfiler
+
+    import jax
+    import jax.numpy as jnp
+
+    mod = types.ModuleType("devprof_race_fixture")
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def scaled(k, x):
+        return x * k
+
+    mod.scaled = scaled
+    sys.modules["devprof_race_fixture"] = mod
+    prof = DispatchProfiler(DevProfConfig(enabled=True))
+    prof.install(prefix="devprof_race_fixture")
+    xs = [jnp.ones((4, 4)), jnp.ones((8, 8))]
+    for x in xs:
+        mod.scaled(2, x)                         # compile outside the race
+    watch = RaceWatch()
+    try:
+        watch.watch_object(prof,
+                           groups_by_class()["DispatchProfiler"][0],
+                           name="prof")
+
+        def worker(tid):
+            for k in range(120):
+                mod.scaled(2, xs[k % 2])
+                if k % 40 == 0:
+                    prof.snapshot()
+                    prof.recompiles()
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        watch.unwatch_all()
+        prof.uninstall()
+        sys.modules.pop("devprof_race_fixture", None)
+    assert watch.reports() == [], \
+        "\n".join(r.message for r in watch.reports())
+    st = watch.field_states()["DispatchProfiler._profiles@prof"]
+    assert "DispatchProfiler._lock@prof" in st.candidate
+
+
+# ------------------------------------------- stage-fold (ISSUE 10 sat.)
+
+def test_hot_stages_report_through_one_histogram_mechanism():
+    """The PR 5 match stages and the PR 6 frontier recompute report
+    through the ONE stage mechanism: a StageTimer.observe / stage()
+    entry renders as both the `_ms` summary and the fixed log-bucket
+    `_seconds` histogram family — no hand-built gauge needed."""
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.http_api import MapApiServer
+    from jax_mapping.utils import global_metrics
+
+    # The exact names the hot paths record (relocalize.py stage_match
+    # spans; frontier_incremental.observe fold).
+    global_metrics.stages.observe("frontier.recompute", 0.004)
+    with global_metrics.stages.stage("match.pyramid_build"):
+        pass
+    with global_metrics.stages.stage("match.coarse_score"):
+        pass
+    with global_metrics.stages.stage("match.refine"):
+        pass
+    api = MapApiServer(Bus(domain_id=1), mapper=None, port=0)
+    text = api.handle("/metrics")[2].decode()
+    for stage in ("frontier_recompute", "match_pyramid_build",
+                  "match_coarse_score", "match_refine"):
+        assert f"# TYPE jax_mapping_stage_{stage}_ms summary" in text
+        assert (f"# TYPE jax_mapping_stage_{stage}_seconds histogram"
+                in text)
+        assert f'jax_mapping_stage_{stage}_seconds_bucket{{le="' in text
+    # The hand-built gauge is GONE — the histogram family is the only
+    # `frontier_recompute` latency surface on /metrics.
+    assert "jax_mapping_frontier_recompute_ms " not in text
+
+
+def test_incremental_pipeline_records_recompute_stage():
+    """The frontier pipeline's recompute folds into the stage
+    mechanism at the source: a compute() that recomputes bumps the
+    `frontier.recompute` stage count."""
+    import jax.numpy as jnp
+
+    from jax_mapping.config import tiny_config
+    from jax_mapping.ops.frontier_incremental import (
+        IncrementalFrontierPipeline,
+    )
+    from jax_mapping.utils import global_metrics
+
+    cfg = tiny_config()
+    tile = cfg.serving.tile_cells
+    nt = cfg.grid.size_cells // tile
+    pipe = IncrementalFrontierPipeline(cfg.frontier, cfg.grid, tile)
+    lo = jnp.zeros((cfg.grid.size_cells,) * 2, jnp.float32)
+    poses = np.zeros((1, 3), np.float32)
+    tile_rev = np.zeros((nt, nt), np.int64)
+    before = global_metrics.stages.snapshot().get(
+        "frontier.recompute", {"count": 0})["count"]
+    out = pipe.compute(lo, poses, tile_rev, 0)
+    assert out.recomputed
+    after = global_metrics.stages.snapshot()["frontier.recompute"]
+    assert after["count"] == before + 1
+    assert pipe.last_recompute_ms is not None    # /status one-glance
+
+
 # --------------------------------------------------- bus context plumbing
 
 def test_bus_carries_context_through_mailboxes():
@@ -453,6 +633,10 @@ def test_bus_subscription_stats_aggregate_and_survive_churn():
 
 # ----------------------------------------------------- /trace endpoint
 
+class _Headers(dict):
+    """Minimal If-None-Match header carrier (http.server's .get API)."""
+
+
 def test_trace_endpoint_gating_and_incremental_poll():
     from jax_mapping.bridge.bus import Bus
     from jax_mapping.bridge.http_api import MapApiServer
@@ -468,20 +652,60 @@ def test_trace_endpoint_gating_and_incremental_poll():
     status, _, body = api.handle("/trace?since=0")[:3]
     assert status == 200
     doc = json.loads(body)
-    # A handler span closes AFTER its own response renders: the first
-    # poll sees an empty ring and echoes `since` back as `next`.
+    # /trace does NOT trace itself (ISSUE 10: a span per poll would
+    # advance the ring every request and the ETag could never match) —
+    # an idle tracer polls empty forever.
     assert doc["traceEvents"] == [] and doc["next"] == 0
-    # The second poll sees the first request's `http:/trace` span.
+    assert json.loads(api.handle("/trace?since=0")[2])["traceEvents"] \
+        == []
+    # Other routes still span; the poll then serves them.
+    api.handle("/status")
     doc2 = json.loads(api.handle("/trace?since=0")[2])
-    assert any(e["name"] == "http:/trace" for e in doc2["traceEvents"])
+    assert any(e["name"] == "http:/status" for e in doc2["traceEvents"])
     nxt = doc2["next"]
-    assert nxt == tr.last_seq() - 1              # in-flight span pending
+    assert nxt == tr.last_seq()
     # Incremental tail: only spans after `since` come back.
+    api.handle("/status")
     doc3 = json.loads(api.handle(f"/trace?since={nxt}")[2])
-    assert all(e["args"]["seq"] > nxt for e in doc3["traceEvents"])
+    assert doc3["traceEvents"] and \
+        all(e["args"]["seq"] > nxt for e in doc3["traceEvents"])
     assert api.handle("/trace?since=bogus")[0] == 400
     # /metrics renders through the registry with no stack attached, and
     # the obs tail families are present.
     text = api.handle("/metrics")[2].decode()
     assert "# TYPE jax_mapping_obs_recorder_events_total counter" in text
     assert "# TYPE jax_mapping_obs_trace_spans_total counter" in text
+
+
+def test_trace_endpoint_etag_304_and_empty_window():
+    """ISSUE 10 satellite: /trace gets the /tiles conditional-GET
+    treatment — ETag keyed on the span-ring head seq READ BEFORE the
+    span content (lint C1), If-None-Match hit answers a body-less 304,
+    and an empty-window poll (since == head) returns an empty event
+    list echoing `since` as `next`."""
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.http_api import MapApiServer
+
+    tr = Tracer(seed=0)
+    bus = Bus(domain_id=1, tracer=tr)
+    api = MapApiServer(bus, mapper=None, port=0)
+    api.handle("/status")                        # one real span
+    res = api.handle("/trace?since=0")
+    assert res[0] == 200
+    etag = res[3]["ETag"]
+    assert etag.startswith('W/"trace-')
+    # Same window, unchanged ring -> 304 with an empty body.
+    res2 = api.handle("/trace?since=0",
+                      headers=_Headers({"If-None-Match": etag}))
+    assert res2[0] == 304 and res2[2] == b""
+    assert res2[3]["ETag"] == etag
+    # Ring advanced -> the stale ETag misses and fresh spans arrive.
+    api.handle("/status")
+    res3 = api.handle("/trace?since=0",
+                      headers=_Headers({"If-None-Match": etag}))
+    assert res3[0] == 200 and res3[3]["ETag"] != etag
+    # Empty-window regression: a poller already at the head gets an
+    # empty list and its own `since` back — never a stale `next`.
+    head = tr.last_seq()
+    doc = json.loads(api.handle(f"/trace?since={head}")[2])
+    assert doc["traceEvents"] == [] and doc["next"] == head
