@@ -96,6 +96,23 @@ def measure_scenario(analysis_cfg=None) -> Dict[str, int]:
                 and st.api.serving.map_store is not None:
             st.api.serving.map_store.refresh()
             st.api.serving.map_store.refresh()
+        # Bucketed fuse entry (ISSUE 11): the short mission rarely
+        # queues a variable-length batch, but the budget must still pin
+        # the bucket variant set ({2^k} ∪ {3·2^(k-1)}, the PR 6
+        # crop-span set) — drive two batch sizes sharing one bucket
+        # (5, 6 -> bucket 6) plus one more bucket (3 -> 3): the
+        # committed max for `grid.fuse_scans_masked` is exactly the
+        # bucket count, and a bucketing regression (one variant per B)
+        # shows up as an over-budget third variant.
+        import jax.numpy as jnp
+        from jax_mapping.ops import grid as G
+        gcfg, scfg = cfg.grid, cfg.scan
+        gr = G.empty_grid(gcfg)
+        for nb in (3, 5, 6):
+            G.fuse_scans_bucketed(
+                gcfg, scfg, gr,
+                jnp.ones((nb, scfg.padded_beams), jnp.float32),
+                jnp.zeros((nb, 3), jnp.float32))
     finally:
         st.shutdown()
     return {k: v for k, v in snapshot_cache_sizes().items() if v > 0}

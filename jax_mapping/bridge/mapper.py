@@ -319,6 +319,48 @@ class MapperNode(Node):
             self._dirty_tiles[r0:r1 + 1, c0:c1 + 1] = True
             self._tile_rev[r0:r1 + 1, c0:c1 + 1] = self.map_revision
 
+    def _mark_dirty_box(self, box) -> None:
+        """Mark an inclusive [tr0, tr1] x [tc0, tc1] serving-tile box
+        dirty (caller holds `_state_lock`; `box` is host ints — the
+        fetch happened outside every lock). The fused-fusion feed
+        (`ops/fuse_kernel.touched_tile_box`): the box is DEVICE-computed
+        from the exact `patch_origin` extents the install's fusion used,
+        so the hint is tighter than `_mark_dirty_patch`'s half-extent
+        padding while staying a conservative superset — the tile store's
+        hash diff remains the re-encode criterion either way."""
+        if self._dirty_tiles is None:
+            return
+        tr0, tr1, tc0, tc1 = box
+        with self._dirty_lock:
+            self._dirty_tiles[tr0:tr1 + 1, tc0:tc1 + 1] = True
+            self._tile_rev[tr0:tr1 + 1, tc0:tc1 + 1] = self.map_revision
+
+    def _touched_box(self, i: int, state, travel_cells: int):
+        """Device-computed touched-tile bounds for an install of robot
+        i's step ending at `state.pose` — None when the fused path or
+        serving is off (callers then fall back to the host marker).
+        Covers the step's pose ENDPOINTS (previous installed estimate +
+        the new one) with the exact patch geometry the fusion used,
+        padded by `travel_cells` — the window's odometric path-length
+        bound, so interior poses (and the per-scan-patch window
+        fallback) stay covered however the robot looped. Runs OUTSIDE
+        `_state_lock` and returns host ints (four scalar fetches, the
+        bool(diag.matched) fetch discipline — never a device wait under
+        a lock); `_correction` is tick-thread-only state (the
+        `_prev_paired` single-writer discipline)."""
+        if not self._serving_enabled or not self.cfg.grid.fused_fusion:
+            return None
+        from jax_mapping.ops import fuse_kernel as FK
+        jnp = self._jnp
+        new_xy = state.pose[:2]
+        prev = self._correction[i]
+        prev_xy = new_xy if prev is None else jnp.asarray(prev[0][:2])
+        pts = jnp.stack([prev_xy, new_xy]).astype(jnp.float32)
+        box = FK.touched_tile_box(
+            self.cfg.grid, self.cfg.serving.tile_cells, pts,
+            jnp.int32(travel_cells))
+        return tuple(int(v) for v in box)
+
     def _mark_dirty_all(self) -> None:
         """Whole-map mutation (closure ring re-fuse, restore, prior
         seed): every tile is suspect. Caller holds `_state_lock`."""
@@ -759,6 +801,7 @@ class MapperNode(Node):
         motion = [self._odom_motion(i, it[1]) for it in items]
         wheels_w = np.asarray([[m[0], m[1]] for m in motion], np.float32)
         dts_w = np.asarray([m[2] for m in motion], np.float32)
+        travel_cells = self._travel_cells(motion)
         state = self.states[i]._replace(grid=base_grid)
         with M.stages.stage("mapper.slam_step_window"):
             state, diag = self._S.slam_step_window(
@@ -783,7 +826,8 @@ class MapperNode(Node):
             return
         installed = self._finish_step(i, state, items[-1][1], W, matched,
                                       closed, base_grid, base_gen,
-                                      items[-1][0].header.stamp)
+                                      items[-1][0].header.stamp,
+                                      travel_cells=travel_cells)
         if not installed:
             return
         self._emit_fuse_spans(i, items)
@@ -819,6 +863,7 @@ class MapperNode(Node):
             base_grid = self.shared_grid
             base_gen = self._state_gen[i]
         wl, wr, dt = self._odom_motion(i, od)
+        travel_cells = self._travel_cells([(wl, wr, dt)])
         state = self.states[i]._replace(grid=base_grid)
         with M.stages.stage("mapper.slam_step"):
             state, diag = self._S.slam_step(
@@ -851,7 +896,8 @@ class MapperNode(Node):
             self._quarantine_items(i, [item])
             return
         if self._finish_step(i, state, od, 1, matched, closed, base_grid,
-                             base_gen, scan.header.stamp):
+                             base_gen, scan.header.stamp,
+                             travel_cells=travel_cells):
             self._emit_fuse_spans(i, [item])
 
     def _reject_low_agreement(self, i: int,
@@ -994,15 +1040,31 @@ class MapperNode(Node):
         flight_recorder.record("relocalized", robot=i,
                                n=self.n_relocalizations)
 
+    def _travel_cells(self, motion) -> int:
+        """Odometric path-length bound of a step's window, grid cells:
+        the touched-tile box's interior-pose slack (`_touched_box`).
+        `motion` is the step's [(wl, wr, dt), ...] equivalent-wheel
+        list — |mean wheel| x coeff x dt bounds each sample's
+        displacement (rotation moves no patch origin)."""
+        coeff = self.cfg.robot.speed_coeff_m_per_unit_s
+        travel_m = sum(abs((wl + wr) * 0.5) * coeff * dt
+                       for wl, wr, dt in motion)
+        return int(travel_m / self.cfg.grid.resolution_m) + 1
+
     def _finish_step(self, i: int, state, od: Odometry, n_scans: int,
                      matched: bool, closed: bool, base_grid,
-                     base_gen: int, newest_stamp: float = -float("inf")
-                     ) -> bool:
+                     base_gen: int, newest_stamp: float = -float("inf"),
+                     travel_cells: int = 0) -> bool:
         """Install the step's results; returns False when the step was
         dropped as stale (callers gate their own telemetry on it).
         `newest_stamp` is the newest fused scan's stamp — it advances
         the robot's stale-rejection watermark only when the step really
         installs."""
+        # Fused path: the dirty-tile hint comes from the device (exact
+        # patch extents, fuse_kernel.touched_tile_box) instead of the
+        # host's half-extent approximation. Computed AND fetched before
+        # the lock — a stale-dropped step just wastes one tiny call.
+        touched_box = self._touched_box(i, state, travel_cells)
         with self._state_lock:
             if self.shared_grid is not base_grid \
                     or self._state_gen[i] != base_gen:
@@ -1067,10 +1129,14 @@ class MapperNode(Node):
             if self._serving_enabled:
                 # Serving delta tracking: this install changed the map.
                 # A closure re-fused (possibly) everything; a plain
-                # step touched at most its fusion patch's tiles.
+                # step touched at most its fusion patch's tiles —
+                # device-computed under the fused path, host-estimated
+                # under the classic one.
                 self.map_revision += 1
                 if closed:
                     self._mark_dirty_all()
+                elif touched_box is not None:
+                    self._mark_dirty_box(touched_box)
                 else:
                     self._mark_dirty_patch(new_est[:2])
             if prev is not None and matched and self._prev_matched[i] \
@@ -1181,8 +1247,14 @@ class MapperNode(Node):
             [st.graph.poses[:cap] for st in self.states], axis=0)
         valid = jnp.concatenate(
             [st.graph.pose_valid[:cap] for st in self.states], axis=0)
-        return G_.fuse_scans_masked(self.cfg.grid, self.cfg.scan, grid,
-                                    rings, poses, valid)
+        # Bucketed entry: R x cap is config-fixed but not a bucket edge
+        # for every fleet size — bucketing keeps one compiled variant
+        # per bucket, never one per fleet-size drift. The midpoint
+        # bucket set means the common configs pay nothing (2x64=128 and
+        # 3x64=192 are both exact edges) and padding never exceeds a
+        # third of the rows on this rare (closure-repair) path.
+        return G_.fuse_scans_bucketed(self.cfg.grid, self.cfg.scan, grid,
+                                      rings, poses, valid)
 
     def _publish_correction(self, i: int, scan: LaserScan,
                             od: Odometry) -> None:

@@ -49,6 +49,14 @@ class GridConfig:
     occ_threshold: float = 0.5        # log-odds above which a cell reports occupied
     free_threshold: float = -0.5      # log-odds below which a cell reports free
     hit_tolerance_cells: float = 1.0  # half-width of the "occupied" band, in cells
+    # Fused fusion path (ops/fuse_kernel.py): classify -> log-odds fold ->
+    # touched-tile accounting in one pass, never materialising the
+    # (B, P, P) deltas array in HBM (streaming XLA engine everywhere; a
+    # fused Mosaic kernel on TPU keeps the window patch VMEM-resident
+    # across the scan batch). False = the pre-fused dispatch chain
+    # bit-exactly (classify batch -> sequential fold -> separate
+    # full-grid tile hash), property-tested in tests/test_fuse_kernel.py.
+    fused_fusion: bool = True
 
     @property
     def extent_m(self) -> float:

@@ -1,0 +1,414 @@
+"""Fused scan-to-log-odds fusion: one pass from ranges to hashed tiles.
+
+Fusion is the per-tick floor every robot pays, and the pre-fused path is
+a chain of separate device passes (visible in the PR 10 dispatch
+profiler): `grid._classify_batch` materialises a (B, P, P) deltas array
+in HBM, a sequential `lax.scan` of dynamic_slice/dynamic_update_slice
+read-modify-writes folds it, and a THIRD full-grid pass
+(`grid.tile_hashes`) plus host-side dirty marking tells
+serving/frontier/pyramid caches what changed. The ray-casting-free
+formulation (PAPERS.md: arxiv 2307.08493, "Occupancy Grid Mapping
+without Ray-Casting") is per-cell evidence with no beam walk — exactly
+the shape that fuses raster + log-odds update + tile accounting into one
+pass, with FPGA-SLAM's stage-overlap mindset (arxiv 2006.01050).
+
+Two parity-tested engines behind the `grid._use_pallas()` dispatch
+convention, gated by `GridConfig.fused_fusion` (False = the pre-fused
+chain bit-exactly):
+
+* **Streaming XLA engine** (every backend; what tier-1 measures):
+  classify and fold ride the same `lax.scan` body in `_STREAM_CHUNK`
+  sub-batches — at most (_STREAM_CHUNK, P, P) of deltas is ever live,
+  never the full (B, P, P) HBM array, and the whole fuse -> touched
+  tiles -> bounded tile hash pipeline is ONE dispatch (the classic
+  chain pays fuse + to_gray + full-grid tile_hashes). Bit-identical to
+  the classic chain on the scattered/masked paths (the per-scan op
+  order is unchanged — only the fusion structure moved); the
+  shared-patch window path reassociates the cross-scan delta sum once
+  per sub-chunk boundary (windows of <= _STREAM_CHUNK scans, i.e.
+  every default `batch_scans` window and the regress-gate `fuse_tiny`
+  workload, are bit-identical; larger windows differ by last-ulp — the
+  documented `sensor_kernel.window_delta` chunk-split caveat).
+* **Pallas TPU engine** (`sensor_kernel._make_kernel(fused_apply=True)`
+  Mosaic kernel, following the beam-table/chunking conventions incl.
+  the `_MAX_B_PER_CALL` SMEM ceiling): each grid strip stays
+  VMEM-resident across the whole scan batch — in-vreg beam-table
+  gather, per-scan log-odds accumulate, and the clamped fold into the
+  resident patch on the last scan: one HBM round-trip per strip instead
+  of window-delta write + read + patch read + write. Bit-identical to
+  the classic Pallas window composition (same b-order accumulation,
+  same single `patch + acc` addition).
+
+Touched-tile contract: the fused entry points report which serving
+tiles their patches may have touched ON DEVICE — exact
+`grid.patch_origin` extents, not the host marker's half-extent
+padding — and `fuse_scans_window_touched` finishes with an incremental
+`tile_hashes` restricted to the touched-tile region in the SAME
+dispatch, so the separate full-grid hash pass and the host dirty-mark
+bookkeeping collapse into consuming the kernel's output. Semantics
+stay validated-superset: the tile store's own hash diff (on the gray
+surface) remains the re-encode criterion; a log-odds-identical tile is
+gray-identical by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from jax_mapping.config import GridConfig, ScanConfig
+from jax_mapping.ops import grid as G
+from jax_mapping.ops import sensor_kernel as SK
+
+Array = jax.Array
+
+#: Scans classified per streaming sub-batch. Measured on the 2-core
+#: CPU builder at the production 640-patch config: a pure per-scan
+#: stream (classify one, fold one) serialises the classify work
+#: XLA:CPU vectorises across a batch and runs ~1.4x slower than the
+#: classic chain, and finer sub-batches (8/16/32) still pay a 13-30%
+#: interleave tax — so the XLA engine streams at 64: batches up to 64
+#: (every mapper window, single scans, the tiny ring repair) keep the
+#: classic classify-then-fold structure EXACTLY (bit-identical, same
+#: speed), while larger batches bound the transient deltas at
+#: 64 x 1.6 MB = 105 MB instead of the classic chain's
+#: _FUSE_CHUNK x 1.6 MB = 420 MB HBM materialisation (1.7 GB unchunked
+#: at the 1024-scan loop repair) for a measured ~5-19% interleave cost.
+#: Fine-grained interleaving is the TPU engine's job — there the fused
+#: Mosaic kernel keeps strips VMEM-resident across the whole batch.
+_STREAM_CHUNK = 64
+
+#: Extra tile-box slack (grid cells) for intra-window robot motion when
+#: deriving touched tiles from step ENDPOINT poses (the mapper's dirty
+#: marking): the window-fits contract bounds how far a window's interior
+#: poses stray from its endpoints — the same 8-cell slack the host
+#: marker `MapperNode._mark_dirty_patch` always carried.
+_ENDPOINT_SLACK_CELLS = 8
+
+
+# ---------------------------------------------------------------------------
+# Streaming XLA engine
+# ---------------------------------------------------------------------------
+
+def stream_fold(grid_cfg: GridConfig, scan_cfg: ScanConfig, grid_arr: Array,
+                ranges_b: Array, poses_b: Array, mask_b: Optional[Array],
+                clamp: bool) -> Array:
+    """Delta-free streaming classify->fold over one chunk (traced; the
+    fused twin of `grid._classify_fold`'s classic chunk body).
+
+    Classification runs in `_STREAM_CHUNK` sub-batches through the same
+    engine-dispatched `grid._classify_batch` the classic chain uses;
+    each sub-batch folds immediately, so the (B, P, P) deltas array the
+    classic chain materialises in HBM never exists. Per-scan op order is
+    identical to classic — bit-identical output (property-tested)."""
+    B = ranges_b.shape[0]
+    if B == 0:
+        return grid_arr
+
+    def fold_chunk(g, r, p, m):
+        deltas, origins = G._classify_batch(grid_cfg, scan_cfg, r, p)
+        if m is not None:
+            deltas = deltas * m[:, None, None].astype(deltas.dtype)
+
+        def body(g2, do):
+            delta, origin = do
+            return G.apply_patch(grid_cfg, g2, delta, origin,
+                                 clamp=clamp), None
+
+        g3, _ = jax.lax.scan(body, g, (deltas, origins))
+        return g3
+
+    c = min(_STREAM_CHUNK, B)
+    nc, rem = B // c, B % c
+    out = grid_arr
+    if nc == 1:
+        # One sub-chunk: no outer scan layer — the extra while-loop
+        # nesting costs ~25% of slam_step's XLA compile for nothing
+        # (this IS the classic classify-then-fold structure, which is
+        # also what makes the <= _STREAM_CHUNK paths bit-identical).
+        out = fold_chunk(out, ranges_b[:c], poses_b[:c],
+                         None if mask_b is None else mask_b[:c])
+    elif nc:
+        cut = nc * c
+
+        def outer(g, rpm):
+            r, p, m = rpm
+            return fold_chunk(g, r, p, m), None
+
+        out, _ = jax.lax.scan(
+            outer, out,
+            (ranges_b[:cut].reshape(nc, c, -1),
+             poses_b[:cut].reshape(nc, c, 3),
+             None if mask_b is None else mask_b[:cut].reshape(nc, c)))
+    if rem:
+        out = fold_chunk(out, ranges_b[B - rem:], poses_b[B - rem:],
+                         None if mask_b is None else mask_b[B - rem:])
+    return out
+
+
+def window_accumulate_xla(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                          ranges_b: Array, poses_b: Array,
+                          origin_rc: Array) -> Array:
+    """Streaming shared-patch window delta (XLA engine): sum of all B
+    scans' deltas on one patch, accumulated per `_STREAM_CHUNK`
+    sub-batch so at most (c, P, P) is ever live. For B <= _STREAM_CHUNK
+    this IS the classic vmap+sum bit-for-bit; beyond that the cross-scan
+    sum reassociates at sub-chunk boundaries (last-ulp, the
+    `window_delta` chunk-split caveat)."""
+    P = grid_cfg.patch_cells
+    B = ranges_b.shape[0]
+    if B == 0:
+        return jnp.zeros((P, P), jnp.float32)
+
+    def chunk_delta(r, p):
+        return jax.vmap(
+            lambda rr, pp: G.classify_patch(grid_cfg, scan_cfg, rr, pp,
+                                            origin_rc)
+        )(r, p).sum(axis=0)
+
+    c = min(_STREAM_CHUNK, B)
+    nc, rem = B // c, B % c
+    if nc == 1 and rem == 0:
+        return chunk_delta(ranges_b, poses_b)
+    acc = jnp.zeros((P, P), jnp.float32)
+    if nc == 1:
+        acc = acc + chunk_delta(ranges_b[:c], poses_b[:c])
+        nc = 0                      # rem handled below; no outer scan
+    if nc:
+        cut = nc * c
+
+        def outer(a, rp):
+            r, p = rp
+            return a + chunk_delta(r, p), None
+
+        acc, _ = jax.lax.scan(outer, acc,
+                              (ranges_b[:cut].reshape(nc, c, -1),
+                               poses_b[:cut].reshape(nc, c, 3)))
+    if rem:
+        acc = acc + chunk_delta(ranges_b[B - rem:], poses_b[B - rem:])
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU engine: grid strips VMEM-resident across the scan batch
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _window_apply_pallas(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                         patch: Array, ranges_b: Array, poses_b: Array,
+                         origin_rc: Array) -> Array:
+    """clip(patch + sum_b delta_b) in ONE kernel: per (S, LANES) strip,
+    accumulate every scan's delta in the resident output register file
+    and fold the current patch in (clamped) on the last scan — the
+    window delta never round-trips HBM. B <= `SK._MAX_B_PER_CALL`
+    (callers chunk; the scoped-SMEM ceiling is the sensor kernel's)."""
+    SK._check_shapes(grid_cfg, scan_cfg)
+    P = grid_cfg.patch_cells
+    S = SK._step_rows(grid_cfg)
+    B = ranges_b.shape[0]
+    nchunk = scan_cfg.padded_beams // SK.LANES
+    table = SK._beam_table(grid_cfg, scan_cfg, ranges_b)
+    origin = jnp.broadcast_to(
+        origin_rc.astype(jnp.int32).reshape(1, 2), (B, 2))
+    kernel = SK._make_kernel(grid_cfg, scan_cfg, S, accumulate=True,
+                             fused_apply=True)
+    rows_tot = P * P // SK.LANES
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows_tot // S, B),
+        in_specs=[
+            pl.BlockSpec((1, nchunk, SK.LANES), lambda t, b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((S, SK.LANES), lambda t, b: (t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((S, SK.LANES), lambda t, b: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows_tot, SK.LANES), jnp.float32),
+        interpret=interpret,
+    )(table, poses_b.astype(jnp.float32), origin,
+      patch.reshape(rows_tot, SK.LANES))
+    return out.reshape(P, P)
+
+
+def window_fused(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                 grid_arr: Array, ranges_b: Array, poses_b: Array,
+                 origin_rc: Array) -> Array:
+    """Fused shared-patch window fuse (traced): engine-dispatched like
+    `grid._classify_batch`. Every pose must satisfy the shared-patch
+    contract (`sensor_kernel.window_fits`) — same as the classic path."""
+    P = grid_cfg.patch_cells
+    B = ranges_b.shape[0]
+    if B == 0:
+        return G.apply_patch(grid_cfg, grid_arr,
+                             jnp.zeros((P, P), jnp.float32), origin_rc,
+                             clamp=True)
+    if G._use_pallas():
+        if B <= SK._MAX_B_PER_CALL:
+            cur = jax.lax.dynamic_slice(
+                grid_arr, (origin_rc[0], origin_rc[1]), (P, P))
+            new = _window_apply_pallas(grid_cfg, scan_cfg, cur, ranges_b,
+                                       poses_b, origin_rc)
+            return jax.lax.dynamic_update_slice(
+                grid_arr, new, (origin_rc[0], origin_rc[1]))
+        # Over the SMEM ceiling: chunked kernel subtotals + one apply —
+        # the classic composition bit-for-bit, still one dispatch.
+        delta = SK.window_delta(grid_cfg, scan_cfg, ranges_b, poses_b,
+                                origin_rc)
+    else:
+        delta = window_accumulate_xla(grid_cfg, scan_cfg, ranges_b,
+                                      poses_b, origin_rc)
+    return G.apply_patch(grid_cfg, grid_arr, delta, origin_rc, clamp=True)
+
+
+# ---------------------------------------------------------------------------
+# Touched-tile accounting (device-computed; serving tile units)
+# ---------------------------------------------------------------------------
+
+def patch_span_tiles(grid_cfg: GridConfig, tile_cells: int) -> int:
+    """Serving tiles per axis that one fusion patch can intersect: the
+    patch spans `patch_cells` from a tile-UNaligned origin, so
+    ceil(P/t) + 1 tiles bound it (clamped to the tile grid)."""
+    if grid_cfg.size_cells % tile_cells:
+        raise ValueError(
+            f"tile_cells={tile_cells} does not divide grid.size_cells="
+            f"{grid_cfg.size_cells}")
+    span = -(-grid_cfg.patch_cells // tile_cells) + 1
+    return min(span, grid_cfg.size_cells // tile_cells)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def touched_tile_box(grid_cfg: GridConfig, tile_cells: int,
+                     poses_xy: Array, pad_cells: Array) -> Array:
+    """(4,) int32 [tr0, tr1, tc0, tc1] INCLUSIVE serving-tile bounds
+    covering every fusion patch a step at these poses touched — the
+    device-computed feed for the mapper's dirty-tile mask
+    (`MapperNode._mark_dirty_box`). Uses the exact `grid.patch_origin`
+    snapping the fusion itself used (the host marker approximated it
+    with half-extent + alignment padding), padded by `pad_cells` —
+    callers pass the step's intra-window TRAVEL bound (window-interior
+    poses lie within the odometric path length of the endpoints, so the
+    box is a true superset even for windows the shared-patch check sent
+    down the per-scan-patch fallback) — plus the fixed endpoint slack
+    AND the origin-alignment quantum: `patch_origin` rounds to
+    align_cols (128 at production), so a pose just past an endpoint can
+    snap its patch a full alignment step beyond the endpoints' own
+    snapped origins — the same snap the host marker's align/2 padding
+    absorbed, needed in full here because both compared values are
+    snapped. `_tile_rev` consumers (pyramid cache, incremental
+    frontier) rely on the superset; the tile store's hash diff stays
+    its own criterion.
+
+    poses_xy: (N, 2) world metres — the step's pose endpoints.
+    pad_cells: () int32 — extra slack in grid cells (traced: one
+    compiled variant regardless of travel).
+    """
+    P = grid_cfg.patch_cells
+    nt = grid_cfg.size_cells // tile_cells
+    origins = jax.vmap(
+        lambda xy: G.patch_origin(grid_cfg, xy))(poses_xy)   # (N, 2) r,c
+    pad = (_ENDPOINT_SLACK_CELLS + pad_cells
+           + max(grid_cfg.align_rows, grid_cfg.align_cols))
+    lo = jnp.clip(origins.min(axis=0) - pad, 0,
+                  grid_cfg.size_cells - 1)
+    hi = jnp.clip(origins.max(axis=0) + P - 1 + pad, 0,
+                  grid_cfg.size_cells - 1)
+    t0 = lo // tile_cells
+    t1 = hi // tile_cells
+    return jnp.stack([t0[0], jnp.minimum(t1[0], nt - 1),
+                      t0[1], jnp.minimum(t1[1], nt - 1)]).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Fused entry points: ranges -> grid (+ touched tiles, + hashed tiles)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def fuse_scans_window_touched(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                              tile_cells: int, grid_arr: Array,
+                              ranges_b: Array, poses_b: Array
+                              ) -> Tuple[Array, Array, Array]:
+    """One dispatch from raw ranges to hashed tiles (the ISSUE 11
+    headline): fuse a shared-patch scan window AND hash exactly the
+    tile region the patch touched.
+
+    Returns (new_grid, tile_rc, hashes): `tile_rc` is the (2,) int32
+    [tile_row, tile_col] origin of the touched K x K tile region
+    (K = `patch_span_tiles`), `hashes` its (K, K, 2) uint32 per-tile
+    content hashes (`grid.tile_hashes` lanes) over the NEW grid — the
+    bounded incremental replacement for the classic chain's separate
+    full-grid hash dispatch. Window semantics (shared patch from the
+    mean pose, clamp once per window) match `grid.fuse_scans_window`;
+    honors `GridConfig.fused_fusion` so parity tests can pin the classic
+    chain through the same output surface.
+    """
+    mean_xy = poses_b[:, :2].mean(axis=0)
+    origin = G.patch_origin(grid_cfg, mean_xy)
+    if grid_cfg.fused_fusion:
+        new = window_fused(grid_cfg, scan_cfg, grid_arr, ranges_b,
+                           poses_b, origin)
+    else:
+        new = G.fuse_scans_window(grid_cfg, scan_cfg, grid_arr, ranges_b,
+                                  poses_b)
+    K = patch_span_tiles(grid_cfg, tile_cells)
+    nt = grid_cfg.size_cells // tile_cells
+    tile_rc = jnp.minimum(origin // tile_cells,
+                          nt - K).astype(jnp.int32)
+    region = jax.lax.dynamic_slice(
+        new, (tile_rc[0] * tile_cells, tile_rc[1] * tile_cells),
+        (K * tile_cells, K * tile_cells))
+    return new, tile_rc, G.tile_hashes(region, tile_cells)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def fuse_scans_touched(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                       tile_cells: int, grid_arr: Array, ranges_b: Array,
+                       poses_b: Array, mask_b: Optional[Array] = None
+                       ) -> Tuple[Array, Array]:
+    """Scattered-pose fused fold with a touched-tile side output: the
+    grid exactly as `fuse_scans`/`fuse_scans_masked` produce it, plus
+    the (nt, nt) bool mask of serving tiles any CONTRIBUTING scan's
+    patch intersected (masked-out scans mark nothing), computed in the
+    same dispatch from the per-patch origins the fold itself used.
+
+    The scattered half of the touched-tile contract. No bridge caller
+    yet: the mapper's scattered installs run inside `slam_step`'s jit
+    (no host consumer for a side output there) and its closure re-fuse
+    marks all tiles anyway — this is the entry the sharded fleet step's
+    halo exchange (ROADMAP item 3) consumes, where per-patch tile
+    extents decide which neighbor slabs must move."""
+    m = None if mask_b is None else mask_b.astype(jnp.bool_)
+    if grid_cfg.fused_fusion:
+        out = stream_fold(grid_cfg, scan_cfg, grid_arr, ranges_b, poses_b,
+                          m, clamp=True)
+    elif m is None:
+        out = G.fuse_scans(grid_cfg, scan_cfg, grid_arr, ranges_b,
+                           poses_b)
+    else:
+        out = G.fuse_scans_masked(grid_cfg, scan_cfg, grid_arr, ranges_b,
+                                  poses_b, m)
+    K = patch_span_tiles(grid_cfg, tile_cells)
+    nt = grid_cfg.size_cells // tile_cells
+    origins = jax.vmap(
+        lambda p: G.patch_origin(grid_cfg, p[:2]))(poses_b)
+    contributing = (jnp.ones(ranges_b.shape[0], jnp.bool_)
+                    if m is None else m)
+
+    def mark(acc, om):
+        o, keep = om
+        rc = jnp.minimum(o // tile_cells, nt - K)
+        marked = jax.lax.dynamic_update_slice(
+            acc, jnp.ones((K, K), jnp.bool_), (rc[0], rc[1]))
+        return jnp.where(keep, marked, acc), None
+
+    touched, _ = jax.lax.scan(mark, jnp.zeros((nt, nt), jnp.bool_),
+                              (origins, contributing))
+    return out, touched

@@ -34,7 +34,7 @@ indexing.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -286,8 +286,46 @@ def _fold(grid_cfg: GridConfig, grid_arr: Array, deltas: Array,
 # Mosaic's scoped SMEM grows with the Pallas grid's step count (B > 512
 # over-runs the 1 MB budget at the full-size 640-patch config — measured
 # on v5e), and the (B, P, P) deltas array is B x 1.6 MB of HBM (the
-# 1024-scan loop-repair refuse would materialise 1.7 GB at once).
+# 1024-scan loop-repair refuse would materialise 1.7 GB at once; the
+# fused streaming engine bounds it at _STREAM_CHUNK x 1.6 MB instead).
 _FUSE_CHUNK = 256
+
+
+def _batch_bucket(n: int) -> int:
+    """Smallest of {2^k} ∪ {3·2^(k-1)} >= n — the scan-batch bucket
+    (the PR 6 crop-span set: the 1.5x midpoints halve bucket overshoot,
+    so padding never exceeds a third of the batch — a fixed 3-robot
+    ring re-fuse of 192 rows buckets to exactly 192, not 256)."""
+    if n <= 2:
+        return max(n, 1)
+    p = 1 << (n - 1).bit_length()           # next pow2
+    mid = 3 * (p // 4)                       # the midpoint below it
+    return mid if mid >= n else p
+
+
+def _pad_batch_to(bucket: int, ranges_b: Array, poses_b: Array,
+                  mask_b: Optional[Array]):
+    """Pad a scan batch to `bucket` rows with mask=0 entries: padded
+    ranges are zeros, padded poses COPY the last real row (keeps the
+    padded patch origins on real data — clip(cur + 0) there is exact on
+    any in-bounds grid), and the returned mask zeroes the pad rows out
+    of the classified deltas, so padding is exact by the same argument
+    the masked fold already rests on."""
+    B = ranges_b.shape[0]
+    m = (jnp.ones(B, jnp.bool_) if mask_b is None
+         else mask_b.astype(jnp.bool_))
+    pad = bucket - B
+    if pad <= 0:
+        return ranges_b, poses_b, m
+    return (
+        jnp.concatenate(
+            [ranges_b, jnp.zeros((pad, ranges_b.shape[1]),
+                                 ranges_b.dtype)]),
+        jnp.concatenate(
+            [poses_b, jnp.broadcast_to(poses_b[B - 1:B],
+                                       (pad, poses_b.shape[1]))]),
+        jnp.concatenate([m, jnp.zeros(pad, jnp.bool_)]),
+    )
 
 
 def _classify_fold(grid_cfg: GridConfig, scan_cfg: ScanConfig,
@@ -299,21 +337,37 @@ def _classify_fold(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     iff mask_b[b] (multiplied on the classified deltas: zeroing ranges
     instead would still carve free space — a zero range means "outlier,
     carve to 10 m", server/.../main.py:152); mask_b=None skips the
-    multiply on the unmasked hot paths."""
+    multiply on the unmasked hot paths.
+
+    `GridConfig.fused_fusion` swaps the chunk body for the streaming
+    engine (`ops/fuse_kernel.stream_fold`): classify and fold in the
+    same scan body, no (B, P, P) deltas in HBM — bit-identical output
+    (tests/test_fuse_kernel.py). False = this pre-fused chain exactly.
+
+    The remainder tail is padded to its `_batch_bucket` with mask=0
+    rows (exact — masked deltas are multiplied out, the PR 6 crop-span
+    idiom), so callers passing unbucketed B > _FUSE_CHUNK batches
+    compile one variant per BUCKET, not per distinct remainder size."""
     B = ranges_b.shape[0]
     if B == 0:
         return grid_arr
 
     def chunk(g, rpm):
         r, p, m = rpm
+        if grid_cfg.fused_fusion:
+            from jax_mapping.ops import fuse_kernel as FK
+            return FK.stream_fold(grid_cfg, scan_cfg, g, r, p, m,
+                                  clamp), None
         deltas, origins = _classify_batch(grid_cfg, scan_cfg, r, p)
         if m is not None:
             deltas = deltas * m[:, None, None].astype(deltas.dtype)
         return _fold(grid_cfg, g, deltas, origins, clamp=clamp), None
 
-    # Full chunks ride one lax.scan; the remainder is a smaller final call
-    # (classifying padded dummy scans would cost full kernel work each —
-    # zero ranges are outliers that carve to max range).
+    # Full chunks ride one lax.scan; the remainder is a smaller final
+    # call at its bucket (padding all the way up to _FUSE_CHUNK would
+    # cost full kernel work per dummy scan — zero ranges are outliers
+    # that carve to max range, hence the mask, and a 257-scan batch
+    # should not pay 255 masked classifies).
     CB = min(_FUSE_CHUNK, B)
     nc, rem = B // CB, B % CB
     out = grid_arr
@@ -325,8 +379,13 @@ def _classify_fold(grid_cfg: GridConfig, scan_cfg: ScanConfig,
              poses_b[:cut].reshape(nc, CB, 3),
              None if mask_b is None else mask_b[:cut].reshape(nc, CB)))
     if rem:
-        out, _ = chunk(out, (ranges_b[B - rem:], poses_b[B - rem:],
-                             None if mask_b is None else mask_b[B - rem:]))
+        bucket = min(_batch_bucket(rem), CB)
+        r, p, m = _pad_batch_to(
+            bucket, ranges_b[B - rem:], poses_b[B - rem:],
+            None if mask_b is None else mask_b[B - rem:])
+        if bucket == rem and mask_b is None:
+            m = None        # no pad rows: keep the unmasked hot path
+        out, _ = chunk(out, (r, p, m))
     return out
 
 
@@ -372,6 +431,30 @@ def fuse_scans_masked(grid_cfg: GridConfig, scan_cfg: ScanConfig,
                           mask_b.astype(jnp.bool_), clamp=True)
 
 
+def fuse_scans_bucketed(grid_cfg: GridConfig, scan_cfg: ScanConfig,
+                        grid_arr: Array, ranges_b: Array, poses_b: Array,
+                        mask_b: Optional[Array] = None) -> Array:
+    """`fuse_scans_masked` with the scan-batch dimension bucketed.
+
+    Host-side wrapper (bucketing must happen OUTSIDE the jit boundary —
+    inside it the trace still keys on the caller's B): pads the batch to
+    its `_batch_bucket` ({2^k} ∪ {3·2^(k-1)} — padding never exceeds a
+    third of the batch) with mask=0 rows (exact — masked deltas are
+    multiplied out, the PR 6 crop-span idiom) and dispatches
+    `fuse_scans_masked`, so callers with churning queue lengths compile
+    one variant per BUCKET instead of one per distinct B. The committed
+    `analysis/compile_budget.json` pins the bucket variant count."""
+    B = ranges_b.shape[0]
+    if B == 0:
+        return grid_arr
+    ranges_b = jnp.asarray(ranges_b)
+    poses_b = jnp.asarray(poses_b)
+    r, p, m = _pad_batch_to(_batch_bucket(B), ranges_b, poses_b,
+                            None if mask_b is None
+                            else jnp.asarray(mask_b))
+    return fuse_scans_masked(grid_cfg, scan_cfg, grid_arr, r, p, m)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def scan_deltas_full(grid_cfg: GridConfig, scan_cfg: ScanConfig,
                      ranges_b: Array, poses_b: Array) -> Array:
@@ -403,9 +486,22 @@ def fuse_scans_window(grid_cfg: GridConfig, scan_cfg: ScanConfig,
     the clamp applies once per window rather than once per scan (the same
     bounded-relaxation slam_toolbox applies per map update cycle,
     `slam_config.yaml:25`).
+
+    `GridConfig.fused_fusion` routes through the fused engines
+    (`ops/fuse_kernel.window_fused`): on TPU the Mosaic fused-apply
+    kernel keeps each grid strip VMEM-resident across the batch
+    (bit-identical to this classic composition); elsewhere the
+    streaming accumulate never materialises more than a sub-chunk of
+    deltas (bit-identical up to the documented cross-scan-sum
+    reassociation for windows over `fuse_kernel._STREAM_CHUNK` scans).
+    False = the chain below, bit-exactly.
     """
     mean_xy = poses_b[:, :2].mean(axis=0)
     origin = patch_origin(grid_cfg, mean_xy)
+    if grid_cfg.fused_fusion:
+        from jax_mapping.ops import fuse_kernel as FK
+        return FK.window_fused(grid_cfg, scan_cfg, grid_arr, ranges_b,
+                               poses_b, origin)
     if _use_pallas():
         from jax_mapping.ops import sensor_kernel as SK
         delta = SK.window_delta(grid_cfg, scan_cfg, ranges_b, poses_b,

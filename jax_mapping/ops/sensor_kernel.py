@@ -128,11 +128,21 @@ def _beam_table(grid_cfg: GridConfig, scan_cfg: ScanConfig,
 
 
 def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig, step_rows: int,
-                 accumulate: bool = True, mode: str = "delta"):
+                 accumulate: bool = True, mode: str = "delta",
+                 fused_apply: bool = False):
     """mode='delta': log-odds inverse sensor model. mode='raster': soft
     scan raster — per cell a triangular weight max(0, 1-|r_cell - z|/res)
     on the hit band (no free-space carving), the correlative matcher's
-    continuous-pose rasterizer (ops/scan_match.py)."""
+    continuous-pose rasterizer (ops/scan_match.py).
+
+    fused_apply (requires accumulate): the ISSUE 11 fused-fusion finale —
+    the kernel takes the CURRENT grid patch as an extra input (same
+    (S, LANES) strip blocking as the output) and, on the batch's last
+    scan, folds the accumulated window delta into it with the log-odds
+    clamp: out = clip(patch + sum_b delta_b). The strip never makes a
+    second HBM round-trip through a separate apply dispatch, and the
+    single `patch + acc` addition is bit-identical to the classic
+    `apply_patch(grid, window_delta(...))` composition."""
     P = grid_cfg.patch_cells
     nchunk = scan_cfg.padded_beams // LANES
     res = grid_cfg.resolution_m
@@ -145,8 +155,12 @@ def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig, step_rows: int,
     ccw = scan_cfg.counterclockwise
     S = step_rows
     patch_rows_per_step = S * LANES // P
+    if fused_apply and not accumulate:
+        raise ValueError("fused_apply needs the accumulating kernel form")
 
-    def kernel(table_ref, pose_ref, origin_ref, out_ref):
+    def kernel(table_ref, pose_ref, origin_ref, *refs):
+        patch_ref = refs[0] if fused_apply else None
+        out_ref = refs[-1]
         # pose/origin ride whole-array in SMEM; the kernel picks its
         # scan's row with the grid index instead of a BlockSpec (Mosaic
         # rejects sub-row blocks over a (B, 3) array).
@@ -235,6 +249,17 @@ def _make_kernel(grid_cfg: GridConfig, scan_cfg: ScanConfig, step_rows: int,
             @pl.when(near)
             def _():
                 out_ref[:] = out_ref[:] + body()
+
+            if fused_apply:
+                # Last scan of the batch: fold the accumulated window
+                # delta into the resident grid strip, clamped — the
+                # whens trace in program order, so the final scan's own
+                # delta (the `near` block above) lands first.
+                @pl.when(b == pl.num_programs(1) - 1)
+                def _():
+                    out_ref[:] = jnp.clip(
+                        patch_ref[:] + out_ref[:],
+                        grid_cfg.logodds_min, grid_cfg.logodds_max)
         else:
             @pl.when(near)
             def _():

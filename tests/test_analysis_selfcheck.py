@@ -363,6 +363,22 @@ def test_no_suppressions_in_scenarios_modules():
         f"{banned}")
 
 
+def test_no_suppressions_in_fusion_modules():
+    """ISSUE 11 CI guard, extending the zero-suppression tier: the
+    fused-fusion path (`ops/fuse_kernel.py`) and its home
+    (`ops/grid.py`, already pinned by the ISSUE 8 guard) plus the
+    sensor kernel it extends carry ZERO baseline suppressions — the
+    per-tick floor every robot pays may not baseline its hazards."""
+    base = Baseline.load(default_baseline_path())
+    banned = [s for s in base.suppressions
+              if s["path"] in ("jax_mapping/ops/fuse_kernel.py",
+                               "jax_mapping/ops/grid.py",
+                               "jax_mapping/ops/sensor_kernel.py")]
+    assert not banned, (
+        "suppressions are not allowed in the fusion modules: "
+        f"{banned}")
+
+
 def test_no_suppressions_in_obs_modules():
     """ISSUE 9 CI guard, extending the zero-suppression tier: the
     observability subsystem (`jax_mapping/obs/`) carries ZERO baseline
