@@ -584,9 +584,12 @@ def main() -> None:
         if suite == "fuse":
             _fuse_main()
             return
+        if suite == "restart":
+            _restart_main()
+            return
         print(f"bench: unknown suite {suite!r} "
-              "(available: serving, match, frontier, obs, fuse; "
-              "also: --validate, --regress)",
+              "(available: serving, match, frontier, obs, fuse, "
+              "restart; also: --validate, --regress)",
               file=sys.stderr, flush=True)
         sys.exit(2)
     if os.environ.get("_JAX_MAPPING_BENCH_CPU_FALLBACK") != "1" \
@@ -608,6 +611,245 @@ def main() -> None:
         import traceback
         traceback.print_exc(file=sys.stderr)
     _emit_and_exit(0)
+
+
+def _argv_value(flag: str):
+    if flag in sys.argv:
+        i = sys.argv.index(flag)
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return None
+
+
+_RESTART_METHODOLOGY = (
+    "supervisor kill->resume->first-fused-scan wall time, executed in "
+    "FRESH subprocesses so jit caches are genuinely cold (the "
+    "in-process tier-1 restarts inherit the warm process cache and "
+    "cannot see the restart compile storm): one seed child populates "
+    "the checkpoint + persistent compile cache + AOT snapshots, then "
+    "cold children (cache root recreated empty per rep) and warm "
+    "children (populated root) launch identical stacks with "
+    "prewarm_on_launch off and time restart_mapper() -> first fused "
+    "scan — the staged restore + priority-ordered pre-warm + "
+    "readiness gate + drive, which is exactly the restart path and "
+    "places the warm tier's own pre-warm cost INSIDE the measured "
+    "span; medians over reps, speedup = cold_p50 / warm_p50; "
+    "process-boot/launch totals are reported alongside as "
+    "total_*_s (they are identical fixed cost in both modes). On "
+    "this CPU builder the AOT tier degrades by design (XLA:CPU "
+    "executables do not deserialize cross-process) and the "
+    "persistent cache carries the speedup; aot counters in "
+    "warm_detail record the degradation")
+
+
+def _restart_main() -> None:
+    """`bench.py --suite restart` — the ISSUE 12 gate: supervisor
+    kill→resume→first-fused-scan wall time, cold vs warm compile
+    caches. Prints exactly ONE JSON line; `--out FILE` copies it (the
+    BENCH_RESTART_r* artifact).
+
+    CPU-pinned like the serving suite: the number is host wall time
+    over subprocess stacks, and a wedged TPU tunnel must not hang the
+    children's backend init."""
+    if "--phase" in sys.argv:
+        # Child mode (spawned by the orchestrator below, already
+        # CPU-pinned via its env): run one seed/resume phase, print one
+        # JSON line.
+        _restart_phase_main()
+        return
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        from jax_mapping.utils.backend_guard import scrubbed_cpu_env
+        os.execvpe(sys.executable, [sys.executable] + sys.argv,
+                   scrubbed_cpu_env(extra_env={
+                       "JAX_PLATFORMS": "cpu",
+                       "JAX_MAPPING_BENCH_DEADLINE_S":
+                           str(max(60.0, _remaining()))}))
+    result = {"metric": "restart_kill_resume_first_fuse_speedup",
+              "suite": "restart", "value": None,
+              "cold_resume_s_p50": None, "warm_resume_s_p50": None,
+              "cold_resume_s": [], "warm_resume_s": [],
+              "grid_hash_equal": None, "seed": None, "warm_detail": None,
+              "sections_completed": [], "provenance": None,
+              "methodology": _RESTART_METHODOLOGY,
+              "error": "watchdog deadline hit"}
+    _run_suite_guarded(result, _restart_run)
+
+
+def _restart_run(result: dict) -> None:
+    import shutil
+    import subprocess
+    import tempfile
+    result.pop("error", None)
+    d = tempfile.mkdtemp(prefix="jm_restart_bench_")
+    from jax_mapping.utils.backend_guard import scrubbed_cpu_env
+    env = scrubbed_cpu_env(extra_env={"JAX_PLATFORMS": "cpu"})
+    # Children must import the repo the orchestrator runs from.
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+
+    def child(phase: str, mode: str = "") -> dict:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--suite", "restart", "--phase", phase, "--dir", d]
+        if mode:
+            cmd += ["--mode", mode]
+        t0 = time.monotonic()
+        p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                           timeout=max(_remaining() - 15.0, 30.0))
+        wall = time.monotonic() - t0
+        rec = None
+        for line in reversed(p.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        if rec is None:
+            sys.stderr.write(p.stderr[-4000:])
+            raise RuntimeError(
+                f"restart child {phase}/{mode} emitted no JSON "
+                f"(rc {p.returncode})")
+        rec["wall_s"] = round(wall, 3)
+        return rec
+
+    seed = child("seed")
+    result["seed"] = seed
+    result["sections_completed"].append("seed")
+    cold, warm = [], []
+    hashes = []
+    warm_detail = None
+    for rep in range(2):
+        # Cold = an EMPTY cache root, recreated per rep (the first cold
+        # child itself repopulates it).
+        shutil.rmtree(os.path.join(d, "cold_cache"), ignore_errors=True)
+        c = child("resume", "cold")
+        cold.append(c["resume_s"])
+        hashes.append(c["grid_hash"])
+        result["sections_completed"].append(f"cold_{rep}")
+        w = child("resume", "warm")
+        warm.append(w["resume_s"])
+        hashes.append(w["grid_hash"])
+        warm_detail = w
+        result["sections_completed"].append(f"warm_{rep}")
+        # One rep pair is the floor; the second runs only inside the
+        # remaining watchdog budget.
+        if rep == 0 and _remaining() < (c["wall_s"] + w["wall_s"]) * 1.6:
+            break
+    result["cold_resume_s"] = cold
+    result["warm_resume_s"] = warm
+    result["cold_resume_s_p50"] = round(float(np.median(cold)), 3)
+    result["warm_resume_s_p50"] = round(float(np.median(warm)), 3)
+    result["value"] = round(result["cold_resume_s_p50"]
+                            / max(result["warm_resume_s_p50"], 1e-9), 3)
+    # Bit-identity across the warm/cold twins: same checkpoint, same
+    # seed, same steps — the fallback ladder must not perturb the map.
+    result["grid_hash_equal"] = len(set(hashes)) == 1
+    result["warm_detail"] = {
+        k: warm_detail.get(k) for k in
+        ("import_s", "launch_s", "restart_s", "resume_s", "total_s",
+         "steps_to_first_fuse", "warmup", "cache")} \
+        if warm_detail else None
+    result["total_cold_s"] = c.get("total_s")
+    result["total_warm_s"] = (warm_detail or {}).get("total_s")
+    try:
+        load1 = round(os.getloadavg()[0], 1)
+    except OSError:
+        load1 = None
+    result["provenance"] = {
+        "cpu_count": os.cpu_count(), "loadavg_1m": load1,
+        "python": ".".join(map(str, sys.version_info[:3]))}
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def _restart_phase_main() -> None:
+    """One restart-bench child: `--phase seed` populates checkpoint +
+    caches + AOT snapshots; `--phase resume --mode cold|warm` times the
+    kill→resume→first-fused-scan path. Exactly one JSON line on
+    stdout; stack chatter goes to stderr."""
+    import contextlib
+    t0 = time.perf_counter()
+    phase = _argv_value("--phase")
+    d = _argv_value("--dir")
+    mode = _argv_value("--mode") or "warm"
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            out = _restart_phase(phase, d, mode, t0)
+    except Exception as e:                          # noqa: BLE001
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        out = {"phase": phase, "mode": mode, "error": str(e)}
+    print(json.dumps(out), flush=True)
+
+
+def _grid_hash(stack) -> str:
+    import hashlib
+    arr = np.asarray(stack.mapper.merged_grid())
+    return hashlib.blake2b(np.ascontiguousarray(arr).tobytes(),
+                           digest_size=8).hexdigest()
+
+
+def _restart_phase(phase: str, d: str, mode: str, t0: float) -> dict:
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.config import (ColdStartConfig, DevProfConfig,
+                                    ObsConfig, tiny_config)
+    from jax_mapping.sim import world as W
+    ckpt = os.path.join(d, "ckpt")
+    cache = os.path.join(
+        d, "cache" if (phase == "seed" or mode == "warm")
+        else "cold_cache")
+    cfg = tiny_config(n_robots=2).replace(
+        # prewarm_on_launch off: the resume children must pay the warm
+        # tier INSIDE the measured restart span, not hide it in launch.
+        cold_start=ColdStartConfig(enabled=True, cache_dir=cache,
+                                   prewarm_on_launch=False),
+        # devprof captures the (function, signature) registry the AOT
+        # snapshot pass serializes.
+        obs=ObsConfig(devprof=DevProfConfig(enabled=True)))
+    world = W.plank_course(96, cfg.grid.resolution_m, n_planks=4, seed=3)
+    import_s = round(time.perf_counter() - t0, 3)
+    st = launch_sim_stack(cfg, world, n_robots=2, http_port=None,
+                          realtime=False, seed=3, checkpoint_dir=ckpt)
+    launch_s = round(time.perf_counter() - t0, 3)
+    try:
+        if phase == "seed":
+            st.brain.start_exploring()
+            st.run_steps(20)
+            st.save_auto_checkpoint()
+            aot = st.save_compile_snapshots()
+            return {"phase": "seed", "import_s": import_s,
+                    "launch_s": launch_s,
+                    "total_s": round(time.perf_counter() - t0, 3),
+                    "aot": aot,
+                    "grid_hash": _grid_hash(st)}
+        # The kill→resume path: the staged supervisor restart (restore
+        # from the seed checkpoint + priority-ordered pre-warm +
+        # readiness gate), then step until the first scan fuses. The
+        # measured span STARTS at the restart — the jit caches of this
+        # fresh process are cold, exactly what the restarted entry
+        # points face — and covers the pre-warm cost in both modes.
+        st.brain.start_exploring()
+        t_kill = time.perf_counter()
+        st.restart_mapper()
+        restart_s = round(time.perf_counter() - t_kill, 3)
+        base = st.mapper.n_scans_fused
+        steps = 0
+        while st.mapper.n_scans_fused <= base and steps < 60:
+            st.run_steps(1)
+            steps += 1
+        resume_s = round(time.perf_counter() - t_kill, 3)
+        return {"phase": "resume", "mode": mode,
+                "import_s": import_s, "launch_s": launch_s,
+                "restart_s": restart_s, "resume_s": resume_s,
+                "total_s": round(time.perf_counter() - t0, 3),
+                "steps_to_first_fuse": steps,
+                "warmup": (st.warmup.snapshot()["report"]
+                           if st.warmup is not None else None),
+                "cache": (st.compile_cache.status()
+                          if st.compile_cache is not None else None),
+                "grid_hash": _grid_hash(st)}
+    finally:
+        st.shutdown()
 
 
 def _match_main() -> None:

@@ -189,6 +189,32 @@ REPO_PROTECTION: List[LockGroup] = [
     # (the C2 class this layout exists to avoid).
     group("CostLedger", "_lock",
           ["_collected"]),
+    # Staged warm-up state machine (resilience/warmup.py): the stage,
+    # the warmed-entry log and the report install together at each
+    # transition; HTTP workers read snapshot()/state() while the
+    # restarting step thread moves the machine — exactly the
+    # cross-thread window the warm-up racewatch gate hammers. The
+    # wiring references (cache/devprof/budget_path) are set-once at
+    # construction, read-only after (the lockfree_ok convention).
+    group("StagedWarmup", "_lock",
+          ["_state", "_warmed", "_report"],
+          lockfree_ok=["cache", "devprof", "budget_path"]),
+    # Compile-cache manager (io/compile_cache.py): wipe refcount +
+    # counters move together under `_lock` (a cache_wipe window racing
+    # a status read must never tear refs from counts); `enabled` and
+    # `fingerprint` are set-once-per-enable flags read bare by the
+    # status convention, file I/O runs outside the lock entirely.
+    group("CompileCacheManager", "_lock",
+          ["_wipe_refs", "_counts"],
+          lockfree_ok=["enabled", "fingerprint"]),
+    # Warm dispatch pool (io/compile_cache.py): the entry table and its
+    # serve/fallthrough/drop counters mutate together from every thread
+    # that dispatches a wrapped entry point; `_bindings`/`installed`
+    # are install-time state serialized by the module _INSTALL_LOCK
+    # (the DispatchProfiler escape, documented not sanctioned).
+    group("WarmPool", "_lock",
+          ["_entries", "n_served", "n_fallthrough", "n_dropped"],
+          lockfree_ok=["_bindings", "installed"]),
 ]
 
 

@@ -123,6 +123,19 @@ class MapApiServer:
             from jax_mapping.obs.ledger import CostLedger
             self.cost_ledger = CostLedger(devprof)
         self.lock_timeout_s = lock_timeout_s
+        #: Staged warm-up window (ISSUE 12): while a supervisor restart
+        #: restores+pre-warms the mapper, serving keeps answering from
+        #: the OLD node's last epoch and /status + /tiles stamp
+        #: `state=warming` — availability over freshness, made visible.
+        #: Set-once-per-window by the restarting thread
+        #: (launch.restart_mapper), read bare by handler threads (the
+        #: lock-free flag convention: a boolean read can only be one
+        #: window edge stale).
+        self.warming = False
+        #: /status `cold_start` provider wired by launch when the
+        #: warm-restart tier is armed (cache counters, warm-pool stats,
+        #: warm-up report).
+        self.coldstart_status: Optional[Callable[[], dict]] = None
         self.n_degraded_responses = 0
         self._lock = threading.Lock()
         #: Request statistics lock: ThreadingHTTPServer runs one worker
@@ -242,6 +255,11 @@ class MapApiServer:
         self._thread: Optional[threading.Thread] = None
 
     # -- restart surface (launch.restart_mapper) -----------------------------
+
+    def set_warming(self, warming: bool) -> None:
+        """Open/close the staged warm-up serving window (the restart
+        path's availability contract: answer stale, say so)."""
+        self.warming = bool(warming)
 
     def rebind_mapper(self, mapper) -> None:
         """Swap the API onto a restarted MapperNode. The serving bundle
@@ -394,6 +412,16 @@ class MapApiServer:
         if route == "/status":
             body = (self.brain.status(lock_timeout_s=self.lock_timeout_s)
                     if self.brain is not None else {})
+            if self.warming:
+                # Staged warm-up window: everything below is the PRIOR
+                # epoch's picture, served instead of blocking while the
+                # restarted mapper restores + pre-warms.
+                body["state"] = "warming"
+            if self.coldstart_status is not None:
+                try:
+                    body["cold_start"] = self.coldstart_status()
+                except Exception:        # noqa: BLE001 — export only
+                    pass
             if self.health is not None:
                 # The whole degraded-mode picture in one glance: driver
                 # link, per-robot OK/no_lidar/dead ladder, health clock.
@@ -810,12 +838,23 @@ class MapApiServer:
         # validity on (epoch, revision), not revision alone — a stale
         # pre-restart ETag can never 304 against the resumed store.
         epoch = self.serving.epoch(source)
-        etag = f'W/"{source}-e{epoch}-r{rev}"'
+        # The warming flag is part of the REPRESENTATION (body and ETag
+        # must agree — the /trace doctrine): a poller current on the
+        # steady-state tag still learns the window opened, and a cached
+        # warming body can never 304 past the window's end.
+        warming = self.warming
+        etag = f'W/"{source}-e{epoch}-r{rev}' + \
+            ('-warming"' if warming else '"')
         if self._etag_hit(headers, etag):
             return 304, "application/json", b"", {"ETag": etag}
         body = dict(meta)
         body.update({"revision": rev, "since": since, "epoch": epoch,
                      "tiles": entries})
+        if warming:
+            # Staged warm-up: these tiles are the PRIOR epoch's content
+            # (the restarted node hasn't entered service yet) — valid,
+            # stamped, and explicitly stale.
+            body["state"] = "warming"
         return 200, "application/json", json.dumps(body).encode(), \
             {"ETag": etag}
 
@@ -1386,6 +1425,17 @@ class MapApiServer:
                           for k, v in sorted(stats.items()))))
             return fams
         reg.add_source(devprof_families)
+
+        def checkpoint_fallback_samples():
+            # Which retention slot checkpoint loads actually resumed
+            # from (ISSUE 12 satellite): a silent .prev / .genNNNNNN
+            # rescue becomes a dashboard fact. All slots always report
+            # (absent label != zero counter).
+            from jax_mapping.io.checkpoint import fallback_counts
+            return [(f'{{slot="{slot}"}}', str(n))
+                    for slot, n in sorted(fallback_counts().items())]
+        reg.family("jax_mapping_checkpoint_fallback_total", "counter",
+                   checkpoint_fallback_samples)
         return reg
 
     # -- lifecycle ----------------------------------------------------------

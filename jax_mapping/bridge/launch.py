@@ -69,6 +69,19 @@ class Stack:
     #: from ("" = auto-checkpointing disabled; pass checkpoint_dir to
     #: launch_sim_stack to enable).
     auto_checkpoint_path: str = ""
+    #: Warm-restart storage tier (io/compile_cache.CompileCacheManager)
+    #: when ColdStartConfig.enabled — persistent XLA cache, AOT
+    #: snapshots, the cache_wipe fault boundary. None = cold restarts.
+    compile_cache: Optional[object] = None
+    #: The staged warm-up state machine (resilience/warmup.StagedWarmup)
+    #: driving restart_mapper's restore→warm→ready ladder; constructed
+    #: lazily on the first restart when launch didn't build one.
+    warmup: Optional[object] = None
+    #: Test seam: called with the stack DURING the warming stage of a
+    #: staged restart (serving still answering from the prior epoch
+    #: with state=warming) — the degraded-serving-window assertion
+    #: hook. Exceptions are contained; the restart proceeds.
+    warmup_hook: Optional[object] = None
     _killed: Set[str] = dataclasses.field(default_factory=set)
     _steps_run: int = 0
 
@@ -133,21 +146,46 @@ class Stack:
             .checkpoint_retain_generations)
 
     def restart_mapper(self) -> None:
-        """The supervisor's mapper restarter: rebuild the MapperNode and
-        resume it from the latest auto-checkpoint with pose re-anchoring.
+        """The supervisor's mapper restarter: a STAGED warm-up (ISSUE
+        12), not a cold boot — rebuild the MapperNode, resume it from
+        the latest auto-checkpoint with pose re-anchoring, pre-warm the
+        jitted entry points in priority order from the cold-start warm
+        tiers, and only then swap the node into the executor/API (which
+        is what re-admits it: the supervisor's fresh heartbeat grace
+        starts when this restarter returns, and FleetHealth-driven
+        assignment resumes with the new node). While the warm-up runs,
+        the API keeps answering from the OLD node's last epoch with
+        `state=warming` instead of blocking — availability over
+        freshness, the degraded-serving contract.
 
         The crash-mid-mission contract (SURVEY.md §5's gap): the map
         resumes from the newest intact checkpoint generation
         (`load_checkpoint_with_fallback` degrades to the rotated
-        last-good file when the newest is corrupt), and each robot's
-        chain re-anchors at the BRAIN's live pose — odometry kept
-        integrating while the mapper was down, so the checkpointed
-        endpoint poses are stale; fusing at them would smear the resumed
-        map. No checkpoint at all degrades to a blank map, still
-        anchored at the live poses."""
+        last-good file when the newest is corrupt — and now records
+        WHICH slot it chose), and each robot's chain re-anchors at the
+        BRAIN's live pose — odometry kept integrating while the mapper
+        was down, so the checkpointed endpoint poses are stale; fusing
+        at them would smear the resumed map. No checkpoint at all
+        degrades to a blank map, still anchored at the live poses."""
+        if self.warmup is None:
+            from jax_mapping.resilience.warmup import StagedWarmup
+            self.warmup = StagedWarmup(cache=self.compile_cache,
+                                       devprof=self.devprof)
+        wu = self.warmup
+        if self.api is not None:
+            self.api.set_warming(True)
+        try:
+            self._restart_mapper_staged(wu)
+        finally:
+            if self.api is not None:
+                self.api.set_warming(False)
+        wu.mark_ready()
+
+    def _restart_mapper_staged(self, wu) -> None:
         n = self.mapper.n_robots
         old = self.mapper
         old.destroy()
+        wu.begin_restore()
         states = None
         if self.auto_checkpoint_path:
             from jax_mapping.io.checkpoint import (
@@ -159,6 +197,25 @@ class Stack:
                     self.auto_checkpoint_path, template)
             except (FileNotFoundError, CheckpointCorrupt):
                 states = None                # no intact generation: blank
+        # Pre-warm BEFORE the new node enters service: entry points
+        # warm fusion-first (time-to-first-fused-scan is the
+        # availability metric) from AOT snapshots, then the persistent
+        # cache, then cold compile; an in-process restart (jit caches
+        # survived) skips in O(registry) time. The profiler re-baselines
+        # inside prewarm so warm-tier variants never count as live
+        # recompiles.
+        wu.begin_warming()
+        sigs = self.devprof.signatures() if self.devprof is not None \
+            else {}
+        wu.prewarm(sigs)
+        if self.warmup_hook is not None:
+            # Test seam: observe the degraded-serving window (prior
+            # epoch content + state=warming) from inside it.
+            try:
+                self.warmup_hook(self)
+            except Exception:                # noqa: BLE001
+                import traceback
+                traceback.print_exc()
         new = MapperNode(self.cfg, self.bus, tf=self.tf, n_robots=n,
                          health=self.health, recovery=self.recovery)
         # Serving restart epoch: the resumed node legitimately re-serves
@@ -195,10 +252,32 @@ class Stack:
             self.api.rebind_mapper(new)
         self._killed.discard("jax_mapper")
 
+    def save_compile_snapshots(self) -> dict:
+        """Serialize AOT executable snapshots for every (function,
+        captured signature) the dispatch profiler observed — the warm
+        half of the restart bench. EXPLICIT only (CLI / bench / tests —
+        the cost-ledger collection doctrine: never a supervisor-cadence
+        side effect); needs both the cold-start tier and an armed
+        profiler, else reports an empty pass."""
+        if self.compile_cache is None or self.devprof is None:
+            return {"n_saved": 0, "n_failed": 0, "n_uncallable": 0,
+                    "names": []}
+        return self.compile_cache.save_aot(self.devprof.signatures(),
+                                           resolve=self.devprof.raw_fn)
+
     def shutdown(self) -> None:
         if self.api is not None:
             self.api.shutdown()
         self.executor.shutdown()
+        if self.compile_cache is not None:
+            # Warm pool BEFORE devprof: a pool installed during a
+            # staged restart wraps the already-installed profiler
+            # wrapper, and uninstalling the profiler first would find
+            # no site holding it (the shutdown-leak case). The pool's
+            # uninstall splices itself out of either nesting; the
+            # profiler then restores cleanly.
+            self.compile_cache.pool.uninstall()
+            self.compile_cache.disable()
         if self.devprof is not None:
             # After the HTTP plane and executor stop: no worker thread
             # is mid-dispatch through a wrapper being unbound.
@@ -221,6 +300,22 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     checkpoint_dir arms the supervisor's auto-checkpoint cadence (and
     therefore restart-from-checkpoint); None keeps the stack disk-free."""
     res = world_res_m if world_res_m is not None else cfg.grid.resolution_m
+    compile_cache = None
+    if cfg.cold_start.enabled:
+        # Warm-restart storage tier (ISSUE 12): the persistent compile
+        # cache must attach BEFORE the first jit compile below so every
+        # compile this launch pays is persisted for the next process.
+        # Failures degrade to plain recompile (flight-recorder event),
+        # never block the launch.
+        cache_root = cfg.cold_start.cache_dir or (
+            os.path.join(checkpoint_dir, "compile_cache")
+            if checkpoint_dir else "")
+        if cache_root:
+            from jax_mapping.io.compile_cache import CompileCacheManager
+            compile_cache = CompileCacheManager(
+                cfg.cold_start, cache_root, config_json=cfg.to_json())
+            compile_cache.enable()
+            compile_cache.evict_lru()
     tracer = None
     if cfg.obs.enabled:
         # Causal tracing (obs/): deterministic trace ids derived from
@@ -337,13 +432,39 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
         ([planner] if planner is not None else []) + \
         ([supervisor] if supervisor is not None else [])
     executor = Executor(nodes)
+    warmup = None
+    if compile_cache is not None:
+        # Launch-time staged warm-up (the resume-process path): load
+        # any AOT snapshots for this fingerprint into the warm pool and
+        # pre-warm the captured entry points through the cache ladder —
+        # BEFORE devprof installs, so the profiler's recompile baseline
+        # lands on the post-warm-up cache sizes (a warm boot must not
+        # report its cold-start repayment as live recompiles).
+        from jax_mapping.resilience.warmup import StagedWarmup
+        warmup = StagedWarmup(cache=compile_cache, devprof=devprof)
+        if cfg.cold_start.prewarm_on_launch:
+            warmup.begin_warming()
+            warmup.prewarm()
+            warmup.mark_ready()
     if devprof is not None:
         devprof.install()
     stack = Stack(cfg=cfg, bus=bus, tf=tf, driver=driver, sim=sim,
                   brain=brain, mapper=mapper, api=api, executor=executor,
                   voxel_mapper=voxel_mapper, planner=planner,
                   health=health, supervisor=supervisor, recovery=recovery,
-                  tracer=tracer, devprof=devprof)
+                  tracer=tracer, devprof=devprof,
+                  compile_cache=compile_cache, warmup=warmup)
+    if api is not None and (compile_cache is not None
+                            or warmup is not None):
+        # /status `cold_start` export: cache counters, warm-pool stats,
+        # the warm-up report (closure over the stack so a later staged
+        # restart's state shows live).
+        api.coldstart_status = lambda: {
+            "cache": (stack.compile_cache.status()
+                      if stack.compile_cache is not None else None),
+            "warmup": (stack.warmup.snapshot()
+                       if stack.warmup is not None else None),
+        }
     if supervisor is not None:
         # Registration needs the Stack (restarter + checkpointer close
         # over it), so it happens after construction. The brain has no

@@ -1448,9 +1448,13 @@ class MapperNode(Node):
 
     def _frontier_incremental(self):
         """The incremental pipeline, or None (disabled config, no
-        revision tracking, or a latched geometry rejection)."""
+        revision tracking, a latched geometry rejection, or decay-aware
+        scoring — the stale mask derives from raw log-odds, which the
+        incremental pipeline's cached coarse masks do not carry, so the
+        knob routes publishes through the full recompute)."""
         if not self.cfg.frontier.incremental or self._tile_rev is None \
-                or self._frontier_pipeline_failed:
+                or self._frontier_pipeline_failed \
+                or self.cfg.frontier.decay_aware:
             return None
         if self._frontier_pipeline is None:
             from jax_mapping.ops.frontier_incremental import \

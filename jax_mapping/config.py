@@ -321,6 +321,25 @@ class FrontierConfig:
     # crop, no robot changed cell) the pipeline reuses the carried
     # fields EXACTLY (a 0-sweep re-mask) — the steady-state fast path.
     warm_extra_iters: int = 4
+    # ---- decay-aware scoring (scenario-engine follow-up) ----------------
+    # Prioritize HEALED/STALE regions for re-verification: under map
+    # decay (DecayConfig) evidence fades toward unknown, so a cell that
+    # was once mapped reads "unknown" again while still carrying
+    # residual sub-threshold log-odds. With `decay_aware` on, frontier
+    # clusters whose targets border such touched-but-unknown cells get
+    # a cost DISCOUNT in the assignment auction (up to `stale_bonus`
+    # fractional, scaled by the stale fraction of the target's
+    # neighbourhood) — the fleet re-verifies what the world may have
+    # changed instead of merely re-opening it. False (default) is the
+    # pre-existing pipeline bit-exactly: no stale mask is computed and
+    # costs are untouched (parity-tested). The bridge publish path runs
+    # decay-aware scoring through the full-recompute pipeline (the
+    # incremental pipeline does not carry a stale mask).
+    decay_aware: bool = False
+    # Maximum fractional cost discount for a fully-stale target
+    # neighbourhood; the auction still ranks by distance within equally
+    # stale frontiers.
+    stale_bonus: float = 0.3
 
 
 @_frozen
@@ -517,6 +536,52 @@ class ResilienceConfig:
     # HTTP management plane: bounded lock wait before answering 503
     # degraded instead of blocking a worker thread indefinitely.
     http_lock_timeout_s: float = 2.0
+
+
+@_frozen
+class ColdStartConfig:
+    """Warm-restart tier: persistent compile cache + AOT executable
+    snapshots (io/compile_cache.py, resilience/warmup.py).
+
+    The cost ledger and recompile telemetry (obs/devprof.py) show every
+    process restart re-pays full XLA compilation, so the supervisor's
+    checkpoint-resume trades availability for a compile storm. These
+    knobs arm the warm-restart path: (1) JAX's persistent compilation
+    cache wired through launch (bounded on-disk size, LRU-evicted;
+    corrupt or incompatible entries degrade to recompile, never crash);
+    (2) AOT executable snapshots — compiled executables serialized per
+    (function, captured signature) under a compatibility FINGERPRINT
+    (jax/jaxlib version, backend, config hash) and served back to live
+    calls by a transparent warm-dispatch wrapper; on any mismatch the
+    ladder degrades snapshot -> persistent cache -> cold compile; and
+    (3) the staged supervisor warm-up (restore, pre-warm entry points
+    in priority order, readiness gate) that re-admits a restarted node
+    only once warmed, while serving answers from the prior epoch with
+    `state=warming`.
+
+    `enabled=False` constructs nothing — no cache config touched, no
+    wrapper on any dispatch path, bit-exact pre-PR behavior. Enabled is
+    bit-inert: a cache/snapshot hit returns the identical compiled
+    executable a cold compile would produce on the same fingerprint
+    (warm-vs-cold mission bit-identity is the bench gate).
+    """
+
+    enabled: bool = False
+    # Cache root directory. "" derives `<checkpoint_dir>/compile_cache`
+    # from the launch checkpoint dir; with neither set, the cold-start
+    # tier stays off (nowhere to persist).
+    cache_dir: str = ""
+    # On-disk budget over the whole cache root (XLA cache entries + AOT
+    # snapshots); least-recently-used files are evicted past it.
+    max_cache_bytes: int = 256 * 1024 * 1024
+    # Serialize AOT executable snapshots on `Stack.save_compile_
+    # snapshots()` and serve them from the warm pool. Off leaves the
+    # persistent cache as the only warm tier.
+    aot_snapshots: bool = True
+    # Run the staged warm-up at launch when snapshots for this
+    # fingerprint exist (the resume-process path); the supervisor
+    # restart path always stages regardless.
+    prewarm_on_launch: bool = True
 
 
 @_frozen
@@ -834,6 +899,7 @@ class SlamConfig:
     serving: ServingConfig = ServingConfig()
     decay: DecayConfig = DecayConfig()
     obs: ObsConfig = ObsConfig()
+    cold_start: ColdStartConfig = ColdStartConfig()
     # slam_toolbox's operating mode (slam_config.yaml:20: "mapping" —
     # the file's comment offers localization as the alternative).
     # "localization" freezes the map: key scans MATCH against it for
@@ -879,6 +945,7 @@ class SlamConfig:
             serving=ServingConfig(**raw.get("serving", {})),
             decay=DecayConfig(**raw.get("decay", {})),
             obs=ObsConfig(**obs_raw),
+            cold_start=ColdStartConfig(**raw.get("cold_start", {})),
             **{k: v for k, v in raw.items()
                if k in ("mode", "map_publish_period_s",
                         "tf_publish_period_s", "domain_id")},
@@ -960,8 +1027,15 @@ def configs_equivalent(json_a: Optional[str], json_b: Optional[str]) -> bool:
         # tracing on/off changes no state shape and no bit of the map
         # (the obs bit-inertness property test), so a checkpoint from a
         # traced run loads into an untraced stack and vice versa.
-        return a.replace(mode="mapping", obs=ObsConfig()) \
-            == b.replace(mode="mapping", obs=ObsConfig())
+        # `cold_start` is equally bit-inert infrastructure (a cache or
+        # snapshot hit returns the identical executable a cold compile
+        # would): a checkpoint saved by a warm-restart-armed stack must
+        # resume in a cold one and vice versa — the restart bench's
+        # cold/warm twins load the SAME checkpoint by construction.
+        return a.replace(mode="mapping", obs=ObsConfig(),
+                         cold_start=ColdStartConfig()) \
+            == b.replace(mode="mapping", obs=ObsConfig(),
+                         cold_start=ColdStartConfig())
     except (TypeError, ValueError, KeyError, AttributeError):
         # AttributeError: valid JSON that is not an object ('"x"', '[]')
         # reaches raw.get() — a corrupted config must refuse, not crash.

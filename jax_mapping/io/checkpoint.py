@@ -18,14 +18,43 @@ from __future__ import annotations
 import io
 import json
 import os
+import threading
 import zipfile
 import zlib
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
 _META_KEY = "__jax_mapping_meta__"
+
+#: Fallback-slot load counters (ISSUE 12 satellite): which generation
+#: `load_checkpoint_with_fallback` actually chose — today a silent
+#: `.prev` rescue is indistinguishable from a clean primary load. The
+#: HTTP plane renders these as
+#: `jax_mapping_checkpoint_fallback_total{slot=...}`; all three slots
+#: always report (an absent label and a zero counter mean different
+#: things to a rate() query).
+_FALLBACK_SLOTS = ("primary", "prev", "generation")
+_fallback_lock = threading.Lock()
+_fallback_counts: Dict[str, int] = {s: 0 for s in _FALLBACK_SLOTS}
+
+
+def fallback_slot(path: str, used_path: str) -> str:
+    """Which retention slot `used_path` is for checkpoint `path`:
+    primary, the rotated `.prev` last-good, or a numbered
+    `.genNNNNNN` generation."""
+    if used_path == path:
+        return "primary"
+    if used_path == previous_checkpoint_path(path):
+        return "prev"
+    return "generation"
+
+
+def fallback_counts() -> Dict[str, int]:
+    """Snapshot of the per-slot fallback-load counters."""
+    with _fallback_lock:
+        return dict(_fallback_counts)
 
 
 class CheckpointCorrupt(ValueError):
@@ -287,10 +316,22 @@ def load_checkpoint_with_fallback(path: str, like: Any
     for p in candidates:
         try:
             state, cfg_json = load_checkpoint(p, like)
-            return state, cfg_json, p
         except (CheckpointCorrupt, FileNotFoundError) as e:
             if first_err is None:
                 first_err = e
+            continue
+        # Which generation actually resumed (ISSUE 12 satellite): the
+        # flight-recorder event + per-slot counter make a silent .prev
+        # or .genNNNNNN rescue operator-visible — a fallback load means
+        # a newer generation rotted, which a postmortem must know.
+        slot = fallback_slot(path, p)
+        with _fallback_lock:
+            _fallback_counts[slot] += 1
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("checkpoint_fallback", slot=slot,
+                               name=os.path.basename(p),
+                               fell_back=slot != "primary")
+        return state, cfg_json, p
     raise first_err
 
 

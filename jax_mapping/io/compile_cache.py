@@ -1,0 +1,831 @@
+"""Warm-restart compile tier: persistent XLA cache + AOT snapshots.
+
+The recompile telemetry (obs/devprof.py) and the XLA cost ledger show
+that every PROCESS restart re-pays full compilation: a supervisor
+checkpoint-resume lands a mapper that spends its first minutes
+compiling, not mapping — availability traded for a compile storm. This
+module is the storage tier of the warm-restart path (the staged
+warm-up state machine lives in resilience/warmup.py):
+
+* **Persistent compilation cache** — JAX's on-disk cache wired through
+  `launch_sim_stack` (`CompileCacheManager.enable()`), with a BOUNDED
+  on-disk budget enforced by least-recently-used eviction
+  (`evict_lru`). Corrupt or incompatible entries are XLA's problem to
+  detect; ours is to never crash on them: enable failures degrade to
+  plain recompile with a flight-recorder event, and zero-byte husks
+  (a crash mid-write) are scrubbed before enabling.
+
+* **AOT executable snapshots** — one serialized `jax.export` program
+  per (function, captured signature): the same jitted-entry-point
+  registry `analysis/compilebudget.py` and `obs/devprof.py` walk
+  supplies the functions (`_ProfiledJit` forwards `lower`, so profiled
+  stacks AOT-lower transparently), and the dispatch profiler's
+  captured abstract signatures supply the shapes. The exported
+  StableHLO program IS the traced-and-lowered computation, so a resume
+  process deserializes it instead of RE-TRACING (the dominant warm
+  cost: slam_step's trace+lower alone runs seconds), and its compiled
+  binary lands in — and is later served from — the persistent cache,
+  which together snapshot the executable portably across processes on
+  every backend (raw `serialize_executable` payloads do not
+  deserialize cross-process on XLA:CPU at all). Custom pytree nodes
+  (SlamState, PoseGraph, ...) are registered for export serialization
+  on demand and recorded in the snapshot so the loader can re-register
+  them. Snapshots live under a compatibility FINGERPRINT directory —
+  blake2b over (jax version, jaxlib version, backend platform,
+  normalized config JSON) — so a snapshot can never be served into an
+  incompatible process: a fingerprint mismatch is counted and DEGRADES
+  to the persistent cache, then to cold compile, never crashes.
+
+* **Warm dispatch pool** — `_WarmJit`, the devprof-wrapper idiom: a
+  transparent pass-through installed over the module aliases of each
+  snapshotted entry point that serves calls whose abstract signature
+  matches a loaded snapshot DIRECTLY through the deserialized
+  program's `call` (the identical lowered computation the jit path
+  would run — bit-identity is pinned by tests and the restart bench's
+  cold/warm grid hashes) and falls through to the wrapped function on
+  any miss or error, dropping the offending entry. A warm-served call
+  never grows the jit cache, so `jax_mapping_jit_recompiles_total`
+  stays honest for AOT-loaded variants by construction.
+
+Thread contract: counters and the wipe refcount mutate only under
+`_lock` (declared in analysis/protection.py); file I/O and jax calls
+run OUTSIDE it — the leaf-lock discipline. The `cache_wipe` FaultPlan
+kind drives `wipe_hold`/`wipe_release`: windows compose by refcount
+(the first window's clear must not re-enable a cache another still
+holds wiped), and a wipe mid-mission leaves the stack on the plain
+recompile path — degraded, never broken.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from jax_mapping.config import ColdStartConfig
+
+#: Snapshot file format version; bump on layout change (old files then
+#: count as incompatible and degrade, never crash).
+_SNAPSHOT_VERSION = 1
+
+#: Process-global warm-pool install guard (the devprof pattern):
+#: module-attribute rebinding is process-wide, one pool at a time.
+_INSTALL_LOCK = threading.Lock()
+_installed_pool: Optional["WarmPool"] = None
+
+
+def cache_fingerprint(config_json: Optional[str] = None) -> str:
+    """Compatibility fingerprint for AOT snapshots: jax + jaxlib
+    versions, backend platform, and the (normalized) config JSON — a
+    serialized executable is only valid against the exact compiler,
+    runtime and static-argument surface that produced it. Infra-only
+    sections (obs, cold_start — both bit-inert) are normalized out so
+    flipping telemetry does not orphan a snapshot set."""
+    import jax
+    import jaxlib
+    cfg_part = ""
+    if config_json is not None:
+        from jax_mapping.config import (ColdStartConfig as _CS,
+                                        ObsConfig, SlamConfig)
+        try:
+            cfg = SlamConfig.from_json(config_json)
+            cfg_part = cfg.replace(obs=ObsConfig(),
+                                   cold_start=_CS()).to_json()
+        except (TypeError, ValueError, KeyError):
+            cfg_part = config_json
+    h = hashlib.blake2b(digest_size=8)
+    for part in (jax.__version__, jaxlib.__version__,
+                 jax.default_backend(), cfg_part):
+        h.update(part.encode())
+        h.update(b"\0")
+    return h.hexdigest()
+
+
+#: Process-global export-serialization registry guard: jax registers
+#: custom pytree serialization once per process; double registration
+#: under a different name is an error, so all paths funnel here.
+_EXPORT_REG_LOCK = threading.Lock()
+_export_registered: set = set()
+
+
+def _register_export_type(qualname: str) -> None:
+    """Register one custom pytree class (by `module.Class` qualname)
+    for jax.export serialization, idempotently."""
+    with _EXPORT_REG_LOCK:
+        if qualname in _export_registered:
+            return
+    import importlib
+
+    from jax import export as jexp
+    modname, clsname = qualname.rsplit(".", 1)
+    cls = getattr(importlib.import_module(modname), clsname)
+    try:
+        jexp.register_namedtuple_serialization(cls,
+                                               serialized_name=qualname)
+    except ValueError:
+        # Already registered (an earlier load, another manager): jax
+        # keeps one process-global registry; ours just mirrors it.
+        pass
+    with _EXPORT_REG_LOCK:
+        _export_registered.add(qualname)
+
+
+def _serialize_with_registrations(exported) -> Tuple[bytes, list]:
+    """`exported.serialize()` with on-demand registration of the custom
+    pytree nodes it trips over (SlamState, PoseGraph, ...). Returns
+    (blob, qualnames) where qualnames is every registration this
+    process has made — a SUPERSET of what this blob needs, recorded in
+    the snapshot so the loading process can re-register before
+    deserializing."""
+    import re
+    for _ in range(32):
+        try:
+            blob = exported.serialize()
+            break
+        except ValueError as e:
+            m = re.search(r"unregistered type `<class '([\w\.]+)'>`",
+                          str(e))
+            if m is None:
+                raise
+            _register_export_type(m.group(1))
+    else:
+        raise RuntimeError(
+            "export serialization registration did not converge")
+    with _EXPORT_REG_LOCK:
+        regs = sorted(_export_registered)
+    return blob, regs
+
+
+def _has_array_leaf(x: Any) -> bool:
+    """Whether an abstracted argument contains any ShapeDtypeStruct-like
+    leaf — the static-vs-dynamic heuristic for calling a `Compiled`
+    (which takes only the dynamic arguments). Misclassification is
+    caught empirically at snapshot time (`_call_mode`)."""
+    import jax
+    found = []
+
+    def look(leaf):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            found.append(True)
+        return leaf
+
+    jax.tree_util.tree_map(look, x)
+    return bool(found)
+
+
+def materialize_zeros(sig: tuple) -> Tuple[tuple, dict]:
+    """(args, kwargs) with every abstract array leaf replaced by a
+    concrete zeros array — the pre-warm input: calling an entry point
+    with these drives exactly the compile (or cache hit) the captured
+    live signature would."""
+    import jax
+    import jax.numpy as jnp
+
+    def concretize(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jnp.zeros(tuple(x.shape), x.dtype)
+        return x
+
+    args, kwargs = jax.tree_util.tree_map(concretize, sig)
+    return args, kwargs
+
+
+class _WarmJit:
+    """Transparent warm-dispatch wrapper for one snapshotted entry
+    point: calls whose abstract signature matches a loaded AOT
+    executable are served from it (the identical compiled binary the
+    jit path would run); everything else falls through to the wrapped
+    function. Forwards `_cache_size`/`lower`/`__name__` so registry
+    walks, compile budgets, profilers and AOT lowering see through it
+    (the `_ProfiledJit` contract)."""
+
+    __slots__ = ("_fn", "_pool", "_name")
+
+    def __init__(self, fn, pool: "WarmPool", name: str):
+        self._fn = fn
+        self._pool = pool
+        self._name = name
+
+    def __call__(self, *args, **kwargs):
+        entry = self._pool.lookup(self._name, args, kwargs)
+        if entry is not None:
+            compiled, mode, dyn_idx, dyn_kw, key = entry
+            try:
+                if mode == "dyn":
+                    return compiled(
+                        *[args[i] for i in dyn_idx if i < len(args)],
+                        **{k: kwargs[k] for k in dyn_kw if k in kwargs})
+                return compiled(*args, **kwargs)
+            except Exception:                       # noqa: BLE001
+                # The ladder's bottom rung: a warm executable that will
+                # not take this call (aval/sharding drift) is dropped
+                # and the call recompiles through the ordinary path.
+                self._pool.drop(self._name, key)
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "_fn"), item)
+
+    # The PR 10 gotcha: `__module__`/`__doc__` land in the class dict
+    # at class creation, so instance lookup never reaches __getattr__ —
+    # forward them explicitly or compilebudget's owner-qualified names
+    # corrupt while the pool is installed.
+    @property
+    def __module__(self):
+        return getattr(self._fn, "__module__", None)
+
+    @property
+    def __doc__(self):
+        return getattr(self._fn, "__doc__", None)
+
+    def __repr__(self) -> str:
+        return f"<warm {self._name}>"
+
+
+class WarmPool:
+    """Loaded AOT executables keyed (function name, signature key),
+    plus the module-rebinding install/uninstall that puts `_WarmJit`
+    wrappers over exactly the snapshotted entry points."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: {fn_name: {sig_key: (compiled, mode, dyn_idx, dyn_kw)}}
+        self._entries: Dict[str, Dict[str, tuple]] = {}
+        self.n_served = 0
+        self.n_fallthrough = 0
+        self.n_dropped = 0
+        self._bindings: List[Tuple[_WarmJit, list]] = []
+        self.installed = False
+
+    def add(self, fn_name: str, sig_key: str, compiled, mode: str,
+            dyn_idx: tuple, dyn_kw: tuple) -> None:
+        with self._lock:
+            self._entries.setdefault(fn_name, {})[sig_key] = \
+                (compiled, mode, dyn_idx, dyn_kw)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def keys_for(self, fn_name: str) -> set:
+        with self._lock:
+            return set(self._entries.get(fn_name, ()))
+
+    def entry(self, fn_name: str, sig_key: str):
+        """(compiled, mode, dyn_idx, dyn_kw) by exact key, or None —
+        the staged warm-up executes each pooled entry once on zeros so
+        its compile cost (a cache hit, normally) is paid during the
+        warm-up, never by the first live call."""
+        with self._lock:
+            return self._entries.get(fn_name, {}).get(sig_key)
+
+    def n_entries(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._entries.values())
+
+    def lookup(self, fn_name: str, args: tuple, kwargs: dict):
+        """The per-call match: abstract the live arguments exactly the
+        way devprof captured them and look the key up. Returns
+        (compiled, mode, dyn_idx, dyn_kw, key) or None."""
+        with self._lock:
+            if not self._entries.get(fn_name):
+                return None
+        from jax_mapping.obs.devprof import abstract_signature
+        try:
+            key = repr(abstract_signature(args, kwargs))
+        except Exception:                           # noqa: BLE001
+            return None
+        with self._lock:
+            # Re-resolve through self._entries (NOT a dict captured in
+            # the first section): a cache_wipe's clear() swaps the
+            # table while the key is computed, and serving from the
+            # orphaned dict would misreport the wipe as survivable
+            # warm state.
+            ent = self._entries.get(fn_name, {}).get(key)
+            if ent is None:
+                self.n_fallthrough += 1
+                return None
+            self.n_served += 1
+            return ent + (key,)
+
+    def drop(self, fn_name: str, sig_key: str) -> None:
+        with self._lock:
+            self._entries.get(fn_name, {}).pop(sig_key, None)
+            self.n_dropped += 1
+
+    def clear(self) -> None:
+        """Drop every entry (cache_wipe); installed wrappers stay and
+        simply fall through from now on."""
+        with self._lock:
+            self._entries = {}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"n_entries": sum(len(v)
+                                     for v in self._entries.values()),
+                    "n_served": self.n_served,
+                    "n_fallthrough": self.n_fallthrough,
+                    "n_dropped": self.n_dropped,
+                    "installed": self.installed}
+
+    # -- module rebinding (the devprof install idiom) -----------------------
+
+    def install(self, prefix: str = "jax_mapping") -> int:
+        """Wrap every importable alias of each pooled entry point;
+        returns how many functions were wrapped. Installs OVER a
+        profiler wrapper transparently (the profiler then times warm
+        dispatches too); a second live pool is refused."""
+        global _installed_pool
+        with _INSTALL_LOCK:
+            if _installed_pool is not None and _installed_pool is not self:
+                raise RuntimeError(
+                    "another WarmPool is installed — uninstall it first "
+                    "(wrappers are process-global)")
+            with self._lock:
+                wanted = {n for n, sigs in self._entries.items() if sigs}
+            targets: Dict[int, Tuple[object, list]] = {}
+            for mod_name in sorted(sys.modules):
+                mod = sys.modules[mod_name]
+                if mod is None or not mod_name.startswith(prefix):
+                    continue
+                for attr in sorted(vars(mod)):
+                    fn = vars(mod)[attr]
+                    if isinstance(fn, _WarmJit):
+                        continue
+                    cache_size = getattr(fn, "_cache_size", None)
+                    if not callable(cache_size) or not callable(fn):
+                        continue
+                    name = qualified_name(fn, mod_name, attr, prefix)
+                    if name not in wanted:
+                        continue
+                    ent = targets.setdefault(id(fn), (fn, []))
+                    ent[1].append((mod, attr, name))
+            for fn, sites in targets.values():
+                wrapper = _WarmJit(fn, self, sites[0][2])
+                for mod, attr, _ in sites:
+                    setattr(mod, attr, wrapper)
+                self._bindings.append((wrapper,
+                                       [(m, a) for m, a, _ in sites]))
+            _installed_pool = self
+            with self._lock:
+                self.installed = True
+            return len(targets)
+
+    def uninstall(self) -> None:
+        """Remove our wrappers from every site, UNWRAPPING from inside
+        wrapper chains: a profiler installed after us holds the site as
+        `_ProfiledJit(_WarmJit(fn))` (and vice versa after a staged
+        restart), and a direct-match-only restore would either strand
+        our wrapper inside the chain or restore nothing — the shutdown
+        leak that leaves a dead wrapper bound at module scope.
+        Idempotent."""
+        global _installed_pool
+        with _INSTALL_LOCK:
+            for wrapper, sites in self._bindings:
+                for mod, attr in sites:
+                    cur = vars(mod).get(attr)
+                    if cur is wrapper:
+                        setattr(mod, attr, wrapper._fn)
+                        continue
+                    node = cur
+                    while hasattr(node, "_fn"):
+                        if node._fn is wrapper:
+                            # Splice ourselves out of the chain; _fn is
+                            # a __slots__ attribute on every wrapper
+                            # class in this repo.
+                            object.__setattr__(node, "_fn", wrapper._fn)
+                            break
+                        node = node._fn
+            self._bindings = []
+            if _installed_pool is self:
+                _installed_pool = None
+            with self._lock:
+                self.installed = False
+
+
+def qualified_name(fn, mod_name: str, attr: str, prefix: str) -> str:
+    """The compilebudget naming contract (defining module + name,
+    stable across from-import aliases) — ONE definition shared with the
+    snapshot filenames so a pool entry always matches its registry
+    walk."""
+    owner = getattr(fn, "__module__", mod_name) or mod_name
+    name = getattr(fn, "__name__", attr) or attr
+    if not owner.startswith(prefix):
+        owner = mod_name
+    return f"{owner}.{name}"
+
+
+def resolve_entry_point(name: str, prefix: str = "jax_mapping"):
+    """The RAW jitted function for a registry-qualified name, unwrapping
+    any profiler/warm wrappers (`._fn` chains) — pre-warm calls and AOT
+    lowering must reach the underlying jit, not count as profiled
+    dispatches."""
+    for mod_name in sorted(sys.modules):
+        mod = sys.modules[mod_name]
+        if mod is None or not mod_name.startswith(prefix):
+            continue
+        for attr in sorted(vars(mod)):
+            fn = vars(mod)[attr]
+            if not callable(getattr(fn, "_cache_size", None)):
+                continue
+            if qualified_name(fn, mod_name, attr, prefix) == name:
+                while hasattr(fn, "_fn"):
+                    fn = fn._fn
+                return fn
+    return None
+
+
+class CompileCacheManager:
+    """One stack's handle on the warm-restart storage tier."""
+
+    def __init__(self, cfg: ColdStartConfig, root: str,
+                 config_json: Optional[str] = None):
+        self.cfg = cfg
+        self.root = root
+        self.config_json = config_json
+        self._lock = threading.Lock()
+        self._wipe_refs = 0
+        self._counts: Dict[str, int] = {}
+        self.enabled = False
+        self.fingerprint: Optional[str] = None
+        self.pool = WarmPool()
+
+    # -- paths ----------------------------------------------------------------
+
+    @property
+    def xla_dir(self) -> str:
+        return os.path.join(self.root, "xla")
+
+    def aot_dir(self, fingerprint: Optional[str] = None) -> str:
+        fp = fingerprint or self.fingerprint or "unknown"
+        return os.path.join(self.root, "aot", fp)
+
+    def _count(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + by
+
+    # -- the persistent compilation cache ------------------------------------
+
+    def enable(self) -> bool:
+        """Point JAX's persistent compilation cache at our XLA dir
+        (min-compile-time and min-entry-size floors dropped so the
+        tiny-config scenario's entries persist too). Failures — an old
+        jax without the flags, an unwritable volume — degrade to plain
+        recompile with a flight-recorder event; never raise."""
+        with self._lock:
+            wiped = self._wipe_refs > 0
+        if wiped:
+            return False
+        try:
+            self.fingerprint = cache_fingerprint(self.config_json)
+            os.makedirs(self.xla_dir, exist_ok=True)
+            self._scrub_husks(self.xla_dir)
+            import jax
+            jax.config.update("jax_compilation_cache_dir", self.xla_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception as e:                      # noqa: BLE001
+            self._count("enable_failed")
+            from jax_mapping.obs.recorder import flight_recorder
+            flight_recorder.record("compile_cache_degraded",
+                                   stage="enable", error=type(e).__name__)
+            self.enabled = False
+            return False
+        self.enabled = True
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("compile_cache_enabled",
+                               fingerprint=self.fingerprint)
+        return True
+
+    def disable(self) -> None:
+        """Detach the process-global cache dir (Stack.shutdown: the next
+        stack owns the config)."""
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:                           # noqa: BLE001
+            pass
+        self.enabled = False
+
+    def _scrub_husks(self, d: str) -> int:
+        """Delete zero-byte cache files (a crash mid-write leaves them;
+        XLA treats a truncated entry as an error worth warning about on
+        every hit) — the cheap structural scrub; content corruption is
+        caught per-entry at load/deserialize time and degrades."""
+        n = 0
+        for base, _dirs, files in os.walk(d):
+            for f in files:
+                p = os.path.join(base, f)
+                try:
+                    if os.path.getsize(p) == 0:
+                        os.unlink(p)
+                        n += 1
+                except OSError:
+                    continue
+        if n:
+            self._count("husks_scrubbed", n)
+            from jax_mapping.obs.recorder import flight_recorder
+            flight_recorder.record("compile_cache_scrub", n=n)
+        return n
+
+    def evict_lru(self) -> Tuple[int, int]:
+        """Enforce `max_cache_bytes` over the cache root: files beyond
+        the budget go oldest-mtime-first. Returns (n_evicted,
+        bytes_freed); errors skip the file (a racing evictor or a
+        permissions oddity must not crash a restart path)."""
+        budget = self.cfg.max_cache_bytes
+        entries = []
+        total = 0
+        for base, _dirs, files in os.walk(self.root):
+            for f in files:
+                p = os.path.join(base, f)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, st.st_size, p))
+                total += st.st_size
+        if total <= budget:
+            return 0, 0
+        entries.sort()
+        n = freed = 0
+        for _mt, size, p in entries:
+            if total - freed <= budget:
+                break
+            try:
+                os.unlink(p)
+            except OSError:
+                continue
+            n += 1
+            freed += size
+        if n:
+            self._count("lru_evicted", n)
+            from jax_mapping.obs.recorder import flight_recorder
+            flight_recorder.record("compile_cache_evict", n=n,
+                                   bytes=freed)
+        return n, freed
+
+    # -- AOT snapshots --------------------------------------------------------
+
+    def save_aot(self, signatures: Dict[str, List[tuple]],
+                 resolve: Optional[Callable[[str], Any]] = None) -> dict:
+        """Serialize one compiled executable per (function, captured
+        signature) into the fingerprint directory. `signatures` is the
+        dispatch profiler's capture (`DispatchProfiler.signatures()`);
+        `resolve` maps a qualified name to its callable (default: the
+        registry walk). Per-entry failures are counted and skipped —
+        a snapshot pass degrades, it never takes the mission down."""
+        report = {"n_saved": 0, "n_failed": 0, "n_uncallable": 0,
+                  "names": []}
+        if not self.cfg.aot_snapshots:
+            return report
+        with self._lock:
+            wiped = self._wipe_refs > 0
+        if wiped:
+            return report
+        try:
+            from jax import export as _jexp                 # noqa: F401
+        except Exception:                           # noqa: BLE001
+            self._count("aot_unavailable")
+            return report
+        if self.fingerprint is None:
+            self.fingerprint = cache_fingerprint(self.config_json)
+        d = self.aot_dir()
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError:
+            report["n_failed"] += 1
+            return report
+        for fn_name in sorted(signatures):
+            fn = (resolve(fn_name) if resolve is not None
+                  else resolve_entry_point(fn_name))
+            if fn is None or not hasattr(fn, "lower"):
+                report["n_failed"] += len(signatures[fn_name])
+                continue
+            for i, sig in enumerate(signatures[fn_name]):
+                try:
+                    entry = self._build_snapshot(fn, fn_name, sig)
+                except Exception:                   # noqa: BLE001
+                    report["n_failed"] += 1
+                    self._count("aot_save_failed")
+                    continue
+                if entry is None:
+                    report["n_uncallable"] += 1
+                    continue
+                safe = fn_name.replace("/", "_")
+                path = os.path.join(d, f"{safe}__{i:02d}.aot")
+                tmp = path + ".tmp"
+                try:
+                    with open(tmp, "wb") as f:
+                        f.write(pickle.dumps(entry))
+                    os.replace(tmp, path)
+                except (OSError, pickle.PicklingError):
+                    report["n_failed"] += 1
+                    self._count("aot_save_failed")
+                    continue
+                report["n_saved"] += 1
+                report["names"].append(fn_name)
+        self._count("aot_saved", report["n_saved"])
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("aot_snapshot_save",
+                               n=report["n_saved"],
+                               n_failed=report["n_failed"])
+        self.evict_lru()
+        return report
+
+    def _build_snapshot(self, fn, fn_name: str, sig: tuple):
+        """One snapshot dict, or None when the exported program's
+        calling convention could not be established (neither
+        dynamic-only nor full-argument calls work — skip rather than
+        snapshot something the warm path can never serve). The
+        validation call doubles as cache population: the exported
+        program's compiled binary lands in the persistent cache NOW, so
+        a resume process's first warm-served call is a cache hit."""
+        from jax import export as jexp
+        from jax_mapping.obs.devprof import abstract_signature
+        args, kwargs = sig
+        exported = jexp.export(fn)(*args, **kwargs)
+        blob, regs = _serialize_with_registrations(exported)
+        zargs, zkwargs = materialize_zeros(sig)
+        dyn_idx = tuple(i for i, a in enumerate(args)
+                        if _has_array_leaf(a))
+        dyn_kw = tuple(k for k, v in sorted(kwargs.items())
+                       if _has_array_leaf(v))
+        mode = None
+        try:
+            exported.call(*[zargs[i] for i in dyn_idx],
+                          **{k: zkwargs[k] for k in dyn_kw})
+            mode = "dyn"
+        except Exception:                           # noqa: BLE001
+            try:
+                exported.call(*zargs, **zkwargs)
+                mode = "full"
+            except Exception:                       # noqa: BLE001
+                return None
+        return {
+            "version": _SNAPSHOT_VERSION,
+            "fn": fn_name,
+            "sig_key": repr(abstract_signature(args, kwargs)),
+            "sig": sig,
+            "blob": bytes(blob),
+            "regs": regs,
+            "mode": mode,
+            "dyn_idx": dyn_idx,
+            "dyn_kw": dyn_kw,
+        }
+
+    def load_aot(self) -> dict:
+        """Walk the fingerprint directory, deserialize every intact
+        snapshot into the warm pool, and return the prewarm manifest:
+        {"pool_names": [...], "signatures": {fn: [sig, ...]},
+        counters...}. Every failure mode DEGRADES: an unpicklable or
+        wrong-version file counts corrupt; an executable the backend
+        will not deserialize (XLA:CPU cross-process) degrades to its
+        captured signature so the warm-up can pre-warm through the
+        persistent cache; other fingerprints present are counted as
+        mismatches and never read."""
+        report = {"n_loaded": 0, "n_corrupt": 0, "n_degraded": 0,
+                  "n_fingerprint_mismatch": 0,
+                  "signatures": {}, "pool_names": []}
+        if not self.cfg.aot_snapshots:
+            return report
+        if self.fingerprint is None:
+            self.fingerprint = cache_fingerprint(self.config_json)
+        aot_root = os.path.join(self.root, "aot")
+        try:
+            siblings = sorted(os.listdir(aot_root))
+        except OSError:
+            siblings = []
+        for fp in siblings:
+            if fp != self.fingerprint and \
+                    os.path.isdir(os.path.join(aot_root, fp)):
+                report["n_fingerprint_mismatch"] += 1
+        d = self.aot_dir()
+        try:
+            files = sorted(f for f in os.listdir(d)
+                           if f.endswith(".aot"))
+        except OSError:
+            files = []
+        jexp = None
+        try:
+            from jax import export as jexp
+        except Exception:                           # noqa: BLE001
+            pass
+        for f in files:
+            path = os.path.join(d, f)
+            try:
+                with open(path, "rb") as fh:
+                    entry = pickle.loads(fh.read())
+                if not isinstance(entry, dict) or \
+                        entry.get("version") != _SNAPSHOT_VERSION:
+                    raise ValueError("bad snapshot layout")
+                fn_name = entry["fn"]
+                sig = entry["sig"]
+            except Exception:                       # noqa: BLE001
+                report["n_corrupt"] += 1
+                continue
+            try:
+                # LRU recency: snapshots are written once and READ on
+                # every warm restart — without a touch, eviction would
+                # reap the hottest tier first (XLA entries written
+                # later always look fresher by mtime).
+                os.utime(path)
+            except OSError:
+                pass
+            report["signatures"].setdefault(fn_name, []).append(sig)
+            if jexp is None:
+                report["n_degraded"] += 1
+                continue
+            try:
+                for qual in entry.get("regs", ()):
+                    _register_export_type(qual)
+                exported = jexp.deserialize(bytearray(entry["blob"]))
+                compiled = exported.call
+            except Exception:                       # noqa: BLE001
+                # Any drift the fingerprint missed (an incompatible
+                # export version, a vanished pytree class): the
+                # signature still pre-warms through the persistent
+                # cache — the ladder's next rung.
+                report["n_degraded"] += 1
+                continue
+            self.pool.add(fn_name, entry["sig_key"], compiled,
+                          entry["mode"], tuple(entry["dyn_idx"]),
+                          tuple(entry["dyn_kw"]))
+            report["n_loaded"] += 1
+            if fn_name not in report["pool_names"]:
+                report["pool_names"].append(fn_name)
+        with self._lock:
+            for k in ("n_loaded", "n_corrupt", "n_degraded",
+                      "n_fingerprint_mismatch"):
+                self._counts["aot_" + k] = \
+                    self._counts.get("aot_" + k, 0) + report[k]
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record(
+            "aot_snapshot_load", n=report["n_loaded"],
+            n_corrupt=report["n_corrupt"],
+            n_degraded=report["n_degraded"],
+            n_fingerprint_mismatch=report["n_fingerprint_mismatch"])
+        return report
+
+    # -- cache_wipe fault boundary -------------------------------------------
+
+    def wipe_hold(self) -> None:
+        """One `cache_wipe` window opens: delete everything under the
+        cache root and suppress cache writes while ANY window holds
+        (refcounted — the FaultPlan composition doctrine). The stack
+        keeps running on plain recompile."""
+        with self._lock:
+            self._wipe_refs += 1
+            self._counts["wipes"] = self._counts.get("wipes", 0) + 1
+        self.disable()
+        n = 0
+        for base, _dirs, files in os.walk(self.root, topdown=False):
+            for f in files:
+                try:
+                    os.unlink(os.path.join(base, f))
+                    n += 1
+                except OSError:
+                    continue
+        # Loaded warm entries are dropped too: their files are gone,
+        # and serving a wiped executable would misreport the wipe as
+        # survivable warm state.
+        self.pool.clear()
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("cache_wipe", n_files=n)
+
+    def wipe_release(self) -> None:
+        """One window clears; the LAST one out re-enables the (now
+        empty) cache so subsequent compiles repopulate it."""
+        with self._lock:
+            self._wipe_refs = max(0, self._wipe_refs - 1)
+            refs = self._wipe_refs
+        if refs == 0:
+            self.enable()
+
+    # -- export ---------------------------------------------------------------
+
+    def disk_usage_bytes(self) -> int:
+        total = 0
+        for base, _dirs, files in os.walk(self.root):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(base, f))
+                except OSError:
+                    continue
+        return total
+
+    def status(self) -> dict:
+        """The /status `cold_start` export (+ test assertion surface)."""
+        with self._lock:
+            counts = dict(self._counts)
+            refs = self._wipe_refs
+        return {"enabled": self.enabled,
+                "fingerprint": self.fingerprint,
+                "wipe_refs": refs,
+                "counts": counts,
+                "pool": self.pool.stats()}

@@ -238,13 +238,44 @@ class DispatchProfiler:
     @staticmethod
     def _qualify(fn, sites, prefix: str) -> str:
         """The compilebudget naming contract: defining module + name,
-        stable across from-import aliases."""
-        mod_name = sites[0][0].__name__
-        owner = getattr(fn, "__module__", mod_name) or mod_name
-        name = getattr(fn, "__name__", sites[0][1]) or sites[0][1]
-        if not owner.startswith(prefix):
-            owner = mod_name
-        return f"{owner}.{name}"
+        stable across from-import aliases. ONE definition shared with
+        the warm-pool/snapshot naming (io/compile_cache.qualified_name)
+        — exact agreement is load-bearing: AOT snapshots are saved
+        under the profiler's names and matched by the pool's walk, and
+        a drift between two hand-copies would silently make every
+        snapshot unmatchable (lazy import: obs stays jax-free and
+        io-free at module import)."""
+        from jax_mapping.io.compile_cache import qualified_name
+        return qualified_name(fn, sites[0][0].__name__, sites[0][1],
+                              prefix)
+
+    def rebaseline(self, names=None) -> int:
+        """Adopt each wrapped function's CURRENT compiled-variant count
+        as the recompile baseline without counting the delta — the
+        warm-restart contract (ISSUE 12): variants brought in by the
+        staged warm-up through the persistent compile cache (or served
+        by AOT snapshots, which never grow the jit cache at all) are
+        cold-start repayment, not live recompiles, and
+        `jax_mapping_jit_recompiles_total` must stay zero across a warm
+        restart exactly as it does across install. Returns how many
+        functions moved their baseline. `names` limits the sweep."""
+        with _INSTALL_LOCK:
+            bindings = list(self._bindings)
+        moved = 0
+        for wrapper, _sites in bindings:
+            if names is not None and wrapper._name not in names:
+                continue
+            try:
+                cache = int(wrapper._fn._cache_size())
+            except Exception:                       # noqa: BLE001
+                continue
+            with self._lock:
+                st = self._profiles.setdefault(wrapper._name,
+                                               _FnProfile(wrapper._name))
+                if cache > st.cache_size:
+                    st.cache_size = cache
+                    moved += 1
+        return moved
 
     def uninstall(self) -> None:
         """Restore the original functions at every site that still

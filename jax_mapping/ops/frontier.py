@@ -117,6 +117,35 @@ def frontier_mask(free: Array, unknown: Array) -> Array:
     return free & near_unknown
 
 
+#: Evidence floor below which a cell counts as genuinely unobserved for
+#: decay-aware scoring: log-odds decay (ops/grid.decay_grid) shrinks
+#: values multiplicatively toward 0 but never reaches it, so any
+#: |log-odds| above this on an unknown-classified cell means "was
+#: observed, evidence faded" — a healed/stale region.
+_STALE_EPS = 1e-4
+
+
+def stale_mask(cfg: FrontierConfig, grid_cfg: GridConfig,
+               logodds: Array) -> Array:
+    """Coarse (n, n) bool mask of HEALED/STALE cells: classified
+    unknown by `coarsen` (evidence below both thresholds) yet carrying
+    residual non-zero log-odds — exactly what map decay leaves behind
+    in regions the world may have changed. Fresh unknown space (never
+    observed, exact 0.0 everywhere) never flags, so the decay-aware
+    discount cannot perturb plain exploration."""
+    d = cfg.downsample
+    _check_pool_divisible(logodds, d)
+    mx = jax.lax.reduce_window(logodds, -jnp.inf, jax.lax.max,
+                               (d, d), (d, d), "VALID")
+    mn = jax.lax.reduce_window(logodds, jnp.inf, jax.lax.min,
+                               (d, d), (d, d), "VALID")
+    amax = jax.lax.reduce_window(jnp.abs(logodds), -jnp.inf, jax.lax.max,
+                                 (d, d), (d, d), "VALID")
+    unknown = ~(mx > grid_cfg.occ_threshold) \
+        & ~(mn < grid_cfg.free_threshold)
+    return unknown & (amax > _STALE_EPS)
+
+
 # ---------------------------------------------------------------------------
 # Connected-component clustering by label propagation
 # ---------------------------------------------------------------------------
@@ -493,10 +522,17 @@ def assign_frontiers(costs: Array) -> Array:
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def compute_frontiers(cfg: FrontierConfig, grid_cfg: GridConfig,
                       logodds: Array, robot_poses: Array) -> FrontierResult:
-    """logodds (N,N) + robot poses (R,3) -> frontiers, clusters, assignment."""
+    """logodds (N,N) + robot poses (R,3) -> frontiers, clusters, assignment.
+
+    With `cfg.decay_aware` the stale mask is derived here from the raw
+    log-odds (the masks alone cannot tell healed from fresh unknown)
+    and threaded into the assignment's cost discount; off (default)
+    compiles the identical pre-existing graph."""
     free, _occ, unknown = coarsen(cfg, grid_cfg, logodds)
+    stale = (stale_mask(cfg, grid_cfg, logodds)
+             if cfg.decay_aware else None)
     return compute_frontiers_from_masks(cfg, grid_cfg, free, unknown,
-                                        robot_poses)
+                                        robot_poses, stale=stale)
 
 
 #: 3x3 neighbourhood offsets (row-major) for greedy field descent —
@@ -548,7 +584,8 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
                                  robot_poses: Array, origin_rc=None,
                                  warm_fields=None,
                                  warm_iters: int | None = None,
-                                 return_fields: bool = False):
+                                 return_fields: bool = False,
+                                 stale=None):
     """Mask-level entry point: lets a spatially-sharded caller coarsen its
     own grid slab locally and all_gather only the coarse masks.
 
@@ -570,7 +607,13 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
     return_fields: also return the (R, n_bfs, n_bfs) cost fields (None
     in euclidean/exact modes) and the BFS blocked mask, for the next
     publish's warm start and its validity check.
-    All three default to the historical single-result behavior with a
+    stale: optional (n, n) bool HEALED/STALE mask at first-level coarse
+    resolution (`stale_mask`); with `cfg.decay_aware` on, each slot's
+    cost is discounted by `stale_bonus` × the stale fraction of the
+    target's 3×3 clustering-cell neighbourhood — healed regions win
+    cost ties and are re-verified first. None (or the knob off) skips
+    the discount entirely.
+    All defaults reproduce the historical single-result behavior with a
     bit-identical trace."""
     mask = frontier_mask(free, unknown)
     c = cfg.cluster_downsample
@@ -647,6 +690,26 @@ def compute_frontiers_from_masks(cfg: FrontierConfig, grid_cfg: GridConfig,
         costs = jnp.linalg.norm(diff, axis=-1) / res
         costs = jnp.where(jnp.isfinite(costs), costs, _BIG)
         costs = jnp.minimum(costs, _BIG)
+    if cfg.decay_aware and stale is not None:
+        # Decay-aware re-verification priority: discount each slot's
+        # cost by the stale fraction around its target. Multiplicative
+        # (not subtractive) so the discount can never push a reachable
+        # cost negative or promote an unreachable (_BIG) slot past the
+        # validity masking below.
+        sb = (_pool_sum(stale, c).astype(jnp.float32) / float(c * c)
+              if c > 1 else stale.astype(jnp.float32))
+        padded_sb = jnp.pad(sb, 1)
+
+        def _stale_frac(r, col):
+            return jnp.mean(jax.lax.dynamic_slice(padded_sb,
+                                                  (r, col), (3, 3)))
+
+        frac = jax.vmap(_stale_frac)(tgt_r, tgt_c)         # (K,)
+        scale = (1.0 - jnp.float32(cfg.stale_bonus)
+                 * jnp.clip(frac, 0.0, 1.0))[None, :]
+        # Only finite costs discount: a scaled _BIG would smuggle an
+        # unreachable slot past the auction's `< _BIG` validity gate.
+        costs = jnp.where(costs < _BIG, costs * scale, costs)
     costs = jnp.where((sizes > 0)[None, :], costs, _BIG)
     assignment = assign_frontiers(costs)
     result = FrontierResult(mask=mask, labels=labels, slots=slots,

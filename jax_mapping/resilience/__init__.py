@@ -10,6 +10,11 @@ The subsystem ISSUE 2 adds on top of the per-module robustness islands
                  backoff restart policy, auto-checkpoint cadence.
 * `faultplan`  — FaultEvent/FaultPlan: scripted, seeded, reproducible
                  multi-fault missions injected at existing boundaries.
+* `warmup`     — StagedWarmup: the availability-aware restart path
+                 (ISSUE 12) — restore, pre-warm jitted entry points in
+                 priority order from the io/compile_cache.py warm
+                 tiers, readiness-gate against the compile budget, and
+                 only then re-admit the node.
 
 Import order note: `bridge.brain` imports `resilience.health` at module
 top, and `faultplan` needs `bridge.brain.robot_ns` — the latter import
@@ -27,4 +32,7 @@ from jax_mapping.resilience.supervisor import (  # noqa: F401
 )
 from jax_mapping.resilience.faultplan import (  # noqa: F401
     SENSOR_KINDS, FaultEvent, FaultPlan, random_plan,
+)
+from jax_mapping.resilience.warmup import (  # noqa: F401
+    StagedWarmup, warmup_order,
 )

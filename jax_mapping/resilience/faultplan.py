@@ -82,6 +82,22 @@ runs mutate the world bit-identically.
                         orbit) of radius `value` metres; `robot` is the
                         crowd id (its path seed). Overlapping windows
                         on one crowd id run the WORST (largest) radius.
+
+INFRASTRUCTURE kind (ISSUE 12, warm-restart tier): the fault targets
+the restart path's own acceleration layer — the mission must keep its
+results bit-identical while restarts degrade from warm to cold.
+
+    cache_wipe          delete the stack's compile-cache root
+                        (persistent XLA cache + AOT snapshots) and
+                        suppress cache writes for the window
+                        (`CompileCacheManager.wipe_hold/release`);
+                        overlapping windows refcount — the first to
+                        clear must not re-enable a cache another still
+                        holds wiped. A restart inside the window is a
+                        genuinely cold restart; the stack degrades to
+                        plain recompile, never crashes. No-op (noted in
+                        the log) on stacks without a cold-start tier,
+                        like corrupt_checkpoint with no file.
 """
 
 from __future__ import annotations
@@ -103,6 +119,7 @@ WORLD_KINDS = frozenset({"door_close", "crowd"})
 KINDS = frozenset({
     "lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
     "kill_node", "kill_robot", "rejoin_robot", "corrupt_checkpoint",
+    "cache_wipe",
 }) | SENSOR_KINDS | WORLD_KINDS
 
 
@@ -373,6 +390,19 @@ class FaultPlan:
         elif ev.kind == "rejoin_robot":
             self._rejoin(stack, ev.robot)
             self._note(step, f"rejoin_robot robot{ev.robot}")
+        elif ev.kind == "cache_wipe":
+            mgr = getattr(stack, "compile_cache", None)
+            if mgr is None:
+                self._note(step, "cache_wipe skipped (no compile "
+                                 "cache on this stack)")
+            else:
+                mgr.wipe_hold()
+                self._note(step, "cache_wipe")
+                if ev.duration:
+                    def _rearm(m=mgr):
+                        m.wipe_release()
+                    self._clears.append((step + ev.duration, _rearm,
+                                         "cache_wipe"))
         elif ev.kind == "corrupt_checkpoint":
             path = ev.name or getattr(stack, "auto_checkpoint_path", "")
             if path and os.path.exists(path):
@@ -419,6 +449,8 @@ def _fault_resource(kind: str, robot: int, name: str = "") -> tuple:
         return ("door", name)
     if kind == "crowd":
         return ("crowd", robot)          # robot field = crowd id
+    if kind == "cache_wipe":
+        return ("cache",)                # one compile cache per stack
     return ("bus", kind)                 # bus_drop / bus_reorder
 
 
@@ -441,7 +473,8 @@ def _sample_value(rng: random.Random, kind: str) -> float:
 
 def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
                 n_robots: int = 1, door_names=(),
-                n_crowds: int = 0) -> FaultPlan:
+                n_crowds: int = 0,
+                allow_cache_wipe: bool = False) -> FaultPlan:
     """Generate a reproducible schedule: `seed` fully determines the
     fault mix, placement, and durations (fuzz-style soak variety with
     CI-replayable failures). Samples the adversarial sensor kinds
@@ -456,8 +489,10 @@ def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
     `door_names` (the doors registered with its WorldDynamics) admits
     `door_close` windows (one door = one resource), `n_crowds` > 0
     admits `crowd` windows with kind-appropriate blob radii (one crowd
-    id = one resource). Default arguments reproduce the pre-scenario
-    sampler bit-for-bit."""
+    id = one resource), `allow_cache_wipe` admits `cache_wipe` windows
+    (stacks with a cold-start compile cache; the one cache = one
+    resource). Default arguments reproduce the pre-scenario sampler
+    bit-for-bit."""
     rng = random.Random(seed)
     kinds = ["lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
              "wheel_slip", "lidar_miscal", "ghost_returns", "scan_jam"]
@@ -466,6 +501,8 @@ def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
         kinds.append("door_close")
     if n_crowds > 0:
         kinds.append("crowd")
+    if allow_cache_wipe:
+        kinds.append("cache_wipe")
     events: List[FaultEvent] = []
     occupied: List[tuple] = []           # (resource, start, end)
     shortfall = 0

@@ -1,0 +1,276 @@
+"""Availability-aware staged warm-up: restore → pre-warm → re-admit.
+
+PR 2's supervisor restart was restore-and-go: the resumed mapper
+re-entered service immediately and paid its XLA compilation lazily,
+scan by scan — the PR 10 cost ledger shows a restarted process spends
+its first minutes compiling, not mapping. This module makes the
+restart a STAGED path:
+
+1. **restoring** — the checkpoint loads (with the PR 2/8 generation
+   fallback ladder);
+2. **warming** — the jitted entry points are pre-warmed in priority
+   order — fusion first (the mapper's time-to-first-fused-scan is the
+   availability metric), then matching, then exploration — from the
+   warm tiers in `io/compile_cache.py`: an AOT snapshot serves the
+   executable outright, otherwise a zeros-materialized call through
+   the persistent compilation cache, otherwise a cold compile (the
+   fallback ladder, never a crash). Meanwhile serving keeps answering
+   from the LAST epoch with `state=warming` instead of blocking
+   (bridge/http_api.py);
+3. **ready** — a READINESS GATE checks the warmed compiled-variant
+   counts against the committed `analysis/compile_budget.json` (a
+   warm-up that compiled MORE variants than the budget sanctions is a
+   recompile regression surfacing at the worst possible moment), the
+   dispatch profiler re-baselines so cache-/AOT-warmed variants never
+   count as live recompiles, and only then does the restarter return —
+   which is what re-admits the node into supervision (the supervisor's
+   fresh heartbeat grace) and FleetHealth-driven work assignment.
+
+Deterministic by construction: pre-warm calls are pure functions on
+zeros, so two same-seed kill+resume missions stay bit-identical — the
+chaos determinism contract extended to the restart path.
+
+Thread contract: the state machine's fields mutate only under `_lock`
+(declared in analysis/protection.py, racewatch-gated); pre-warm's jax
+work runs outside it. HTTP workers read `state()`/`snapshot()`
+concurrently with the restarting step thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: Warm-up priority classes, in order: the fusion tier gates
+#: time-to-first-fused-scan (slam_step IS the mapper's fuse entry),
+#: matching gates the first key scan, exploration gates the first
+#: publish; everything else (sim, serving hashes, planner) follows.
+#: Classification is by qualified-name substring — the registry's
+#: naming contract (module + function name).
+_PRIORITY_CLASSES = (
+    ("fusion", ("fuse", "slam_step", "sensor_kernel")),
+    ("match", ("match", "pyramid", "scan_agreement", "posegraph")),
+    ("frontier", ("frontier", "costfield", "planner")),
+)
+
+IDLE = "idle"
+RESTORING = "restoring"
+WARMING = "warming"
+READY = "ready"
+
+
+def warmup_class(name: str) -> int:
+    """Priority class index for a qualified entry-point name (lower
+    warms earlier; unclassified names warm last)."""
+    for i, (_label, needles) in enumerate(_PRIORITY_CLASSES):
+        if any(n in name for n in needles):
+            return i
+    return len(_PRIORITY_CLASSES)
+
+
+def warmup_order(names) -> List[str]:
+    """Names sorted fusion → match → frontier → rest, alphabetical
+    within a class (deterministic walk order)."""
+    return sorted(names, key=lambda n: (warmup_class(n), n))
+
+
+class StagedWarmup:
+    """The restart state machine + pre-warm driver."""
+
+    def __init__(self, cache=None, devprof=None,
+                 budget_path: Optional[str] = None):
+        #: io/compile_cache.CompileCacheManager, or None (in-process
+        #: restart with no cold-start tier: the stages still run, the
+        #: pre-warm degenerates to already-warm skips).
+        self.cache = cache
+        self.devprof = devprof
+        self.budget_path = budget_path
+        self._lock = threading.Lock()
+        self._state = IDLE
+        #: [(fn_name, how)] per warmed signature, in warm order —
+        #: how ∈ {aot, prewarmed, in_process, error}.
+        self._warmed: List[tuple] = []
+        self._report: Dict[str, object] = {}
+
+    # -- state machine -------------------------------------------------------
+
+    def _move(self, new: str) -> None:
+        with self._lock:
+            old = self._state
+            self._state = new
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("warmup_stage", old=old, new=new)
+
+    def begin_restore(self) -> None:
+        self._move(RESTORING)
+
+    def begin_warming(self) -> None:
+        self._move(WARMING)
+
+    def mark_ready(self) -> None:
+        self._move(READY)
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> dict:
+        """The /status export + test assertion surface."""
+        with self._lock:
+            return {"state": self._state,
+                    "n_warmed": len(self._warmed),
+                    "warmed": list(self._warmed),
+                    "report": dict(self._report)}
+
+    # -- pre-warm ------------------------------------------------------------
+
+    def prewarm(self, signatures: Optional[Dict[str, list]] = None
+                ) -> dict:
+        """Warm the captured entry points in priority order and run the
+        readiness gate. `signatures` maps qualified names to captured
+        abstract signatures (the dispatch profiler's live capture, or
+        the snapshot manifest's persisted ones); the cache manager's
+        loaded pool supplies AOT entries on top. Returns the report
+        (also kept for `snapshot()`). Never raises — per-signature
+        failures are counted and the ladder degrades."""
+        from jax_mapping.io.compile_cache import (materialize_zeros,
+                                                  resolve_entry_point)
+        t0 = time.perf_counter()
+        baseline_sizes = self._cache_sizes()
+        sigs: Dict[str, list] = {}
+        pool_names = []
+        if self.cache is not None:
+            manifest = self.cache.load_aot()
+            for name, ss in manifest["signatures"].items():
+                sigs.setdefault(name, []).extend(ss)
+            pool_names = manifest["pool_names"]
+            if manifest["n_loaded"] and not self.cache.pool.installed:
+                self.cache.pool.install()
+        for name, ss in (signatures or {}).items():
+            for s in ss:
+                if all(repr(s) != repr(x) for x in sigs.get(name, [])):
+                    sigs.setdefault(name, []).append(s)
+        warmed: List[tuple] = []
+        n_errors = 0
+        for name in warmup_order(sigs):
+            fn = resolve_entry_point(name)
+            if fn is None:
+                warmed.append((name, "error"))
+                n_errors += 1
+                continue
+            try:
+                already = int(fn._cache_size()) > 0
+            except Exception:                       # noqa: BLE001
+                already = False
+            if already:
+                # In-process restart: the jit cache survived the node;
+                # nothing to pay, nothing to pre-warm.
+                warmed.append((name, "in_process"))
+                continue
+            pooled = set()
+            if self.cache is not None and name in pool_names:
+                pooled = self.cache.pool.keys_for(name)
+            for sig in sigs[name]:
+                key = self._sig_key(sig)
+                if key is not None and key in pooled:
+                    # The AOT tier serves this variant — no re-trace,
+                    # no jit-cache growth. Execute it once on zeros so
+                    # the exported program's compile (a persistent-
+                    # cache hit, normally) is paid HERE, inside the
+                    # warm-up, never by the first live call; a failing
+                    # snapshot degrades to the pre-warm rung below.
+                    ent = self.cache.pool.entry(name, key)
+                    try:
+                        zargs, zkwargs = materialize_zeros(sig)
+                        compiled, mode, dyn_idx, dyn_kw = ent
+                        if mode == "dyn":
+                            compiled(*[zargs[i] for i in dyn_idx],
+                                     **{k: zkwargs[k] for k in dyn_kw})
+                        else:
+                            compiled(*zargs, **zkwargs)
+                        warmed.append((name, "aot"))
+                        continue
+                    except Exception:               # noqa: BLE001
+                        self.cache.pool.drop(name, key)
+                try:
+                    zargs, zkwargs = materialize_zeros(sig)
+                    fn(*zargs, **zkwargs)
+                    warmed.append((name, "prewarmed"))
+                except Exception:                   # noqa: BLE001
+                    warmed.append((name, "error"))
+                    n_errors += 1
+        report = {
+            "n_warmed": len([w for w in warmed if w[1] != "error"]),
+            "n_errors": n_errors,
+            "n_aot": len([w for w in warmed if w[1] == "aot"]),
+            "n_prewarmed": len([w for w in warmed
+                                if w[1] == "prewarmed"]),
+            "n_in_process": len([w for w in warmed
+                                 if w[1] == "in_process"]),
+            "warm_s": round(time.perf_counter() - t0, 3),
+        }
+        report["readiness_violations"] = self._readiness(baseline_sizes)
+        if self.devprof is not None:
+            # Satellite contract: cache-/AOT-warmed variants are NOT
+            # live recompiles — the profiler's baseline moves to the
+            # post-warm-up cache sizes before service resumes.
+            report["n_rebaselined"] = self.devprof.rebaseline()
+        with self._lock:
+            self._warmed = warmed
+            self._report = report
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record(
+            "warmup_ready", n_warmed=report["n_warmed"],
+            n_aot=report["n_aot"], n_errors=report["n_errors"],
+            n_readiness_violations=len(report["readiness_violations"]))
+        return report
+
+    @staticmethod
+    def _sig_key(sig: tuple) -> Optional[str]:
+        """The pool's signature key for an already-abstract captured
+        signature (the devprof key contract)."""
+        try:
+            return repr(sig)
+        except Exception:                           # noqa: BLE001
+            return None
+
+    @staticmethod
+    def _cache_sizes() -> Dict[str, int]:
+        try:
+            from jax_mapping.analysis.compilebudget import \
+                snapshot_cache_sizes
+            return snapshot_cache_sizes()
+        except Exception:                           # noqa: BLE001
+            return {}
+
+    def _readiness(self, baseline: Dict[str, int]) -> List[str]:
+        """The readiness gate: a budgeted function THIS warm-up grew
+        past its `compile_budget.json` ceiling is a recompile
+        regression surfacing on the restart path — report it. The gate
+        compares against the pre-warm-up baseline because the budget is
+        defined for a COLD canonical scenario: in a fresh resume
+        process baseline is zero and the check is absolute, while in a
+        warm long-lived process (in-process restarts, test suites) the
+        accumulated variant history is not this warm-up's doing and
+        must not cry wolf. Violations are reported (and
+        flight-recorded via the caller), not raised: a degraded
+        warm-up still re-admits; it just says so."""
+        path = self.budget_path
+        if path is None:
+            from jax_mapping.analysis.compilebudget import \
+                default_budget_path
+            path = default_budget_path()
+        try:
+            from jax_mapping.analysis.compilebudget import Budget
+            budget = Budget.load(path)
+        except Exception:                           # noqa: BLE001
+            return ["compile budget unreadable — readiness unchecked"]
+        sizes = self._cache_sizes()
+        out = []
+        for e in budget.entries:
+            n = sizes.get(e["name"], 0)
+            if n > e["max"] and n > baseline.get(e["name"], 0):
+                out.append(f"{e['name']}: {n} compiled variant(s) after "
+                           f"warm-up exceeds budget {e['max']}")
+        return out

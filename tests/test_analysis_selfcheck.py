@@ -391,6 +391,21 @@ def test_no_suppressions_in_obs_modules():
         f"suppressions are not allowed in obs/: {banned}")
 
 
+def test_no_suppressions_in_coldstart_modules():
+    """ISSUE 12 CI guard, extending the zero-suppression tier: the
+    warm-restart tier (`io/compile_cache.py`, the staged warm-up
+    `resilience/warmup.py`) carries ZERO baseline suppressions — the
+    path that runs exactly when the system is recovering from a fault
+    may not baseline its hazards."""
+    base = Baseline.load(default_baseline_path())
+    banned = [s for s in base.suppressions
+              if s["path"] in ("jax_mapping/io/compile_cache.py",
+                               "jax_mapping/resilience/warmup.py")]
+    assert not banned, (
+        "suppressions are not allowed in the warm-restart modules: "
+        f"{banned}")
+
+
 def test_protection_map_matches_code(package_modules):
     """Every lock-protection declaration names a real class, its real
     lock attributes, and fields actually assigned in that class — a

@@ -1,0 +1,567 @@
+"""Warm-restart resilience (ISSUE 12): persistent compile cache, AOT
+executable snapshots, the staged warm-up state machine, the cache_wipe
+fault kind, and the devprof warm-process recompile baseline.
+
+Everything here is IN-PROCESS and cheap: one real jitted entry point
+(`fuse_scans_masked` at tiny config) proves the AOT serialize →
+deserialize → warm-dispatch ladder bit-identically; the cross-process
+economics are the restart bench's job (`bench.py --suite restart`,
+BENCH_RESTART_r01.json — on the CPU builder the AOT tier degrades by
+design and the persistent cache carries the speedup)."""
+
+import json
+import os
+import sys
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import (ColdStartConfig, DevProfConfig,
+                                FrontierConfig, GridConfig, tiny_config)
+from jax_mapping.io.compile_cache import (CompileCacheManager, WarmPool,
+                                          cache_fingerprint,
+                                          materialize_zeros)
+from jax_mapping.resilience.warmup import (StagedWarmup, warmup_class,
+                                           warmup_order)
+
+
+# ---------------------------------------------------------------- fingerprint
+
+def test_fingerprint_keys_and_infra_normalization():
+    """Same config → same fingerprint; a state-shape change → a new
+    one; flipping bit-inert infra (obs, cold_start itself) → the SAME
+    one, so arming telemetry never orphans a snapshot set."""
+    cfg = tiny_config()
+    fp = cache_fingerprint(cfg.to_json())
+    assert fp == cache_fingerprint(cfg.to_json())
+    assert fp != cache_fingerprint(tiny_config(n_robots=3).to_json())
+    from jax_mapping.config import ObsConfig
+    traced = cfg.replace(obs=ObsConfig(enabled=True),
+                         cold_start=ColdStartConfig(enabled=True,
+                                                    cache_dir="/x"))
+    assert fp == cache_fingerprint(traced.to_json())
+
+
+# ------------------------------------------------------------- priority order
+
+def test_warmup_priority_fusion_then_match_then_frontier():
+    names = ["jax_mapping.ops.frontier.compute_frontiers",
+             "jax_mapping.sim.lidar.simulate_scans",
+             "jax_mapping.ops.scan_match.match_scan",
+             "jax_mapping.models.slam.slam_step",
+             "jax_mapping.ops.grid.fuse_scans_masked",
+             "jax_mapping.ops.costfield.cost_fields"]
+    ordered = warmup_order(names)
+    classes = [warmup_class(n) for n in ordered]
+    assert classes == sorted(classes)
+    assert ordered[0] in ("jax_mapping.models.slam.slam_step",
+                          "jax_mapping.ops.grid.fuse_scans_masked")
+    # Fusion tier strictly precedes matching, matching precedes
+    # exploration, unclassified (sim) comes last.
+    assert ordered.index("jax_mapping.ops.grid.fuse_scans_masked") \
+        < ordered.index("jax_mapping.ops.scan_match.match_scan") \
+        < ordered.index("jax_mapping.ops.frontier.compute_frontiers") \
+        < ordered.index("jax_mapping.sim.lidar.simulate_scans")
+
+
+def test_materialize_zeros_concretizes_only_arrays():
+    sig = ((jax.ShapeDtypeStruct((2, 3), jnp.float32), 7, "static"),
+           {"m": jax.ShapeDtypeStruct((2,), jnp.bool_)})
+    args, kwargs = materialize_zeros(sig)
+    assert args[0].shape == (2, 3) and args[1] == 7 and args[2] == "static"
+    assert kwargs["m"].dtype == jnp.bool_
+    assert not np.asarray(args[0]).any()
+
+
+# ------------------------------------------------- the AOT ladder, in-process
+
+@pytest.fixture(scope="module")
+def aot_workspace(tiny_cfg, tmp_path_factory):
+    """ONE snapshot pass shared by the ladder tests (tier-1 wall-clock
+    is the scarce resource — each save pays an export + two validation
+    compiles): a profiled fuse dispatch captures the signature, a
+    manager saves the snapshot set, the profiler uninstalls. Yields
+    (cache_root, signatures, live args, live output). Tests that
+    mutate files copy the root first."""
+    from jax_mapping.obs.devprof import DispatchProfiler
+    from jax_mapping.ops import grid as G
+    prof = DispatchProfiler(DevProfConfig(enabled=True))
+    prof.install()
+    try:
+        gcfg, scfg = tiny_cfg.grid, tiny_cfg.scan
+        args = (gcfg, scfg, G.empty_grid(gcfg),
+                jnp.ones((4, scfg.padded_beams), jnp.float32),
+                jnp.zeros((4, 3), jnp.float32), jnp.ones((4,), bool))
+        out = G.fuse_scans_masked(*args)
+        sigs = prof.signatures()
+        name = "jax_mapping.ops.grid.fuse_scans_masked"
+        if name not in sigs:
+            # Warm process (an earlier test already compiled this
+            # variant, so the profiler saw no cache growth): synthesize
+            # the capture — byte-identical to what a cold process's
+            # profiler records.
+            from jax_mapping.obs.devprof import abstract_signature
+            sigs = {name: [abstract_signature(args, {})]}
+        root = str(tmp_path_factory.mktemp("aot_ws") / "cache")
+        mgr = CompileCacheManager(
+            ColdStartConfig(enabled=True, cache_dir=root), root,
+            config_json=tiny_cfg.to_json())
+        rep = mgr.save_aot(sigs, resolve=prof.raw_fn)
+        assert rep["n_saved"] >= 1 and rep["n_failed"] == 0
+    finally:
+        # Uninstall BEFORE yielding: the profiler was only needed for
+        # the capture, and a module-scoped install would collide with
+        # tests that arm their own (install is process-exclusive).
+        prof.uninstall()
+    yield root, sigs, args, np.asarray(out)
+
+
+def test_aot_snapshot_roundtrip_and_warm_dispatch(tiny_cfg,
+                                                  aot_workspace):
+    """The whole warm tier on one entry point: load the saved snapshot
+    in-process, install the warm pool, and the next live call is
+    SERVED from the deserialized program — bit-identical output, zero
+    jit-cache growth, clean uninstall."""
+    from jax_mapping.io.compile_cache import resolve_entry_point
+    from jax_mapping.ops import grid as G
+    root, _sigs, args, out_cold = aot_workspace
+    mgr = CompileCacheManager(
+        ColdStartConfig(enabled=True, cache_dir=root), root,
+        config_json=tiny_cfg.to_json())
+    manifest = mgr.load_aot()
+    assert manifest["n_loaded"] >= 1 and manifest["n_corrupt"] == 0
+    assert mgr.pool.install() >= 1
+    try:
+        raw = resolve_entry_point("jax_mapping.ops.grid.fuse_scans_masked")
+        cache_before = int(raw._cache_size())
+        out_warm = G.fuse_scans_masked(*args)
+        stats = mgr.pool.stats()
+        assert stats["n_served"] >= 1
+        np.testing.assert_array_equal(np.asarray(out_warm), out_cold)
+        # A warm-served call never grows the jit cache — the recompile
+        # counter stays honest for AOT-loaded variants by construction.
+        assert int(raw._cache_size()) == cache_before
+    finally:
+        mgr.pool.uninstall()
+    assert not mgr.pool.installed
+
+
+def test_aot_corrupt_and_fingerprint_mismatch_degrade(tiny_cfg, tmp_path,
+                                                      aot_workspace):
+    """The fallback ladder's two upper failure modes: a truncated
+    snapshot file counts corrupt and is skipped; a different config's
+    fingerprint directory is counted and never read — both degrade,
+    neither raises, and the degraded entry still yields its signature
+    for the persistent-cache pre-warm."""
+    import shutil
+    root, _sigs, _args, _out = aot_workspace
+    copy = str(tmp_path / "cache")
+    shutil.copytree(root, copy)
+    mgr = CompileCacheManager(
+        ColdStartConfig(enabled=True, cache_dir=copy), copy,
+        config_json=tiny_cfg.to_json())
+    mgr.fingerprint = cache_fingerprint(tiny_cfg.to_json())
+    victim = sorted(f for f in os.listdir(mgr.aot_dir())
+                    if f.endswith(".aot"))[0]
+    with open(os.path.join(mgr.aot_dir(), victim), "r+b") as f:
+        f.truncate(16)
+    m2 = mgr.load_aot()
+    assert m2["n_corrupt"] >= 1
+
+    # A state-shape config change moves the fingerprint: the other
+    # directory is counted as a mismatch and never read.
+    other = CompileCacheManager(
+        ColdStartConfig(enabled=True, cache_dir=copy), copy,
+        config_json=tiny_config(n_robots=3).to_json())
+    m3 = other.load_aot()
+    assert m3["n_fingerprint_mismatch"] >= 1
+    assert m3["n_loaded"] == 0 and not m3["signatures"]
+
+
+def test_warm_pool_falls_through_on_signature_miss():
+    pool = WarmPool()
+    pool.add("jax_mapping.x.f", "sig-a", lambda *a, **k: "warm", "full",
+             (), ())
+    assert pool.lookup("jax_mapping.x.f", (jnp.ones(3),), {}) is None
+    assert pool.stats()["n_fallthrough"] == 1
+    assert pool.lookup("jax_mapping.y.g", (), {}) is None
+
+
+# --------------------------------------------------------- LRU + husk scrub
+
+def test_evict_lru_bounds_disk_and_scrubs_husks(tmp_path):
+    root = str(tmp_path / "cache")
+    mgr = CompileCacheManager(
+        ColdStartConfig(enabled=True, cache_dir=root,
+                        max_cache_bytes=3000), root)
+    os.makedirs(mgr.xla_dir)
+    for i in range(5):
+        p = os.path.join(mgr.xla_dir, f"entry{i}")
+        with open(p, "wb") as f:
+            f.write(b"x" * 1000)
+        os.utime(p, (1000 + i, 1000 + i))      # oldest first
+    husk = os.path.join(mgr.xla_dir, "husk")
+    open(husk, "wb").close()
+    assert mgr._scrub_husks(mgr.xla_dir) == 1
+    assert not os.path.exists(husk)
+    n, freed = mgr.evict_lru()
+    assert n == 2 and freed == 2000
+    left = sorted(os.listdir(mgr.xla_dir))
+    assert left == ["entry2", "entry3", "entry4"]   # oldest evicted
+    assert mgr.disk_usage_bytes() <= 3000
+
+
+# ------------------------------------------------------------- cache_wipe
+
+def test_cache_wipe_faultplan_refcount_composes(tmp_path):
+    """Two overlapping cache_wipe windows: files go at first fire, the
+    cache stays suppressed until the LAST window clears, then
+    re-enables empty — the refcount composition every windowed kind
+    honors."""
+    from jax_mapping.resilience.faultplan import FaultEvent, FaultPlan
+    root = str(tmp_path / "cache")
+    mgr = CompileCacheManager(
+        ColdStartConfig(enabled=True, cache_dir=root), root)
+    os.makedirs(mgr.xla_dir)
+    with open(os.path.join(mgr.xla_dir, "e"), "wb") as f:
+        f.write(b"x" * 10)
+    stack = types.SimpleNamespace(bus=None, compile_cache=mgr)
+    plan = FaultPlan([
+        FaultEvent(step=1, kind="cache_wipe", duration=4),
+        FaultEvent(step=2, kind="cache_wipe", duration=6),
+    ], seed=0)
+    plan.apply(stack, 1)
+    assert not os.listdir(mgr.xla_dir)
+    plan.apply(stack, 2)
+    assert mgr.status()["wipe_refs"] == 2
+    plan.apply(stack, 5)                     # first window clears
+    assert mgr.status()["wipe_refs"] == 1 and not mgr.enabled
+    # Saves are suppressed while any window holds.
+    assert mgr.save_aot({"f": [((), {})]})["n_saved"] == 0
+    plan.apply(stack, 8)                     # last window clears
+    assert mgr.status()["wipe_refs"] == 0 and mgr.enabled
+    assert plan.done()
+    mgr.disable()
+
+
+def test_cache_wipe_skips_without_manager():
+    from jax_mapping.resilience.faultplan import FaultEvent, FaultPlan
+    stack = types.SimpleNamespace(bus=None)
+    plan = FaultPlan([FaultEvent(step=0, kind="cache_wipe")], seed=0)
+    plan.apply(stack, 0)
+    assert any("cache_wipe skipped" in d for _s, d in plan.log)
+
+
+def test_cache_wipe_has_a_resource_and_samples():
+    from jax_mapping.resilience.faultplan import (_fault_resource,
+                                                  random_plan)
+    assert _fault_resource("cache_wipe", 0) == ("cache",)
+    plan = random_plan(200, n_faults=12, seed=7, allow_cache_wipe=True)
+    kinds = {e.kind for e in plan.events}
+    # Seeded sampling admits the kind; defaults exclude it (bit-compat
+    # with the pre-ISSUE-12 sampler is pinned elsewhere).
+    default_plan = random_plan(200, n_faults=12, seed=7)
+    assert "cache_wipe" not in {e.kind for e in default_plan.events}
+    assert kinds <= set(__import__(
+        "jax_mapping.resilience.faultplan", fromlist=["KINDS"]).KINDS)
+
+
+# ------------------------------------------- devprof warm-process baseline
+
+def test_devprof_rebaseline_excludes_warm_variants():
+    """The satellite regression: variants compiled by the warm-up
+    (through the RAW function, as StagedWarmup.prewarm does) must not
+    count as live recompiles once `rebaseline()` runs — and without it
+    they would, which is exactly the warm-process bug being fixed."""
+    from jax_mapping.obs.devprof import DispatchProfiler
+    mod = types.ModuleType("jax_mapping._coldstart_probe")
+
+    def probe_fn(x):
+        return x * 2 + 1
+
+    mod.probe_fn = jax.jit(probe_fn)
+    sys.modules["jax_mapping._coldstart_probe"] = mod
+    prof = DispatchProfiler(DevProfConfig(enabled=True))
+    try:
+        prof.install()
+        name = [n for n in prof.recompiles()
+                if n.endswith("probe_fn")][0]
+        raw = prof.raw_fn(name)
+        raw(jnp.ones(3))                     # warm-up compile, unprofiled
+        assert prof.rebaseline() == 1
+        mod.probe_fn(jnp.ones(3))            # first live call, same variant
+        assert prof.recompiles()[name] == 0  # NOT a live recompile
+        # Control: the same sequence WITHOUT rebaseline counts — the
+        # pre-fix behavior this satellite exists to kill.
+        raw(jnp.ones(4))                     # second variant via warm-up
+        mod.probe_fn(jnp.ones(4))
+        assert prof.recompiles()[name] == 1
+    finally:
+        prof.uninstall()
+        del sys.modules["jax_mapping._coldstart_probe"]
+
+
+def test_warm_pool_uninstall_unwraps_from_wrapper_chains():
+    """Shutdown-leak regression: whichever of (profiler, pool)
+    installed second wraps the other's wrapper, and the pool's
+    uninstall must splice itself out of EITHER nesting — a
+    direct-match-only restore would strand a dead wrapper at module
+    scope and starve later profilers of those entry points."""
+    from jax_mapping.obs.devprof import DispatchProfiler, _ProfiledJit
+    from jax_mapping.io.compile_cache import _WarmJit
+
+    for pool_second in (True, False):
+        mod = types.ModuleType("jax_mapping._chain_probe")
+
+        def chain_fn(x):
+            return x + 3
+
+        raw = jax.jit(chain_fn)
+        mod.chain_fn = raw
+        sys.modules["jax_mapping._chain_probe"] = mod
+        prof = DispatchProfiler(DevProfConfig(enabled=True))
+        pool = WarmPool()
+        name = "jax_mapping._chain_probe.chain_fn"
+        pool.add(name, "never-matches", lambda *a: None, "full", (), ())
+        try:
+            if pool_second:
+                prof.install()
+                pool.install()
+                assert isinstance(mod.chain_fn, _WarmJit)
+            else:
+                pool.install()
+                prof.install()
+                assert isinstance(mod.chain_fn, _ProfiledJit)
+            # Shutdown order contract: pool first, then profiler.
+            pool.uninstall()
+            prof.uninstall()
+            assert mod.chain_fn is raw, (pool_second, mod.chain_fn)
+        finally:
+            pool.uninstall()
+            prof.uninstall()
+            del sys.modules["jax_mapping._chain_probe"]
+
+
+# ------------------------------------------------- staged warm-up machine
+
+def test_staged_warmup_walks_stages_and_reports(tmp_path):
+    from jax_mapping.obs.recorder import flight_recorder
+    mark = flight_recorder.mark()
+    wu = StagedWarmup()
+    assert wu.state() == "idle"
+    wu.begin_restore()
+    wu.begin_warming()
+    rep = wu.prewarm({})
+    wu.mark_ready()
+    assert wu.state() == "ready"
+    snap = wu.snapshot()
+    assert snap["report"]["n_errors"] == 0
+    kinds = [e["kind"] for e in flight_recorder.events_since(mark)]
+    assert kinds.count("warmup_stage") == 3
+    assert "warmup_ready" in kinds
+    assert rep["readiness_violations"] == []
+
+
+def test_staged_warmup_readiness_gate_flags_over_budget(tmp_path):
+    """A variant THIS warm-up compiled past its budget ceiling is
+    REPORTED (not raised); variants the long-lived process accumulated
+    before the warm-up are not the warm-up's doing and stay quiet (the
+    baseline-delta semantics — a warm tier-1 process must not cry
+    wolf)."""
+    from jax_mapping.obs.devprof import abstract_signature
+    mod = types.ModuleType("jax_mapping._readiness_probe")
+
+    def readiness_fn(x):
+        return x - 1
+
+    mod.readiness_fn = jax.jit(readiness_fn)
+    sys.modules["jax_mapping._readiness_probe"] = mod
+    try:
+        name = "jax_mapping._readiness_probe.readiness_fn"
+        budget = tmp_path / "budget.json"
+        budget.write_text(json.dumps(
+            {"version": 1, "budgets": [{"name": name, "max": 0}]}))
+        sig = abstract_signature((jnp.ones(3),), {})
+        wu = StagedWarmup(budget_path=str(budget))
+        rep = wu.prewarm({name: [sig]})      # warm-up compiles it: 1 > 0
+        assert any(name in v for v in rep["readiness_violations"])
+        # Pre-existing variants do NOT violate: a second warm-up that
+        # compiles nothing new reports clean against the same budget.
+        rep2 = StagedWarmup(budget_path=str(budget)).prewarm({})
+        assert rep2["readiness_violations"] == []
+    finally:
+        del sys.modules["jax_mapping._readiness_probe"]
+
+
+def test_staged_warmup_racewatch_converges_on_declared_lock():
+    """Eraser refinement over the warm-up state machine: a reader
+    thread hammers state()/snapshot() while the driver walks the
+    stages — zero reports, every watched field's candidate lockset
+    converges on `_lock` (the analysis/protection.py declaration)."""
+    from jax_mapping.analysis.protection import groups_by_class
+    from jax_mapping.analysis.racewatch import RaceWatch
+    wu = StagedWarmup()
+    watch = RaceWatch()
+    try:
+        watch.watch_object(wu, groups_by_class()["StagedWarmup"][0],
+                           name="warmup")
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                wu.state()
+                wu.snapshot()
+                stop.wait(0.001)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        for _ in range(8):
+            wu.begin_restore()
+            wu.begin_warming()
+            wu.prewarm({})
+            wu.mark_ready()
+        stop.set()
+        t.join(timeout=10)
+    finally:
+        watch.unwatch_all()
+    assert watch.reports() == []
+    states = watch.field_states()
+    moved = [st for st in states.values()
+             if st.state == "shared-modified"]
+    assert moved, "nothing went shared-modified — the gate saw no race"
+    for st in moved:
+        assert "StagedWarmup._lock@warmup" in st.candidate, st
+
+
+def test_staged_warmup_prewarm_skips_in_process_warm(aot_workspace):
+    """An in-process restart (jit caches survived the node) pre-warms
+    in O(registry): every already-compiled function reports
+    `in_process`, no zeros call runs."""
+    _root, sigs, _args, _out = aot_workspace
+    wu = StagedWarmup()
+    rep = wu.prewarm(sigs)
+    assert rep["n_in_process"] >= 1
+    assert rep["n_prewarmed"] == 0 and rep["n_errors"] == 0
+
+
+# ------------------------------------------------ decay-aware frontier score
+
+@pytest.fixture()
+def decay_gcfg():
+    return GridConfig(size_cells=64, resolution_m=0.1, patch_cells=32,
+                      max_range_m=2.0, align_rows=8, align_cols=8)
+
+
+@pytest.fixture()
+def decay_fcfg():
+    return FrontierConfig(downsample=4, cluster_downsample=1,
+                          max_clusters=8, min_cluster_cells=1,
+                          label_prop_iters=16, bfs_iters=32,
+                          obstacle_aware=False, incremental=False)
+
+
+def _two_cluster_world(gcfg, fcfg):
+    """A log-odds grid with two disjoint free pockets symmetric about
+    a centred robot — each pocket's boundary is one frontier cluster
+    at equal Euclidean distance; returns (logodds, pose)."""
+    n = gcfg.size_cells
+    lo = np.zeros((n, n), np.float32)
+    lo[28:36, 8:24] = -2.0      # left pocket
+    lo[28:36, 40:56] = -2.0     # right pocket (mirror)
+    return jnp.asarray(lo), jnp.asarray([[0.0, 0.0, 0.0]], jnp.float32)
+
+
+def test_decay_aware_off_is_bit_exact(decay_gcfg, decay_fcfg):
+    """Knob off (default) and knob on over a grid with NO stale cells
+    produce bit-identical assignments/targets/costs: the discount
+    multiplies by exactly 1.0 when nothing is stale, and is never
+    traced at all when the knob is off."""
+    import dataclasses
+    lo, pose = _two_cluster_world(decay_gcfg, decay_fcfg)
+    off = F_compute(decay_fcfg, decay_gcfg, lo, pose)
+    on_cfg = dataclasses.replace(decay_fcfg, decay_aware=True)
+    on = F_compute(on_cfg, decay_gcfg, lo, pose)
+    np.testing.assert_array_equal(np.asarray(off.costs),
+                                  np.asarray(on.costs))
+    np.testing.assert_array_equal(np.asarray(off.assignment),
+                                  np.asarray(on.assignment))
+    np.testing.assert_array_equal(np.asarray(off.targets),
+                                  np.asarray(on.targets))
+
+
+def F_compute(fcfg, gcfg, lo, pose):
+    from jax_mapping.ops import frontier as F
+    return F.compute_frontiers(fcfg, gcfg, lo, pose)
+
+
+def test_stale_mask_flags_healed_not_fresh(decay_gcfg, decay_fcfg):
+    from jax_mapping.ops import frontier as F
+    n = decay_gcfg.size_cells
+    lo = np.zeros((n, n), np.float32)
+    lo[8:12, 8:12] = 0.2           # decayed evidence: sub-threshold, != 0
+    lo[40:44, 40:44] = -2.0        # solidly free: not unknown
+    mask = np.asarray(F.stale_mask(decay_fcfg, decay_gcfg,
+                                   jnp.asarray(lo)))
+    d = decay_fcfg.downsample
+    assert mask[8 // d, 8 // d]
+    assert not mask[40 // d, 40 // d]
+    assert not mask[0, 0]          # fresh unknown never flags
+
+
+def test_decay_aware_prefers_stale_frontier(decay_gcfg, decay_fcfg):
+    """Two equidistant clusters; residual decayed evidence beyond one
+    end. decay_aware=True steers the assignment to the stale side for
+    re-verification; False keeps the plain distance tie-break."""
+    import dataclasses
+    lo_np, pose = _two_cluster_world(decay_gcfg, decay_fcfg)
+    lo_np = np.array(np.asarray(lo_np))
+    # Healed region beyond the RIGHT pocket: touched, sub-threshold.
+    lo_np[28:36, 56:62] = 0.1
+    lo = jnp.asarray(lo_np)
+    off = F_compute(decay_fcfg, decay_gcfg, lo, pose)
+    on = F_compute(dataclasses.replace(decay_fcfg, decay_aware=True),
+                   decay_gcfg, lo, pose)
+    tx_off = float(np.asarray(off.targets)[int(np.asarray(off.assignment)[0])][0])
+    tx_on = float(np.asarray(on.targets)[int(np.asarray(on.assignment)[0])][0])
+    # The discounted (stale, right-side) cluster wins under the knob.
+    assert tx_on > 0.0
+    assert tx_on >= tx_off
+
+
+# --------------------------------------------- checkpoint-load observability
+
+def test_checkpoint_fallback_slot_recorded(tmp_path):
+    from jax_mapping.io.checkpoint import (fallback_counts,
+                                           load_checkpoint_with_fallback,
+                                           save_checkpoint)
+    from jax_mapping.obs.recorder import flight_recorder
+    path = str(tmp_path / "ck.npz")
+    state = {"a": np.arange(6, dtype=np.float32)}
+    save_checkpoint(path, state)
+    save_checkpoint(path, {"a": np.arange(6, dtype=np.float32) + 1})
+    before = fallback_counts()
+    mark = flight_recorder.mark()
+    _st, _cfg, used = load_checkpoint_with_fallback(path, state)
+    assert used == path
+    after = fallback_counts()
+    assert after["primary"] == before["primary"] + 1
+    evs = [e for e in flight_recorder.events_since(mark)
+           if e["kind"] == "checkpoint_fallback"]
+    assert evs and evs[-1]["slot"] == "primary" \
+        and evs[-1]["fell_back"] is False
+    # Rot the primary: the .prev rescue is now VISIBLE, not silent.
+    with open(path, "r+b") as f:
+        f.truncate(20)
+    mark = flight_recorder.mark()
+    _st, _cfg, used = load_checkpoint_with_fallback(path, state)
+    assert used.endswith(".prev.npz")
+    assert fallback_counts()["prev"] == before["prev"] + 1
+    evs = [e for e in flight_recorder.events_since(mark)
+           if e["kind"] == "checkpoint_fallback"]
+    assert evs and evs[-1]["slot"] == "prev" \
+        and evs[-1]["fell_back"] is True

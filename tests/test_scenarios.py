@@ -8,6 +8,7 @@ launching its own stack) plus pure-unit coverage; the heavyweights
 are `slow`.
 """
 
+import json
 import os
 
 import numpy as np
@@ -407,6 +408,25 @@ def scenario_mission(tmp_path_factory):
     rect = (d["r0"] + off, d["r1"] + off, d["c0"] + off, d["c1"] + off)
 
     client = DeltaMapClient(f"http://127.0.0.1:{st.api.port}")
+
+    # Degraded-serving window probe (ISSUE 12 piggyback): the staged
+    # restart's warmup_hook fires INSIDE the warming stage — the old
+    # node destroyed, the new one not yet bound — which is exactly the
+    # window /status and /tiles must answer from the prior epoch with
+    # state=warming instead of blocking. handle() direct: no socket
+    # round-trip, same handler path.
+    warm_probe = {}
+
+    def _probe_warming_window(stack):
+        warm_probe["status"] = json.loads(
+            stack.api.handle("/status")[2])
+        warm_probe["tiles"] = json.loads(
+            stack.api.handle("/tiles?since=-1")[2])
+        warm_probe["warmup_state"] = stack.warmup.state()
+        warm_probe["old_epoch"] = stack.mapper.restart_epoch
+
+    st.warmup_hook = _probe_warming_window
+
     st.run_steps(_DOOR_CLOSE_AT + _DOOR_STEPS - 2)   # door still closed
     client.poll()
     pre_restart_epoch = client.epoch
@@ -488,6 +508,7 @@ def scenario_mission(tmp_path_factory):
         "race_reports": race_reports, "race_states": race_states,
         "spans": spans, "recorder_events": recorder_events,
         "metrics_text": metrics_text, "trace_resp": trace_resp,
+        "warm_probe": warm_probe,
     }
     yield art
     st.shutdown()
@@ -551,6 +572,61 @@ def test_scenario_client_epoch_resync_across_restart(scenario_mission):
     assert client.epoch == 1
     assert client.n_epoch_resyncs == 1
     assert client.revision == a["revision_at_final_poll"]
+
+
+def test_scenario_degraded_serving_window_reports_warming(
+        scenario_mission):
+    """ISSUE 12 satellite: DURING the staged restart's warming stage,
+    /status and /tiles keep answering — from the prior epoch — and
+    stamp `state=warming` instead of blocking. The probe ran inside
+    the warmup_hook, i.e. after the old node was destroyed and before
+    the new one was bound."""
+    probe = scenario_mission["warm_probe"]
+    assert probe, "warmup_hook never fired — staged restart regressed"
+    assert probe["warmup_state"] == "warming"
+    assert probe["status"]["state"] == "warming"
+    assert probe["tiles"]["state"] == "warming"
+    # Prior-epoch content: the window serves the PRE-restart epoch (0)
+    # with real tiles; after the mission the stack serves epoch 1.
+    assert probe["old_epoch"] == 0
+    assert probe["tiles"]["epoch"] == 0
+    assert probe["tiles"]["tiles"], "warming window served no content"
+    st = scenario_mission["stack"]
+    post = json.loads(st.api.handle("/tiles?since=-1")[2])
+    assert post["epoch"] == 1 and "state" not in post
+    post_status = json.loads(st.api.handle("/status")[2])
+    assert "state" not in post_status
+    assert st.warmup is not None and st.warmup.state() == "ready"
+
+
+def test_scenario_restart_checkpoint_fallback_is_visible(
+        scenario_mission):
+    """The restart's checkpoint load records WHICH generation it chose
+    (flight-recorder event) and the per-slot counter reaches /metrics
+    — a silent .prev rescue is no longer indistinguishable from a
+    clean load. (This mission's restart loads the intact primary.)"""
+    evs = [e for e in scenario_mission["recorder_events"]
+           if e["kind"] == "checkpoint_fallback"]
+    assert evs, "restart resumed without recording its slot"
+    assert evs[-1]["slot"] == "primary" and not evs[-1]["fell_back"]
+    st = scenario_mission["stack"]
+    metrics = st.api.handle("/metrics")[2].decode()
+    assert 'jax_mapping_checkpoint_fallback_total{slot="primary"}' \
+        in metrics
+    assert 'jax_mapping_checkpoint_fallback_total{slot="prev"}' \
+        in metrics
+
+
+def test_scenario_staged_warmup_recorded_and_clean(scenario_mission):
+    """The staged restart walked restore→warming→ready on the flight
+    recorder, and the in-process warm-up reported no errors (jit
+    caches survived the node — everything skips as in_process)."""
+    kinds = [e["kind"] for e in scenario_mission["recorder_events"]]
+    assert "warmup_stage" in kinds and "warmup_ready" in kinds
+    st = scenario_mission["stack"]
+    snap = st.warmup.snapshot()
+    assert snap["state"] == "ready"
+    assert snap["report"]["n_errors"] == 0
 
 
 def test_scenario_plan_log_is_the_script(scenario_mission):
