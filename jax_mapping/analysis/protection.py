@@ -207,6 +207,22 @@ REPO_PROTECTION: List[LockGroup] = [
     group("CompileCacheManager", "_lock",
           ["_wipe_refs", "_counts"],
           lockfree_ok=["enabled", "fingerprint"]),
+    # Tenant control plane (tenancy/controlplane.py): the mission
+    # registry, lane order, live batch, warmed-bucket set, per-tenant
+    # tile stores and the lifecycle counters form ONE consistent
+    # snapshot under `_lock` — admissions/evictions from operator or
+    # HTTP threads race the stepping thread, which is exactly the
+    # cross-thread churn the tenancy racewatch gate hammers
+    # (tests/test_tenancy.py). The wiring references (cfg,
+    # world_res_m, checkpoint_dir, warmup) are set-once at
+    # construction, read-only after (the StagedWarmup convention).
+    group("TenantControlPlane", "_lock",
+          ["_missions", "_order", "_prev_order", "_batch",
+           "_warmed_buckets", "_tile_stores", "_last_diag",
+           "n_admitted", "n_evicted", "n_suspended", "n_resumed",
+           "n_prewarms", "n_ticks", "n_compactions"],
+          lockfree_ok=["cfg", "world_res_m", "checkpoint_dir",
+                       "warmup"]),
     # Warm dispatch pool (io/compile_cache.py): the entry table and its
     # serve/fallthrough/drop counters mutate together from every thread
     # that dispatches a wrapped entry point; `_bindings`/`installed`

@@ -136,6 +136,14 @@ class MapApiServer:
         #: warm-restart tier is armed (cache counters, warm-pool stats,
         #: warm-up report).
         self.coldstart_status: Optional[Callable[[], dict]] = None
+        #: Mission multi-tenancy control plane
+        #: (tenancy/controlplane.TenantControlPlane) wired by launch
+        #: when TenancyConfig.enabled: `/status.tenancy`,
+        #: `jax_mapping_tenant_*` metrics, and per-tenant
+        #: `/tiles?tenant=` delta sessions. Set-once before serving,
+        #: read bare by handler threads (the lock-free flag
+        #: convention).
+        self.tenancy = None
         self.n_degraded_responses = 0
         self._lock = threading.Lock()
         #: Request statistics lock: ThreadingHTTPServer runs one worker
@@ -497,6 +505,12 @@ class MapApiServer:
                     "cost_ledger_uncollected":
                         self.cost_ledger.n_uncollected(),
                 }
+            if self.tenancy is not None:
+                # Mission multi-tenancy picture: per-tenant lifecycle
+                # state, serving (epoch, revision) namespaces, bucket
+                # capacity/occupancy and pad waste, admit/evict/
+                # pre-warm counters (tenancy/controlplane.py).
+                body["tenancy"] = self.tenancy.status()
             if self.extra_status is not None:
                 body.update(self.extra_status())
             return 200, "application/json", json.dumps(body).encode()
@@ -815,29 +829,64 @@ class MapApiServer:
         base64 PNGs in a JSON manifest. since=-1 (or omitted) is the
         initial full snapshot. ETag on the store revision, so a poller
         that is already current pays a 304."""
-        if self.serving is None:
-            return 404, "application/json", json.dumps(
-                {"error": "serving disabled "
-                          "(ServingConfig.enabled=False)"}).encode()
-        store = self.serving.store(source)
-        if store is None:
-            return 404, "application/json", json.dumps(
-                {"error": f"no {source} tile store (run the stack with "
-                          "the producing mapper attached)"}).encode()
         q = parse_qs(urlparse(path).query)
+        tenant = q.get("tenant", [None])[0]
+        if tenant is not None:
+            # Per-tenant delta session (tenancy/): the tenant's OWN
+            # (epoch, revision) namespace replaces the mapper's — a
+            # resumed mission's epoch bump invalidates pre-suspend
+            # ETags exactly like a supervisor restart does for the
+            # shared map, and co-tenant churn never touches it.
+            if self.tenancy is None:
+                return 404, "application/json", json.dumps(
+                    {"error": "no tenant control plane attached "
+                              "(TenancyConfig.enabled=False)"}).encode()
+            try:
+                store = self.tenancy.tile_store(tenant)
+            except (KeyError, ValueError) as e:
+                return 404, "application/json", json.dumps(
+                    {"error": str(e)}).encode()
+            source = f"tenant:{tenant}"
+        else:
+            if self.serving is None:
+                return 404, "application/json", json.dumps(
+                    {"error": "serving disabled "
+                              "(ServingConfig.enabled=False)"}).encode()
+            store = self.serving.store(source)
+            if store is None:
+                return 404, "application/json", json.dumps(
+                    {"error": f"no {source} tile store (run the stack "
+                              "with the producing mapper "
+                              "attached)"}).encode()
         try:
             since = int(q.get("since", ["-1"])[0])
             level = int(q["level"][0]) if "level" in q else None
         except (ValueError, IndexError):
             return 400, "application/json", json.dumps(
                 {"error": "since and level must be integers"}).encode()
-        store.refresh()
+        try:
+            store.refresh()
+        except (KeyError, ValueError) as e:
+            # A tenant evicted between store lookup and refresh: its
+            # snapshot has no state to serve anymore.
+            return 404, "application/json", json.dumps(
+                {"error": str(e)}).encode()
         rev, entries, meta = store.tiles_since(since, level)
         # Restart epoch in body AND ETag: a supervisor restart-resume
-        # legitimately re-serves an older revision; clients key cache
-        # validity on (epoch, revision), not revision alone — a stale
-        # pre-restart ETag can never 304 against the resumed store.
-        epoch = self.serving.epoch(source)
+        # (or a tenant evict→re-admit) legitimately re-serves an older
+        # revision; clients key cache validity on (epoch, revision),
+        # not revision alone — a stale pre-restart ETag can never 304
+        # against the resumed store. Read AFTER the refresh on both
+        # paths: an epoch captured before it could stamp fresh content
+        # with the PRIOR epoch and match a stale client's ETag.
+        if tenant is not None:
+            try:
+                epoch = self.tenancy.epoch(tenant)
+            except KeyError:
+                return 404, "application/json", json.dumps(
+                    {"error": f"unknown tenant {tenant!r}"}).encode()
+        else:
+            epoch = self.serving.epoch(source)
         # The warming flag is part of the REPRESENTATION (body and ETag
         # must agree — the /trace doctrine): a poller current on the
         # steady-state tag still learns the window opened, and a cached
@@ -1436,6 +1485,18 @@ class MapApiServer:
                     for slot, n in sorted(fallback_counts().items())]
         reg.family("jax_mapping_checkpoint_fallback_total", "counter",
                    checkpoint_fallback_samples)
+
+        def tenancy_families():
+            # Mission multi-tenancy (tenancy/): active/suspended/
+            # evicted tenant counts, bucket capacity/occupancy and the
+            # pad-slot waste fraction — ONE consistent control-plane
+            # status snapshot per render. Whole block omitted when no
+            # control plane is attached.
+            cp = self.tenancy
+            if cp is None:
+                return None
+            return cp.metric_families()
+        reg.add_source(tenancy_families)
         return reg
 
     # -- lifecycle ----------------------------------------------------------

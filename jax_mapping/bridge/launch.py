@@ -82,6 +82,11 @@ class Stack:
     #: with state=warming) — the degraded-serving-window assertion
     #: hook. Exceptions are contained; the restart proceeds.
     warmup_hook: Optional[object] = None
+    #: Mission multi-tenancy control plane
+    #: (tenancy/controlplane.TenantControlPlane) when
+    #: TenancyConfig.enabled — admit/evict megabatched model-level
+    #: missions alongside this bridge stack; None = no tenancy.
+    tenancy: Optional[object] = None
     _killed: Set[str] = dataclasses.field(default_factory=set)
     _steps_run: int = 0
 
@@ -454,6 +459,20 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
                   health=health, supervisor=supervisor, recovery=recovery,
                   tracer=tracer, devprof=devprof,
                   compile_cache=compile_cache, warmup=warmup)
+    if cfg.tenancy.enabled:
+        # Mission multi-tenancy (tenancy/): the control plane that
+        # admits/evicts megabatched model-level missions alongside
+        # this bridge stack, sharing its warm-restart storage tier and
+        # dispatch profiler. `enabled=False` constructs NOTHING — no
+        # plane, no batch, no megabatch trace; bit-exact pre-tenancy.
+        from jax_mapping.tenancy import TenantControlPlane
+        stack.tenancy = TenantControlPlane(
+            cfg, world_res_m=res,
+            checkpoint_dir=(os.path.join(checkpoint_dir, "tenants")
+                            if checkpoint_dir else None),
+            compile_cache=compile_cache, devprof=devprof)
+        if api is not None:
+            api.tenancy = stack.tenancy
     if api is not None and (compile_cache is not None
                             or warmup is not None):
         # /status `cold_start` export: cache counters, warm-pool stats,

@@ -1448,13 +1448,13 @@ class MapperNode(Node):
 
     def _frontier_incremental(self):
         """The incremental pipeline, or None (disabled config, no
-        revision tracking, a latched geometry rejection, or decay-aware
-        scoring — the stale mask derives from raw log-odds, which the
-        incremental pipeline's cached coarse masks do not carry, so the
-        knob routes publishes through the full recompute)."""
+        revision tracking, or a latched geometry rejection). Decay-
+        aware scoring rides the incremental path too: the pipeline
+        carries the HEALED/STALE mask tile-incrementally alongside the
+        other coarse masks (a decay pass bumps every tile revision, so
+        staleness refreshes with them — ROADMAP item 7c)."""
         if not self.cfg.frontier.incremental or self._tile_rev is None \
-                or self._frontier_pipeline_failed \
-                or self.cfg.frontier.decay_aware:
+                or self._frontier_pipeline_failed:
             return None
         if self._frontier_pipeline is None:
             from jax_mapping.ops.frontier_incremental import \

@@ -332,9 +332,11 @@ class FrontierConfig:
     # neighbourhood) — the fleet re-verifies what the world may have
     # changed instead of merely re-opening it. False (default) is the
     # pre-existing pipeline bit-exactly: no stale mask is computed and
-    # costs are untouched (parity-tested). The bridge publish path runs
-    # decay-aware scoring through the full-recompute pipeline (the
-    # incremental pipeline does not carry a stale mask).
+    # costs are untouched (parity-tested). The bridge publish path
+    # keeps its incremental pipeline either way: the HEALED/STALE mask
+    # is carried tile-incrementally alongside the other coarse masks
+    # (`frontier_incremental`; a decay pass bumps every tile revision,
+    # so staleness refreshes with them).
     decay_aware: bool = False
     # Maximum fractional cost discount for a fully-stale target
     # neighbourhood; the auction still ranks by distance within equally
@@ -852,6 +854,50 @@ class ServingConfig:
 
 
 @_frozen
+class TenancyConfig:
+    """Mission multi-tenancy (tenancy/ subsystem).
+
+    "Millions of users" is MANY independent missions, each tiny
+    relative to the accelerator — not one giant fleet. These knobs
+    parameterize the tenant megabatch (`tenancy/megabatch.py`: mission
+    states stacked along a pow2-bucketed leading axis, one jitted step
+    per tick for the whole batch) and its control plane
+    (`tenancy/controlplane.py`: admit / suspend / resume / evict,
+    admission pre-warm through the warm-restart ladder, eviction
+    checkpoints, per-tenant serving epoch/revision namespaces).
+
+    `enabled=False` constructs NOTHING — no control plane, no batch,
+    no new jitted entry point traced; bit-exact pre-tenancy behavior
+    (the ObsConfig/DecayConfig doctrine). Enabled changes no
+    single-mission numerics either: a tenant's megabatched trajectory
+    is bit-identical to its solo run (the megabatch contract,
+    property-tested)."""
+
+    enabled: bool = False
+    #: Hard capacity ceiling: `bucket_capacity` refuses admissions
+    #: past it, so a runaway admission loop cannot grow device
+    #: footprint without bound.
+    max_tenants: int = 64
+    #: Serve capacities from the BIT-EXACT bucket ladder only
+    #: (megabatch.EXACT_BUCKETS — every bucket verified bit-identical
+    #: to solo runs on this backend; tops out at 12 on XLA:CPU, where
+    #: larger batches vectorize with FMA/SIMD choices the solo
+    #: executable does not make). False opts into the full
+    #: {2^k} ∪ {3·2^(k-1)} set at any size — throughput mode,
+    #: documented ulp-faithful rather than bit-exact on CPU.
+    bit_exact_buckets: bool = True
+    #: Pre-warm a not-yet-compiled bucket variant through the
+    #: StagedWarmup ladder BEFORE the tenant joins the batch (ROADMAP
+    #: item 7b pairing). Off = the first tick at a new bucket pays the
+    #: compile inline.
+    prewarm_on_admit: bool = True
+    #: Eviction writes the mission's final state through
+    #: `io/checkpoint.save_checkpoint` (generation-retained) when the
+    #: control plane has a checkpoint dir.
+    checkpoint_on_evict: bool = True
+
+
+@_frozen
 class AnalysisConfig:
     """Canonical scenario for the jit recompile-budget tracker
     (`analysis/compilebudget.py`): a deterministic tiny-config stack
@@ -866,6 +912,12 @@ class AnalysisConfig:
     budget_world_cells: int = 96      # plank_course arena edge
     budget_steps: int = 16            # exploration steps driven
     budget_seed: int = 3
+    # Tenant-megabatch bucket drive (ISSUE 14): tenant counts stepped
+    # through `megabatch_step` at the `micro_config` mission shape —
+    # 5 and 6 share the 6-bucket of {2^k} ∪ {3·2^(k-1)}, so exactly
+    # TWO variants compile; a bucketing regression (one variant per
+    # count) surfaces as a third.
+    budget_tenant_counts: Tuple[int, ...] = (3, 5, 6)
 
 
 @_frozen
@@ -900,6 +952,7 @@ class SlamConfig:
     decay: DecayConfig = DecayConfig()
     obs: ObsConfig = ObsConfig()
     cold_start: ColdStartConfig = ColdStartConfig()
+    tenancy: TenancyConfig = TenancyConfig()
     # slam_toolbox's operating mode (slam_config.yaml:20: "mapping" —
     # the file's comment offers localization as the alternative).
     # "localization" freezes the map: key scans MATCH against it for
@@ -946,6 +999,7 @@ class SlamConfig:
             decay=DecayConfig(**raw.get("decay", {})),
             obs=ObsConfig(**obs_raw),
             cold_start=ColdStartConfig(**raw.get("cold_start", {})),
+            tenancy=TenancyConfig(**raw.get("tenancy", {})),
             **{k: v for k, v in raw.items()
                if k in ("mode", "map_publish_period_s",
                         "tf_publish_period_s", "domain_id")},
@@ -1002,6 +1056,39 @@ def tiny_config(n_robots: int = 2) -> SlamConfig:
     )
 
 
+def micro_config(n_robots: int = 1) -> SlamConfig:
+    """Smallest-legal static shapes: the mission-multi-tenancy regime
+    (MANY missions, each tiny relative to the accelerator). One shared
+    definition for the tenant compile-budget scenario, the tenancy
+    test suite and `bench.py --suite tenant`, so the committed budget
+    names a reproducible mission shape. Scan keeps >= 30 live beams
+    (the explorer's front-cone slices need them); the patch-coverage
+    contract holds at 24/2 - 8/2 = 8 cells = 0.4 m."""
+    return SlamConfig(
+        grid=GridConfig(size_cells=64, patch_cells=24, max_range_m=0.4,
+                        align_rows=8, align_cols=8),
+        scan=ScanConfig(n_beams=36, padded_beams=64, range_max_m=0.4,
+                        angle_increment_rad=2.0 * math.pi / 36.0),
+        matcher=MatcherConfig(search_half_extent_m=0.05,
+                              coarse_angle_half_rad=0.0698,
+                              coarse_angle_step_rad=0.0349,
+                              fine_angle_step_rad=0.0175,
+                              fine_step_m=0.025),
+        loop=LoopClosureConfig(max_poses=32, max_edges=128, gn_iters=2,
+                               min_chain_size=6, loop_window_m=2.0,
+                               coarse_downsample=2),
+        frontier=FrontierConfig(downsample=2, cluster_downsample=2,
+                                max_clusters=8, min_cluster_cells=2,
+                                label_prop_iters=16, bfs_iters=32,
+                                mg_levels=2, mg_refine_iters=4),
+        fleet=FleetConfig(n_robots=n_robots, batch_scans=1),
+        # 4x4 tiles over the 64^2 grid so the micro shape can run the
+        # full deployed stack (serving included) in benches and tests.
+        serving=ServingConfig(tile_cells=16, pyramid_levels=2,
+                              event_wait_max_s=5.0),
+    )
+
+
 def configs_equivalent(json_a: Optional[str], json_b: Optional[str]) -> bool:
     """Semantic config-drift comparison for checkpoint/bag guards.
 
@@ -1032,10 +1119,16 @@ def configs_equivalent(json_a: Optional[str], json_b: Optional[str]) -> bool:
         # would): a checkpoint saved by a warm-restart-armed stack must
         # resume in a cold one and vice versa — the restart bench's
         # cold/warm twins load the SAME checkpoint by construction.
+        # `tenancy` is bit-inert the same way: a megabatched tenant's
+        # trajectory is bit-identical to its solo run, so an eviction
+        # checkpoint written by a tenancy-armed control plane must
+        # resume in a plain solo stack and vice versa.
         return a.replace(mode="mapping", obs=ObsConfig(),
-                         cold_start=ColdStartConfig()) \
+                         cold_start=ColdStartConfig(),
+                         tenancy=TenancyConfig()) \
             == b.replace(mode="mapping", obs=ObsConfig(),
-                         cold_start=ColdStartConfig())
+                         cold_start=ColdStartConfig(),
+                         tenancy=TenancyConfig())
     except (TypeError, ValueError, KeyError, AttributeError):
         # AttributeError: valid JSON that is not an object ('"x"', '[]')
         # reaches raw.get() — a corrupted config must refuse, not crash.

@@ -261,11 +261,71 @@ def _close_loops(cfg: SlamConfig, graphs: PG.PoseGraph, grid: Array,
     return graphs3, grid2, est2, closed
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2))
-def fleet_step(cfg: SlamConfig, state: FleetState, world_res_m: float,
-               world: Array) -> tuple[FleetState, FleetDiag]:
-    """One synchronous fleet tick (the reference's 10 Hz loop, batched)."""
-    ensure_valid_mode(cfg)
+class _TickPre(NamedTuple):
+    """Everything one fleet tick computes BEFORE the batch-level loop-
+    closure cond: sense/act/move/match/fuse/graph-growth plus the
+    closure candidates. Split out of `fleet_step` so the tenant
+    megabatch (`tenancy/megabatch.py`) can vmap this part over a
+    tenant axis and hoist the closure `lax.cond` ABOVE the vmap — a
+    cond with a vmapped predicate lowers to `select` (BOTH branches
+    execute every tick for every tenant), which turns the rare-tick
+    closure repair into an every-tick tax. Hoisted, the predicate is
+    the any() over the whole batch and the common no-candidate tick
+    skips closure work exactly like a solo run."""
+
+    sim2: thymio.FleetSimState  # moved ground truth
+    pol: PolicyOut
+    fr: F.FrontierResult
+    match_response: Array       # (R,)
+    est: Array                  # (R, 3) post-match estimates
+    is_key: Array               # (R,) bool
+    grid: Array                 # fused (mapping) or untouched grid
+    graphs: PG.PoseGraph
+    rings: Array
+    k_idx: Array                # (R,) slot of each robot's new pose
+    scans: Array                # (R, padded_beams)
+    cand: Array                 # (R,) own-graph loop candidate index
+    attempt: Array              # (R,) bool own-graph closure attempts
+    xrobot: Array               # (R,) cross-robot candidate owner
+    xcand: Array                # (R,) cross-robot candidate pose index
+    xattempt: Array             # (R,) bool cross-robot attempts
+
+
+class _TickSense(NamedTuple):
+    """The sense/act/move/match/fuse half of one tick (steps 1-7),
+    composed from `_tick_move` / `_tick_est` / `_tick_map`. The
+    megabatch vmaps this half wholesale — its per-lane bit-stability
+    is exactly what bounds `tenancy.megabatch.EXACT_BUCKETS` (the
+    odometry rk2 and matcher fine-stage arithmetic vectorize with
+    different FMA/SIMD choices past that ladder, measured ~3e-10 est
+    drift at power-of-two tenant counts >= 4). The graph-growth half
+    (`_tick_graph`) is split out because ITS `pose_between` edge
+    arithmetic drifts (~1e-9) under a tenant vmap even at ladder
+    buckets in edge-heavy missions — the megabatch runs that half
+    per-lane under `lax.map` instead."""
+
+    sim2: thymio.FleetSimState
+    pol: PolicyOut
+    fr: F.FrontierResult
+    res: M.MatchResult
+    est: Array
+    is_key: Array
+    grid: Array
+    scans: Array
+
+
+class _TickMove(NamedTuple):
+    """Steps 1-3: sense, frontier-driven policy, simulated motion."""
+
+    sim2: thymio.FleetSimState
+    measured: Array             # (R, 2) measured wheel speeds
+    pol: PolicyOut
+    fr: F.FrontierResult
+    scans: Array
+
+
+def _tick_move(cfg: SlamConfig, state: FleetState, world_res_m: float,
+               world: Array) -> _TickMove:
     dt = 1.0 / cfg.robot.control_rate_hz
     n_samples = int(cfg.scan.range_max_m / (world_res_m * 0.5))
 
@@ -293,11 +353,22 @@ def fleet_step(cfg: SlamConfig, state: FleetState, world_res_m: float,
     # 3. Move the simulated fleet; read measured wheel speeds.
     sim2, measured = thymio.step_fleet(cfg.robot, state.sim,
                                        pol.targets.astype(jnp.float32), dt)
+    return _TickMove(sim2=sim2, measured=measured, pol=pol, fr=fr,
+                     scans=scans)
 
-    # 4. Odometry propagate estimates from measured speeds.
-    est = jax.vmap(lambda p, w: rk2_step(cfg.robot, p, w[0], w[1], dt))(
-        state.est_poses, measured)
 
+def _tick_est(cfg: SlamConfig, est_poses: Array,
+              measured: Array) -> Array:
+    """Step 4: odometry propagate estimates from measured speeds."""
+    dt = 1.0 / cfg.robot.control_rate_hz
+    return jax.vmap(lambda p, w: rk2_step(cfg.robot, p, w[0], w[1], dt))(
+        est_poses, measured)
+
+
+def _tick_map(cfg: SlamConfig, state: FleetState, est: Array,
+              scans: Array):
+    """Steps 5-7: key gate, correlative correction, fusion. Returns
+    (res, est, is_key, grid)."""
     # 5. Key-scan gate (slam_config.yaml:37-38): matching, fusion, and
     # graph growth only for robots that moved enough.
     d = jnp.linalg.norm(est[:, :2] - state.last_key_poses[:, :2], axis=-1)
@@ -313,50 +384,123 @@ def fleet_step(cfg: SlamConfig, state: FleetState, world_res_m: float,
     if cfg.mode == "localization":
         # Frozen-map mode (models/slam.slam_step's key_branch analog for
         # the batch path): the matcher's corrections stand, nothing
-        # fuses, graphs never grow, closures never fire. Static config
-        # -> the mapping machinery below is compiled out entirely.
+        # fuses. Graph growth and closures are compiled out in
+        # `_tick_graph`.
         grid = state.grid
-        graphs, rings = state.graphs, state.scan_rings
-        closed = jnp.zeros_like(is_key)
     else:
         # 7. Fuse this tick's key scans (masked batched fold, exact under
         # overlap; sub-gate robots add nothing).
         grid = G.fuse_scans_masked(cfg.grid, cfg.scan, state.grid, scans,
                                    est, is_key)
+    return res, est, is_key, grid
 
-        # 8. Pose graphs + loop closure.
-        graphs, rings, k_idx = _update_graphs(cfg, state.graphs, est,
-                                              is_key, scans,
-                                              state.scan_rings)
-        cand, cand_found = jax.vmap(
-            lambda g, q: PG.loop_candidate(cfg.loop, g, q))(graphs, k_idx)
-        attempt = is_key & cand_found & bool(cfg.loop.enabled)
-        # Cross-robot closure for key robots without an own candidate,
-        # gated on the robot being LOST: its narrow-window match against
-        # the shared map was rejected. A robot matching happily is
-        # already coupled to the fleet through the shared grid;
-        # cross-verification is the wide-window relocalization against a
-        # fleet-mate's chain for the drifted one.
-        xrobot, xcand, xfound = _cross_candidates(cfg, graphs, est)
-        xattempt = is_key & ~res.accepted & xfound & ~attempt & \
-            bool(cfg.loop.enabled) & bool(cfg.loop.cross_robot)
 
-        graphs, grid, est, closed = jax.lax.cond(
-            (attempt | xattempt).any(),
-            lambda args: _close_loops(cfg, *args),
-            lambda args: (args[0], args[1], args[3],
-                          jnp.zeros_like(attempt)),
-            (graphs, grid, rings, est, scans, k_idx, cand, attempt,
-             xrobot, xcand, xattempt))
+def _tick_sense(cfg: SlamConfig, state: FleetState, world_res_m: float,
+                world: Array) -> _TickSense:
+    """Steps 1-7 of the fleet tick: sense, frontier-driven policy,
+    move, odometry, key gate, correlative match, fusion."""
+    mv = _tick_move(cfg, state, world_res_m, world)
+    est = _tick_est(cfg, state.est_poses, mv.measured)
+    res, est, is_key, grid = _tick_map(cfg, state, est, mv.scans)
+    return _TickSense(sim2=mv.sim2, pol=mv.pol, fr=mv.fr, res=res,
+                      est=est, is_key=is_key, grid=grid,
+                      scans=mv.scans)
 
-    last_key = jnp.where(is_key[:, None], est, state.last_key_poses)
-    state2 = FleetState(sim=sim2, est_poses=est, grid=grid,
+
+def _tick_graph(cfg: SlamConfig, graphs: PG.PoseGraph, rings: Array,
+                est: Array, is_key: Array, scans: Array,
+                accepted: Array):
+    """Step 8, the graph-growth half of one tick: key-pose append +
+    odometry edges + ring updates + own/cross loop-closure candidates.
+    Returns (graphs, rings, k_idx, cand, attempt, xrobot, xcand,
+    xattempt); localization mode compiles the whole phase out (dead
+    zeros the caller never reads)."""
+    R = est.shape[0]
+    if cfg.mode == "localization":
+        zi = jnp.zeros((R,), jnp.int32)
+        zb = jnp.zeros((R,), bool)
+        return graphs, rings, zi, zi, zb, zi, zi, zb
+
+    graphs, rings, k_idx = _update_graphs(cfg, graphs, est, is_key,
+                                          scans, rings)
+    cand, cand_found = jax.vmap(
+        lambda g, q: PG.loop_candidate(cfg.loop, g, q))(graphs, k_idx)
+    attempt = is_key & cand_found & bool(cfg.loop.enabled)
+    # Cross-robot closure for key robots without an own candidate,
+    # gated on the robot being LOST: its narrow-window match against
+    # the shared map was rejected. A robot matching happily is
+    # already coupled to the fleet through the shared grid;
+    # cross-verification is the wide-window relocalization against a
+    # fleet-mate's chain for the drifted one.
+    xrobot, xcand, xfound = _cross_candidates(cfg, graphs, est)
+    xattempt = is_key & ~accepted & xfound & ~attempt & \
+        bool(cfg.loop.enabled) & bool(cfg.loop.cross_robot)
+    return (graphs, rings, k_idx, cand, attempt, xrobot, xcand,
+            xattempt)
+
+
+def _tick_pre(cfg: SlamConfig, state: FleetState, world_res_m: float,
+              world: Array) -> _TickPre:
+    """Steps 1-8 of the fleet tick up to (but excluding) the closure
+    cond; trace-identical to the historical `fleet_step` prefix."""
+    sense = _tick_sense(cfg, state, world_res_m, world)
+    (graphs, rings, k_idx, cand, attempt, xrobot, xcand,
+     xattempt) = _tick_graph(cfg, state.graphs, state.scan_rings,
+                             sense.est, sense.is_key, sense.scans,
+                             sense.res.accepted)
+    return _TickPre(sim2=sense.sim2, pol=sense.pol, fr=sense.fr,
+                    match_response=sense.res.response, est=sense.est,
+                    is_key=sense.is_key, grid=sense.grid,
+                    graphs=graphs, rings=rings, k_idx=k_idx,
+                    scans=sense.scans, cand=cand, attempt=attempt,
+                    xrobot=xrobot, xcand=xcand, xattempt=xattempt)
+
+
+def _tick_finish(cfg: SlamConfig, state: FleetState, pre: _TickPre,
+                 grid: Array, graphs: PG.PoseGraph, est: Array,
+                 closed: Array) -> tuple[FleetState, FleetDiag]:
+    """Fold the (possibly closure-repaired) results back into the next
+    FleetState + FleetDiag; trace-identical to the historical
+    `fleet_step` suffix."""
+    last_key = jnp.where(pre.is_key[:, None], est, state.last_key_poses)
+    state2 = FleetState(sim=pre.sim2, est_poses=est, grid=grid,
                         exploring=state.exploring, last_key_poses=last_key,
-                        graphs=graphs, scan_rings=rings,
+                        graphs=graphs, scan_rings=pre.rings,
                         n_loops=state.n_loops + closed.astype(jnp.int32),
                         t=state.t + 1)
-    diag = FleetDiag(policy=pol, frontiers=fr, match_response=res.response,
+    diag = FleetDiag(policy=pre.pol, frontiers=pre.fr,
+                     match_response=pre.match_response,
                      pose_err=jnp.linalg.norm(
-                         est[:, :2] - sim2.poses[:, :2], axis=-1),
-                     is_key=is_key, loop_closed=closed)
+                         est[:, :2] - pre.sim2.poses[:, :2], axis=-1),
+                     is_key=pre.is_key, loop_closed=closed)
     return state2, diag
+
+
+def _fleet_step_impl(cfg: SlamConfig, state: FleetState,
+                     world_res_m: float, world: Array
+                     ) -> tuple[FleetState, FleetDiag]:
+    """The un-jitted fleet tick: pre -> batch-level closure cond ->
+    finish. `fleet_step` jits it; the tenant megabatch vmaps the pre/
+    finish halves and hoists the cond above the tenant axis."""
+    ensure_valid_mode(cfg)
+    pre = _tick_pre(cfg, state, world_res_m, world)
+    if cfg.mode == "localization":
+        grid, graphs, est = pre.grid, pre.graphs, pre.est
+        closed = jnp.zeros_like(pre.is_key)
+    else:
+        graphs, grid, est, closed = jax.lax.cond(
+            (pre.attempt | pre.xattempt).any(),
+            lambda args: _close_loops(cfg, *args),
+            lambda args: (args[0], args[1], args[3],
+                          jnp.zeros_like(pre.attempt)),
+            (pre.graphs, pre.grid, pre.rings, pre.est, pre.scans,
+             pre.k_idx, pre.cand, pre.attempt, pre.xrobot, pre.xcand,
+             pre.xattempt))
+    return _tick_finish(cfg, state, pre, grid, graphs, est, closed)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def fleet_step(cfg: SlamConfig, state: FleetState, world_res_m: float,
+               world: Array) -> tuple[FleetState, FleetDiag]:
+    """One synchronous fleet tick (the reference's 10 Hz loop, batched)."""
+    return _fleet_step_impl(cfg, state, world_res_m, world)

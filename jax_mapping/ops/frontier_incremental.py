@@ -96,7 +96,7 @@ def _next_pow2(x: int) -> int:
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def _refresh_tiles(fcfg: FrontierConfig, grid_cfg: GridConfig,
                    tile_cells: int, logodds: Array, free: Array, occ: Array,
-                   unknown: Array, tile_rc: Array, valid: Array):
+                   unknown: Array, stale, tile_rc: Array, valid: Array):
     """Re-coarsen the (bucket-padded) dirty tiles into the persistent
     coarse-mask buffers; one jitted dispatch per bucket size.
 
@@ -107,11 +107,17 @@ def _refresh_tiles(fcfg: FrontierConfig, grid_cfg: GridConfig,
     input). Field-carry validity is NOT judged from per-tile flags: the
     BFS blocked mask depends on the frontier mask as well as occupancy,
     so `_field_mode` compares the actual crop blocked masks instead.
+
+    `stale` is the persistent HEALED/STALE coarse mask (decay-aware
+    scoring, ROADMAP item 7c): with `fcfg.decay_aware` it re-pools
+    from the raw log-odds tile-locally exactly like the other masks —
+    `stale_mask` is a tile-local block pool, so per-tile refresh is
+    exact — and None otherwise (nothing computed, nothing carried).
     """
     tcc = tile_cells // fcfg.downsample
 
     def body(m, carry):
-        free, occ, unknown, obs = carry
+        free, occ, unknown, stale, obs = carry
         tr = tile_rc[m]
         of = (tr[0] * tile_cells, tr[1] * tile_cells)
         oc = (tr[0] * tcc, tr[1] * tcc)
@@ -127,23 +133,32 @@ def _refresh_tiles(fcfg: FrontierConfig, grid_cfg: GridConfig,
         free = jax.lax.dynamic_update_slice(free, f, oc)
         occ = jax.lax.dynamic_update_slice(occ, o, oc)
         unknown = jax.lax.dynamic_update_slice(unknown, u, oc)
+        if fcfg.decay_aware:
+            st = F.stale_mask(fcfg, grid_cfg, patch)
+            cs = jax.lax.dynamic_slice(stale, oc, (tcc, tcc))
+            st = jnp.where(v, st, cs)
+            stale = jax.lax.dynamic_update_slice(stale, st, oc)
         obs = obs.at[m].set(v & (~u).any())
-        return free, occ, unknown, obs
+        return free, occ, unknown, stale, obs
 
     obs = jnp.zeros(valid.shape, bool)
     return jax.lax.fori_loop(0, tile_rc.shape[0], body,
-                             (free, occ, unknown, obs))
+                             (free, occ, unknown, stale, obs))
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def _refresh_full(fcfg: FrontierConfig, grid_cfg: GridConfig,
-                  tile_cells: int, logodds: Array):
+                  tile_cells: int, logodds: Array, stale):
     """Dense-dirt fallback: one full-grid coarsen + per-tile observed
     flags (occupancy growth is not tracked here — the caller treats a
-    full refresh as warm-start-invalidating, the conservative stance)."""
+    full refresh as warm-start-invalidating, the conservative stance).
+    With `fcfg.decay_aware` the stale mask re-pools full-grid too;
+    otherwise the None input passes through untouched."""
     free, occ, unknown = F.coarsen(fcfg, grid_cfg, logodds)
+    if fcfg.decay_aware:
+        stale = F.stale_mask(fcfg, grid_cfg, logodds)
     obs = F._pool_any(~unknown, tile_cells // fcfg.downsample)
-    return free, occ, unknown, obs
+    return free, occ, unknown, stale, obs
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
@@ -166,33 +181,45 @@ def _crop_blocked(fcfg: FrontierConfig, grid_cfg: GridConfig, span: int,
     return ~bfs_passable
 
 
+def _crop_stale(fcfg: FrontierConfig, stale, origin_rc: Array,
+                span: int):
+    """The stale-mask crop for decay-aware scoring (None when the knob
+    is off — `compute_frontiers_from_masks` then skips the discount
+    with a bit-identical trace)."""
+    if not fcfg.decay_aware or stale is None:
+        return None
+    return jax.lax.dynamic_slice(stale, (origin_rc[0], origin_rc[1]),
+                                 (span, span))
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def _compute_crop(fcfg: FrontierConfig, grid_cfg: GridConfig, span: int,
-                  free: Array, unknown: Array, origin_rc: Array,
+                  free: Array, unknown: Array, stale, origin_rc: Array,
                   poses: Array):
     f = jax.lax.dynamic_slice(free, (origin_rc[0], origin_rc[1]),
                               (span, span))
     u = jax.lax.dynamic_slice(unknown, (origin_rc[0], origin_rc[1]),
                               (span, span))
-    return F.compute_frontiers_from_masks(fcfg, grid_cfg, f, u, poses,
-                                          origin_rc=origin_rc,
-                                          return_fields=True)
+    return F.compute_frontiers_from_masks(
+        fcfg, grid_cfg, f, u, poses, origin_rc=origin_rc,
+        return_fields=True,
+        stale=_crop_stale(fcfg, stale, origin_rc, span))
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def _compute_crop_warm(fcfg: FrontierConfig, grid_cfg: GridConfig,
                        span: int, warm_iters: int, free: Array,
-                       unknown: Array, origin_rc: Array, poses: Array,
-                       prev_fields: Array):
+                       unknown: Array, stale, origin_rc: Array,
+                       poses: Array, prev_fields: Array):
     f = jax.lax.dynamic_slice(free, (origin_rc[0], origin_rc[1]),
                               (span, span))
     u = jax.lax.dynamic_slice(unknown, (origin_rc[0], origin_rc[1]),
                               (span, span))
-    return F.compute_frontiers_from_masks(fcfg, grid_cfg, f, u, poses,
-                                          origin_rc=origin_rc,
-                                          warm_fields=prev_fields,
-                                          warm_iters=warm_iters,
-                                          return_fields=True)
+    return F.compute_frontiers_from_masks(
+        fcfg, grid_cfg, f, u, poses, origin_rc=origin_rc,
+        warm_fields=prev_fields, warm_iters=warm_iters,
+        return_fields=True,
+        stale=_crop_stale(fcfg, stale, origin_rc, span))
 
 
 class IncrementalFrontierPipeline:
@@ -237,6 +264,15 @@ class IncrementalFrontierPipeline:
         self._free = jnp.zeros((self._n, self._n), bool)
         self._occ = jnp.zeros((self._n, self._n), bool)
         self._unknown = jnp.ones((self._n, self._n), bool)
+        # Decay-aware scoring (ROADMAP item 7c): the HEALED/STALE mask
+        # is carried tile-incrementally like the other coarse masks —
+        # `stale_mask` is a tile-local block pool of the raw log-odds,
+        # and a decay pass bumps every tile's revision, so staleness
+        # can never go out of date against the tile cache. None when
+        # the knob is off: nothing computed, bit-identical pre-7c
+        # traces.
+        self._stale = (jnp.zeros((self._n, self._n), bool)
+                       if fcfg.decay_aware else None)
         self._seen_rev = np.full((self._nt, self._nt), -1, np.int64)
         self._tile_observed = np.zeros((self._nt, self._nt), bool)
         self._extra_key = None
@@ -356,8 +392,9 @@ class IncrementalFrontierPipeline:
         if ndirty:
             logodds = jnp.asarray(logodds)
             if ndirty >= max(1, int(dirty.size * _DENSE_DIRTY_FRAC)):
-                self._free, self._occ, self._unknown, obs = _refresh_full(
-                    fcfg, g, self.tile_cells, logodds)
+                (self._free, self._occ, self._unknown, self._stale,
+                 obs) = _refresh_full(
+                    fcfg, g, self.tile_cells, logodds, self._stale)
                 # np.array (copy): np.asarray of a device array is a
                 # read-only view, and the sparse path writes into this.
                 self._tile_observed = np.array(obs)
@@ -371,11 +408,11 @@ class IncrementalFrontierPipeline:
                     idx = np.concatenate(
                         [idx, np.zeros((pad, 2), np.int32)], axis=0)
                 valid = np.arange(m_b) < ndirty
-                (self._free, self._occ, self._unknown,
+                (self._free, self._occ, self._unknown, self._stale,
                  obs_f) = _refresh_tiles(
                      fcfg, g, self.tile_cells, logodds, self._free,
-                     self._occ, self._unknown, jnp.asarray(idx),
-                     jnp.asarray(valid))
+                     self._occ, self._unknown, self._stale,
+                     jnp.asarray(idx), jnp.asarray(valid))
                 self._tile_observed[dirty] = np.asarray(obs_f)[:ndirty]
                 self.compiled_shapes.add(("refresh", m_b))
             self._seen_rev = np.where(dirty, tile_rev, self._seen_rev)
@@ -420,14 +457,15 @@ class IncrementalFrontierPipeline:
                 self.compiled_shapes.add(("warmsub", m_b, span))
             fr, fields, blocked_out = _compute_crop_warm(
                 fcfg, g, span, 0, self._free, self._unknown,
-                origin, poses_d, carried)
+                self._stale, origin, poses_d, carried)
             self.n_warm_starts += 1
             if mode == "reuse":
                 self.n_field_reuses += 1
             self.compiled_shapes.add(("crop", span, 0))
         else:
             fr, fields, blocked_out = _compute_crop(
-                fcfg, g, span, self._free, self._unknown, origin, poses_d)
+                fcfg, g, span, self._free, self._unknown, self._stale,
+                origin, poses_d)
             self.compiled_shapes.add(("crop", span, "cold"))
         if mode != "reuse":
             self._field_cells = cells // fcfg.cluster_downsample
@@ -518,6 +556,12 @@ class IncrementalFrontierPipeline:
         """(free, occupied, unknown) persistent device buffers — parity
         tests compare them against a full-grid coarsen."""
         return self._free, self._occ, self._unknown
+
+    def stale(self):
+        """The carried HEALED/STALE coarse mask (decay-aware scoring),
+        or None when `decay_aware` is off — parity tests compare it
+        against a full-grid `frontier.stale_mask`."""
+        return self._stale
 
     def status(self) -> dict:
         """Lock-free observability snapshot (/status `frontier` object)."""

@@ -125,27 +125,39 @@ class StagedWarmup:
 
     # -- pre-warm ------------------------------------------------------------
 
-    def prewarm(self, signatures: Optional[Dict[str, list]] = None
-                ) -> dict:
+    def prewarm(self, signatures: Optional[Dict[str, list]] = None,
+                force: bool = False, manifest: bool = True) -> dict:
         """Warm the captured entry points in priority order and run the
         readiness gate. `signatures` maps qualified names to captured
         abstract signatures (the dispatch profiler's live capture, or
         the snapshot manifest's persisted ones); the cache manager's
-        loaded pool supplies AOT entries on top. Returns the report
-        (also kept for `snapshot()`). Never raises — per-signature
-        failures are counted and the ladder degrades."""
+        loaded pool supplies AOT entries on top. `force` warms the
+        EXPLICITLY-passed names even when their functions already hold
+        compiled variants — the tenant control plane's admission case,
+        where a NEW bucket shape of an already-warm entry point must
+        compile before the tenant joins (an already-compiled signature
+        is a cheap jit-cache hit, so forcing never recompiles). Names
+        that came only from the AOT manifest keep the in-process
+        short-circuit regardless: forcing them would re-execute every
+        persisted signature per admission. `manifest=False` skips the
+        AOT-manifest merge entirely and warms ONLY the passed
+        signatures — the tenant admission case again, where one new
+        bucket variant must not drag the whole persisted warm sweep
+        behind it (the restart path keeps the full merge). Returns
+        the report (also kept for `snapshot()`). Never raises —
+        per-signature failures are counted and the ladder degrades."""
         from jax_mapping.io.compile_cache import (materialize_zeros,
                                                   resolve_entry_point)
         t0 = time.perf_counter()
         baseline_sizes = self._cache_sizes()
         sigs: Dict[str, list] = {}
         pool_names = []
-        if self.cache is not None:
-            manifest = self.cache.load_aot()
-            for name, ss in manifest["signatures"].items():
+        if self.cache is not None and manifest:
+            loaded = self.cache.load_aot()
+            for name, ss in loaded["signatures"].items():
                 sigs.setdefault(name, []).extend(ss)
-            pool_names = manifest["pool_names"]
-            if manifest["n_loaded"] and not self.cache.pool.installed:
+            pool_names = loaded["pool_names"]
+            if loaded["n_loaded"] and not self.cache.pool.installed:
                 self.cache.pool.install()
         for name, ss in (signatures or {}).items():
             for s in ss:
@@ -159,8 +171,9 @@ class StagedWarmup:
                 warmed.append((name, "error"))
                 n_errors += 1
                 continue
+            forced = force and name in (signatures or {})
             try:
-                already = int(fn._cache_size()) > 0
+                already = not forced and int(fn._cache_size()) > 0
             except Exception:                       # noqa: BLE001
                 already = False
             if already:

@@ -1,0 +1,31 @@
+"""Mission multi-tenancy: thousands of concurrent worlds on one
+accelerator (ROADMAP item 4).
+
+"Millions of users" is not one giant fleet — it is MANY independent
+missions, each tiny relative to the accelerator. This package adds a
+TENANT axis to the mission hot path and a control plane to feed it:
+
+* :mod:`jax_mapping.tenancy.megabatch` — the :class:`TenantBatch`
+  pytree (independent mission states stacked along a leading,
+  pow2-bucketed tenant dimension) and ONE jitted ``megabatch_step``
+  that vmaps the existing `models/fleet` tick over that axis, so N
+  missions cost one dispatch chain per tick instead of N.
+* :mod:`jax_mapping.tenancy.controlplane` — admit / suspend / resume /
+  evict for missions, bucket growth/shrink, admission pre-warm through
+  the ISSUE 12 staged-warm-up ladder, eviction checkpoints through the
+  generation-retention machinery, and per-tenant serving
+  epoch/revision namespaces for `/tiles` delta sessions.
+
+Bit-identity is the contract: a tenant's trajectory inside a megabatch
+equals its solo `fleet_step` trajectory bit-for-bit — same seed, any
+bucket size, any co-tenants (tests/test_tenancy.py).
+"""
+
+from jax_mapping.tenancy.megabatch import (TenantBatch, bucket_capacity,
+                                           make_tenant_batch,
+                                           megabatch_step,
+                                           megabatch_tick)
+from jax_mapping.tenancy.controlplane import TenantControlPlane
+
+__all__ = ["TenantBatch", "bucket_capacity", "make_tenant_batch",
+           "megabatch_step", "megabatch_tick", "TenantControlPlane"]

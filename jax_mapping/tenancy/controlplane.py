@@ -1,0 +1,575 @@
+"""Tenant control plane: admit / suspend / resume / evict missions.
+
+The megabatch (`tenancy/megabatch.py`) makes N missions cost one
+dispatch chain per tick; this module is the host-side plane that feeds
+it over a mission's whole LIFETIME:
+
+* **admit** — a mission joins the batch. When the post-admission
+  bucket has no compiled variant yet, admission first pre-warms it
+  through the ISSUE 12 `StagedWarmup` ladder (ROADMAP item 7b
+  pairing): the warm call runs on a throwaway zeros batch, rides any
+  armed AOT-snapshot / persistent-compile-cache tiers, runs the
+  readiness gate against `analysis/compile_budget.json`, and
+  re-baselines the dispatch profiler so warmed variants never count as
+  live recompiles. Only then does the tenant join — an admission can
+  never stall the live batch behind a compile.
+* **suspend / resume** — a suspended tenant's state is held host-side
+  and the batch COMPACTS (bucket shrink when a smaller bucket fits):
+  suspended tenants are never ticked as eternal pad slots. Resume
+  re-admits the held state and bumps the tenant's serving epoch.
+* **evict** — the mission leaves for good; its final state checkpoints
+  through the existing generation-retention machinery
+  (`io/checkpoint.save_checkpoint`), so an evicted tenant can be
+  re-admitted later from disk like a supervisor resume.
+
+Each tenant owns a serving **epoch/revision namespace**: `revision`
+advances once per ticked step, `epoch` bumps on every (re-)admission —
+the restart-epoch contract per mission, so `/tiles?tenant=` delta
+sessions key cache validity on (epoch, revision) and a resumed
+mission can never 304 a stale pre-suspend tile as current
+(`tile_store`).
+
+Thread contract: the mission registry, slot order and live batch
+mutate only under `_lock` (declared in `analysis/protection.py`,
+racewatch-gated over cross-thread admit/evict); flight-recorder
+events emit AFTER the lock releases (the StagedWarmup `_move`
+discipline), and counters are read lock-free by the /status
+convention.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import SlamConfig
+from jax_mapping.models import fleet as FM
+from jax_mapping.tenancy import megabatch as MB
+
+#: The megabatch entry point's registry-qualified name (the devprof /
+#: compile-budget naming contract).
+MEGABATCH_ENTRY = "jax_mapping.tenancy.megabatch.megabatch_step"
+
+
+class _Mission:
+    """One tenant's host-side record (mutated only under the plane's
+    `_lock`)."""
+
+    __slots__ = ("tid", "seed", "epoch", "revision", "state", "world",
+                 "dynamics", "steps", "held_state", "key")
+
+    def __init__(self, tid: str, seed: int, world, key,
+                 dynamics=None):
+        self.tid = tid
+        self.seed = seed
+        self.epoch = -1            # first admit bumps to 0
+        self.revision = 0
+        self.state = "new"         # active | suspended | evicted
+        self.world = world
+        self.dynamics = dynamics
+        self.steps = 0
+        self.held_state: Optional[FM.FleetState] = None
+        self.key = key
+
+
+class TenantControlPlane:
+    """Admit/evict/suspend for megabatched missions on one config."""
+
+    def __init__(self, cfg: SlamConfig, world_res_m: Optional[float] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 compile_cache=None, devprof=None):
+        self.cfg = cfg
+        self.world_res_m = (cfg.grid.resolution_m if world_res_m is None
+                            else world_res_m)
+        self.checkpoint_dir = checkpoint_dir
+        from jax_mapping.resilience.warmup import StagedWarmup
+        #: Admission pre-warm rides the warm-restart ladder: AOT pool /
+        #: persistent cache when armed, cold compile otherwise, plus
+        #: the compile-budget readiness gate and devprof rebaseline.
+        self.warmup = StagedWarmup(cache=compile_cache, devprof=devprof)
+        self._lock = threading.Lock()
+        self._missions: Dict[str, _Mission] = {}
+        #: Active lane order: lane i of the batch is mission
+        #: `_order[i]`; pad lanes (i >= len(_order)) are inactive.
+        self._order: List[str] = []
+        #: Lane order the live batch was last stacked under — how
+        #: `_rebuild` carries surviving lanes across admit/evict/
+        #: suspend churn.
+        self._prev_order: List[str] = []
+        self._batch: Optional[MB.TenantBatch] = None
+        self._last_diag = None
+        self._warmed_buckets: set = set()
+        # Observability (lock-free /status counter convention).
+        self.n_admitted = 0
+        self.n_evicted = 0
+        self.n_suspended = 0
+        self.n_resumed = 0
+        self.n_prewarms = 0
+        self.n_ticks = 0
+        self.n_compactions = 0
+        self._tile_stores: Dict[str, object] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def admit(self, tid: str, world, seed: int = 0,
+              state: Optional[FM.FleetState] = None,
+              dynamics=None) -> None:
+        """A mission joins the batch. `world` is the tenant's ground
+        truth (all tenants share one world SHAPE — the batch stacks
+        it); `state` resumes from a given FleetState (eviction
+        re-admission), otherwise the mission initialises from its
+        seed. Pre-warms the post-admission bucket variant first when
+        it has not compiled yet."""
+        world = jnp.asarray(world)
+        key = jax.random.PRNGKey(seed)
+        if state is None:
+            state = FM.init_fleet_state(self.cfg, key)
+        with self._lock:
+            if tid in self._missions \
+                    and self._missions[tid].state in ("active",
+                                                      "suspended"):
+                # Suspended tenants hold un-checkpointed state;
+                # resume() is the sanctioned path back — an admit here
+                # would silently reinitialise and destroy it.
+                raise ValueError(
+                    f"tenant {tid!r} is "
+                    f"{self._missions[tid].state}; use resume()")
+            n_next = len(self._order) + 1
+            bucket = MB.bucket_capacity(
+                n_next, self.cfg.tenancy.max_tenants,
+                exact=self.cfg.tenancy.bit_exact_buckets)
+        prewarmed = self._prewarm_bucket(bucket, state, world)
+        with self._lock:
+            # Re-check under the COMMIT lock: the pre-warm ran outside
+            # it, so a racing admit of the same tid (or one that grew
+            # the batch past the ladder) must lose here, not corrupt
+            # the registry.
+            existing = self._missions.get(tid)
+            if existing is not None and existing.state in (
+                    "active", "suspended"):
+                raise ValueError(
+                    f"tenant {tid!r} is {existing.state}; lost the "
+                    "admission race")
+            order2 = self._order + [tid]
+            # Rebuild BEFORE any registry mutation: bucket_capacity
+            # revalidation and the world-shape stack can both raise,
+            # and a failed admission must leave the plane untouched
+            # (no half-admitted tenant over a stale batch).
+            batch2, prev2, compacted = self._rebuilt(
+                order2, extra={tid: (state, world, key)})
+            m = existing
+            if m is None:
+                m = _Mission(tid, seed, world, key, dynamics=dynamics)
+                self._missions[tid] = m
+            m.seed = seed
+            m.world = world
+            m.key = key
+            if dynamics is not None:
+                m.dynamics = dynamics
+            m.epoch += 1
+            if existing is not None:
+                # Re-admission: epoch bump ⇒ revision bump, so an
+                # (epoch, revision) ETag pair can never recur with
+                # different content — a client's pre-eviction ETag
+                # cannot 304 against the re-admitted mission's tiles
+                # even if it races the store swap. (A brand-new
+                # mission has no prior ETags to collide with.)
+                m.revision += 1
+            m.state = "active"
+            m.held_state = None
+            self._order = order2
+            self._batch = batch2
+            self._prev_order = prev2
+            if compacted:
+                self.n_compactions += 1
+            self.n_admitted += 1
+            epoch = m.epoch
+            self._tile_stores.pop(tid, None)
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("tenancy_admit", tenant=tid, seed=seed,
+                               epoch=epoch, bucket=bucket,
+                               prewarmed=prewarmed)
+
+    def suspend(self, tid: str) -> None:
+        """Remove a tenant from the batch, holding its state host-side;
+        the batch compacts (bucket shrink when a smaller bucket fits)
+        instead of ticking the slot as a pad forever."""
+        with self._lock:
+            m = self._require(tid, "active")
+            held = self._lane_state_locked(tid)
+            order2 = [t for t in self._order if t != tid]
+            batch2, prev2, compacted = self._rebuilt(order2)
+            m.held_state = held
+            m.state = "suspended"
+            self._order = order2
+            self._batch = batch2
+            self._prev_order = prev2
+            if compacted:
+                self.n_compactions += 1
+            self.n_suspended += 1
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("tenancy_suspend", tenant=tid)
+
+    def resume(self, tid: str) -> None:
+        """Re-admit a suspended tenant from its held state; its serving
+        epoch bumps (the per-mission restart-epoch contract)."""
+        with self._lock:
+            m = self._require(tid, "suspended")
+            held, world, key = m.held_state, m.world, m.key
+            bucket = MB.bucket_capacity(
+                len(self._order) + 1, self.cfg.tenancy.max_tenants,
+                exact=self.cfg.tenancy.bit_exact_buckets)
+        prewarmed = self._prewarm_bucket(bucket, held, world)
+        with self._lock:
+            # Re-require SUSPENDED under the commit lock: a concurrent
+            # evict() between the read above and here must win — a
+            # resume that re-activated from the pre-evict snapshot
+            # would silently undo the eviction (and contradict its
+            # checkpoint + flight event).
+            m = self._require(tid, "suspended")
+            order2 = self._order + [tid]
+            batch2, prev2, compacted = self._rebuilt(
+                order2, extra={tid: (held, world, key)})
+            m.epoch += 1
+            m.revision += 1      # the admit() epoch⇒revision contract
+            m.state = "active"
+            m.held_state = None
+            self._order = order2
+            self._batch = batch2
+            self._prev_order = prev2
+            if compacted:
+                self.n_compactions += 1
+            self.n_resumed += 1
+            epoch = m.epoch
+            self._tile_stores.pop(tid, None)
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("tenancy_resume", tenant=tid,
+                               epoch=epoch, bucket=bucket,
+                               prewarmed=prewarmed)
+
+    def evict(self, tid: str, checkpoint: Optional[bool] = None) -> Optional[str]:
+        """A mission leaves for good: its final state checkpoints
+        through the generation-retention machinery (when a checkpoint
+        dir is configured) and its lane compacts out. Returns the
+        checkpoint path, if one was written."""
+        if checkpoint is None:
+            checkpoint = self.cfg.tenancy.checkpoint_on_evict
+        with self._lock:
+            m = self._require(tid, ("active", "suspended"))
+            if m.state == "active":
+                final = self._lane_state_locked(tid)
+                order2 = [t for t in self._order if t != tid]
+                batch2, prev2, compacted = self._rebuilt(order2)
+                self._order = order2
+                self._batch = batch2
+                self._prev_order = prev2
+                if compacted:
+                    self.n_compactions += 1
+            else:
+                final = m.held_state
+            m.held_state = None
+            m.state = "evicted"
+            # Free the heavy references: a long-lived plane churning
+            # through many distinct tenant ids must not pin one world
+            # array per lifetime eviction. The record itself stays as
+            # a tombstone — epoch continuity across a later
+            # re-admission is a serving-correctness fact.
+            m.world = None
+            m.dynamics = None
+            self.n_evicted += 1
+            self._tile_stores.pop(tid, None)
+        path = None
+        if checkpoint and self.checkpoint_dir is not None:
+            from jax_mapping.io.checkpoint import save_checkpoint
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            path = os.path.join(self.checkpoint_dir,
+                                f"tenant_{tid}.ckpt")
+            save_checkpoint(
+                path, final, config_json=self.cfg.to_json(),
+                retain_generations=(
+                    self.cfg.resilience.checkpoint_retain_generations))
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("tenancy_evict", tenant=tid,
+                               checkpointed=path is not None)
+        return path
+
+    # -- stepping ------------------------------------------------------------
+
+    def step(self, n: int = 1):
+        """Advance every active tenant `n` ticks (one megabatch
+        dispatch chain per tick). Returns the last tick's FleetDiag
+        (leading tenant axis; inactive lanes meaningless), or None
+        when no tenant is active.
+
+        The tick runs under `_lock` (the MapperNode _state_lock
+        precedent: device work inside the guarded section), so
+        concurrent /status, /metrics and tile snapshots stall up to
+        one tick — bounded by the megabatch dispatch plus any closure
+        re-runs. A finer-grained scheme (tick a snapshot outside the
+        lock, reconcile admissions on install) is a known follow-up,
+        not a correctness issue."""
+        diag = None
+        for _ in range(n):
+            with self._lock:
+                if not self._order:
+                    return None
+                refreshed = self._refreshed_worlds()
+                if refreshed is not None:
+                    self._batch = self._batch._replace(
+                        worlds=refreshed)
+                batch = self._batch
+                self._batch, diag = MB.megabatch_tick(
+                    self.cfg, batch, self.world_res_m)
+                for tid in self._order:
+                    m = self._missions[tid]
+                    m.revision += 1
+                    m.steps += 1
+                self._last_diag = diag
+                self.n_ticks += 1
+        return diag
+
+    def _refreshed_worlds(self):
+        """The batch's worlds array with any changed-geometry tenant
+        rows re-uploaded (the SimNode `world_if_changed` idiom), or
+        None when nothing changed. Pure reader + mission-record
+        updates; the caller installs the result under `_lock`."""
+        worlds = None
+        for i, tid in enumerate(self._order):
+            m = self._missions[tid]
+            if m.dynamics is None:
+                continue
+            w = m.dynamics.world_if_changed(m.steps)
+            if w is None:
+                continue
+            m.world = jnp.asarray(w)
+            worlds = (self._batch.worlds if worlds is None else worlds)
+            worlds = worlds.at[i].set(m.world)
+        return worlds
+
+    # -- state access --------------------------------------------------------
+
+    def live_batch(self) -> Optional[MB.TenantBatch]:
+        """The current device batch (None when no tenant is active) —
+        the bench/test device-barrier handle."""
+        with self._lock:
+            return self._batch
+
+    def tenant_state(self, tid: str) -> FM.FleetState:
+        """The tenant's current FleetState — its live lane when
+        active, the held state when suspended."""
+        with self._lock:
+            m = self._missions[tid]
+            if m.state == "active":
+                return self._lane_state_locked(tid)
+            if m.held_state is not None:
+                return m.held_state
+            raise ValueError(f"tenant {tid!r} is {m.state}; no state held")
+
+    def tenant_grid(self, tid: str):
+        return self.tenant_state(tid).grid
+
+    def epoch(self, tid: str) -> int:
+        with self._lock:
+            return self._missions[tid].epoch
+
+    def revision(self, tid: str) -> int:
+        with self._lock:
+            return self._missions[tid].revision
+
+    def tile_store(self, tid: str):
+        """Per-tenant serving TileStore (lazily built): the tenant's
+        grid rendered through the ordinary `to_gray` path, revisioned
+        by the tenant's OWN (epoch, revision) namespace — `/tiles?
+        tenant=` delta sessions stay per-mission correct across
+        co-tenant churn and suspend/resume cycles."""
+        with self._lock:
+            store = self._tile_stores.get(tid)
+            if store is None:
+                # Validate BEFORE constructing anything: this sits on
+                # the public /tiles?tenant= surface, and caching a
+                # store per unknown/evicted id would let a client loop
+                # over bogus ids and grow the dict without bound.
+                self._require(tid, ("active", "suspended"))
+        if store is not None:
+            return store
+        from jax_mapping.ops import grid as G
+        from jax_mapping.serving.tiles import TileStore
+
+        def _revision() -> int:
+            return self.revision(tid)
+
+        def _snapshot():
+            with self._lock:
+                m = self._missions[tid]
+                if m.state == "evicted" or (
+                        m.state != "active" and m.held_state is None):
+                    raise ValueError(
+                        f"tenant {tid!r} is {m.state}; nothing to serve")
+                # Revision BEFORE content (the serving-snapshot
+                # ordering): both reads sit in one lock section here,
+                # but the order still documents the contract.
+                rev = m.revision
+                grid = (self._lane_state_locked(tid).grid
+                        if m.state == "active" else m.held_state.grid)
+            gray = np.asarray(G.to_gray(self.cfg.grid, grid))
+            return rev, gray, None
+
+        store = TileStore(self.cfg.serving, f"tenant:{tid}",
+                          _revision, _snapshot)
+        with self._lock:
+            # First builder wins under concurrent HTTP readers.
+            store = self._tile_stores.setdefault(tid, store)
+        return store
+
+    # -- internals -----------------------------------------------------------
+
+    def _require(self, tid: str, states) -> _Mission:
+        m = self._missions.get(tid)
+        if m is None:
+            raise KeyError(f"unknown tenant {tid!r}")
+        allowed = (states,) if isinstance(states, str) else states
+        if m.state not in allowed:
+            raise ValueError(
+                f"tenant {tid!r} is {m.state}, need {allowed}")
+        return m
+
+    def _lane_state_locked(self, tid: str) -> FM.FleetState:
+        i = self._order.index(tid)
+        return MB.lane_state(self._batch, i)
+
+    def _rebuilt(self, order, extra: Optional[dict] = None):
+        """(batch, prev_order, compacted) re-stacked for `order` —
+        lanes already in the old batch slice out of it, `extra` maps
+        not-yet-registered tids to their (state, world, key). Pure
+        compute that can RAISE (ladder/ceiling refusal, world-shape
+        mismatch) without touching any plane state: callers install
+        the triple — and only then mutate the registry — under their
+        own `with self._lock` block, so a failed rebuild rolls back to
+        exactly the prior plane and every guarded-field write sits
+        lexically inside a lock region (the B3 discipline)."""
+        old_cap = (0 if self._batch is None
+                   else int(self._batch.active.shape[0]))
+        if not order:
+            return None, [], old_cap > 0
+        states, worlds, keys = [], [], []
+        for tid in order:
+            if extra is not None and tid in extra:
+                s, w, k = extra[tid]
+            else:
+                m = self._missions[tid]
+                s, w, k = self._old_lane(tid), m.world, m.key
+            states.append(s)
+            worlds.append(w)
+            keys.append(k)
+        cap = MB.bucket_capacity(len(order),
+                                 self.cfg.tenancy.max_tenants,
+                                 exact=self.cfg.tenancy.bit_exact_buckets)
+        batch = MB.make_tenant_batch(states, worlds, keys,
+                                     capacity=cap)
+        return batch, list(order), cap < old_cap
+
+    def _old_lane(self, tid: str) -> FM.FleetState:
+        if self._batch is None or tid not in self._prev_order:
+            raise KeyError(f"tenant {tid!r} has no live lane to carry")
+        return MB.lane_state(self._batch, self._prev_order.index(tid))
+
+    def _prewarm_bucket(self, bucket: int, template: FM.FleetState,
+                        world) -> bool:
+        """Compile (or warm-tier-load) the megabatch variant for
+        `bucket` BEFORE the tenant joins, through the StagedWarmup
+        ladder: begin_warming -> zeros pre-warm (AOT pool / persistent
+        cache / cold compile) -> readiness gate vs compile_budget.json
+        + devprof rebaseline -> ready. Returns True when a warm-up
+        actually ran."""
+        with self._lock:
+            if not self.cfg.tenancy.prewarm_on_admit \
+                    or bucket in self._warmed_buckets:
+                return False
+        from jax_mapping.obs.devprof import abstract_signature
+        warm = MB.make_tenant_batch(
+            [template], [world], [jax.random.PRNGKey(0)])
+        # Pad the 1-mission template batch up to the target bucket by
+        # abstractly widening the leading axis: the signature is what
+        # compiles, not the values.
+        def widen(x):
+            return jax.ShapeDtypeStruct((bucket,) + tuple(x.shape[1:]),
+                                        x.dtype)
+        warm_abs = jax.tree.map(widen, warm)
+        sig = abstract_signature(
+            (self.cfg, warm_abs, self.world_res_m), {})
+        self.warmup.begin_warming()
+        # manifest=False: warm ONLY this bucket's signature — an
+        # admission must not re-run the whole persisted AOT warm sweep
+        # (that is the RESTART path's job, once).
+        self.warmup.prewarm(signatures={MEGABATCH_ENTRY: [sig]},
+                            force=True, manifest=False)
+        self.warmup.mark_ready()
+        with self._lock:
+            self._warmed_buckets.add(bucket)
+            self.n_prewarms += 1
+        return True
+
+    # -- exports -------------------------------------------------------------
+
+    def status(self) -> dict:
+        """The /status `tenancy` object (one consistent section)."""
+        with self._lock:
+            n_active = len(self._order)
+            cap = (0 if self._batch is None
+                   else int(self._batch.active.shape[0]))
+            tenants = {
+                tid: {"state": m.state, "epoch": m.epoch,
+                      "revision": m.revision, "steps": m.steps,
+                      "seed": m.seed}
+                for tid, m in sorted(self._missions.items())}
+            counters = dict(
+                n_admitted=self.n_admitted, n_evicted=self.n_evicted,
+                n_suspended=self.n_suspended, n_resumed=self.n_resumed,
+                n_prewarms=self.n_prewarms, n_ticks=self.n_ticks,
+                n_compactions=self.n_compactions)
+            warmed = sorted(self._warmed_buckets)
+        n_susp = sum(1 for t in tenants.values()
+                     if t["state"] == "suspended")
+        n_evic = sum(1 for t in tenants.values()
+                     if t["state"] == "evicted")
+        return {
+            "n_active": n_active,
+            "n_suspended": n_susp,
+            "n_evicted": n_evic,
+            "bucket_capacity": cap,
+            "bucket_occupancy": (n_active / cap) if cap else 0.0,
+            "pad_waste_frac": ((cap - n_active) / cap) if cap else 0.0,
+            "warmed_buckets": warmed,
+            "warmup": self.warmup.snapshot(),
+            "tenants": tenants,
+            **counters,
+        }
+
+    def metric_families(self):
+        """`jax_mapping_tenant_*` gauge families for the declarative
+        /metrics registry (obs/registry.py) — one consistent status
+        snapshot per render."""
+        from jax_mapping.obs.registry import Family
+        s = self.status()
+        return (
+            Family("jax_mapping_tenant_active", "gauge",
+                   (("", str(s["n_active"])),)),
+            Family("jax_mapping_tenant_suspended", "gauge",
+                   (("", str(s["n_suspended"])),)),
+            Family("jax_mapping_tenant_evicted", "gauge",
+                   (("", str(s["n_evicted"])),)),
+            Family("jax_mapping_tenant_bucket_capacity", "gauge",
+                   (("", str(s["bucket_capacity"])),)),
+            Family("jax_mapping_tenant_bucket_occupancy", "gauge",
+                   (("", f"{s['bucket_occupancy']:.4f}"),)),
+            Family("jax_mapping_tenant_pad_waste_frac", "gauge",
+                   (("", f"{s['pad_waste_frac']:.4f}"),)),
+            Family("jax_mapping_tenant_ticks_total", "counter",
+                   (("", str(s["n_ticks"])),)),
+        )

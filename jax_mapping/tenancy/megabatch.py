@@ -1,0 +1,269 @@
+"""Megabatched mission step: one dispatch chain per tick for N tenants.
+
+Every per-mission ingredient is already deterministic, seeded and
+config-driven, and the whole fleet tick is pure jax — so independent
+missions lift onto a leading TENANT axis with `jax.vmap`. What does
+NOT survive a naive ``vmap(fleet_step)`` is the loop-closure
+``lax.cond``: under vmap a cond with a batched predicate lowers to
+``select`` — BOTH branches execute, every tick, for every tenant, and
+the rare-tick closure repair (full ring re-fusion + per-robot chain
+verification + graph optimisation) becomes an every-tick tax that
+erases the batching win. ``megabatch_step`` therefore hoists closure
+handling out of the batch entirely: the jitted step advances every
+tenant down the (common) NO-closure path — sense/match/fuse vmapped,
+graph growth per-lane under ``lax.map`` — and reports per-tenant
+closure-PENDING flags; ``megabatch_tick`` (the host-driven tick) then
+re-runs each pending tenant's tick through the solo `fleet_step`
+executable itself. That host hop is what makes closure ticks
+bit-exact: XLA:CPU gives no cross-executable bit-stability (a
+closure body recompiled inside the megabatch — vmapped OR
+lax.map-wrapped — drifts 1e-11..1e-7 from the solo trace via
+fusion/FMA and GEMM/Cholesky lowering differences, measured), so the
+only airtight closure path IS the solo executable.
+
+Bit-identity contract: a tenant's trajectory inside a megabatch equals
+its solo `fleet_step` trajectory bit-for-bit — same seed, any bucket
+on the EXACT ladder, any co-tenants (property-tested in
+tests/test_tenancy.py). The ladder boundary is a backend fact (see
+`EXACT_BUCKETS`): past it, XLA:CPU vectorizes the batched executable
+with FMA/SIMD choices the solo executable's lowering does not make,
+and NO construction reproduces solo bits — vmap, lax.map-wrapped
+solo bodies, and separately-jitted sub-programs were all measured to
+drift (1e-11..1e-7 per op). `bit_exact_buckets=False` opts into the
+full bucket set at any size for throughput work (the bench's 32-way
+megabatch), documented ulp-faithful rather than bit-exact there.
+
+Bucketing: the tenant dimension is padded to the bucket set
+{2^k} ∪ {3·2^(k-1)} (the PR 6 crop-span / PR 11 scan-batch idiom —
+the 1.5x midpoints halve worst-case pad waste while the set stays
+logarithmic) — restricted to `EXACT_BUCKETS` while the bit-exact
+contract is armed — so admit/evict churn cannot explode
+compiled-variant counts; the per-bucket variant budget is pinned in
+`analysis/compile_budget.json`. Pad slots carry a copy of lane 0's
+state with ``active=False`` and are frozen by a final select — an
+exact no-op: a pad lane's state never advances, and vmap lanes are
+independent, so pads cannot perturb active tenants.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import SlamConfig, ensure_valid_mode
+from jax_mapping.models import fleet as FM
+
+Array = jax.Array
+
+
+#: The bit-exact tenant ladder: the subset of the {2^k} ∪ {3·2^(k-1)}
+#: bucket set whose vmapped lowering is VERIFIED bit-identical to the
+#: solo `fleet_step` executable for the shipped micro mission shape on
+#: the XLA:CPU builder (the property suite pins it). The boundary is a
+#: backend fact, not a design choice: at power-of-two batch sizes
+#: >= 4 and at any size >= 16, LLVM vectorizes the tiny per-robot
+#: arithmetic clusters (odometry rk2, matcher fine-stage pose
+#: assembly) with FMA/SIMD contraction the solo executable's scalar
+#: lowering does not use — measured est drift ~3e-10/step at
+#: B ∈ {4, 8, 16, 17, 24, 32, 33}, bit-exact at B ∈ {2, 3, 5, 6, 9,
+#: 12}. GOTCHA: the boundary also moves with compile-context knobs —
+#: the test harness's `--xla_force_host_platform_device_count=8`
+#: virtual mesh shifts LLVM's vectorization thresholds enough to
+#: perturb edge-heavy configs even at B=2, which is why the
+#: solo-parity gates run in CLEAN subprocesses (no mesh flag) and why
+#: this ladder must be re-derived per backend/toolchain (TPU's
+#: lanewise VPU lowering is a different story entirely — unmeasured
+#: here).
+EXACT_BUCKETS = (1, 2, 3, 6, 12)
+
+
+def bucket_capacity(n: int, cap: Optional[int] = None,
+                    exact: bool = True) -> int:
+    """Smallest allowed tenant capacity >= n. `exact=True` (the
+    default, `TenancyConfig.bit_exact_buckets`) picks from
+    `EXACT_BUCKETS` — every capacity whose megabatch is bit-identical
+    to solo runs on this backend — and refuses tenant counts past the
+    ladder's top instead of silently degrading the contract.
+    `exact=False` serves the full {2^k} ∪ {3·2^(k-1)} set to any
+    size: trajectories are then ulp-faithful but NOT bit-exact on
+    XLA:CPU past the exact ladder (see EXACT_BUCKETS). `cap` bounds
+    the answer (the control plane's max_tenants)."""
+    if n < 1:
+        raise ValueError(f"bucket_capacity needs n >= 1, got {n}")
+    if exact:
+        for b in EXACT_BUCKETS:
+            if b >= n:
+                break
+        else:
+            raise ValueError(
+                f"{n} tenant(s) exceed the bit-exact bucket ladder "
+                f"(top {EXACT_BUCKETS[-1]} on this backend); set "
+                "TenancyConfig.bit_exact_buckets=False for larger "
+                "megabatches (ulp-faithful, not bit-exact, on "
+                "XLA:CPU)")
+    else:
+        # ONE definition of the {2^k} ∪ {3·2^(k-1)} set repo-wide (the
+        # PR 6 crop-span / PR 11 scan-batch helper) — the tenant axis
+        # must not grow a drifting copy of it.
+        from jax_mapping.ops.grid import _batch_bucket
+        b = _batch_bucket(n)
+    if cap is not None and b > cap:
+        raise ValueError(f"{n} tenant(s) exceed max capacity {cap}")
+    return b
+
+
+class TenantBatch(NamedTuple):
+    """Independent mission states stacked along a leading tenant axis.
+
+    Every leaf of `states` (and `worlds` / `keys` / `active`) carries
+    the same bucket-padded leading dimension B. `keys` is the
+    per-mission PRNG identity (the seed the mission's `FleetState` was
+    initialised from — restart/determinism bookkeeping, not consumed
+    by the step itself: the fleet tick draws no randomness).
+    """
+
+    states: FM.FleetState    # every leaf (B, ...)
+    worlds: Array            # (B, H, W) per-tenant ground truth
+    keys: Array              # (B, 2) uint32 per-mission PRNG keys
+    active: Array            # (B,) bool; pad/suspended slots False
+
+
+def stack_states(states: Sequence[FM.FleetState]) -> FM.FleetState:
+    """Stack per-mission FleetStates along a new leading tenant axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def make_tenant_batch(states: Sequence[FM.FleetState],
+                      worlds: Sequence[Array],
+                      keys: Sequence[Array],
+                      capacity: Optional[int] = None,
+                      exact: bool = True) -> TenantBatch:
+    """Bucket-pad N missions into a TenantBatch. Pad slots duplicate
+    lane 0 (identical shapes, no special-cased compute path) and are
+    marked inactive — `megabatch_step`'s final select freezes them, so
+    a pad tick is an exact no-op on state."""
+    n = len(states)
+    if n == 0:
+        raise ValueError("make_tenant_batch needs at least one mission")
+    if not (len(worlds) == len(keys) == n):
+        raise ValueError("states / worlds / keys length mismatch")
+    b = capacity if capacity is not None else bucket_capacity(
+        n, exact=exact)
+    if b < n:
+        raise ValueError(f"capacity {b} < {n} tenant(s)")
+    idx = list(range(n)) + [0] * (b - n)
+    stacked = stack_states([states[i] for i in idx])
+    return TenantBatch(
+        states=stacked,
+        worlds=jnp.stack([jnp.asarray(worlds[i]) for i in idx]),
+        keys=jnp.stack([jnp.asarray(keys[i]) for i in idx]),
+        active=jnp.asarray([i < n for i in range(b)]))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def megabatch_step(cfg: SlamConfig, batch: TenantBatch,
+                   world_res_m: float
+                   ) -> tuple[TenantBatch, FM.FleetDiag, Array]:
+    """One megabatched NO-CLOSURE tick + per-tenant closure-pending
+    flags: every active tenant advances exactly as its solo
+    `fleet_step` would on a tick whose closure cond stays false
+    (bit-for-bit), inactive slots are frozen, and the whole batch
+    costs ONE dispatch chain. Returns ``(batch', diag, pending)``
+    where ``pending[i]`` means tenant i had a loop-closure candidate
+    this tick — its lane in ``batch'`` is the (wrong) no-closure
+    evolution and MUST be resolved by the caller; `megabatch_tick` is
+    the host-driven form that does so through the solo `fleet_step`
+    executable itself.
+
+    Why closures resolve on the host: XLA:CPU gives no cross-
+    executable bit-stability — the closure body's Gauss-Newton
+    GEMM/Cholesky (and, under the test harness's virtual multi-device
+    mesh, even a `lax.map`-wrapped copy of the solo graph) lowers with
+    different fusion/FMA choices inside the megabatch executable and
+    drifts 1e-11..1e-7 from the solo trace. The ONLY airtight way to
+    keep a closure tick bit-identical to the solo run is to run it
+    through the very same compiled `fleet_step` the solo run uses.
+    Closure ticks are rare (the whole point of gating on candidates),
+    so the per-tenant solo re-dispatch is the cold path.
+
+    The returned FleetDiag carries the leading tenant axis; inactive
+    lanes' diag rows are meaningless (their state did not advance)."""
+    ensure_valid_mode(cfg)
+    # Sense/policy/move/match/fuse: vmapped — bit-stable per lane on
+    # the exact bucket ladder (EXACT_BUCKETS; past it the batched
+    # vectorization departs from the solo lowering and the contract
+    # is ulp-faithful only). Graph growth: per-lane lax.map — its
+    # pose_between edge arithmetic fuses with different FMA choices
+    # under a tenant vmap even at ladder buckets (measured ~1e-9 edge
+    # drift at B=2 in edge-heavy missions), and the lax.map body's
+    # (R,)-shaped fusion cluster lowers like the solo one.
+    sense = jax.vmap(
+        lambda s, w: FM._tick_sense(cfg, s, world_res_m, w))(
+            batch.states, batch.worlds)
+    (graphs, rings, k_idx, cand, attempt, xrobot, xcand,
+     xattempt) = jax.lax.map(
+        lambda a: FM._tick_graph(cfg, *a),
+        (batch.states.graphs, batch.states.scan_rings, sense.est,
+         sense.is_key, sense.scans, sense.res.accepted))
+    pre = FM._TickPre(sim2=sense.sim2, pol=sense.pol, fr=sense.fr,
+                      match_response=sense.res.response,
+                      est=sense.est, is_key=sense.is_key,
+                      grid=sense.grid, graphs=graphs, rings=rings,
+                      k_idx=k_idx, scans=sense.scans, cand=cand,
+                      attempt=attempt, xrobot=xrobot, xcand=xcand,
+                      xattempt=xattempt)
+
+    pending = (attempt | xattempt).any(axis=-1) & batch.active
+    closed = jnp.zeros_like(pre.is_key)
+    states2, diag = jax.vmap(
+        lambda st, pr, g, gr, e, cl: FM._tick_finish(
+            cfg, st, pr, g, gr, e, cl))(
+                batch.states, pre, pre.grid, pre.graphs, pre.est,
+                closed)
+
+    # Freeze pad/suspended lanes: active lanes pass through untouched
+    # (a True select is the identity), inactive lanes keep their
+    # previous state bit-for-bit — the exact-no-op pad contract.
+    def freeze(new, old):
+        act = batch.active.reshape(
+            (-1,) + (1,) * (new.ndim - 1))
+        return jnp.where(act, new, old)
+
+    states2 = jax.tree.map(freeze, states2, batch.states)
+    return batch._replace(states=states2), diag, pending
+
+
+def megabatch_tick(cfg: SlamConfig, batch: TenantBatch,
+                   world_res_m: float
+                   ) -> tuple[TenantBatch, FM.FleetDiag]:
+    """ONE host-driven megabatch tick, closure ticks included: the
+    megabatch dispatch advances every tenant down the no-closure path
+    and reports closure-pending lanes; each pending tenant's tick is
+    then re-run from its PRE-tick lane state through the solo
+    `fleet_step` — the identical executable the solo oracle runs, so
+    closure ticks are bit-exact by construction — and written back
+    into the lane (state AND diag row). The pending fetch doubles as
+    the tick's device barrier."""
+    import numpy as np
+
+    new_batch, diag, pending = megabatch_step(cfg, batch, world_res_m)
+    pending_np = np.asarray(pending)
+    if pending_np.any():
+        states = new_batch.states
+        for i in np.nonzero(pending_np)[0]:
+            i = int(i)
+            s1, d1 = FM.fleet_step(cfg, lane_state(batch, i),
+                                   world_res_m, batch.worlds[i])
+            states = jax.tree.map(lambda b, s: b.at[i].set(s),
+                                  states, s1)
+            diag = jax.tree.map(lambda b, s: b.at[i].set(s), diag, d1)
+        new_batch = new_batch._replace(states=states)
+    return new_batch, diag
+
+
+def lane_state(batch: TenantBatch, i: int) -> FM.FleetState:
+    """Extract tenant lane `i`'s FleetState (device slices)."""
+    return jax.tree.map(lambda x: x[i], batch.states)

@@ -391,6 +391,18 @@ def test_no_suppressions_in_obs_modules():
         f"suppressions are not allowed in obs/: {banned}")
 
 
+def test_no_suppressions_in_tenancy_modules():
+    """ISSUE 14 CI guard, extending the zero-suppression tier: the
+    mission-multi-tenancy subsystem (`jax_mapping/tenancy/`) carries
+    ZERO baseline suppressions — the control plane that multiplexes
+    many missions onto one accelerator may not baseline its hazards."""
+    base = Baseline.load(default_baseline_path())
+    banned = [s for s in base.suppressions
+              if s["path"].startswith("jax_mapping/tenancy/")]
+    assert not banned, (
+        f"suppressions are not allowed in tenancy/: {banned}")
+
+
 def test_no_suppressions_in_coldstart_modules():
     """ISSUE 12 CI guard, extending the zero-suppression tier: the
     warm-restart tier (`io/compile_cache.py`, the staged warm-up

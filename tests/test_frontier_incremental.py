@@ -549,3 +549,66 @@ def test_tile_observed_mask_stays_writable_after_full_refresh():
     sim.step(grow=True)
     pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
     assert pipe._tile_observed.flags.writeable
+
+
+# ------------------------------------------------ decay-aware (ISSUE 14)
+
+def test_decay_aware_incremental_matches_full_over_decaying_mission():
+    """ROADMAP item 7c follow-through: the incremental pipeline carries
+    the HEALED/STALE mask tile-incrementally, so `decay_aware`
+    publishes match the full recompute (which derives the stale mask
+    from raw log-odds each publish) at every step — including across a
+    decay-style pass (all evidence shrunk toward unknown, every tile
+    revision bumped, residual sub-threshold log-odds left behind)."""
+    g = _gcfg(512)
+    fcfg = _fcfg(decay_aware=True, stale_bonus=0.3)
+    sim = WorldSim(g, seed=2, walls=True)
+    pipe = IncrementalFrontierPipeline(fcfg, g, TILE)
+
+    def check(step):
+        pub = pipe.compute(sim.lo, sim.poses, sim.tile_rev, sim.rev)
+        full = F.compute_frontiers(fcfg, g, jnp.asarray(sim.lo),
+                                   jnp.asarray(sim.poses))
+        _assert_parity(pub, full, "decay", step)
+        stale_full = F.stale_mask(fcfg, g, jnp.asarray(sim.lo))
+        np.testing.assert_array_equal(
+            np.asarray(pipe.stale()), np.asarray(stale_full),
+            err_msg=f"carried stale mask diverged (step {step})")
+
+    for step in range(4):
+        if step:
+            sim.step(grow=True)
+        check(step)
+    # The stale mask must have actually been EMPTY so far (no decay
+    # ran): fresh unknown space never flags.
+    assert not np.asarray(pipe.stale()).any()
+    # A decay pass: multiplicative shrink leaves previously-saturated
+    # cells sub-threshold but nonzero — HEALED regions — and rides an
+    # ordinary every-tile revision bump, exactly like
+    # mapper._apply_decay.
+    sim.lo *= 0.2
+    sim._mark(0, 0, g.size_cells, g.size_cells)
+    check("post-decay")
+    assert np.asarray(pipe.stale()).any(), (
+        "decay left residual evidence but nothing flagged stale")
+    # Incremental dirty steps after the decay keep the carry exact.
+    for step in range(2):
+        sim.step(grow=True)
+        check(f"post-decay+{step}")
+
+
+def test_decay_aware_publishes_ride_incremental_pipeline(tiny_cfg):
+    """The mapper no longer routes decay-aware publishes around the
+    incremental pipeline (the pre-7c behavior this satellite
+    retires): with `decay_aware=True` the pipeline is constructed and
+    the publish path uses it."""
+    mapper, bus, cfg = _mk_mapper(dataclasses.replace(
+        tiny_cfg, frontier=dataclasses.replace(
+            tiny_cfg.frontier, decay_aware=True)), n_robots=1)
+    assert mapper._frontier_incremental() is not None, (
+        "decay_aware publish fell back to the full recompute path")
+    _seed_map(mapper, cfg)
+    mapper.publish_frontiers()
+    pipe = mapper._frontier_pipeline
+    assert pipe is not None and pipe.n_recomputes >= 1
+    assert pipe.stale() is not None
