@@ -163,8 +163,30 @@ REPO_PROTECTION: List[LockGroup] = [
     # /status counter convention), and the configure() targets are
     # re-pointed between stacks but always under the lock.
     group("FlightRecorder", "_lock",
-          ["_ring", "n_events", "_dump_dir", "_tracer", "_dump_seq"],
+          ["_ring", "n_events", "_dump_dir", "_tracer", "_dump_seq",
+           "_pipeline"],
           lockfree_ok=["n_dumps", "dumps"]),
+    # Pipeline latency ledger (obs/pipeline.py): the pending waypoint
+    # table, hop histograms, sample windows, record ring and the
+    # last-install/last-delivered marks mutate together under `_lock`
+    # from the mapper tick thread (installed/notified), HTTP workers
+    # (encoded on tile-store refresh, delivered on /tiles responses)
+    # and the tenancy stepping thread at once — exactly the
+    # cross-thread stamp emission the ledger racewatch gate hammers
+    # (tests/test_obs.py). `n_stamps` is the setattr write witness
+    # (container mutation records as a read — the documented racewatch
+    # limit); the completion counters read lock-free by the /status
+    # convention.
+    group("PipelineLedger", "_lock",
+          ["_pending", "_hists", "_samples", "_records", "_ages",
+           "_last_install_tick", "_last_delivered", "_delivered_epoch",
+           "_tick", "_notified_rev", "_encoded_rev", "n_stamps"],
+          lockfree_ok=["n_completed", "n_evicted"]),
+    # Freshness SLO engine (obs/slo.py): per-objective window state
+    # and the alert history move together — the mapper tick thread
+    # evaluates while HTTP workers read status()/metric_families().
+    group("SloEngine", "_lock",
+          ["_objs", "_alerts", "n_evaluations"]),
     # Declarative /metrics registry (obs/registry.py): the source list
     # is append-only under `_lock`; render() snapshots it there, then
     # collects outside (no foreign collector code under our lock).

@@ -92,7 +92,8 @@ class MapApiServer:
                  voxel_mapper=None, planner=None, health=None,
                  supervisor=None, recovery=None, devprof=None,
                  lock_timeout_s: Optional[float] = 2.0,
-                 socket_timeout_s: Optional[float] = 30.0):
+                 socket_timeout_s: Optional[float] = 30.0,
+                 pipeline=None, slo=None):
         self.bus = bus
         self.brain = brain
         self.mapper = mapper
@@ -122,6 +123,15 @@ class MapApiServer:
         if devprof is not None:
             from jax_mapping.obs.ledger import CostLedger
             self.cost_ledger = CostLedger(devprof)
+        #: Pipeline latency ledger (obs/pipeline.py) or None: serving
+        #: routes stamp first-client-delivery waypoints and answer
+        #: with `Server-Timing`-style revision-age headers — SERVER
+        #: monotonic deltas, so a client measures observed staleness
+        #: without trusting any cross-host wall clock.
+        self.pipeline = pipeline
+        #: Freshness SLO engine (obs/slo.py) or None: `/status.slo` +
+        #: `jax_mapping_slo_*` metric families ride along.
+        self.slo = slo
         self.lock_timeout_s = lock_timeout_s
         #: Staged warm-up window (ISSUE 12): while a supervisor restart
         #: restores+pre-warms the mapper, serving keeps answering from
@@ -183,7 +193,8 @@ class MapApiServer:
                 mapper.cfg.serving.enabled:
             from jax_mapping.serving import MapServing
             self.serving = MapServing(mapper.cfg.serving, mapper=mapper,
-                                      voxel_mapper=voxel_mapper)
+                                      voxel_mapper=voxel_mapper,
+                                      pipeline=pipeline)
             mapper.add_revision_listener(self.serving.on_map_revision)
 
         #: The /metrics exposition, declared once (obs/registry.py):
@@ -285,7 +296,8 @@ class MapApiServer:
             old = self.serving
             self.serving = MapServing(mapper.cfg.serving, mapper=mapper,
                                       voxel_mapper=self.voxel_mapper,
-                                      events=old.events)
+                                      events=old.events,
+                                      pipeline=self.pipeline)
             # The voxel provider did NOT restart: carry its store over
             # like the event channel — a fresh store would re-hash and
             # re-encode every voxel tile for nothing (and reset its
@@ -511,6 +523,16 @@ class MapApiServer:
                 # capacity/occupancy and pad waste, admit/evict/
                 # pre-warm counters (tenancy/controlplane.py).
                 body["tenancy"] = self.tenancy.status()
+            if self.pipeline is not None:
+                # Freshness pipeline picture: pending/completed
+                # revisions, windowed scan→served p99, last
+                # install/delivery ticks (obs/pipeline.py).
+                body["pipeline"] = self.pipeline.status()
+            if self.slo is not None:
+                # The freshness-budget picture (`/status.slo`): per
+                # objective value vs threshold, fast/slow burn rates,
+                # firing state, and the recent alert transitions.
+                body["slo"] = self.slo.status()
             if self.extra_status is not None:
                 body.update(self.extra_status())
             return 200, "application/json", json.dumps(body).encode()
@@ -792,12 +814,18 @@ class MapApiServer:
         # current ETag pays a 304 header instead of the full PNG body —
         # the byte-saving half of the cache even before the tile path.
         etag = f'W/"map-{msg.header.stamp}"'
+        # Revision-age header on the whole-PNG route too: the legacy
+        # polling client measures the same server-monotonic staleness
+        # the tile clients do (the /map message trails the live grid
+        # by up to a publish period — the age reports the newest
+        # INSTALLED revision, the freshness a poller could have).
+        timing = self._timing_header(None)
         if self._etag_hit(headers, etag):
-            return 304, "image/png", b"", {"ETag": etag}
+            return 304, "image/png", b"", {"ETag": etag, **timing}
         data = self._cached_png(
             "map", msg.header.stamp,
             lambda: png_codec.encode_gray(msg.as_image_array()))
-        return 200, "image/png", data, {"ETag": etag}
+        return 200, "image/png", data, {"ETag": etag, **timing}
 
     def _voxel_image(self, headers=None) -> Tuple:
         """Grayscale height-map PNG of the 3D voxel map (0 = unmapped
@@ -894,8 +922,24 @@ class MapApiServer:
         warming = self.warming
         etag = f'W/"{source}-e{epoch}-r{rev}' + \
             ('-warming"' if warming else '"')
+        # First-client-delivery waypoint + Server-Timing revision age:
+        # a 304 confirms freshness exactly as a body does (the client
+        # HOLDS the revision), so both answers stamp and both carry
+        # the age header. Grid + tenant surfaces only — they own the
+        # freshness chain; the voxel overview rides outside it.
+        timing = {}
+        if self.pipeline is not None and rev >= 0 \
+                and (tenant is not None or source == "grid"):
+            # Epoch threaded through: a restart/re-admission resets
+            # the ledger's delivered mark so the staleness objective
+            # tracks the NEW epoch's numbering instead of going blind
+            # until it outgrows the old mark.
+            self.pipeline.delivered(rev, tenant=tenant or "",
+                                    epoch=epoch)
+            timing = self._timing_header(rev, tenant=tenant or "")
         if self._etag_hit(headers, etag):
-            return 304, "application/json", b"", {"ETag": etag}
+            return 304, "application/json", b"", \
+                {"ETag": etag, **timing}
         body = dict(meta)
         body.update({"revision": rev, "since": since, "epoch": epoch,
                      "tiles": entries})
@@ -905,7 +949,24 @@ class MapApiServer:
             # stamped, and explicitly stale.
             body["state"] = "warming"
         return 200, "application/json", json.dumps(body).encode(), \
-            {"ETag": etag}
+            {"ETag": etag, **timing}
+
+    def _timing_header(self, revision: Optional[int],
+                       tenant: str = "") -> Dict[str, str]:
+        """`Server-Timing: rev;desc=..., age;dur=<ms>` for a response
+        serving `revision` (None = the newest installed): the age is a
+        SERVER monotonic delta since the revision's install, so a
+        client measures observed staleness without clock trust. Empty
+        when no ledger is armed or the revision predates it — better
+        no header than a fabricated age."""
+        if self.pipeline is None:
+            return {}
+        age = self.pipeline.revision_age_ms(revision, tenant=tenant)
+        if age is None:
+            return {}
+        rev_desc = "latest" if revision is None else str(revision)
+        return {"Server-Timing":
+                f'rev;desc="{rev_desc}", age;dur={age:.1f}'}
 
     def _map_events_poll(self, path: str) -> Tuple[int, str, bytes]:
         """GET /map-events?mode=poll&since=R[&wait_s=S] — bounded
@@ -936,7 +997,8 @@ class MapApiServer:
             if current > since:
                 return 200, "application/json", json.dumps(
                     {"map": "grid", "revision": current,
-                     "timed_out": False}).encode()
+                     "timed_out": False}).encode(), \
+                    self._timing_header(current)
             deadline = time.monotonic() + wait_s
             while not self._shutting_down.is_set():
                 remaining = deadline - time.monotonic()
@@ -944,15 +1006,17 @@ class MapApiServer:
                     break
                 ev = sub.next(min(0.5, remaining))
                 if ev is not None and int(ev.get("revision", -1)) > since:
+                    rev = int(ev["revision"])
                     return 200, "application/json", json.dumps(
-                        {"map": "grid",
-                         "revision": int(ev["revision"]),
-                         "timed_out": False}).encode()
+                        {"map": "grid", "revision": rev,
+                         "timed_out": False}).encode(), \
+                        self._timing_header(rev)
         finally:
             self.serving.events.unsubscribe(sub)
+        current = self.mapper.serving_revision()
         return 200, "application/json", json.dumps(
-            {"map": "grid", "revision": self.mapper.serving_revision(),
-             "timed_out": True}).encode()
+            {"map": "grid", "revision": current,
+             "timed_out": True}).encode(), self._timing_header(current)
 
     def _serve_sse(self, handler) -> None:
         """GET /map-events — Server-Sent Events stream of map-revision
@@ -980,6 +1044,11 @@ class MapApiServer:
             handler.send_header("Content-Type", "text/event-stream")
             handler.send_header("Cache-Control", "no-cache")
             handler.send_header("Connection", "close")
+            for k, v in self._timing_header(None).items():
+                # Stream-start revision age (the newest installed):
+                # SSE headers go out once; per-event freshness rides
+                # the revision numbers in the events themselves.
+                handler.send_header(k, v)
             handler.end_headers()
             last_sent = since
             current = (self.mapper.serving_revision()
@@ -1497,6 +1566,65 @@ class MapApiServer:
                 return None
             return cp.metric_families()
         reg.add_source(tenancy_families)
+
+        def pipeline_families():
+            # Freshness pipeline (obs/pipeline.py): per-hop fixed
+            # log-bucket latency histograms (ONE family sliced by
+            # hop/tenant labels — the devprof labeled-family idiom)
+            # plus the end-to-end scan→served family. Host-mapper
+            # series carry no tenant label; tenant namespaces slice
+            # with `tenant="<id>"` (the PR 14 serving namespaces).
+            if self.pipeline is None:
+                return None
+            from jax_mapping.obs.registry import (
+                labeled_histogram_samples)
+            hists = self.pipeline.histograms()
+            hop_samples = []
+            e2e_samples = []
+            for (hop, tenant), h in sorted(hists.items()):
+                if hop == "scan_to_served":
+                    labels = f'tenant="{tenant}"' if tenant else None
+                    if labels is None:
+                        e2e_samples += histogram_samples(
+                            h["edges_s"], h["buckets"], h["sum_s"],
+                            h["count"])
+                    else:
+                        e2e_samples += labeled_histogram_samples(
+                            labels, h["edges_s"], h["buckets"],
+                            h["sum_s"], h["count"])
+                    continue
+                labels = f'hop="{hop}"' + \
+                    (f',tenant="{tenant}"' if tenant else "")
+                hop_samples += labeled_histogram_samples(
+                    labels, h["edges_s"], h["buckets"], h["sum_s"],
+                    h["count"])
+            st = self.pipeline.status()
+            fams = [
+                Family("jax_mapping_pipeline_hop_seconds", "histogram",
+                       tuple(hop_samples)),
+                Family("jax_mapping_scan_to_served_seconds",
+                       "histogram", tuple(e2e_samples)),
+                Family("jax_mapping_pipeline_revisions_completed"
+                       "_total", "counter",
+                       (("", str(st["completed_revisions"])),)),
+                Family("jax_mapping_pipeline_revisions_pending",
+                       "gauge",
+                       (("", str(st["pending_revisions"])),)),
+                Family("jax_mapping_pipeline_revisions_evicted_total",
+                       "counter",
+                       (("", str(st["evicted_revisions"])),)),
+            ]
+            return fams
+        reg.add_source(pipeline_families)
+
+        def slo_families():
+            # Freshness SLO engine (obs/slo.py): firing state, burn
+            # rates and alert counters per objective — ONE consistent
+            # engine snapshot per render (the tenancy pattern).
+            if self.slo is None:
+                return None
+            return self.slo.metric_families()
+        reg.add_source(slo_families)
         return reg
 
     # -- lifecycle ----------------------------------------------------------

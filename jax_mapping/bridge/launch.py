@@ -60,6 +60,15 @@ class Stack:
     #: same object rides bus.tracer — this field is the test/operator
     #: handle (span export, /trace backs onto it through the bus).
     tracer: Optional[object] = None
+    #: Pipeline latency ledger (obs/pipeline.PipelineLedger) when
+    #: ObsConfig.enabled: per-revision scan→served waypoint stamps,
+    #: exported on /metrics + /status and carried as the `pipeline`
+    #: section of flight-recorder dumps (critical-path CLI input).
+    pipeline: Optional[object] = None
+    #: Freshness SLO engine (obs/slo.SloEngine) when ObsConfig.enabled
+    #: and objectives are declared in ObsConfig.slo — evaluated once
+    #: per mapper tick, alerts flight-recorded + on /status.slo.
+    slo: Optional[object] = None
     #: Dispatch profiler (obs/devprof.DispatchProfiler) when
     #: ObsConfig.devprof.enabled — wraps the jitted entry points
     #: process-wide; shutdown() uninstalls so a later stack can own
@@ -222,7 +231,8 @@ class Stack:
                 import traceback
                 traceback.print_exc()
         new = MapperNode(self.cfg, self.bus, tf=self.tf, n_robots=n,
-                         health=self.health, recovery=self.recovery)
+                         health=self.health, recovery=self.recovery,
+                         pipeline=self.pipeline, slo=self.slo)
         # Serving restart epoch: the resumed node legitimately re-serves
         # an OLDER map_revision (checkpoints lag the live map); the
         # bumped epoch tells delta clients to drop their cache and
@@ -322,6 +332,8 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
             compile_cache.enable()
             compile_cache.evict_lru()
     tracer = None
+    pipeline = None
+    slo = None
     if cfg.obs.enabled:
         # Causal tracing (obs/): deterministic trace ids derived from
         # (this seed, topic, per-topic publish seq) — two same-seed
@@ -329,6 +341,16 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
         # constructs nothing: the bus hot path is bit-exact pre-obs.
         from jax_mapping.obs import Tracer
         tracer = Tracer(seed=seed, capacity=cfg.obs.trace_ring)
+        # Freshness tier (obs/pipeline.py, obs/slo.py): the ledger
+        # rides the tracing gate (per-revision scan→served waypoint
+        # stamps, host-side bookkeeping only); the SLO engine only
+        # exists when objectives are declared. enabled=False
+        # constructs NEITHER — bit-exact, the ObsConfig doctrine.
+        from jax_mapping.obs.pipeline import PipelineLedger
+        pipeline = PipelineLedger()
+        if cfg.obs.slo:
+            from jax_mapping.obs.slo import SloEngine
+            slo = SloEngine(cfg.obs.slo, pipeline=pipeline)
     devprof = None
     if cfg.obs.devprof.enabled:
         # Device-side dispatch profiling (obs/devprof.py): wraps the
@@ -348,7 +370,8 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     flight_recorder.configure(
         dump_dir=(os.path.join(checkpoint_dir, "postmortem")
                   if checkpoint_dir else None),
-        tracer=tracer, capacity=cfg.obs.recorder_ring)
+        tracer=tracer, capacity=cfg.obs.recorder_ring,
+        pipeline=pipeline)
     bus = Bus(domain_id=cfg.domain_id, drop_prob=drop_prob, seed=seed,
               tracer=tracer)
     tf = TfTree()
@@ -386,7 +409,7 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     # (the fleet model's convention, models/fleet.py init_fleet_state).
     brain.poses = sim.truth_poses().copy()
     mapper = MapperNode(cfg, bus, tf=tf, n_robots=n_robots, health=health,
-                        recovery=recovery)
+                        recovery=recovery, pipeline=pipeline, slo=slo)
     for i, st in enumerate(mapper.states):
         mapper.states[i] = st._replace(pose=jnp.asarray(brain.poses[i]))
 
@@ -428,7 +451,7 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
                            mapper=mapper, voxel_mapper=voxel_mapper,
                            planner=planner, health=health,
                            supervisor=supervisor, recovery=recovery,
-                           devprof=devprof,
+                           devprof=devprof, pipeline=pipeline, slo=slo,
                            lock_timeout_s=cfg.resilience.http_lock_timeout_s)
         api.serve_thread()
 
@@ -457,8 +480,8 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
                   brain=brain, mapper=mapper, api=api, executor=executor,
                   voxel_mapper=voxel_mapper, planner=planner,
                   health=health, supervisor=supervisor, recovery=recovery,
-                  tracer=tracer, devprof=devprof,
-                  compile_cache=compile_cache, warmup=warmup)
+                  tracer=tracer, devprof=devprof, pipeline=pipeline,
+                  slo=slo, compile_cache=compile_cache, warmup=warmup)
     if cfg.tenancy.enabled:
         # Mission multi-tenancy (tenancy/): the control plane that
         # admits/evicts megabatched model-level missions alongside
@@ -470,7 +493,8 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
             cfg, world_res_m=res,
             checkpoint_dir=(os.path.join(checkpoint_dir, "tenants")
                             if checkpoint_dir else None),
-            compile_cache=compile_cache, devprof=devprof)
+            compile_cache=compile_cache, devprof=devprof,
+            pipeline=pipeline)
         if api is not None:
             api.tenancy = stack.tenancy
     if api is not None and (compile_cache is not None

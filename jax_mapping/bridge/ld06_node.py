@@ -43,6 +43,16 @@ class Ld06IngestNode(Node):
                                  band_m=band_m)
         self.pub = self.create_publisher(topic, qos_sensor_data)
         self.n_scans_published = 0
+        #: Scans published under a cross-process acquisition context
+        #: (the transport decoded a trace frame): the wire context is
+        #: made CURRENT around the publish, so the bus derives the
+        #: publish's TraceContext as a CHILD of the remote acquisition
+        #: span — a fused scan's span chain crosses the process
+        #: boundary back to the Pi-side acquisition. Attribution is
+        #: per-poll (the freshest frame's context covers the rotations
+        #: completed by that poll's bytes — frames outpace rotations,
+        #: so the approximation is one frame at most).
+        self.n_traced_publishes = 0
         # Heartbeat for the Supervisor; the payload surfaces the
         # transport's reconnect pressure (TcpTransport.stats: counters +
         # current jittered backoff) so an operator sees a flapping lidar
@@ -57,13 +67,25 @@ class Ld06IngestNode(Node):
         data = self.transport()
         if data:
             self.parser.feed(data)
+        # Cross-process trace propagation: a framing transport exposes
+        # the freshest acquisition TraceContext decoded from the wire;
+        # with a tracer armed, the scan publish runs under it so the
+        # bus chains the publish as a child of the REMOTE acquisition
+        # span (absent either — legacy peer, tracing off — the publish
+        # roots locally, the pre-frames behavior exactly).
+        tracer = getattr(self.bus, "tracer", None)
+        wire_ctx = None
+        if tracer is not None:
+            ctx_fn = getattr(self.transport, "trace_context", None)
+            if callable(ctx_fn):
+                wire_ctx = ctx_fn()
         while True:
             out = self.parser.take_scan()
             if out is None:
                 break
             ranges, intensities = out
             sc = self.scan_cfg
-            self.pub.publish(LaserScan(
+            msg = LaserScan(
                 header=Header(stamp=time.monotonic(),
                               frame_id=self.frame_id),
                 angle_min=sc.angle_min_rad,
@@ -73,7 +95,13 @@ class Ld06IngestNode(Node):
                 range_min=sc.range_min_m,
                 range_max=sc.range_max_m,
                 ranges=np.asarray(ranges, np.float32),
-                intensities=np.asarray(intensities, np.float32)))
+                intensities=np.asarray(intensities, np.float32))
+            if wire_ctx is not None:
+                with tracer.use(wire_ctx):
+                    self.pub.publish(msg)
+                self.n_traced_publishes += 1
+            else:
+                self.pub.publish(msg)
             self.n_scans_published += 1
         payload = {"scans_published": self.n_scans_published}
         stats = getattr(self.transport, "stats", None)

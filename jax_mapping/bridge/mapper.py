@@ -54,7 +54,7 @@ class MapperNode(Node):
     def __init__(self, cfg: SlamConfig, bus: Bus,
                  tf: Optional[TfTree] = None, n_robots: int = 1,
                  tick_period_s: Optional[float] = None, health=None,
-                 recovery=None):
+                 recovery=None, pipeline=None, slo=None):
         super().__init__("jax_mapper", bus, tf)
         import jax.numpy as jnp
 
@@ -71,6 +71,16 @@ class MapperNode(Node):
         #: context per scan so a fused scan's span chain reaches back
         #: to its sim publish. None = pre-obs behavior exactly.
         self._tracer = getattr(bus, "tracer", None)
+        #: Pipeline latency ledger (obs/pipeline.py) or None: stamps
+        #: each revision's scan-enqueued → installed → notified
+        #: waypoints (the serving tier stamps encode/deliver). None =
+        #: pre-obs behavior exactly — not a single time call added.
+        self._pipeline = pipeline
+        #: Freshness SLO engine (obs/slo.py) or None: evaluated once
+        #: per tick on the deterministic step clock, AFTER the tick
+        #: body (the tick's own duration feeds the deadline
+        #: objective).
+        self._slo = slo
         self._tick_no = 0
         #: Per-robot monotone fuse-span keys (deterministic — see
         #: _emit_fuse_spans).
@@ -425,6 +435,11 @@ class MapperNode(Node):
         if not self._serving_enabled:
             return
         rev = self.map_revision
+        if self._pipeline is not None and rev > 0:
+            # Notify waypoint: the revision is now fanned to listeners
+            # (the /map-events nudge) — idempotent for already-marked
+            # revisions, so the unconditional call is cheap.
+            self._pipeline.notified(rev)
         if rev != self._last_recorded_revision:
             self._last_recorded_revision = rev
             from jax_mapping.obs.recorder import flight_recorder
@@ -559,14 +574,20 @@ class MapperNode(Node):
     # -- topic callbacks -----------------------------------------------------
 
     def _scan_cb(self, i: int, msg: LaserScan) -> None:
-        # Queue entries are (scan, delivery TraceContext|None) pairs:
-        # the bus made the publish context current for this callback,
-        # and capturing it HERE (not at tick time) is what lets the
-        # fuse span of a scan that waited in the queue still chain to
-        # the publish that produced it.
+        # Queue entries are (scan, delivery TraceContext|None, enqueue
+        # stamp|None) triples: the bus made the publish context current
+        # for this callback, and capturing it HERE (not at tick time)
+        # is what lets the fuse span of a scan that waited in the queue
+        # still chain to the publish that produced it. The enqueue
+        # stamp is the pipeline ledger's scan→served starting waypoint
+        # (server monotonic — the queue wait is part of freshness);
+        # None when no ledger is armed, so the disabled path adds not
+        # even a clock read.
         ctx = self._tracer.current() if self._tracer is not None else None
+        enq_t = time.perf_counter() if self._pipeline is not None \
+            else None
         with self._state_lock:
-            self._scan_q[i].append((msg, ctx))
+            self._scan_q[i].append((msg, ctx, enq_t))
 
     def _odom_cb(self, i: int, msg: Odometry) -> None:
         with self._state_lock:
@@ -636,20 +657,32 @@ class MapperNode(Node):
         context outranks it (`_emit_fuse_spans`).
         """
         self._tick_no += 1
+        if self._pipeline is not None:
+            self._pipeline.note_tick(self._tick_no)
+        t0 = time.perf_counter()
         with M.stages.stage("mapper.tick"):
             if self._tracer is not None:
                 with self._tracer.span("mapper.tick", key=self._tick_no):
                     self._tick_body()
             else:
                 self._tick_body()
+        if self._slo is not None:
+            # Once per tick, AFTER the body: the step clock the burn
+            # windows count on, with the just-finished tick's duration
+            # (deadline objective) and the live revision counter
+            # (staleness objective).
+            self._slo.evaluate(self._tick_no,
+                               tick_ms=(time.perf_counter() - t0) * 1e3,
+                               map_revision=self.map_revision)
 
     def _tick_body(self) -> None:
         jnp = self._jnp
         with self._state_lock:
             work: List[List] = [[] for _ in range(self.n_robots)]
             for i in range(self.n_robots):
-                for scan, ctx in sorted(self._scan_q[i],
-                                        key=lambda e: e[0].header.stamp):
+                for scan, ctx, enq_t in sorted(
+                        self._scan_q[i],
+                        key=lambda e: e[0].header.stamp):
                     if self.cfg.resilience.enabled and \
                             scan.header.stamp < \
                             self._last_accepted_stamp[i]:
@@ -672,7 +705,7 @@ class MapperNode(Node):
                     # forward, or good reordered scans arriving next
                     # tick would be discarded against a watermark no
                     # fused evidence ever set.
-                    work[i].append((scan, od, ctx))
+                    work[i].append((scan, od, ctx, enq_t))
                 self._scan_q[i].clear()
 
         for i, items in enumerate(work):
@@ -752,9 +785,19 @@ class MapperNode(Node):
             self.shared_grid = g
             for j in range(self.n_robots):
                 self.states[j] = self.states[j]._replace(grid=g)
+            rev = None
             if self._serving_enabled:
                 self.map_revision += 1
+                rev = self.map_revision
                 self._mark_dirty_all()
+        if self._pipeline is not None and rev is not None:
+            # A decay pass stamps its revision (served-revision ages
+            # stay honest) but is NOT ingest: healing has no
+            # acquisition, and advancing the ingest-stall clock here
+            # would mask a scan-path outage from the SLO guard on
+            # every decay cadence.
+            self._pipeline.installed(rev, tick=self._tick_no,
+                                     ingest=False)
         self.n_decay_passes += 1
         M.counters.inc("mapper.decay_passes")
         from jax_mapping.obs.recorder import flight_recorder
@@ -827,7 +870,8 @@ class MapperNode(Node):
         installed = self._finish_step(i, state, items[-1][1], W, matched,
                                       closed, base_grid, base_gen,
                                       items[-1][0].header.stamp,
-                                      travel_cells=travel_cells)
+                                      travel_cells=travel_cells,
+                                      enq_t=self._oldest_enq(items))
         if not installed:
             return
         self._emit_fuse_spans(i, items)
@@ -897,7 +941,8 @@ class MapperNode(Node):
             return
         if self._finish_step(i, state, od, 1, matched, closed, base_grid,
                              base_gen, scan.header.stamp,
-                             travel_cells=travel_cells):
+                             travel_cells=travel_cells,
+                             enq_t=self._oldest_enq([item])):
             self._emit_fuse_spans(i, [item])
 
     def _reject_low_agreement(self, i: int,
@@ -1040,6 +1085,15 @@ class MapperNode(Node):
         flight_recorder.record("relocalized", robot=i,
                                n=self.n_relocalizations)
 
+    @staticmethod
+    def _oldest_enq(items: List):
+        """Oldest pipeline enqueue stamp among a step's work items —
+        the scan→served chain measures the WORST-case freshness of the
+        step's evidence. None when no ledger is armed."""
+        return min((it[3] for it in items
+                    if len(it) > 3 and it[3] is not None),
+                   default=None)
+
     def _travel_cells(self, motion) -> int:
         """Odometric path-length bound of a step's window, grid cells:
         the touched-tile box's interior-pose slack (`_touched_box`).
@@ -1054,7 +1108,8 @@ class MapperNode(Node):
     def _finish_step(self, i: int, state, od: Odometry, n_scans: int,
                      matched: bool, closed: bool, base_grid,
                      base_gen: int, newest_stamp: float = -float("inf"),
-                     travel_cells: int = 0) -> bool:
+                     travel_cells: int = 0,
+                     enq_t: Optional[float] = None) -> bool:
         """Install the step's results; returns False when the step was
         dropped as stale (callers gate their own telemetry on it).
         `newest_stamp` is the newest fused scan's stamp — it advances
@@ -1065,6 +1120,7 @@ class MapperNode(Node):
         # host's half-extent approximation. Computed AND fetched before
         # the lock — a stale-dropped step just wastes one tiny call.
         touched_box = self._touched_box(i, state, travel_cells)
+        rev_installed = None
         with self._state_lock:
             if self.shared_grid is not base_grid \
                     or self._state_gen[i] != base_gen:
@@ -1133,6 +1189,7 @@ class MapperNode(Node):
                 # device-computed under the fused path, host-estimated
                 # under the classic one.
                 self.map_revision += 1
+                rev_installed = self.map_revision
                 if closed:
                     self._mark_dirty_all()
                 elif touched_box is not None:
@@ -1159,6 +1216,12 @@ class MapperNode(Node):
             # The installed (estimate, paired odom) pair IS the live
             # map->odom correction for robot i (depth_anchor consumers).
             self._correction[i] = (new_est, new_odo)
+        if self._pipeline is not None and rev_installed is not None:
+            # Install waypoint, OUTSIDE the state lock (the ledger has
+            # its own leaf lock): the revision captured at the bump,
+            # the step's oldest enqueue stamp, the deterministic tick.
+            self._pipeline.installed(rev_installed, enq_t=enq_t,
+                                     tick=self._tick_no)
         self.n_scans_fused += n_scans
         M.counters.inc("mapper.scans_fused", n_scans)
         if matched:

@@ -779,6 +779,53 @@ class DevProfConfig:
 
 
 @_frozen
+class SloObjective:
+    """One declarative freshness/latency objective (obs/slo.py).
+
+    Objectives are evaluated IN-PROCESS once per mapper tick (the
+    deterministic step clock — wall-clock evaluation would make alert
+    firing host-speed-dependent in faster-than-realtime runs) over the
+    pipeline latency ledger (obs/pipeline.py) and the mapper's revision
+    counters, on multi-window sliding BREACH counters with classic
+    fast/slow burn-rate gating: the alert fires when BOTH the fast
+    window (is it burning right now?) and the slow window (has it been
+    burning long enough to matter?) exceed their budget fractions, and
+    clears when the fast window recovers. Windows are in TICKS and
+    burn fractions use the FIXED window sizes as denominators, so two
+    same-seed runs evaluate identical breach sequences and fire at the
+    identical step (the chaos-determinism contract extended to
+    alerting; the FaultPlan partition/reorder windows are the intended
+    alert drill)."""
+
+    name: str = ""
+    #: Metric kind:
+    #:   scan_to_served_p99_ms  — p99 of the ledger's completed
+    #:       scan-enqueue→first-client-delivery samples (ms) exceeds
+    #:       `threshold`; `max_silent_ticks` adds the tick-clocked
+    #:       ingest-stall guard (a bus partition on the scan path
+    #:       delivers NO samples — silence past the guard is a breach).
+    #:   tile_staleness_revs    — map_revision minus the newest
+    #:       revision any client was served exceeds `threshold`.
+    #:   tick_deadline_ms       — the mapper tick's wall duration
+    #:       exceeds `threshold` ms (deadline-miss fraction is the
+    #:       slow-window burn rate).
+    metric: str = "scan_to_served_p99_ms"
+    threshold: float = 250.0
+    #: scan_to_served only: breach when this many consecutive ticks
+    #: pass with no scan INSTALLED (after at least one ever installed)
+    #: — the freshness question a completed-sample p99 cannot see,
+    #: because an ingest outage produces no samples at all. 0 = off.
+    max_silent_ticks: int = 0
+    fast_window_ticks: int = 20
+    slow_window_ticks: int = 120
+    #: Budget fraction of breaching ticks per window before it counts
+    #: as burning (denominator = the FIXED window size, so a cold
+    #: start cannot fire off one breach).
+    fast_burn: float = 0.5
+    slow_burn: float = 0.25
+
+
+@_frozen
 class ObsConfig:
     """Causal tracing + flight recorder (obs/ subsystem).
 
@@ -813,6 +860,14 @@ class ObsConfig:
     #: device side must not force span-ring bookkeeping on, and vice
     #: versa.
     devprof: DevProfConfig = DevProfConfig()
+    #: Freshness SLO objectives (obs/slo.py), evaluated over the
+    #: pipeline latency ledger (obs/pipeline.py — constructed whenever
+    #: `enabled` is True; per-revision scan-enqueued → installed →
+    #: revision-visible → tile-re-encoded → first-client-delivery
+    #: waypoints folded into fixed log-bucket hop histograms). Empty =
+    #: no SLO engine constructed; `enabled=False` constructs NEITHER
+    #: ledger nor engine — bit-exact, the ObsConfig doctrine.
+    slo: Tuple[SloObjective, ...] = ()
 
 
 @_frozen
@@ -982,6 +1037,13 @@ class SlamConfig:
         obs_raw = dict(raw.get("obs", {}))
         if isinstance(obs_raw.get("devprof"), dict):
             obs_raw["devprof"] = DevProfConfig(**obs_raw["devprof"])
+        if isinstance(obs_raw.get("slo"), (list, tuple)):
+            # Objectives serialize as a list of dicts; rebuild the
+            # frozen (hashable, jit-static-usable) tuple the same way
+            # devprof rebuilds its nested dataclass.
+            obs_raw["slo"] = tuple(
+                SloObjective(**o) if isinstance(o, dict) else o
+                for o in obs_raw["slo"])
         return SlamConfig(
             grid=GridConfig(**raw.get("grid", {})),
             scan=ScanConfig(**raw.get("scan", {})),

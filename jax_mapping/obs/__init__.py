@@ -34,6 +34,19 @@ bit-determinism contract every prior PR defended:
                compiled variant via `lowered.compile().cost_analysis()`
                over the profiler's signatures, cross-checked against
                `analysis/compile_budget.json`.
+* `pipeline` — `PipelineLedger` (ISSUE 15): per-revision freshness
+               waypoints (scan enqueued → installed → notified → tile
+               re-encoded → first client delivery) folded into fixed
+               log-bucket hop histograms + the end-to-end
+               `scan_to_served` family, per-tenant sliced; the
+               Server-Timing revision-age source and the critical-path
+               CLI's record feed. Rides the `ObsConfig.enabled` gate.
+* `slo`      — `SloEngine` (ISSUE 15): the declarative freshness
+               objectives in `ObsConfig.slo`, evaluated per mapper
+               tick on multi-window sliding breach counters with
+               fast/slow burn-rate alerting — alerts flight-recorded,
+               on `/status.slo` + `jax_mapping_slo_*`, deterministic
+               firing steps across same-seed runs.
 
 `python -m jax_mapping.obs` is the CLI (diff two dumps, export a dump
 to a Perfetto-loadable trace, run the cost ledger). Importing the
@@ -53,8 +66,14 @@ from jax_mapping.obs.ledger import (                       # noqa: F401
 from jax_mapping.obs.export import (                       # noqa: F401
     chrome_events, dump_to_chrome, write_chrome_trace,
 )
+from jax_mapping.obs.pipeline import (                     # noqa: F401
+    FixedHistogram, PipelineLedger,
+)
 from jax_mapping.obs.recorder import (                     # noqa: F401
     FlightRecorder, flight_recorder,
+)
+from jax_mapping.obs.slo import (                          # noqa: F401
+    SloEngine,
 )
 from jax_mapping.obs.registry import (                     # noqa: F401
     Family, MetricsRegistry, histogram_samples, summary_samples,

@@ -11,6 +11,22 @@ no jax import):
     export DUMP [-o OUT]   Convert a flight-recorder dump to a Chrome-
                            trace/Perfetto JSON (default OUT:
                            DUMP + ".trace.json").
+    critical-path DUMP [BASELINE]
+                           Walk a dump's `pipeline` section (the
+                           latency ledger's completed-revision records,
+                           obs/pipeline.py) and report the per-revision
+                           scan→served critical path — which hop
+                           (fuse / notify / encode / deliver) dominated
+                           each revision, aggregate hop shares, and the
+                           slowest revisions. With BASELINE, diff the
+                           two runs' records through obs/diff.py
+                           normalization (hop durations and the
+                           dominance they imply are volatile; the
+                           deterministic structure — revision, tick,
+                           tenant sequence — must match for two
+                           same-seed runs). Exit 0 identical/ok, 1 on
+                           structural divergence, 2 on usage/no
+                           records.
     cost-ledger [-o OUT]   Run the canonical compile-budget scenario
                            with the dispatch profiler installed and
                            print the static XLA cost ledger (FLOPs /
@@ -48,6 +64,55 @@ def _load(path: str) -> dict:
     return doc
 
 
+def _critical_path(dump_path: str, baseline_path: Optional[str]) -> int:
+    """The critical-path analyzer (see module docstring)."""
+    from jax_mapping.obs.diff import VOLATILE_FIELDS, diff_streams
+    from jax_mapping.obs.pipeline import HOPS, RECORD_VOLATILE
+    recs = _load(dump_path).get("pipeline") or []
+    if not recs:
+        print("no pipeline records in dump (ledger absent, or no "
+              "revision completed a client delivery)", file=sys.stderr)
+        return 2
+    dominant = {}
+    hop_total = {}
+    for r in recs:
+        dominant[r.get("critical")] = \
+            dominant.get(r.get("critical"), 0) + 1
+        for hop, ms in (r.get("hops_ms") or {}).items():
+            hop_total[hop] = hop_total.get(hop, 0.0) + ms
+    total = sum(hop_total.values()) or 1.0
+    print(f"{len(recs)} completed revision(s), "
+          f"{len({r.get('tenant', '') for r in recs})} tenant "
+          f"namespace(s)")
+    print("hop shares (summed hop time; dominant = revisions this hop "
+          "was the critical one):")
+    for hop in list(HOPS) + sorted(set(hop_total) - set(HOPS)):
+        if hop not in hop_total:
+            continue
+        print(f"  {hop:<8} {hop_total[hop]:>10.1f} ms "
+              f"({100.0 * hop_total[hop] / total:5.1f}%)  "
+              f"dominant in {dominant.get(hop, 0)} revision(s)")
+    slowest = sorted(recs, key=lambda r: -r.get("total_ms", 0.0))[:5]
+    print("slowest revisions (scan→served):")
+    for r in slowest:
+        tenant = r.get("tenant") or "-"
+        print(f"  rev {r.get('revision')} (tenant {tenant}, tick "
+              f"{r.get('tick')}): {r.get('total_ms', 0.0):.1f} ms, "
+              f"critical hop = {r.get('critical')}")
+    if baseline_path is None:
+        return 0
+    base = _load(baseline_path).get("pipeline") or []
+    div = diff_streams(recs, base,
+                       ignore=tuple(VOLATILE_FIELDS)
+                       + tuple(RECORD_VOLATILE))
+    if div is None:
+        print("baseline: structurally identical (same revision/tick/"
+              "tenant sequence; hop timings are volatile by design)")
+        return 0
+    print("baseline: " + div.describe())
+    return 1
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m jax_mapping.obs",
@@ -67,6 +132,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                             "canonical scenario (imports jax)")
     c.add_argument("-o", "--out", default=None)
     c.add_argument("--budget", default=None, metavar="JSON")
+    k = sub.add_parser("critical-path",
+                       help="per-revision scan→served critical path "
+                            "from a dump's pipeline records")
+    k.add_argument("dump")
+    k.add_argument("baseline", nargs="?", default=None)
     try:
         args = p.parse_args(argv)
     except SystemExit as ex:
@@ -89,6 +159,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 json.dump(doc, f)
             print(f"wrote {out} ({len(doc['traceEvents'])} events)")
             return 0
+        if args.cmd == "critical-path":
+            return _critical_path(args.dump, args.baseline)
         if args.cmd == "cost-ledger":
             import contextlib
             from jax_mapping.obs.ledger import run_cost_ledger

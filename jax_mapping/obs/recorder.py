@@ -67,19 +67,26 @@ class FlightRecorder:
         self.dumps: List[str] = []
         self._dump_dir: Optional[str] = None
         self._tracer = None
+        self._pipeline = None
 
     # -- wiring (launch layer) ----------------------------------------------
 
     def configure(self, dump_dir: Optional[str] = None, tracer=None,
-                  capacity: Optional[int] = None) -> None:
-        """Point the recorder at a stack's checkpoint dir and tracer
-        (each launch re-configures; the recorder itself is process-
-        wide). `dump_dir=None` disables file dumps — events still
-        record. A capacity change rebuilds the ring, keeping the newest
-        events."""
+                  capacity: Optional[int] = None,
+                  pipeline=None) -> None:
+        """Point the recorder at a stack's checkpoint dir, tracer and
+        pipeline latency ledger (each launch re-configures; the
+        recorder itself is process-wide). `dump_dir=None` disables
+        file dumps — events still record. A capacity change rebuilds
+        the ring, keeping the newest events. An attached ledger's
+        completed-revision records ride each dump as its `pipeline`
+        section (the critical-path CLI's input; obs/diff.py compares
+        only events+spans, so dumps stay same-seed-diffable to zero —
+        hop durations are wall time)."""
         with self._lock:
             self._dump_dir = dump_dir
             self._tracer = tracer
+            self._pipeline = pipeline
             if capacity is not None and capacity != self._ring.maxlen:
                 self._ring = collections.deque(self._ring,
                                                maxlen=capacity)
@@ -153,6 +160,7 @@ class FlightRecorder:
         with self._lock:
             dump_dir = self._dump_dir
             tracer = self._tracer
+            pipeline = self._pipeline
             events = [dict(e) for e in self._ring]
             if dump_dir is not None:
                 n = self._dump_seq
@@ -160,6 +168,7 @@ class FlightRecorder:
         if dump_dir is None:
             return None
         spans = tracer.spans_since(0) if tracer is not None else []
+        records = pipeline.records() if pipeline is not None else []
         safe = re.sub(r"[^A-Za-z0-9_.-]+", "_", reason)[:80]
         path = os.path.join(dump_dir, f"flight_{n:04d}_{safe}.json")
         # The dump is itself a load-bearing transition (path kept to a
@@ -168,7 +177,8 @@ class FlightRecorder:
         self.record("postmortem_dump", reason=reason,
                     path=os.path.basename(path))
         payload = {"reason": reason, "wall_time": time.time(),
-                   "events": events, "spans": spans}
+                   "events": events, "spans": spans,
+                   "pipeline": records}
         return payload, path
 
     def _write(self, payload: dict, path: str) -> Optional[str]:

@@ -78,6 +78,13 @@ class MissionReport:
     #: a failed soak gate: `python -m jax_mapping.obs diff` two
     #: same-seed missions' dumps for the first divergent transition.
     postmortem_dumps: List[str] = dataclasses.field(default_factory=list)
+    #: SLO alert transitions THIS mission fired (obs/slo.py `slo_alert`
+    #: flight events past the mission's event mark): (tick, objective,
+    #: state) tuples, state "firing"/"clear" — empty when no SLO engine
+    #: was armed. Deterministic fields, so two same-seed missions
+    #: report identical alert schedules (the chaos-determinism
+    #: contract extended to alerting).
+    slo_alerts: List[tuple] = dataclasses.field(default_factory=list)
 
     def known_cells(self, thresh: float = 0.5) -> int:
         return int((np.abs(self.grid) > thresh).sum())
@@ -151,6 +158,10 @@ def run_lifelong_mission(cfg: SlamConfig, world: np.ndarray, doors,
             health_transitions=(list(st.health.transitions)
                                 if st.health is not None else []),
             postmortem_dumps=_mission_dumps(flight_recorder, ev_mark),
+            slo_alerts=[(e.get("tick"), e.get("objective"),
+                         e.get("state"))
+                        for e in flight_recorder.events_since(ev_mark)
+                        if e["kind"] == "slo_alert"],
         )
     finally:
         st.shutdown()

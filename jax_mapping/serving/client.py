@@ -13,12 +13,35 @@ from __future__ import annotations
 
 import base64
 import json
+import re
 import urllib.request
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from jax_mapping.bridge import png as png_codec
+
+#: `Server-Timing: rev;desc="42", age;dur=12.3` — the revision-age
+#: entry's duration, milliseconds (the serving tier's freshness
+#: stamp: a SERVER monotonic delta since the served revision's
+#: install, so the client measures observed staleness without
+#: trusting any cross-host wall clock).
+_AGE_RE = re.compile(r"\bage;dur=([0-9.]+)")
+
+
+def parse_revision_age_ms(server_timing: Optional[str]
+                          ) -> Optional[float]:
+    """The `age;dur=` milliseconds of a Server-Timing header value,
+    or None (absent header, no age entry, malformed)."""
+    if not server_timing:
+        return None
+    m = _AGE_RE.search(server_timing)
+    if m is None:
+        return None
+    try:
+        return float(m.group(1))
+    except ValueError:
+        return None
 
 
 class RevisionRegression(AssertionError):
@@ -50,6 +73,14 @@ class DeltaMapClient:
         self.bytes_received = 0
         self.snapshot_bytes = 0       # first (full) poll's body size
         self._etag: Optional[str] = None
+        #: Client-observed staleness (the freshness-SLO tier): the
+        #: served revision's age per response, from the Server-Timing
+        #: header (server monotonic deltas — no clock trust). None
+        #: until a header arrives; the bounded history feeds loadgen's
+        #: revision-age percentiles.
+        self.last_revision_age_ms: Optional[float] = None
+        self.revision_ages_ms: List[float] = []
+        self._age_history_cap = 4096
 
     # -- protocol ------------------------------------------------------------
 
@@ -70,10 +101,15 @@ class DeltaMapClient:
                                         timeout=self.timeout_s) as r:
                 raw = r.read()
                 self._etag = r.headers.get("ETag") or self._etag
+                self._note_age(r.headers.get("Server-Timing"))
         except urllib.error.HTTPError as e:
             if e.code != 304:
                 raise
             e.read()
+            # A 304 confirms freshness like a body does — the age
+            # header rides it, and the client's staleness series must
+            # include its already-current polls.
+            self._note_age(e.headers.get("Server-Timing"))
             self.n_polls += 1
             self.n_not_modified += 1
             return {"revision": self.revision, "since": self.revision,
@@ -92,6 +128,14 @@ class DeltaMapClient:
             return self.poll(level)
         self.apply(body)
         return body
+
+    def _note_age(self, server_timing: Optional[str]) -> None:
+        age = parse_revision_age_ms(server_timing)
+        if age is None:
+            return
+        self.last_revision_age_ms = age
+        self.revision_ages_ms.append(age)
+        del self.revision_ages_ms[:-self._age_history_cap]
 
     def _note_epoch(self, body: dict) -> bool:
         """Track the server's restart epoch; on an advance, drop every

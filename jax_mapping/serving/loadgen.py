@@ -32,16 +32,32 @@ import numpy as np
 
 
 class ClientStats:
-    """One synthetic client's accounting (single-thread writer)."""
+    """One synthetic client's accounting (single-thread writer).
+
+    Request latencies land BOTH in the raw list (exact percentiles at
+    bench scale) and in the registry's fixed log-bucket histogram
+    machinery (`obs/pipeline.FixedHistogram`, the HIST_EDGES_S grid
+    every histogram in the repo shares) — the suite JSON reports the
+    bucket counts so two runs compare bucket-for-bucket, the ROADMAP
+    serving-scale-out contract ("per-percentile latency histograms")."""
 
     def __init__(self, mode: str):
+        from jax_mapping.obs.pipeline import FixedHistogram
         self.mode = mode
         self.bytes_total = 0
         self.snapshot_bytes = 0
         self.latencies_s: List[float] = []
+        self.latency_hist = FixedHistogram()
+        #: Client-observed revision ages (ms) from the Server-Timing
+        #: freshness headers (delta clients only).
+        self.revision_ages_ms: List[float] = []
         self.n_polls = 0
         self.n_tiles = 0
         self.errors: List[str] = []
+
+    def observe_latency(self, dt_s: float) -> None:
+        self.latencies_s.append(dt_s)
+        self.latency_hist.observe(dt_s)
 
 
 def _percentile(xs: List[float], p: float) -> Optional[float]:
@@ -60,7 +76,7 @@ def _png_poller(base: str, stop: threading.Event, poll_s: float,
                                         timeout=10) as r:
                 body = r.read()
             stats.bytes_total += len(body)
-            stats.latencies_s.append(time.monotonic() - t0)
+            stats.observe_latency(time.monotonic() - t0)
             stats.n_polls += 1
         except Exception as e:     # noqa: BLE001 — survey, don't crash
             stats.errors.append(f"{type(e).__name__}: {e}")
@@ -79,7 +95,7 @@ def _delta_poller(base: str, stop: threading.Event, poll_s: float,
             # for zoomed-out dashboards, which would poll a coarse
             # level INSTEAD — mixed-level polling pays for both).
             body = client.poll(level=0)
-            stats.latencies_s.append(time.monotonic() - t0)
+            stats.observe_latency(time.monotonic() - t0)
             stats.n_polls += 1
             stats.n_tiles += len(body["tiles"])
         except Exception as e:     # noqa: BLE001
@@ -87,6 +103,7 @@ def _delta_poller(base: str, stop: threading.Event, poll_s: float,
         stop.wait(poll_s)
     stats.bytes_total = client.bytes_received
     stats.snapshot_bytes = client.snapshot_bytes
+    stats.revision_ages_ms = list(client.revision_ages_ms)
 
 
 def _sse_listener(base: str, stop: threading.Event,
@@ -227,11 +244,21 @@ def run_serving_benchmark(cfg=None, *, n_clients: int = 8,
     stack.shutdown()
 
     def _mode_summary(stats_list: List[ClientStats]) -> dict:
+        from jax_mapping.obs.pipeline import FixedHistogram
         lats = [x for s in stats_list for x in s.latencies_s]
+        ages = [x for s in stats_list for x in s.revision_ages_ms]
         total = sum(s.bytes_total for s in stats_list)
         snap = sum(s.snapshot_bytes for s in stats_list)
         n = len(stats_list)
-        return {
+        # Mode-aggregate fixed log-bucket histogram (per-client hists
+        # fold bucketwise — same HIST_EDGES_S grid everywhere).
+        agg = FixedHistogram()
+        for s in stats_list:
+            for k, c in enumerate(s.latency_hist.buckets):
+                agg.buckets[k] += c
+            agg.total_s += s.latency_hist.total_s
+            agg.count += s.latency_hist.count
+        out = {
             "n_clients": n,
             "polls": sum(s.n_polls for s in stats_list),
             "bytes_total": total,
@@ -241,10 +268,34 @@ def run_serving_benchmark(cfg=None, *, n_clients: int = 8,
                 (total - snap) / n / elapsed, 1),
             "latency_p50_ms": (None if not lats else round(
                 _percentile(lats, 50) * 1e3, 2)),
+            "latency_p90_ms": (None if not lats else round(
+                _percentile(lats, 90) * 1e3, 2)),
             "latency_p99_ms": (None if not lats else round(
                 _percentile(lats, 99) * 1e3, 2)),
+            "latency_histogram": {
+                "edges_s": list(agg.summary()["edges_s"]),
+                "buckets": agg.summary()["buckets"],
+                "count": agg.count,
+                "sum_s": round(agg.total_s, 6),
+                "hist_p50_ms": agg.percentile_ms(50),
+                "hist_p90_ms": agg.percentile_ms(90),
+                "hist_p99_ms": agg.percentile_ms(99),
+            },
             "errors": sorted({e for s in stats_list for e in s.errors}),
         }
+        if ages:
+            # Client-observed staleness (Server-Timing freshness
+            # headers, delta mode): the number BENCH_SERVING throughput
+            # lacked — bytes say what serving costs, this says how
+            # fresh the map the client holds actually is.
+            out["revision_age_ms"] = {
+                "n": len(ages),
+                "p50": round(_percentile(ages, 50), 2),
+                "p90": round(_percentile(ages, 90), 2),
+                "p99": round(_percentile(ages, 99), 2),
+                "max": round(max(ages), 2),
+            }
+        return out
 
     png = _mode_summary(png_stats)
     delta = _mode_summary(delta_stats)

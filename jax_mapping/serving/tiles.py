@@ -65,12 +65,19 @@ class TileStore:
                  snapshot_fn: Callable[[], Tuple[int, object,
                                                  Optional[np.ndarray]]],
                  downsample_fn: Optional[Callable] = None,
-                 meta: Optional[dict] = None):
+                 meta: Optional[dict] = None,
+                 on_install: Optional[Callable[[int], None]] = None):
         self.cfg = cfg
         self.name = name
         self._revision_fn = revision_fn
         self._snapshot_fn = snapshot_fn
         self._downsample_fn = downsample_fn
+        #: Telemetry hook called with the committed store revision
+        #: after each refresh that re-installed (the pipeline ledger's
+        #: tile-re-encoded waypoint). Invoked OUTSIDE both store locks
+        #: (lint B2: no foreign code under a lock); failures are
+        #: contained — telemetry must never break serving.
+        self._on_install = on_install
         self.meta = dict(meta or {})
         self._lock = threading.Lock()
         self._refresh_lock = threading.Lock()
@@ -122,7 +129,15 @@ class TileStore:
                 rev, image, hint = self._snapshot_fn()
                 rev = int(rev)
                 self._install(rev, image, hint)
-            return rev
+        if self._on_install is not None:
+            # After BOTH locks release: the commit is visible, the
+            # waypoint stamp is honest, and no foreign code ran under
+            # a serving lock.
+            try:
+                self._on_install(rev)
+            except Exception:                     # noqa: BLE001
+                pass                              # telemetry only
+        return rev
 
     def _install(self, rev: int, image, hint: Optional[np.ndarray]) -> None:
         """Hash, diff, and re-encode under `_refresh_lock`; commit
@@ -225,9 +240,14 @@ class MapServing:
     every `/map-events` client queue."""
 
     def __init__(self, cfg: ServingConfig, mapper=None, voxel_mapper=None,
-                 events=None):
+                 events=None, pipeline=None):
         from jax_mapping.serving.events import EventChannel
         self.cfg = cfg
+        #: Pipeline latency ledger (obs/pipeline.py) or None: the GRID
+        #: store's refresh commits stamp the tile-re-encoded waypoint
+        #: (the freshness chain is the occupancy surface's; the voxel
+        #: height map rides outside it).
+        self.pipeline = pipeline
         #: `events` carry-over: a mapper restart rebuilds this bundle
         #: around the new node (http_api.rebind_mapper) but must keep
         #: the live EventChannel — connected /map-events clients ride
@@ -250,7 +270,9 @@ class MapServing:
                 meta={"resolution_m": g.resolution_m,
                       "origin_m": list(g.origin_m),
                       "size_cells": g.size_cells,
-                      "orientation": "grid-row0-min-y"})
+                      "orientation": "grid-row0-min-y"},
+                on_install=(None if pipeline is None
+                            else pipeline.encoded))
         if voxel_mapper is not None and \
                 self._voxel_servable(cfg, voxel_mapper.cfg.voxel):
             v = voxel_mapper.cfg.voxel

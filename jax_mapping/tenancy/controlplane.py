@@ -83,8 +83,15 @@ class TenantControlPlane:
 
     def __init__(self, cfg: SlamConfig, world_res_m: Optional[float] = None,
                  checkpoint_dir: Optional[str] = None,
-                 compile_cache=None, devprof=None):
+                 compile_cache=None, devprof=None, pipeline=None):
         self.cfg = cfg
+        #: Pipeline latency ledger (obs/pipeline.py) or None: tenant
+        #: revision bumps and tile-store commits stamp under the
+        #: tenant's OWN label (the serving-namespace contract applied
+        #: to freshness telemetry), so `/metrics` pipeline histograms
+        #: slice per tenant. Set-once wiring, read bare (the
+        #: StagedWarmup convention).
+        self.pipeline = pipeline
         self.world_res_m = (cfg.grid.resolution_m if world_res_m is None
                             else world_res_m)
         self.checkpoint_dir = checkpoint_dir
@@ -316,6 +323,7 @@ class TenantControlPlane:
         not a correctness issue."""
         diag = None
         for _ in range(n):
+            stamped = []
             with self._lock:
                 if not self._order:
                     return None
@@ -330,8 +338,16 @@ class TenantControlPlane:
                     m = self._missions[tid]
                     m.revision += 1
                     m.steps += 1
+                    stamped.append((tid, m.revision, m.steps))
                 self._last_diag = diag
                 self.n_ticks += 1
+            if self.pipeline is not None:
+                # Install waypoints OUTSIDE the plane lock (the ledger
+                # is a leaf lock of its own): one per tenant revision,
+                # under the tenant's serving-namespace label.
+                for tid, rev, steps in stamped:
+                    self.pipeline.installed(rev, tick=steps,
+                                            tenant=tid)
         return diag
 
     def _refreshed_worlds(self):
@@ -420,8 +436,15 @@ class TenantControlPlane:
             gray = np.asarray(G.to_gray(self.cfg.grid, grid))
             return rev, gray, None
 
+        on_install = None
+        if self.pipeline is not None:
+            ledger = self.pipeline
+
+            def on_install(rev, _tid=tid):
+                ledger.encoded(rev, tenant=_tid)
+
         store = TileStore(self.cfg.serving, f"tenant:{tid}",
-                          _revision, _snapshot)
+                          _revision, _snapshot, on_install=on_install)
         with self._lock:
             # First builder wins under concurrent HTTP readers.
             store = self._tile_stores.setdefault(tid, store)

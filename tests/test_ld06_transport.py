@@ -164,11 +164,15 @@ def test_tcp_backoff_jitter_is_seeded_and_desynchronizes():
     for i, w in enumerate(a):
         lo = min(base * 2 ** i, 4.0)
         assert lo <= w < lo * 1.25 + 1e-9
-    # Heartbeat-payload export carries the reconnect posture.
+    # Heartbeat-payload export carries the reconnect posture (and,
+    # since the trace-frame tier, the wire protocol's framing posture).
     tr = TcpTransport("127.0.0.1", 1, seed=3)
     st = tr.stats()
     assert st == {"connected": False, "n_connects": 0,
-                  "n_reconnects": 0, "backoff_s": 0.0}
+                  "n_reconnects": 0, "backoff_s": 0.0,
+                  "framing": {"mode": "unknown", "n_frames": 0,
+                              "n_traced_frames": 0,
+                              "n_frame_errors": 0}}
     tr._fail_attempt()
     assert tr.stats()["backoff_s"] > 0
     tr.close()
@@ -213,3 +217,238 @@ def test_transports_nonblocking_when_idle(tiny_cfg):
     assert time.monotonic() - t0 < 0.5
     st.close()
     os.close(master)
+
+
+# --------------------------- cross-process trace frames (ISSUE 15)
+
+def test_frame_codec_roundtrip_and_context():
+    """Unit tier for the wire format: framed payloads reassemble across
+    arbitrary read boundaries, contexts decode exactly, context-less
+    frames clear the freshest context."""
+    from jax_mapping.bridge.ld06_transport import (FrameDecoder,
+                                                   encode_frame)
+    from jax_mapping.obs.trace import TraceContext
+    ctx = TraceContext(0x1122334455667788, 0x99AABBCCDDEEFF00, 7)
+    wire = encode_frame(b"abc", ctx) + encode_frame(b"defg")
+    d = FrameDecoder()
+    out = b""
+    for k in range(len(wire)):            # byte-at-a-time worst case
+        out += d.feed(wire[k:k + 1])
+    assert out == b"abcdefg"
+    assert d.mode == "framed"
+    assert d.n_frames == 2 and d.n_traced_frames == 1
+    assert d.n_frame_errors == 0
+    assert d.last_ctx is None             # frame 2 carried no context
+    d2 = FrameDecoder()
+    d2.feed(encode_frame(b"x", ctx))
+    assert d2.last_ctx == ctx
+
+
+def test_frame_decoder_garbage_header_degrades_untraced():
+    """The robustness contract: a truncated/garbage frame header
+    degrades to untraced raw delivery with a counter — the byte stream
+    keeps flowing (the LD06 parser's own resync copes), never a
+    protocol abort, and subsequent good frames parse traced again."""
+    from jax_mapping.bridge.ld06_transport import (FRAME_MAGIC,
+                                                   FrameDecoder,
+                                                   encode_frame)
+    from jax_mapping.obs.trace import TraceContext
+    ctx = TraceContext(1, 2, 0)
+    d = FrameDecoder()
+    # Open framed, then a corrupted header (bad version), then garbage
+    # bytes, then a good traced frame.
+    wire = encode_frame(b"good1", ctx)
+    wire += FRAME_MAGIC + bytes((99, 0)) + (5).to_bytes(4, "little")
+    wire += b"JUNKJUNK"
+    wire += encode_frame(b"good2", ctx)
+    out = d.feed(wire)
+    assert b"good1" in out and b"good2" in out
+    assert d.n_frame_errors >= 1
+    assert d.last_ctx == ctx              # the good tail re-traced
+    assert d.mode == "framed"
+
+
+def test_tcp_framed_sender_traces_ingest_publish(tiny_cfg):
+    """End-to-end cross-process propagation: a framing server (the
+    Pi-side acquisition process) sends rotations wrapped in trace
+    frames; the receiving ingest node — on a TRACED bus — publishes
+    each completed rotation under the wire context, so the publish
+    span chains as a child of the REMOTE acquisition span."""
+    if not N.native_available():
+        pytest.skip("libld06 not buildable")
+    from jax_mapping.bridge.ld06_transport import FrameEncoder
+    from jax_mapping.obs import Tracer
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    sender_tracer = Tracer(seed=99)       # the remote process's seed
+    enc = FrameEncoder(tracer=sender_tracer)
+    tr = TcpTransport("127.0.0.1", port, reconnect_backoff_s=0.05)
+    receiver_tracer = Tracer(seed=0)
+    bus = Bus(tracer=receiver_tracer)
+    scans = _collect_scans(bus)
+    node = Ld06IngestNode(tiny_cfg.scan, bus, tr, realtime=False)
+
+    def serve():
+        conn, _ = srv.accept()
+        data = _rotation_bytes(tiny_cfg.scan.n_beams) \
+            + _rotation_bytes(tiny_cfg.scan.n_beams)
+        # One frame per LD06 packet, like a per-packet bridge.
+        for i in range(0, len(data), N.PACKET_BYTES):
+            conn.sendall(enc.encode(data[i:i + N.PACKET_BYTES]))
+        time.sleep(0.3)
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    _drain(node, tr)
+    assert node.n_scans_published >= 1
+    assert node.n_traced_publishes >= 1
+    assert tr.stats()["framing"]["mode"] == "framed"
+    assert tr.stats()["framing"]["n_traced_frames"] > 0
+    assert tr.stats()["framing"]["n_frame_errors"] == 0
+    # The publish span's parent is a WIRE span id — one the sender's
+    # tracer minted (it exists in the sender's ring, not ours).
+    pubs = [s for s in receiver_tracer.spans_since(0)
+            if s["name"] == "publish:scan"]
+    assert pubs, "traced bus recorded no scan publish"
+    sender_span_ids = {s["span_id"]
+                       for s in sender_tracer.spans_since(0)}
+    assert any(p["parent_span"] in sender_span_ids for p in pubs), \
+        "no publish chained to a remote acquisition span"
+    tr.close()
+    srv.close()
+
+
+def test_tcp_framed_sender_against_legacy_receiver(tiny_cfg):
+    """Interop, PC-side-lags direction: a framing sender against a
+    receiver that predates frames (`framed=False` = the old byte
+    passthrough exactly). Frame headers are small inter-packet garbage
+    the LD06 parser's checksum resync skips — rotations still parse,
+    just untraced."""
+    if not N.native_available():
+        pytest.skip("libld06 not buildable")
+    from jax_mapping.bridge.ld06_transport import FrameEncoder
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    enc = FrameEncoder()                  # context-less frames
+    tr = TcpTransport("127.0.0.1", port, reconnect_backoff_s=0.05,
+                      framed=False)
+    bus = Bus()
+    node = Ld06IngestNode(tiny_cfg.scan, bus, tr, realtime=False)
+
+    def serve():
+        conn, _ = srv.accept()
+        data = _rotation_bytes(tiny_cfg.scan.n_beams) * 3
+        for i in range(0, len(data), N.PACKET_BYTES):
+            conn.sendall(enc.encode(data[i:i + N.PACKET_BYTES]))
+        time.sleep(0.3)
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    _drain(node, tr)
+    assert node.n_scans_published >= 1
+    assert node.n_traced_publishes == 0
+    assert "framing" not in tr.stats()    # the pre-frames export shape
+    tr.close()
+    srv.close()
+
+
+def test_tcp_legacy_sender_against_framed_receiver(tiny_cfg):
+    """Interop, Pi-side-lags direction: a legacy raw-byte sender
+    against the auto-detecting receiver — the connection negotiates to
+    legacy passthrough (absent frames = legacy peer), scans parse,
+    nothing counts as a frame error."""
+    if not N.native_available():
+        pytest.skip("libld06 not buildable")
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    tr = TcpTransport("127.0.0.1", port, reconnect_backoff_s=0.05)
+    bus = Bus()
+    node = Ld06IngestNode(tiny_cfg.scan, bus, tr, realtime=False)
+
+    def serve():
+        conn, _ = srv.accept()
+        conn.sendall(_rotation_bytes(tiny_cfg.scan.n_beams))
+        conn.sendall(_rotation_bytes(tiny_cfg.scan.n_beams))
+        time.sleep(0.3)
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    _drain(node, tr)
+    assert node.n_scans_published >= 1
+    st = tr.stats()["framing"]
+    assert st["mode"] == "legacy"
+    assert st["n_frames"] == 0 and st["n_frame_errors"] == 0
+    assert tr.trace_context() is None
+    tr.close()
+    srv.close()
+
+
+def test_tcp_garbage_frame_midstream_never_disconnects(tiny_cfg):
+    """The degraded-delivery contract end to end: a framing session
+    with a corrupted header mid-stream counts the error, keeps the
+    connection, and later rotations still arrive."""
+    if not N.native_available():
+        pytest.skip("libld06 not buildable")
+    from jax_mapping.bridge.ld06_transport import (FRAME_MAGIC,
+                                                   FrameEncoder)
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    enc = FrameEncoder()
+    tr = TcpTransport("127.0.0.1", port, reconnect_backoff_s=0.05)
+    bus = Bus()
+    node = Ld06IngestNode(tiny_cfg.scan, bus, tr, realtime=False)
+
+    def serve():
+        conn, _ = srv.accept()
+        data = _rotation_bytes(tiny_cfg.scan.n_beams)
+        for i in range(0, len(data), N.PACKET_BYTES):
+            conn.sendall(enc.encode(data[i:i + N.PACKET_BYTES]))
+        # Corrupted header: right magic, bogus version, then garbage.
+        conn.sendall(FRAME_MAGIC + bytes((200, 7))
+                     + (9).to_bytes(4, "little") + b"\x00" * 9)
+        data = _rotation_bytes(tiny_cfg.scan.n_beams, r0=3.0) \
+            + _rotation_bytes(tiny_cfg.scan.n_beams, r0=3.0)
+        for i in range(0, len(data), N.PACKET_BYTES):
+            conn.sendall(enc.encode(data[i:i + N.PACKET_BYTES]))
+        time.sleep(0.3)
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    scans = _collect_scans(bus)
+
+    def got_new():
+        return any(abs(float(s.ranges.max()) - 3.0) < 0.01
+                   for s in scans)
+    while not got_new() and time.monotonic() - t0 < 5.0:
+        node.poll()
+        time.sleep(0.005)
+    assert got_new(), "post-garbage rotations never arrived"
+    st = tr.stats()["framing"]
+    assert st["n_frame_errors"] >= 1
+    assert tr.n_reconnects == 0           # degraded, never disconnected
+    tr.close()
+    srv.close()

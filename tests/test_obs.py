@@ -709,3 +709,360 @@ def test_trace_endpoint_etag_304_and_empty_window():
     head = tr.last_seq()
     doc = json.loads(api.handle(f"/trace?since={head}")[2])
     assert doc["traceEvents"] == [] and doc["next"] == head
+
+
+# ------------------------------------- pipeline latency ledger (ISSUE 15)
+
+def _ledger():
+    from jax_mapping.obs.pipeline import PipelineLedger
+    return PipelineLedger()
+
+
+def test_pipeline_ledger_waypoints_fold_into_hops():
+    """One revision's full waypoint chain produces all four hop
+    observations plus the end-to-end sample and a completed record
+    whose critical hop is the dominant one."""
+    import time
+    led = _ledger()
+    led.note_tick(7)
+    t0 = time.perf_counter()
+    led.installed(3, enq_t=t0, tick=7)
+    led.notified(3)
+    led.encoded(3)
+    led.delivered(3)
+    hists = led.histograms()
+    for hop in ("fuse", "notify", "encode", "deliver",
+                "scan_to_served"):
+        assert (hop, "") in hists, hop
+        assert hists[(hop, "")]["count"] == 1
+    (rec,) = led.records()
+    assert rec["revision"] == 3 and rec["tick"] == 7
+    assert set(rec["hops_ms"]) == {"fuse", "notify", "encode",
+                                   "deliver"}
+    assert rec["critical"] in rec["hops_ms"]
+    assert rec["total_ms"] >= max(rec["hops_ms"].values()) - 1e-6
+    assert led.p99_ms() is not None
+    assert led.last_delivered() == (7, 3)
+
+
+def test_pipeline_ledger_delivery_completes_superseded_revisions():
+    """Serving revision N completes every pending revision <= N (a
+    client holding N is at least as fresh as N-1 — freshness is
+    cumulative), and later duplicate deliveries are no-ops."""
+    led = _ledger()
+    for rev in (1, 2, 3):
+        led.installed(rev, tick=rev)
+    led.delivered(3)
+    recs = led.records()
+    assert [r["revision"] for r in recs] == [1, 2, 3]
+    assert led.status()["pending_revisions"] == 0
+    led.delivered(3)                      # idempotent
+    assert len(led.records()) == 3
+    # A revision the ledger never saw installed still moves the
+    # delivered mark (restore-resumed epochs serve unknown revisions).
+    led.delivered(5)
+    assert led.last_delivered()[1] == 5
+
+
+def test_pipeline_ledger_bounded_and_tenant_sliced():
+    """The pending table is bounded (an unserved mission cannot grow
+    host memory), and tenant stamps land under their own label."""
+    from jax_mapping.obs.pipeline import PipelineLedger
+    led = PipelineLedger(pending_cap=8)
+    for rev in range(20):
+        led.installed(rev, tick=rev)
+    assert led.status()["pending_revisions"] <= 8
+    assert led.n_evicted >= 12
+    led.installed(1, tick=1, tenant="t0")
+    led.encoded(1, tenant="t0")
+    led.delivered(1, tenant="t0")
+    hists = led.histograms()
+    assert ("deliver", "t0") in hists
+    assert led.last_delivered("t0") == (0, 1)
+    (rec,) = [r for r in led.records() if r["tenant"] == "t0"]
+    assert rec["revision"] == 1
+
+
+def test_pipeline_ledger_revision_age_is_monotonic_and_scoped():
+    led = _ledger()
+    assert led.revision_age_ms(1) is None         # pre-ledger revision
+    led.installed(4, tick=1)
+    age4 = led.revision_age_ms(4)
+    assert age4 is not None and age4 >= 0
+    # Serving revision 9 (never installed) falls back to the newest
+    # known install at or below it; revision 3 predates the ledger.
+    assert led.revision_age_ms(9) is not None
+    assert led.revision_age_ms(3) is None
+    assert led.revision_age_ms(None) is not None
+
+
+def test_fixed_histogram_percentiles_bucket_resolved():
+    from jax_mapping.obs.pipeline import FixedHistogram
+    from jax_mapping.utils.profiling import HIST_EDGES_S
+    h = FixedHistogram()
+    assert h.percentile_ms(99) is None
+    for _ in range(99):
+        h.observe(0.0002)                 # below the first edge
+    h.observe(1.0)                        # one outlier
+    assert h.percentile_ms(50) == HIST_EDGES_S[0] * 1e3
+    p99 = h.percentile_ms(99)
+    assert p99 is not None and p99 <= HIST_EDGES_S[0] * 1e3
+    assert h.percentile_ms(100) >= 1000.0 * 0.9
+
+
+def test_server_timing_header_parse():
+    from jax_mapping.serving.client import parse_revision_age_ms
+    assert parse_revision_age_ms('rev;desc="42", age;dur=12.5') == 12.5
+    assert parse_revision_age_ms("age;dur=0.0") == 0.0
+    assert parse_revision_age_ms('rev;desc="42"') is None
+    assert parse_revision_age_ms(None) is None
+    assert parse_revision_age_ms("age;dur=bogus") is None
+
+
+# ------------------------------------------------- SLO engine (ISSUE 15)
+
+def _slo_cfg(**kw):
+    from jax_mapping.config import SloObjective
+    base = dict(name="obj", metric="tile_staleness_revs", threshold=5,
+                fast_window_ticks=4, slow_window_ticks=8,
+                fast_burn=0.5, slow_burn=0.25)
+    base.update(kw)
+    return SloObjective(**base)
+
+
+def test_slo_engine_fires_and_clears_on_burn_windows():
+    """Multi-window burn gating: breaches must fill BOTH windows'
+    budgets to fire, and the alert clears when the fast window
+    recovers — transitions flight-recorded with deterministic
+    fields."""
+    from jax_mapping.obs.recorder import flight_recorder
+    from jax_mapping.obs.slo import SloEngine
+    mark = flight_recorder.mark()
+    eng = SloEngine((_slo_cfg(),))
+    # Staleness grows with no deliveries (pipeline=None -> served
+    # revision 0): breach from map_revision > 5.
+    for t in range(1, 9):
+        eng.evaluate(t, map_revision=t)
+    st = eng.status()["objectives"][0]
+    assert st["firing"], st
+    assert st["last_fire_tick"] is not None
+    fire_tick = st["last_fire_tick"]
+    # Healing: staleness back under threshold -> the fast window
+    # drains below its burn budget -> clear.
+    for t in range(9, 16):
+        eng.evaluate(t, map_revision=1)
+    st = eng.status()["objectives"][0]
+    assert not st["firing"]
+    assert st["n_fired"] == 1 and st["n_cleared"] == 1
+    evs = [e for e in flight_recorder.events_since(mark)
+           if e["kind"] == "slo_alert"]
+    assert [(e["state"], e["tick"]) for e in evs] == [
+        ("firing", fire_tick),
+        ("clear", st["last_clear_tick"])]
+
+
+def test_slo_engine_same_inputs_fire_at_identical_steps():
+    """The determinism contract at the engine level: two engines fed
+    the identical evaluation sequence fire and clear at the identical
+    ticks (burn denominators are the FIXED window sizes; everything is
+    clocked in ticks)."""
+    from jax_mapping.obs.slo import SloEngine
+
+    def drive():
+        eng = SloEngine((_slo_cfg(),))
+        for t in range(1, 40):
+            rev = t if t < 25 else 1
+            eng.evaluate(t, map_revision=rev)
+        return eng.alerts()
+
+    a, b = drive(), drive()
+    assert a == b and a, a
+
+
+def test_slo_engine_silent_ticks_guard_breaches_without_samples():
+    """The ingest-stall guard: a partition delivers NO scan→served
+    samples, so the p99 predicate alone can never see the outage —
+    silence past `max_silent_ticks` breaches instead."""
+    from jax_mapping.obs.pipeline import PipelineLedger
+    from jax_mapping.obs.slo import SloEngine
+    led = PipelineLedger()
+    cfg = _slo_cfg(metric="scan_to_served_p99_ms", threshold=1e9,
+                   max_silent_ticks=3)
+    eng = SloEngine((cfg,), pipeline=led)
+    led.installed(1, tick=2)
+    fired_at = None
+    for t in range(1, 20):
+        eng.evaluate(t, map_revision=1)
+        st = eng.status()["objectives"][0]
+        if st["firing"] and fired_at is None:
+            fired_at = t
+    assert fired_at is not None
+    st = eng.status()["objectives"][0]
+    # silent_ticks surfaced for the operator on /status.slo.
+    assert st["silent_ticks"] == 19 - 2
+    # Breaches begin at tick 6 (silence > 3 past install tick 2):
+    # fast window (4, burn 0.5) fills at 7, slow (8, burn 0.25) at 7.
+    assert fired_at == 7, fired_at
+
+
+def test_slo_engine_tick_deadline_metric():
+    from jax_mapping.obs.slo import SloEngine
+    eng = SloEngine((_slo_cfg(metric="tick_deadline_ms",
+                              threshold=100.0),))
+    for t in range(1, 10):
+        eng.evaluate(t, tick_ms=500.0)
+    st = eng.status()["objectives"][0]
+    assert st["firing"] and st["value"] == 500.0
+    fams = {f.name for f in eng.metric_families()}
+    assert "jax_mapping_slo_firing" in fams
+    assert "jax_mapping_slo_burn_rate_fast" in fams
+
+
+def test_racewatch_gate_cross_thread_pipeline_stamps():
+    """ISSUE 15 CI satellite: hammer one PipelineLedger's stamp surface
+    from concurrent threads (mapper tick installs / HTTP delivery /
+    tenancy stepping in miniature) under RaceWatch — zero reports, and
+    the stamp counter's candidate lockset converges on the declared
+    ledger lock."""
+    from jax_mapping.analysis.protection import groups_by_class
+    from jax_mapping.analysis.racewatch import RaceWatch
+    from jax_mapping.obs.pipeline import PipelineLedger
+
+    led = PipelineLedger()
+    watch = RaceWatch()
+    try:
+        watch.watch_object(led, groups_by_class()["PipelineLedger"][0],
+                           name="led")
+
+        def worker(tid):
+            tenant = "" if tid % 2 == 0 else f"t{tid}"
+            for k in range(150):
+                led.note_tick(k)
+                led.installed(k, tick=k, tenant=tenant)
+                led.notified(k, tenant=tenant)
+                led.encoded(k, tenant=tenant)
+                led.delivered(k, tenant=tenant)
+                if k % 25 == 0:
+                    led.status()
+                    led.histograms()
+                    led.records()
+                    led.revision_age_ms(k, tenant=tenant)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        watch.unwatch_all()
+    assert watch.reports() == [], \
+        "\n".join(r.message for r in watch.reports())
+    counter = watch.field_states()["PipelineLedger.n_stamps@led"]
+    assert counter.state == "shared-modified"
+    assert "PipelineLedger._lock@led" in counter.candidate
+
+
+# -------------------------------------------- critical-path CLI (ISSUE 15)
+
+def _pipeline_dump(tmp_path, name, hops):
+    doc = {"reason": "test", "events": [], "spans": [],
+           "pipeline": [
+               {"revision": r, "tenant": "", "tick": r,
+                "hops_ms": dict(h), "total_ms": sum(h.values()),
+                "critical": max(h, key=h.get)}
+               for r, h in hops]}
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_obs_cli_critical_path_report_and_diff(tmp_path, capsys):
+    hops_a = [(1, {"fuse": 1.0, "deliver": 9.0}),
+              (2, {"fuse": 7.0, "deliver": 2.0})]
+    a = _pipeline_dump(tmp_path, "a.json", hops_a)
+    assert obs_main(["critical-path", a]) == 0
+    out = capsys.readouterr().out
+    assert "2 completed revision(s)" in out
+    assert "dominant in 1 revision(s)" in out
+    # Same structure, different timings: identical after normalization
+    # (hop durations and dominance are volatile by design).
+    hops_b = [(1, {"fuse": 8.0, "deliver": 1.0}),
+              (2, {"fuse": 1.0, "deliver": 8.0})]
+    b = _pipeline_dump(tmp_path, "b.json", hops_b)
+    assert obs_main(["critical-path", a, b]) == 0
+    assert "structurally identical" in capsys.readouterr().out
+    # Structural divergence (an extra revision) is exit 1.
+    hops_c = hops_a + [(3, {"fuse": 1.0})]
+    c = _pipeline_dump(tmp_path, "c.json", hops_c)
+    assert obs_main(["critical-path", a, c]) == 1
+    # No pipeline section: usage error, not a crash.
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"events": [], "spans": []}))
+    assert obs_main(["critical-path", str(empty)]) == 2
+
+
+def test_recorder_dump_carries_pipeline_section(tmp_path):
+    """A configured ledger's completed records ride every dump as its
+    `pipeline` section — and the dump stays same-seed diffable to zero
+    (diff compares only events+spans)."""
+    from jax_mapping.obs.pipeline import PipelineLedger
+    rec = FlightRecorder(capacity=64)
+    led = PipelineLedger()
+    rec.configure(dump_dir=str(tmp_path), pipeline=led)
+    led.installed(1, tick=1)
+    led.delivered(1)
+    rec.record("map_revision", revision=1)
+    path = rec.dump("test")
+    doc = json.load(open(path))
+    assert [r["revision"] for r in doc["pipeline"]] == [1]
+    res = diff_dumps(doc, {"events": doc["events"], "spans": [],
+                           "pipeline": []})
+    assert res["identical"]
+
+
+def test_pipeline_non_ingest_install_does_not_feed_silence_guard():
+    """Regression (caught by a live drive): a decay pass stamps its
+    revision for age bookkeeping but is NOT scan ingest — it must not
+    advance the ingest-stall clock, or a healing cadence running
+    through a scan-path outage re-arms the silence guard every pass
+    and the outage alert flaps instead of holding."""
+    led = _ledger()
+    led.installed(1, tick=5)                      # real scan ingest
+    assert led.last_install_tick() == 5
+    led.installed(2, tick=12, ingest=False)       # decay pass
+    assert led.last_install_tick() == 5           # clock unmoved
+    assert led.revision_age_ms(2) is not None     # age still honest
+    led.installed(3, tick=14)                     # ingest resumes
+    assert led.last_install_tick() == 14
+
+
+def test_pipeline_epoch_restart_resets_ages_and_delivered_mark():
+    """Review regressions: a restarted epoch replays SMALLER revision
+    numbers. (1) `revision_age_ms(None)` must track the NEWEST install
+    — re-inserting an old revision key must reorder it to the tail, or
+    the newest-age read reports the dead epoch's stamp forever. (2) a
+    delivery stamped with a NEW epoch resets the delivered mark, so
+    the staleness objective follows the new numbering instead of
+    reading negative until it outgrows the old epoch's mark."""
+    import time as _time
+    led = _ledger()
+    for rev in (1, 2, 3):
+        led.installed(rev, tick=rev)
+    led.delivered(3, epoch=0)
+    assert led.last_delivered()[1] == 3
+    _time.sleep(0.01)
+    # Epoch restart: revision numbering starts over.
+    led.installed(1, tick=10)                    # re-inserts key 1
+    age = led.revision_age_ms(None)
+    assert age is not None and age < 8.0, \
+        f"newest-install age reports the dead epoch: {age} ms"
+    # Old-epoch mark would make staleness negative; the epoch stamp
+    # resets it to the new epoch's delivery.
+    led.delivered(1, epoch=1)
+    assert led.last_delivered()[1] == 1
+    # Same-epoch idle repeat (the steady 304 poll): fast-pathed, mark
+    # unchanged, nothing completed twice.
+    n = led.n_completed
+    led.delivered(1, epoch=1)
+    assert led.n_completed == n and led.last_delivered()[1] == 1

@@ -15,7 +15,7 @@ import numpy as np
 import pytest
 
 from jax_mapping.config import (DecayConfig, DevProfConfig, ObsConfig,
-                                tiny_config)
+                                SloObjective, tiny_config)
 from jax_mapping.resilience.faultplan import (
     FaultEvent, FaultPlan, KINDS, WORLD_KINDS, random_plan,
 )
@@ -384,8 +384,24 @@ def scenario_mission(tmp_path_factory):
         # live surface for dispatch attribution, /status.perf, the
         # /metrics device families and the steady-state recompile
         # guard — no new tier-1 stack launch.
+        # ISSUE 15 piggyback: the freshness tier rides the same
+        # mission (pipeline ledger + SLO engine are bit-inert like the
+        # rest of obs). The staleness objective is DELIBERATELY tight:
+        # the delta client polls once mid-mission and once at the end,
+        # so served staleness grows ~2 revisions/step in between and
+        # must fire exactly one burn-rate alert at a deterministic
+        # step; the post-kill restart serves a fresh epoch's SMALLER
+        # revisions (staleness goes negative against the old delivered
+        # mark), which is what clears it.
         obs=ObsConfig(enabled=True,
-                      devprof=DevProfConfig(enabled=True)))
+                      devprof=DevProfConfig(enabled=True),
+                      slo=(SloObjective(name="staleness",
+                                        metric="tile_staleness_revs",
+                                        threshold=30.0,
+                                        fast_window_ticks=8,
+                                        slow_window_ticks=24,
+                                        fast_burn=0.5,
+                                        slow_burn=0.25),)))
     world, doors = W.arena_with_door(96, cfg.grid.resolution_m)
     td = str(tmp_path_factory.mktemp("scenario_ckpt"))
     rec_mark = flight_recorder.mark()
@@ -460,6 +476,11 @@ def scenario_mission(tmp_path_factory):
     recorder_events = flight_recorder.events_since(rec_mark)
     metrics_text = st.api.handle("/metrics")[2].decode()
     trace_resp = st.api.handle("/trace?since=0")
+    # Freshness tier (ISSUE 15): the SLO picture and a /tiles probe
+    # (Server-Timing revision-age header) captured with the other
+    # quantitative artifacts.
+    slo_status = json.loads(st.api.handle("/status")[2]).get("slo")
+    tiles_probe = st.api.handle("/tiles?since=-1")
 
     # Racewatch over the scenario engine's lock (ISSUE 8 satellite):
     # a side thread hammers the door/snapshot boundary while the step
@@ -509,6 +530,7 @@ def scenario_mission(tmp_path_factory):
         "spans": spans, "recorder_events": recorder_events,
         "metrics_text": metrics_text, "trace_resp": trace_resp,
         "warm_probe": warm_probe,
+        "slo_status": slo_status, "tiles_probe": tiles_probe,
     }
     yield art
     st.shutdown()
@@ -1130,7 +1152,15 @@ def test_obs_tracing_is_bit_inert(tmp_path):
                                       seed=1)
 
         def drive(obs_on):
-            cfg = base.replace(obs=ObsConfig(enabled=obs_on))
+            # The enabled side arms the FULL host-side obs stack —
+            # tracing + pipeline ledger + SLO engine (ISSUE 15): the
+            # freshness tier must be exactly as bit-inert as the
+            # tracer it rides with, objectives evaluating and all.
+            slo = (SloObjective(name="stale",
+                                metric="tile_staleness_revs",
+                                threshold=5.0, fast_window_ticks=4,
+                                slow_window_ticks=8),) if obs_on else ()
+            cfg = base.replace(obs=ObsConfig(enabled=obs_on, slo=slo))
             st = launch_sim_stack(cfg, world, n_robots=2,
                                   realtime=False, seed=seed)
             st.brain.start_exploring()
@@ -1138,8 +1168,11 @@ def test_obs_tracing_is_bit_inert(tmp_path):
             if obs_on:
                 assert st.tracer is not None
                 assert st.tracer.last_seq() > 0
+                assert st.pipeline is not None and st.slo is not None
+                assert st.slo.status()["n_evaluations"] >= 40
             else:
                 assert st.tracer is None
+                assert st.pipeline is None and st.slo is None
             lo = np.array(np.asarray(st.mapper.merged_grid()),
                           copy=True)
             poses = np.stack([np.asarray(s.pose)
@@ -1274,3 +1307,201 @@ def test_obs_same_seed_runs_emit_identical_streams(tmp_path):
     spans_c, _ = drive(1)
     div = diff_streams(spans_a, spans_c)
     assert div is not None and div.index == 0
+
+
+# --------------------------------- shared mission: freshness/SLO tier
+
+def test_slo_mission_fires_exactly_one_deterministic_alert(
+        scenario_mission):
+    """ISSUE 15 acceptance on the shared mission: the deliberately-
+    tight staleness objective fires EXACTLY ONE flight-recorded alert
+    (the mid-mission poll→silence stretch), and the post-restart
+    epoch's smaller revisions clear it — both transitions recorded
+    with deterministic (tick, objective, state) fields. The firing
+    STEP's same-seed determinism is pinned at the engine level
+    (tests/test_obs.py) and by the slow two-run partition drill; here
+    the live mission proves the loop closes once, end to end."""
+    evs = [e for e in scenario_mission["recorder_events"]
+           if e["kind"] == "slo_alert"]
+    fires = [e for e in evs if e["state"] == "firing"]
+    clears = [e for e in evs if e["state"] == "clear"]
+    assert len(fires) == 1, evs
+    assert len(clears) == 1, evs
+    assert fires[0]["objective"] == "staleness"
+    assert isinstance(fires[0]["tick"], int)
+    # Fired while the first epoch was still serving (before the step-48
+    # kill), cleared by the restarted epoch's fresh revision numbering.
+    assert fires[0]["tick"] < _KILL_AT
+    st = scenario_mission["stack"]
+    assert st.slo is not None
+    assert st.slo.firing() == []
+    alerts = st.slo.alerts()
+    assert [(a[1], a[2]) for a in alerts] == [("staleness", "firing"),
+                                              ("staleness", "clear")]
+
+
+def test_slo_mission_status_and_metrics_surface(scenario_mission):
+    """`/status.slo` carries the objective picture and the
+    `jax_mapping_slo_*` + pipeline families render on /metrics —
+    after the historical tail (the registry-append contract)."""
+    slo = scenario_mission["slo_status"]
+    assert slo is not None
+    (obj,) = slo["objectives"]
+    assert obj["name"] == "staleness"
+    assert obj["metric"] == "tile_staleness_revs"
+    assert obj["n_fired"] == 1 and obj["n_cleared"] == 1
+    assert obj["breach_ticks"] > 0
+    assert slo["alerts"], "alert history missing from /status.slo"
+    text = scenario_mission["metrics_text"]
+    assert 'jax_mapping_slo_firing{objective="staleness"}' in text
+    assert 'jax_mapping_slo_alerts_fired_total{objective="staleness"} 1' \
+        in text
+    assert "jax_mapping_pipeline_hop_seconds_bucket" in text
+    assert 'hop="fuse"' in text and 'hop="deliver"' in text
+    assert "jax_mapping_scan_to_served_seconds_bucket" in text
+    assert "jax_mapping_pipeline_revisions_completed_total" in text
+
+
+def test_pipeline_mission_ledger_completed_scan_to_served(
+        scenario_mission):
+    """The ledger closed real scan→served chains on the live mission:
+    completed records exist, carry the fuse hop (a scan enqueue
+    started them), and /status.pipeline reports the windowed p99."""
+    st = scenario_mission["stack"]
+    assert st.pipeline is not None
+    recs = st.pipeline.records()
+    assert recs, "no revision ever completed a client delivery"
+    with_scan = [r for r in recs if "fuse" in r["hops_ms"]]
+    assert with_scan, "no completed revision carried a scan waypoint"
+    for r in with_scan[:5]:
+        assert set(r["hops_ms"]) <= {"fuse", "notify", "encode",
+                                     "deliver"}
+        assert r["critical"] in r["hops_ms"]
+    status = json.loads(
+        st.api.handle("/status")[2])["pipeline"]
+    assert status["completed_revisions"] >= len(recs)
+    assert "scan_to_served_p99_ms" in status
+
+
+def test_pipeline_mission_server_timing_header(scenario_mission):
+    """Serving responses stamp the Server-Timing revision-age header —
+    server monotonic deltas, the client-observed staleness measure
+    that needs no cross-host clock trust."""
+    from jax_mapping.serving.client import parse_revision_age_ms
+    probe = scenario_mission["tiles_probe"]
+    assert probe[0] == 200
+    headers = probe[3]
+    assert "Server-Timing" in headers, headers
+    age = parse_revision_age_ms(headers["Server-Timing"])
+    assert age is not None and age >= 0.0
+    # The dump artifact carries the ledger's records as its `pipeline`
+    # section (the critical-path CLI's input).
+    import glob
+    dumps = sorted(glob.glob(os.path.join(
+        scenario_mission["ckpt_dir"], "postmortem", "flight_*.json")))
+    assert dumps
+    doc = json.load(open(dumps[-1]))
+    assert "pipeline" in doc
+
+
+def test_obs_disabled_constructs_no_freshness_tier(scenario_mission):
+    """The constructs-nothing contract, structurally: SLO objectives
+    declared under `obs.enabled=False` build NO ledger and NO engine
+    anywhere (launch leaves every handle None) — checked without a
+    stack launch (tier-1 budget) by driving the launch-time gate
+    directly."""
+    from jax_mapping.config import ObsConfig as _Obs
+    cfg = tiny_config().replace(obs=_Obs(
+        enabled=False,
+        slo=(SloObjective(name="x", metric="tick_deadline_ms",
+                          threshold=1.0),)))
+    # The launch gate in one line: everything hangs off obs.enabled.
+    assert not cfg.obs.enabled and cfg.obs.slo
+    from jax_mapping.bridge.mapper import MapperNode
+    from jax_mapping.bridge.bus import Bus
+    mapper = MapperNode(cfg, Bus(), n_robots=1)
+    assert mapper._pipeline is None and mapper._slo is None
+    mapper.destroy()
+    # And the armed mission stack has the full tier (the piggyback's
+    # positive control).
+    st = scenario_mission["stack"]
+    assert st.pipeline is not None and st.slo is not None
+    assert st.mapper._pipeline is st.pipeline
+    assert st.api.pipeline is st.pipeline
+
+
+@pytest.mark.slow
+def test_slo_partition_drill_fires_and_clears_deterministically(
+        tmp_path):
+    """THE chaos drill (ISSUE 15 acceptance): under a seeded FaultPlan
+    partition window on the scan path (`lidar_dead` takes every
+    robot's scan topic down), the scan→served freshness objective
+    fires a burn-rate alert DURING the window and clears after heal —
+    flight-recorded, visible on /status.slo, and two same-seed runs
+    fire and clear at the IDENTICAL step (the chaos-determinism
+    contract extended to alerting)."""
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.obs.recorder import flight_recorder
+
+    WINDOW_START, WINDOW_LEN, STEPS = 16, 24, 56
+    cfg = tiny_config().replace(obs=ObsConfig(enabled=True, slo=(
+        SloObjective(name="scan_to_served",
+                     metric="scan_to_served_p99_ms",
+                     threshold=1e9,          # wall p99 never breaches:
+                     max_silent_ticks=4,     # the drill is the stall
+                     fast_window_ticks=6, slow_window_ticks=12,
+                     fast_burn=0.5, slow_burn=0.25),)))
+    world, _ = W.rooms_with_doors(96, cfg.grid.resolution_m, seed=1)
+
+    def drive(seed):
+        mark = flight_recorder.mark()
+        st = launch_sim_stack(cfg, world, n_robots=2, realtime=False,
+                              seed=seed, http_port=0)
+        st.brain.start_exploring()
+        plan = FaultPlan(
+            [FaultEvent(step=WINDOW_START, kind="lidar_dead", robot=r,
+                        duration=WINDOW_LEN) for r in range(2)],
+            seed=seed)
+        st.attach_fault_plan(plan)
+        status_in_window = None
+        from jax_mapping.serving.client import DeltaMapClient
+        client = DeltaMapClient(f"http://127.0.0.1:{st.api.port}")
+        for step in range(STEPS):
+            st.run_steps(1)
+            client.poll()
+            if step == WINDOW_START + WINDOW_LEN - 2:
+                status_in_window = json.loads(
+                    st.api.handle("/status")[2])["slo"]
+        alerts = st.slo.alerts()
+        events = [
+            (e["tick"], e["objective"], e["state"])
+            for e in flight_recorder.events_since(mark)
+            if e["kind"] == "slo_alert"]
+        st.shutdown()
+        return alerts, events, status_in_window, client
+
+    alerts_a, events_a, status_a, client_a = drive(0)
+    # The loop closes: fired during the window, cleared after heal.
+    assert [(a[1], a[2]) for a in alerts_a] == [
+        ("scan_to_served", "firing"), ("scan_to_served", "clear")]
+    fire_tick, clear_tick = alerts_a[0][0], alerts_a[1][0]
+    assert WINDOW_START < fire_tick <= WINDOW_START + WINDOW_LEN, \
+        (fire_tick, alerts_a)
+    assert clear_tick > WINDOW_START + WINDOW_LEN, (clear_tick,
+                                                    alerts_a)
+    # Visible on /status.slo while inside the window.
+    (obj,) = status_a["objectives"]
+    assert obj["firing"] and obj["silent_ticks"] > 4
+    # Flight-recorded with the same deterministic fields.
+    assert events_a == [(fire_tick, "scan_to_served", "firing"),
+                        (clear_tick, "scan_to_served", "clear")]
+    # The client observed the staleness too (Server-Timing ages grow
+    # through the window).
+    assert client_a.revision_ages_ms
+    assert max(client_a.revision_ages_ms) > min(
+        client_a.revision_ages_ms)
+    # Determinism: the second same-seed run fires and clears at the
+    # IDENTICAL steps.
+    alerts_b, events_b, _, _ = drive(0)
+    assert alerts_b == alerts_a
+    assert events_b == events_a
