@@ -924,6 +924,7 @@ def _tenant_main() -> None:
         "speedup_32_vs_stack": None,
         "speedup_32_vs_dispatch": None,
         "bucket_variants_compiled": None,
+        "sentinel_overhead": None,
         "methodology": (
             "host-driven per-call wall time with a device barrier per "
             "tick on BOTH sides (never a fori_loop chain — the PR 5 "
@@ -939,7 +940,11 @@ def _tenant_main() -> None:
             "alongside and is much smaller on CPU (vmapped per-tenant "
             "compute amortizes ~2-3x here; the host tick loop is what "
             "megabatching removes — on TPU the compute axis "
-            "vectorizes too)"),
+            "vectorizes too). sentinel_overhead = two 8-tenant planes "
+            "(lane_health off vs armed) ticked tick-interleaved (the "
+            "PR 15 A/B methodology: host drift cancels); the armed "
+            "sentinel rides the SAME dispatch (zero extra dispatches), "
+            "gated <5% per-tick overhead"),
         "sections_completed": [], "sections_skipped": {},
         "devices": "unknown", "provenance": None}
     _run_suite_guarded(result, _tenant_run)
@@ -1014,6 +1019,52 @@ def _tenant_run(result: dict) -> None:
             int(megabatch_step._cache_size())
     except Exception:                       # noqa: BLE001 — telemetry
         pass
+
+    # --- sentinel overhead: tick-interleaved armed/off A/B ------------
+    # ISSUE 17 acceptance: the lane-health sentinel (health word fused
+    # into the megabatch dispatch — zero extra dispatches) must cost
+    # <5% per tick. The two planes alternate tick-for-tick (the PR 15
+    # interleave methodology: host drift lands on both sides equally),
+    # so the medians compare the same machine moment.
+    if _remaining() > 60.0:
+        armed_cfg = dataclasses.replace(cfg, tenancy=TenancyConfig(
+            enabled=True, prewarm_on_admit=False,
+            bit_exact_buckets=False, lane_health=True))
+        T_s = 8
+        planes = {}
+        for label, c in (("off", ten_cfg), ("armed", armed_cfg)):
+            p = TenantControlPlane(c, world_res_m=res)
+            for m in range(T_s):
+                p.admit(f"s{m}", world_np, seed=m)
+            p.step(warm_ticks)
+            jax.block_until_ready(p.live_batch().states.grid)
+            planes[label] = p
+        reps = 24
+        times = {"off": [], "armed": []}
+        for _ in range(reps):
+            for label in ("off", "armed"):
+                p = planes[label]
+                t0 = time.perf_counter()
+                p.step(1)
+                jax.block_until_ready(p.live_batch().states.grid)
+                times[label].append(time.perf_counter() - t0)
+        off_ms = float(np.median(times["off"])) * 1e3
+        armed_ms = float(np.median(times["armed"])) * 1e3
+        frac = (armed_ms - off_ms) / off_ms if off_ms > 0 else None
+        result["sentinel_overhead"] = {
+            "tenant_count": T_s, "reps": reps,
+            "off_ms_per_tick": round(off_ms, 4),
+            "armed_ms_per_tick": round(armed_ms, 4),
+            "overhead_frac": None if frac is None else round(frac, 4),
+            "gate_frac": 0.05,
+            "within_gate": None if frac is None else bool(frac < 0.05)}
+        result["sections_completed"].append("sentinel_overhead")
+        print(f"bench[tenant]: sentinel overhead: off {off_ms:.3f} ms "
+              f"armed {armed_ms:.3f} ms/tick "
+              f"({'n/a' if frac is None else f'{frac * 100:+.1f}%'})",
+              file=sys.stderr, flush=True)
+    else:
+        _skip_section("sentinel_overhead", f"{_remaining():.0f}s left")
 
     # --- sequential floor: bare solo fleet_step per mission -----------
     if _remaining() > 60.0:

@@ -28,6 +28,14 @@ B3 `B3-unguarded-write` state written without the lock that guards it
                         `__init__`) is a torn-read hazard. Deliberate
                         single-writer/GIL-atomic sites are baselined,
                         with the justification in the baseline note.
+                        The checker understands the `_locked` helper
+                        convention interprocedurally: a private method
+                        whose every same-class call site runs with a
+                        lock held (lexically, or via a caller that
+                        itself qualifies) is walked as lock-held — but
+                        a method that escapes as a value (callback
+                        reference) or is reachable from any unlocked
+                        site is not.
 
 Known static blind spots (the runtime `lockwatch` recorder covers the
 live stack where these matter): cross-*object* edges (`sub._offer`
@@ -142,6 +150,9 @@ class _ClassWalker:
         #: (attr, site node, symbol) unguarded writes outside __init__
         self.unguarded_writes: List[Tuple[str, ast.AST, str]] = []
         self._acquires_cache: Dict[str, Set[str]] = {}
+        #: True while walking a method that qualifies for the `_locked`
+        #: helper convention (see _entry_locked_map)
+        self._entry_locked_now = False
 
     def lock_name(self, attr: str) -> str:
         return f"{self.cls.name}.{self.aliases.get(attr, attr)}"
@@ -174,9 +185,76 @@ class _ClassWalker:
         return out
 
     def walk(self) -> None:
+        entry = self._entry_locked_map()
         for name, meth in self.cls.methods.items():
+            self._entry_locked_now = entry.get(name, False)
             self._walk_body(meth.body, [], f"{self.cls.name}.{name}",
                             in_init=(name == "__init__"))
+        self._entry_locked_now = False
+
+    # -- the `_locked` helper convention (B3 interprocedural step) -------
+    #
+    # A private method whose EVERY same-class reference runs with a lock
+    # held — lexically at the call site, or transitively because the
+    # caller itself qualifies — executes under that lock at runtime even
+    # though no `with` statement is visible in its own body. Treating
+    # its attribute accesses as unguarded would force either inlining
+    # every helper into the guarded block or baselining true positives,
+    # and the zero-suppression tiers forbid the latter. Public and
+    # dunder methods never qualify (they are entered from outside the
+    # class), nor does a method that escapes as a value (a callback
+    # reference is an unlocked entry point we cannot see).
+
+    def _entry_locked_map(self) -> Dict[str, bool]:
+        sites: Dict[str, List[Tuple[str, bool]]] = {}
+        for name, meth in self.cls.methods.items():
+            self._collect_sites(meth.body, False, name, sites)
+        cand = {n for n in sites
+                if n in self.cls.methods and n.startswith("_")
+                and not n.startswith("__")}
+        locked = {n: True for n in cand}
+        changed = True
+        while changed:          # monotone: True flips False, never back
+            changed = False
+            for n in cand:
+                if locked[n] and not all(
+                        lex or locked.get(caller, False)
+                        for caller, lex in sites[n]):
+                    locked[n] = False
+                    changed = True
+        return locked
+
+    def _collect_sites(self, node, held: bool, caller: str,
+                       sites: Dict[str, List[Tuple[str, bool]]]) -> None:
+        if isinstance(node, list):
+            for n in node:
+                self._collect_sites(n, held, caller, sites)
+            return
+        if isinstance(node, ast.With):
+            h = held or any(self._with_lock_attr(i) is not None
+                            for i in node.items)
+            self._collect_sites(node.items, held, caller, sites)
+            self._collect_sites(node.body, h, caller, sites)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                       # nested defs run later, unheld
+        if isinstance(node, ast.Call):
+            m = A._self_attr(node.func)
+            if m is not None and m in self.cls.methods:
+                sites.setdefault(m, []).append((caller, held))
+                for sub in ast.iter_child_nodes(node):
+                    if sub is not node.func:
+                        self._collect_sites(sub, held, caller, sites)
+                return
+        elif isinstance(node, ast.Attribute):
+            m = A._self_attr(node)
+            if m is not None and m in self.cls.methods:
+                # `self._helper` escaping as a value: an entry point
+                # whose lock posture we cannot see — count it unlocked.
+                sites.setdefault(m, []).append((caller, False))
+        for sub in ast.iter_child_nodes(node):
+            self._collect_sites(sub, held, caller, sites)
 
     def _walk_body(self, body: List[ast.stmt], held: List[str],
                    symbol: str, in_init: bool) -> None:
@@ -215,11 +293,12 @@ class _ClassWalker:
                 attr = self._store_attr(t)
                 if attr is None or attr in self.cls.lock_attrs:
                     continue
-                if held:
+                if held or self._entry_locked_now:
                     self.guarded.add(attr)
                 elif not in_init:
                     self.unguarded_writes.append((attr, node, symbol))
-        elif isinstance(node, ast.Attribute) and held:
+        elif isinstance(node, ast.Attribute) \
+                and (held or self._entry_locked_now):
             attr = A._self_attr(node)
             if attr is not None and attr not in self.cls.lock_attrs:
                 self.guarded.add(attr)

@@ -235,16 +235,23 @@ REPO_PROTECTION: List[LockGroup] = [
     # snapshot under `_lock` — admissions/evictions from operator or
     # HTTP threads race the stepping thread, which is exactly the
     # cross-thread churn the tenancy racewatch gate hammers
-    # (tests/test_tenancy.py). The wiring references (cfg,
-    # world_res_m, checkpoint_dir, warmup) are set-once at
-    # construction, read-only after (the StagedWarmup convention).
+    # (tests/test_tenancy.py), joined in this PR by the lane-health
+    # ladder, the poison set and the quarantine/admission counters —
+    # the sentinel fold and the /status reader race across threads
+    # (tests/test_tenant_containment.py's racewatch gate). The wiring
+    # references (cfg, world_res_m, checkpoint_dir, warmup, pipeline,
+    # _journal) are set-once at construction, read-only after (the
+    # StagedWarmup convention; the journal's own file state is only
+    # ever touched under `_lock`).
     group("TenantControlPlane", "_lock",
           ["_missions", "_order", "_prev_order", "_batch",
            "_warmed_buckets", "_tile_stores", "_last_diag",
+           "_lanehealth", "_poisoned", "_admissions_in_flight",
            "n_admitted", "n_evicted", "n_suspended", "n_resumed",
-           "n_prewarms", "n_ticks", "n_compactions"],
+           "n_prewarms", "n_ticks", "n_compactions",
+           "n_quarantined", "n_admissions_rejected"],
           lockfree_ok=["cfg", "world_res_m", "checkpoint_dir",
-                       "warmup"]),
+                       "warmup", "pipeline", "_journal"]),
     # Warm dispatch pool (io/compile_cache.py): the entry table and its
     # serve/fallthrough/drop counters mutate together from every thread
     # that dispatches a wrapped entry point; `_bindings`/`installed`

@@ -918,10 +918,20 @@ class MapApiServer:
         # The warming flag is part of the REPRESENTATION (body and ETag
         # must agree — the /trace doctrine): a poller current on the
         # steady-state tag still learns the window opened, and a cached
-        # warming body can never 304 past the window's end.
+        # warming body can never 304 past the window's end. The
+        # quarantine stamp follows the same doctrine: a quarantined
+        # tenant keeps serving its frozen last-good revision, but the
+        # body and tag both say so — a client current on the healthy
+        # tag re-fetches once and learns the state, and a cached
+        # quarantined body can never 304 past the re-admission (whose
+        # epoch bump changes the tag anyway).
         warming = self.warming
-        etag = f'W/"{source}-e{epoch}-r{rev}' + \
-            ('-warming"' if warming else '"')
+        quarantined = (tenant is not None
+                       and self.tenancy.tenant_lifecycle(tenant)
+                       == "quarantined")
+        suffix = ('-warming' if warming else '') + \
+            ('-quarantined' if quarantined else '')
+        etag = f'W/"{source}-e{epoch}-r{rev}{suffix}"'
         # First-client-delivery waypoint + Server-Timing revision age:
         # a 304 confirms freshness exactly as a body does (the client
         # HOLDS the revision), so both answers stamp and both carry
@@ -948,6 +958,11 @@ class MapApiServer:
             # (the restarted node hasn't entered service yet) — valid,
             # stamped, and explicitly stale.
             body["state"] = "warming"
+        if quarantined:
+            # Containment: the frozen last-good revision of a
+            # quarantined tenant — valid, stamped, and explicitly not
+            # advancing until a re-admission probe passes.
+            body["state"] = "quarantined"
         return 200, "application/json", json.dumps(body).encode(), \
             {"ETag": etag, **timing}
 

@@ -149,7 +149,9 @@ class Stack:
     def save_auto_checkpoint(self) -> None:
         """The supervisor's checkpoint cadence hook: snapshot the mapper
         to `auto_checkpoint_path` (save_checkpoint rotates the previous
-        generation to the .prev slot — the corruption fallback)."""
+        generation to the .prev slot — the corruption fallback). A
+        journal-armed tenancy plane checkpoints its live tenants on the
+        same cadence — the durability heartbeat `restore()` replays."""
         from jax_mapping.io.checkpoint import save_checkpoint
         os.makedirs(os.path.dirname(self.auto_checkpoint_path),
                     exist_ok=True)
@@ -158,6 +160,34 @@ class Stack:
             config_json=self.cfg.to_json(),
             retain_generations=self.cfg.resilience
             .checkpoint_retain_generations)
+        if self.tenancy is not None:
+            self.tenancy.checkpoint_all()
+
+    def crash_controlplane(self) -> dict:
+        """Kill the tenancy control plane and rebuild it from its
+        journal + checkpoints (the `controlplane_crash` FaultPlan kind
+        and the supervisor-restart durability contract): the in-memory
+        registry is dropped wholesale, a NEW plane replays
+        snapshot+journal via `restore()`, and the API swaps to it
+        atomically — the same tenant set comes back with every epoch
+        advanced, so live `/tiles?tenant=` clients resync instead of
+        seeing revision regressions. Returns the restore report."""
+        old = self.tenancy
+        if old is None:
+            raise ValueError("crash_controlplane: no tenancy plane "
+                             "on this stack")
+        old.checkpoint_all()
+        from jax_mapping.tenancy import TenantControlPlane
+        plane = TenantControlPlane(
+            self.cfg, world_res_m=old.world_res_m,
+            checkpoint_dir=old.checkpoint_dir,
+            compile_cache=self.compile_cache, devprof=self.devprof,
+            pipeline=self.pipeline)
+        report = plane.restore()
+        self.tenancy = plane
+        if self.api is not None:
+            self.api.tenancy = plane
+        return report
 
     def restart_mapper(self) -> None:
         """The supervisor's mapper restarter: a STAGED warm-up (ISSUE

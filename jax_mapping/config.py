@@ -951,6 +951,66 @@ class TenancyConfig:
     #: control plane has a checkpoint dir.
     checkpoint_on_evict: bool = True
 
+    # -- tenant blast-radius containment (ISSUE 17) ----------------------
+    # All default OFF: the defaults reproduce pre-containment behavior
+    # bit-exactly (the knob-off doctrine, property-tested). Arming
+    # `lane_health` changes no numerics either — the health word is a
+    # pure READER fused into the megabatch dispatch.
+
+    #: Compute a per-tenant health word ON DEVICE inside the SAME
+    #: `megabatch_step` dispatch (no extra dispatch; the host reads it
+    #: at the pending-flag barrier it already pays): bit 0 = NaN/Inf
+    #: in the lane's pose / grid-delta leaves, bit 1 = pose-jump
+    #: magnitude over `pose_jump_max_m`, bit 2 = accepted-key
+    #: match response under `match_floor`. The control plane folds the
+    #: word into the healthy -> suspect -> QUARANTINED hysteresis
+    #: ladder (tenancy/lanehealth.py, the EstimatorWatchdog semantics
+    #: lifted from robots to tenants).
+    lane_health: bool = False
+    #: Per-tick pose-jump gate, metres: the max over robots of the
+    #: within-step estimated-pose translation. A healthy micro mission
+    #: moves ~cm/tick; an estimator blow-up teleports.
+    pose_jump_max_m: float = 0.5
+    #: Match-response floor for ACCEPTED key-step matches; 0.0 disables
+    #: the bit (sub-gate steps carry no match information).
+    match_floor: float = 0.0
+    #: Hysteresis: consecutive flagged ticks before a suspect tenant is
+    #: QUARANTINED (its lane frozen in place via the pad-style
+    #: `active=False` select — an exact no-op for co-tenants). One
+    #: flagged tick already demotes healthy -> suspect; a clean tick
+    #: returns suspect -> healthy. There is NO flag-based exit from
+    #: quarantine (the watchdog asymmetry): only a verified
+    #: re-admission probe resumes the lane.
+    quarantine_persist_ticks: int = 2
+    #: Re-admission probe cadence, in plane ticks after quarantine: the
+    #: probe finite-checks the held last-good state and runs ONE tick
+    #: through the solo `fleet_step` executable (never a megabatch
+    #: variant); output must stay finite and within the pose-jump gate.
+    #: Success resumes the lane and bumps the tenant's epoch.
+    readmit_probe_ticks: int = 8
+    #: Bounded probe budget: after this many failed probes the tenant
+    #: stays quarantined until an operator evicts or resumes it
+    #: explicitly — a NaN-poisoned state must not buy a solo dispatch
+    #: forever.
+    max_readmit_probes: int = 3
+    #: Durable control plane: append-only CRC-per-record lifecycle
+    #: journal + compaction snapshots under the checkpoint dir
+    #: (tenancy/journal.py, the io/checkpoint corruption doctrine:
+    #: torn tail truncated, never fatal). `restore()` replays
+    #: snapshot+journal and re-admits tenants from their
+    #: generation-retained checkpoints with epochs bumped, so a plane
+    #: crash with live tenants comes back with the SAME tenant set.
+    journal: bool = False
+    #: Compact the journal into a registry snapshot every N appended
+    #: records (0 = compact only on checkpoint_all/restore).
+    journal_compact_every: int = 64
+    #: Bounded admission queue: more than this many concurrent
+    #: `admit()`/`resume()` calls in flight (the pre-warm window) are
+    #: REJECTED with `AdmissionRejected` + a `tenancy_admission_
+    #: rejected` flight event instead of blocking without bound behind
+    #: the commit lock. 0 = unbounded (pre-containment behavior).
+    admission_queue_max: int = 0
+
 
 @_frozen
 class AnalysisConfig:

@@ -98,6 +98,35 @@ results bit-identical while restarts degrade from warm to cold.
                         plain recompile, never crashes. No-op (noted in
                         the log) on stacks without a cold-start tier,
                         like corrupt_checkpoint with no file.
+
+TENANT kinds (ISSUE 17, blast-radius containment): the fault targets
+ONE tenant of a megabatched control plane — the containment contract
+is that co-tenants never notice (bit-identical to a no-fault twin)
+while the sentinels quarantine the victim. Injected at the plane's
+own chaos seams (`TenantControlPlane.set_tenant_poison` /
+`state_jump_tenant`), never by reaching into the batch from outside.
+`name` is the tenant id for both. No-op (noted) on stacks without a
+tenancy plane.
+
+    tenant_poison       NaN the tenant's est-pose lane inputs every
+                        tick of the window (covariance collapse /
+                        odometry blow-up); overlapping windows on one
+                        tenant refcount — the first to clear must not
+                        un-poison a lane another window still holds.
+                        The NONFINITE sentinel quarantines the lane
+                        within the hysteresis budget.
+    tenant_state_jump   teleport the tenant's estimated poses by
+                        `value` metres (one-shot): survivable-state
+                        corruption — the poses stay finite, but scan
+                        matching against the tenant's own map degrades,
+                        which the MATCH-FLOOR sentinel catches.
+    controlplane_crash  kill the plane mid-mission and rebuild it from
+                        its journal + checkpoints
+                        (`Stack.crash_controlplane`): the in-memory
+                        registry is lost, `restore()` replays
+                        snapshot+journal, and every tenant comes back
+                        with its epoch advanced (clients resync via
+                        the epoch protocol).
 """
 
 from __future__ import annotations
@@ -116,11 +145,17 @@ SENSOR_KINDS = frozenset({
 #: the decaying mapper's healing path is their target).
 WORLD_KINDS = frozenset({"door_close", "crowd"})
 
+#: Tenant blast-radius kinds (TenantControlPlane chaos-seam boundary;
+#: the containment ladder + durable registry are their targets).
+TENANT_KINDS = frozenset({
+    "tenant_poison", "tenant_state_jump", "controlplane_crash",
+})
+
 KINDS = frozenset({
     "lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
     "kill_node", "kill_robot", "rejoin_robot", "corrupt_checkpoint",
     "cache_wipe",
-}) | SENSOR_KINDS | WORLD_KINDS
+}) | SENSOR_KINDS | WORLD_KINDS | TENANT_KINDS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -166,6 +201,16 @@ class FaultEvent:
             raise ValueError(
                 "crowd needs value > 0: the blob radius in metres "
                 "(0.0 stamps nothing)")
+        if self.kind in ("tenant_poison", "tenant_state_jump") \
+                and not self.name:
+            raise ValueError(
+                f"{self.kind} needs name = the target tenant id (an "
+                "unnamed tenant fault is a no-op a chaos drill would "
+                "silently 'pass' with)")
+        if self.kind == "tenant_state_jump" and self.value <= 0.0:
+            raise ValueError(
+                "tenant_state_jump needs value > 0: the teleport "
+                "distance in metres (0.0 jumps nowhere)")
 
 
 class FaultPlan:
@@ -210,6 +255,9 @@ class FaultPlan:
         #: crowd id -> active radii (the sensor pattern: the sim runs
         #: the WORST = largest active blob, gone when none remain).
         self._crowd: Dict[int, list] = {}
+        #: tenant id -> held-poison refcount (the partition pattern:
+        #: last window out un-poisons the lane).
+        self._tenant_poison_refs: Dict[str, int] = {}
 
     # -- boundary helpers ----------------------------------------------------
 
@@ -403,6 +451,38 @@ class FaultPlan:
                         m.wipe_release()
                     self._clears.append((step + ev.duration, _rearm,
                                          "cache_wipe"))
+        elif ev.kind in ("tenant_poison", "tenant_state_jump"):
+            plane = getattr(stack, "tenancy", None)
+            if plane is None:
+                self._note(step, f"{ev.kind} skipped (no tenant "
+                                 "control plane on this stack)")
+            elif ev.kind == "tenant_poison":
+                self._hold_tenant_poison(plane, ev.name)
+                self._note(step, f"tenant_poison {ev.name}")
+                if ev.duration:
+                    def _unpoison(tid=ev.name):
+                        # Re-read the plane at clear time: a
+                        # controlplane_crash inside the window must
+                        # clear against the RESTORED plane, not the
+                        # dead one.
+                        self._release_tenant_poison(
+                            getattr(stack, "tenancy", None), tid)
+                    self._clears.append((step + ev.duration, _unpoison,
+                                         f"tenant_poison {ev.name}"))
+            else:
+                plane.state_jump_tenant(ev.name, ev.value)
+                self._note(step,
+                           f"tenant_state_jump {ev.name}={ev.value}m")
+        elif ev.kind == "controlplane_crash":
+            crash = getattr(stack, "crash_controlplane", None)
+            if crash is None or getattr(stack, "tenancy", None) is None:
+                self._note(step, "controlplane_crash skipped (no "
+                                 "tenant control plane on this stack)")
+            else:
+                report = crash()
+                self._note(step, "controlplane_crash restored="
+                                 f"{len(report.get('restored', []))} "
+                                 f"lost={len(report.get('lost', []))}")
         elif ev.kind == "corrupt_checkpoint":
             path = ev.name or getattr(stack, "auto_checkpoint_path", "")
             if path and os.path.exists(path):
@@ -414,6 +494,17 @@ class FaultPlan:
             else:
                 self._note(step, f"corrupt_checkpoint skipped "
                                  f"(no file at {path!r})")
+
+    def _hold_tenant_poison(self, plane, tid: str) -> None:
+        self._tenant_poison_refs[tid] = \
+            self._tenant_poison_refs.get(tid, 0) + 1
+        plane.set_tenant_poison(tid, True)
+
+    def _release_tenant_poison(self, plane, tid: str) -> None:
+        n = self._tenant_poison_refs.get(tid, 1) - 1
+        self._tenant_poison_refs[tid] = max(0, n)
+        if n <= 0 and plane is not None:
+            plane.set_tenant_poison(tid, False)  # last window out
 
     def _rejoin(self, stack, robot: int) -> None:
         if self._robot_kill_refs.get(robot, 0) <= 0:
@@ -451,6 +542,10 @@ def _fault_resource(kind: str, robot: int, name: str = "") -> tuple:
         return ("crowd", robot)          # robot field = crowd id
     if kind == "cache_wipe":
         return ("cache",)                # one compile cache per stack
+    if kind in ("tenant_poison", "tenant_state_jump"):
+        return ("tenant", name)          # name field = tenant id
+    if kind == "controlplane_crash":
+        return ("controlplane",)         # one plane per stack
     return ("bus", kind)                 # bus_drop / bus_reorder
 
 
@@ -468,13 +563,19 @@ def _sample_value(rng: random.Random, kind: str) -> float:
         return round(rng.uniform(0.1, 0.4), 3)
     if kind == "crowd":
         return round(rng.uniform(0.15, 0.4), 3)
+    if kind == "tenant_state_jump":
+        # Well past any honest per-tick translation, well inside the
+        # arena: the jump must corrupt, not escape the map.
+        return round(rng.uniform(0.5, 2.0), 3)
     return 0.0
 
 
 def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
                 n_robots: int = 1, door_names=(),
                 n_crowds: int = 0,
-                allow_cache_wipe: bool = False) -> FaultPlan:
+                allow_cache_wipe: bool = False,
+                tenant_ids=(),
+                allow_controlplane_crash: bool = False) -> FaultPlan:
     """Generate a reproducible schedule: `seed` fully determines the
     fault mix, placement, and durations (fuzz-style soak variety with
     CI-replayable failures). Samples the adversarial sensor kinds
@@ -491,18 +592,27 @@ def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
     admits `crowd` windows with kind-appropriate blob radii (one crowd
     id = one resource), `allow_cache_wipe` admits `cache_wipe` windows
     (stacks with a cold-start compile cache; the one cache = one
-    resource). Default arguments reproduce the pre-scenario sampler
+    resource), `tenant_ids` (ids live on the stack's tenancy plane)
+    admits `tenant_poison` / `tenant_state_jump` windows (one tenant =
+    one resource), and `allow_controlplane_crash` admits ONE
+    `controlplane_crash` per plan (the one plane = one resource).
+    Default arguments reproduce the pre-scenario sampler
     bit-for-bit."""
     rng = random.Random(seed)
     kinds = ["lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
              "wheel_slip", "lidar_miscal", "ghost_returns", "scan_jam"]
     door_names = list(door_names)
+    tenant_ids = list(tenant_ids)
     if door_names:
         kinds.append("door_close")
     if n_crowds > 0:
         kinds.append("crowd")
     if allow_cache_wipe:
         kinds.append("cache_wipe")
+    if tenant_ids:
+        kinds += ["tenant_poison", "tenant_state_jump"]
+    if allow_controlplane_crash:
+        kinds.append("controlplane_crash")
     events: List[FaultEvent] = []
     occupied: List[tuple] = []           # (resource, start, end)
     shortfall = 0
@@ -513,13 +623,22 @@ def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
             duration = rng.randrange(3, 12)
             robot = rng.randrange(n_crowds) if kind == "crowd" \
                 else rng.randrange(n_robots)
-            name = rng.choice(door_names) if kind == "door_close" else ""
+            name = ""
+            if kind == "door_close":
+                name = rng.choice(door_names)
+            elif kind in ("tenant_poison", "tenant_state_jump"):
+                name = rng.choice(tenant_ids)
             res = _fault_resource(kind, robot, name)
-            end = step + duration
-            if any(r == res and step <= e and s <= end
+            start, end = step, step + duration
+            if kind == "controlplane_crash":
+                # The crash occupies the plane for the WHOLE mission:
+                # one crash per plan (a second restore would re-bump
+                # every epoch and make no fault attributable to either).
+                start, end = 0, mission_steps
+            if any(r == res and start <= e and s <= end
                    for r, s, e in occupied):
                 continue                 # same-resource overlap: reject
-            occupied.append((res, step, end))
+            occupied.append((res, start, end))
             events.append(FaultEvent(
                 step=step, kind=kind, robot=robot, duration=duration,
                 value=_sample_value(rng, kind), name=name))

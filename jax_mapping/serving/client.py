@@ -48,6 +48,20 @@ class RevisionRegression(AssertionError):
     """The server violated revision monotonicity for this client."""
 
 
+class TenantGone(RuntimeError):
+    """A tenant route answered 404: the tenant was evicted (or never
+    existed) — mission CHURN, not server breakage. Loadgen and
+    operators branch on this instead of a generic HTTPError; the
+    server's error body rides along as `.detail`."""
+
+    def __init__(self, route: str, detail: str = ""):
+        super().__init__(
+            f"tenant route {route} is gone"
+            + (f": {detail}" if detail else ""))
+        self.route = route
+        self.detail = detail
+
+
 class DeltaMapClient:
     """Polls one tile route and maintains the reconstructed mosaics."""
 
@@ -81,6 +95,11 @@ class DeltaMapClient:
         self.last_revision_age_ms: Optional[float] = None
         self.revision_ages_ms: List[float] = []
         self._age_history_cap = 4096
+        #: The body's status stamp from the last 200 ("warming" /
+        #: "quarantined" / None for steady state) — a quarantined
+        #: tenant keeps serving its frozen last-good revision, and
+        #: this is how a client tells frozen-by-design from stalled.
+        self.state: Optional[str] = None
 
     # -- protocol ------------------------------------------------------------
 
@@ -90,7 +109,10 @@ class DeltaMapClient:
         Replays the server's ETag as `If-None-Match`: a client that is
         already at the live revision pays a body-less 304, not even the
         empty-manifest JSON."""
-        url = f"{self.base_url}{self.route}?since={self.revision}"
+        # Routes may carry their own query (the per-tenant namespace:
+        # route="/tiles?tenant=m0"); extend it instead of double-"?".
+        sep = "&" if "?" in self.route else "?"
+        url = f"{self.base_url}{self.route}{sep}since={self.revision}"
         if level is not None:
             url += f"&level={level}"
         req = urllib.request.Request(url)
@@ -103,6 +125,15 @@ class DeltaMapClient:
                 self._etag = r.headers.get("ETag") or self._etag
                 self._note_age(r.headers.get("Server-Timing"))
         except urllib.error.HTTPError as e:
+            if e.code == 404 and "tenant=" in self.route:
+                # Tenant churn, typed: an evicted/unknown tenant's 404
+                # must read as TenantGone, not generic breakage.
+                try:
+                    detail = json.loads(e.read() or b"{}").get(
+                        "error", "")
+                except (ValueError, OSError):
+                    detail = ""
+                raise TenantGone(self.route, detail) from e
             if e.code != 304:
                 raise
             e.read()
@@ -120,6 +151,7 @@ class DeltaMapClient:
         self.bytes_received += len(raw)
         if first:
             self.snapshot_bytes = len(raw)
+        self.state = body.get("state")
         if self._note_epoch(body):
             # Restart epoch advanced: this body is a delta against a
             # serving generation we no longer share. Cache dropped;

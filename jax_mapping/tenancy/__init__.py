@@ -15,17 +15,36 @@ TENANT axis to the mission hot path and a control plane to feed it:
   the ISSUE 12 staged-warm-up ladder, eviction checkpoints through the
   generation-retention machinery, and per-tenant serving
   epoch/revision namespaces for `/tiles` delta sessions.
+* :mod:`jax_mapping.tenancy.lanehealth` /
+  :mod:`jax_mapping.tenancy.journal` — tenant blast-radius containment
+  (ISSUE 17): the healthy -> suspect -> QUARANTINED hysteresis ladder
+  fed by the megabatch's fused device health word, and the
+  append-only CRC-per-record lifecycle journal + compaction snapshot
+  that make the registry survive a plane crash (`restore()`).
 
 Bit-identity is the contract: a tenant's trajectory inside a megabatch
 equals its solo `fleet_step` trajectory bit-for-bit — same seed, any
-bucket size, any co-tenants (tests/test_tenancy.py).
+bucket size, any co-tenants (tests/test_tenancy.py) — and a
+quarantined co-tenant freezes via the same exact-no-op select pads
+use, so containment never bends that contract.
 """
 
-from jax_mapping.tenancy.megabatch import (TenantBatch, bucket_capacity,
+from jax_mapping.tenancy.megabatch import (HEALTH_MATCH_FLOOR,
+                                           HEALTH_NONFINITE,
+                                           HEALTH_POSE_JUMP,
+                                           TenantBatch, bucket_capacity,
+                                           lane_health_host,
                                            make_tenant_batch,
                                            megabatch_step,
                                            megabatch_tick)
-from jax_mapping.tenancy.controlplane import TenantControlPlane
+from jax_mapping.tenancy.lanehealth import LaneHealthLadder
+from jax_mapping.tenancy.journal import ControlJournal, read_registry
+from jax_mapping.tenancy.controlplane import (AdmissionRejected,
+                                              TenantControlPlane)
 
 __all__ = ["TenantBatch", "bucket_capacity", "make_tenant_batch",
-           "megabatch_step", "megabatch_tick", "TenantControlPlane"]
+           "megabatch_step", "megabatch_tick", "TenantControlPlane",
+           "HEALTH_NONFINITE", "HEALTH_POSE_JUMP",
+           "HEALTH_MATCH_FLOOR", "lane_health_host",
+           "LaneHealthLadder", "ControlJournal", "read_registry",
+           "AdmissionRejected"]

@@ -29,12 +29,39 @@ sessions key cache validity on (epoch, revision) and a resumed
 mission can never 304 a stale pre-suspend tile as current
 (`tile_store`).
 
+Blast-radius containment (ISSUE 17) adds three facilities on top:
+
+* **lane health** (`TenancyConfig.lane_health`): `step()` folds the
+  megabatch's device-computed health words through a per-tenant
+  `LaneHealthLadder` (healthy -> suspect -> QUARANTINED). A suspect
+  tenant's published revision FREEZES on its last-good content (the
+  pre-flag lane state is held and served, so a frozen revision's
+  bytes never drift under it); a quarantined tenant's lane freezes
+  in place via the pad-style ``active=False`` select — an exact
+  no-op, so co-tenants stay bit-identical to a no-fault run by the
+  same construction pads use — and bounded seeded probes
+  (finite-check + one solo-executable tick) gate re-admission, which
+  bumps the epoch like any other re-admission.
+* **durable registry** (`TenancyConfig.journal`): every lifecycle
+  transition appends a CRC'd record to `tenancy/journal.py` under
+  the checkpoint dir; `checkpoint_all()` snapshots live tenant state
+  through the generation-retention machinery and `restore()` replays
+  snapshot+journal to re-admit the SAME tenant set after a plane
+  crash, every epoch bumped (the PR 8 epoch protocol — clients
+  resync instead of seeing revision regressions).
+* **chaos hooks** (`set_tenant_poison` / `state_jump_tenant`): the
+  seam `resilience/faultplan.py`'s tenant kinds drive — lane-input
+  mutation happens here, under the plane's own lock, never by
+  reaching into the batch from outside.
+
 Thread contract: the mission registry, slot order and live batch
 mutate only under `_lock` (declared in `analysis/protection.py`,
 racewatch-gated over cross-thread admit/evict); flight-recorder
 events emit AFTER the lock releases (the StagedWarmup `_move`
 discipline), and counters are read lock-free by the /status
-convention.
+convention. The health ladder and journal are LEAF structures owned
+by `_lock` (the `_missions` convention — journal file IO ordering
+must match the registry mutation order it records).
 """
 
 from __future__ import annotations
@@ -51,10 +78,21 @@ import jax.numpy as jnp
 from jax_mapping.config import SlamConfig
 from jax_mapping.models import fleet as FM
 from jax_mapping.tenancy import megabatch as MB
+from jax_mapping.tenancy.lanehealth import (HEALTHY, QUARANTINED,
+                                            LaneHealthLadder)
 
 #: The megabatch entry point's registry-qualified name (the devprof /
 #: compile-budget naming contract).
 MEGABATCH_ENTRY = "jax_mapping.tenancy.megabatch.megabatch_step"
+
+
+class AdmissionRejected(RuntimeError):
+    """Raised by `admit()` when `TenancyConfig.admission_queue_max`
+    concurrent admissions are already in flight: bounded backpressure
+    instead of unbounded serialization behind the commit lock — the
+    caller retries or sheds, and the rejection is a
+    `tenancy_admission_rejected` flight event + /status counter, not
+    an invisible stall."""
 
 
 class _Mission:
@@ -120,7 +158,24 @@ class TenantControlPlane:
         self.n_prewarms = 0
         self.n_ticks = 0
         self.n_compactions = 0
+        self.n_quarantined = 0
+        self.n_admissions_rejected = 0
         self._tile_stores: Dict[str, object] = {}
+        #: Blast-radius containment (ISSUE 17): the hysteresis ladder
+        #: and chaos-poison set are leaf structures mutated only under
+        #: `_lock`; `_admissions_in_flight` is the bounded-admission
+        #: gauge behind `AdmissionRejected`.
+        self._lanehealth = LaneHealthLadder(cfg.tenancy)
+        self._poisoned: set = set()
+        self._admissions_in_flight = 0
+        #: Durable registry: armed by `TenancyConfig.journal` when a
+        #: checkpoint dir exists. Set-once wiring (the warmup
+        #: convention); appends run under `_lock`.
+        self._journal = None
+        if cfg.tenancy.journal and checkpoint_dir is not None:
+            from jax_mapping.tenancy.journal import ControlJournal
+            self._journal = ControlJournal(
+                os.path.join(checkpoint_dir, "controlplane"))
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -132,7 +187,38 @@ class TenantControlPlane:
         it); `state` resumes from a given FleetState (eviction
         re-admission), otherwise the mission initialises from its
         seed. Pre-warms the post-admission bucket variant first when
-        it has not compiled yet."""
+        it has not compiled yet.
+
+        When `admission_queue_max > 0`, at most that many admissions
+        may be in flight at once (an admission spans its pre-warm, so
+        an unbounded pile-up would serialize behind the commit lock
+        for a compile each): excess admissions raise
+        `AdmissionRejected` immediately instead of queueing."""
+        qmax = self.cfg.tenancy.admission_queue_max
+        with self._lock:
+            if qmax > 0 and self._admissions_in_flight >= qmax:
+                self.n_admissions_rejected += 1
+                in_flight = self._admissions_in_flight
+            else:
+                self._admissions_in_flight += 1
+                in_flight = None
+        if in_flight is not None:
+            from jax_mapping.obs.recorder import flight_recorder
+            flight_recorder.record("tenancy_admission_rejected",
+                                   tenant=tid, in_flight=in_flight,
+                                   queue_max=qmax)
+            raise AdmissionRejected(
+                f"admission of {tid!r} rejected: {in_flight} "
+                f"admission(s) already in flight "
+                f"(admission_queue_max={qmax})")
+        try:
+            self._admit(tid, world, seed, state, dynamics)
+        finally:
+            with self._lock:
+                self._admissions_in_flight -= 1
+
+    def _admit(self, tid: str, world, seed: int,
+               state: Optional[FM.FleetState], dynamics) -> None:
         world = jnp.asarray(world)
         key = jax.random.PRNGKey(seed)
         if state is None:
@@ -198,6 +284,12 @@ class TenantControlPlane:
             self.n_admitted += 1
             epoch = m.epoch
             self._tile_stores.pop(tid, None)
+            self._journal_append("admit", m)
+        if self._journal is not None:
+            # A journal-armed admission durably exists from tick zero:
+            # a plane crash before the first checkpoint_all() must
+            # restore the tenant, not report it lost.
+            self._checkpoint_tenant(tid, state, world, key)
         from jax_mapping.obs.recorder import flight_recorder
         flight_recorder.record("tenancy_admit", tenant=tid, seed=seed,
                                epoch=epoch, bucket=bucket,
@@ -212,7 +304,10 @@ class TenantControlPlane:
             held = self._lane_state_locked(tid)
             order2 = [t for t in self._order if t != tid]
             batch2, prev2, compacted = self._rebuilt(order2)
-            m.held_state = held
+            if m.held_state is None:
+                # A SUSPECT tenant already holds its last-good state —
+                # suspending must not clobber it with the flagged lane.
+                m.held_state = held
             m.state = "suspended"
             self._order = order2
             self._batch = batch2
@@ -220,6 +315,10 @@ class TenantControlPlane:
             if compacted:
                 self.n_compactions += 1
             self.n_suspended += 1
+            # Out of the batch means out of the ladder: a later resume
+            # re-enters with a clean bill of health.
+            self._lanehealth.forget(tid)
+            self._journal_append("suspend", m)
         from jax_mapping.obs.recorder import flight_recorder
         flight_recorder.record("tenancy_suspend", tenant=tid)
 
@@ -255,6 +354,7 @@ class TenantControlPlane:
             self.n_resumed += 1
             epoch = m.epoch
             self._tile_stores.pop(tid, None)
+            self._journal_append("resume", m)
         from jax_mapping.obs.recorder import flight_recorder
         flight_recorder.record("tenancy_resume", tenant=tid,
                                epoch=epoch, bucket=bucket,
@@ -268,9 +368,14 @@ class TenantControlPlane:
         if checkpoint is None:
             checkpoint = self.cfg.tenancy.checkpoint_on_evict
         with self._lock:
-            m = self._require(tid, ("active", "suspended"))
-            if m.state == "active":
-                final = self._lane_state_locked(tid)
+            m = self._require(tid, ("active", "suspended",
+                                    "quarantined"))
+            if m.state in ("active", "quarantined"):
+                # A quarantined tenant's live lane is its FROZEN
+                # (possibly poisoned) state; the held last-good state
+                # is what an eviction checkpoint must preserve.
+                final = (m.held_state if m.state == "quarantined"
+                         else self._lane_state_locked(tid))
                 order2 = [t for t in self._order if t != tid]
                 batch2, prev2, compacted = self._rebuilt(order2)
                 self._order = order2
@@ -282,6 +387,9 @@ class TenantControlPlane:
                 final = m.held_state
             m.held_state = None
             m.state = "evicted"
+            self._lanehealth.forget(tid)
+            self._poisoned.discard(tid)
+            self._journal_append("evict", m)
             # Free the heavy references: a long-lived plane churning
             # through many distinct tenant ids must not pin one world
             # array per lifetime eviction. The record itself stays as
@@ -292,7 +400,8 @@ class TenantControlPlane:
             self.n_evicted += 1
             self._tile_stores.pop(tid, None)
         path = None
-        if checkpoint and self.checkpoint_dir is not None:
+        if checkpoint and self.checkpoint_dir is not None \
+                and final is not None:
             from jax_mapping.io.checkpoint import save_checkpoint
             os.makedirs(self.checkpoint_dir, exist_ok=True)
             path = os.path.join(self.checkpoint_dir,
@@ -322,8 +431,11 @@ class TenantControlPlane:
         lock, reconcile admissions on install) is a known follow-up,
         not a correctness issue."""
         diag = None
+        armed = (self.cfg.tenancy.enabled
+                 and self.cfg.tenancy.lane_health)
         for _ in range(n):
             stamped = []
+            events = []
             with self._lock:
                 if not self._order:
                     return None
@@ -331,16 +443,40 @@ class TenantControlPlane:
                 if refreshed is not None:
                     self._batch = self._batch._replace(
                         worlds=refreshed)
+                # Last-good capture point: BEFORE the chaos seam, so a
+                # poisoned tick's held state is the genuine pre-fault
+                # content, not the injected garbage.
+                batch_before = self._batch
+                if self._poisoned:
+                    self._inject_poison_locked()
                 batch = self._batch
-                self._batch, diag = MB.megabatch_tick(
+                self._batch, diag, health = MB.megabatch_tick(
                     self.cfg, batch, self.world_res_m)
+                tick = self.n_ticks + 1
+                frozen = (self._fold_health_locked(
+                    health, batch_before, tick, events) if armed else ())
                 for tid in self._order:
                     m = self._missions[tid]
+                    if m.state != "active" or tid in frozen:
+                        # Quarantined lanes are frozen no-ops and
+                        # SUSPECT lanes do not publish: their revision
+                        # stays pinned to the held last-good content
+                        # (so a frozen revision's bytes never drift)
+                        # and their pipeline label goes silent — which
+                        # is exactly what lets the per-tenant SLO
+                        # ingest-stall breach single out the sick
+                        # tenant.
+                        continue
                     m.revision += 1
                     m.steps += 1
                     stamped.append((tid, m.revision, m.steps))
+                if armed:
+                    self._run_probes_locked(tick, events)
                 self._last_diag = diag
-                self.n_ticks += 1
+                self.n_ticks = tick
+            from jax_mapping.obs.recorder import flight_recorder
+            for name, kw in events:
+                flight_recorder.record(name, **kw)
             if self.pipeline is not None:
                 # Install waypoints OUTSIDE the plane lock (the ledger
                 # is a leaf lock of its own): one per tenant revision,
@@ -368,6 +504,324 @@ class TenantControlPlane:
             worlds = worlds.at[i].set(m.world)
         return worlds
 
+    # -- blast-radius containment (ISSUE 17) ---------------------------------
+
+    def _fold_health_locked(self, health, batch_before, tick: int,
+                            events: list):
+        """Fold one tick's (B,) health words through the hysteresis
+        ladder; returns the set of tids whose revision must FREEZE
+        this tick (suspect or newly quarantined). Caller holds
+        `_lock`; flight events append to `events` for post-release
+        emission."""
+        frozen = set()
+        for i, tid in enumerate(self._order):
+            m = self._missions[tid]
+            if m.state != "active":
+                continue
+            word = int(health[i])
+            if word and m.held_state is None:
+                # Entering suspect: hold the PRE-tick lane — the exact
+                # content of the currently published revision, which
+                # is what keeps serving while the lane is sick.
+                m.held_state = MB.lane_state(batch_before, i)
+            verdict = self._lanehealth.observe(tid, word, tick)
+            if word:
+                frozen.add(tid)
+            elif m.held_state is not None \
+                    and self._lanehealth.state(tid) == HEALTHY:
+                # Clean tick after a transient: the lane is its own
+                # truth again; the next revision bump publishes it.
+                m.held_state = None
+            if verdict == QUARANTINED:
+                # Freeze the lane in place via the pad-style inactive
+                # select: an exact no-op (the pad contract), so
+                # co-tenant lanes keep their bit-identical trajectory
+                # by construction — no rebuild, no restack.
+                self._batch = self._batch._replace(
+                    active=self._batch.active.at[i].set(False))
+                m.state = "quarantined"
+                self.n_quarantined += 1
+                self._poisoned.discard(tid)
+                self._journal_append("quarantine", m, word=word)
+                events.append(("tenancy_quarantine",
+                               dict(tenant=tid, tick=tick, word=word,
+                                    streak=self.cfg.tenancy
+                                    .quarantine_persist_ticks)))
+        return frozen
+
+    def _run_probes_locked(self, tick: int, events: list) -> None:
+        """Bounded seeded re-admission probes for quarantined tenants
+        on the deterministic tick clock (same-seed runs probe at
+        identical steps). A passing probe re-activates the lane from
+        the held last-good state and bumps the epoch; a failing one
+        burns one unit of the probe budget."""
+        for tid in self._lanehealth.quarantined():
+            m = self._missions.get(tid)
+            if m is None or m.state != "quarantined":
+                continue
+            if not self._lanehealth.probe_due(tid, tick):
+                continue
+            ok = self._probe_locked(m)
+            readmit = self._lanehealth.note_probe(tid, ok, tick)
+            events.append(("tenancy_readmit_probe",
+                           dict(tenant=tid, tick=tick, ok=ok)))
+            if readmit:
+                self._readmit_locked(tid, m, tick, events)
+
+    def _probe_locked(self, m: "_Mission") -> bool:
+        """One re-admission probe verdict: the held state must be
+        finite in every float leaf AND survive one solo-executable
+        tick (the identical `fleet_step` the solo oracle runs) with a
+        clean health word — the ISSUE 17 revalidation gate."""
+        held = m.held_state
+        if held is None or m.world is None:
+            return False
+        for leaf in jax.tree_util.tree_leaves(held):
+            a = np.asarray(leaf)
+            if np.issubdtype(a.dtype, np.floating) \
+                    and not np.isfinite(a).all():
+                return False
+        s1, d1 = FM.fleet_step(self.cfg, held, self.world_res_m,
+                               m.world)
+        return MB.lane_health_host(self.cfg, held, s1, d1) == 0
+
+    def _readmit_locked(self, tid: str, m: "_Mission", tick: int,
+                        events: list) -> None:
+        """Re-activate a probe-verified tenant from its held state.
+        In-batch lanes rewrite in place (`.at[i].set` per leaf — no
+        restack, co-tenant values untouched); a restored-quarantined
+        tenant without a live lane re-joins through the resume-style
+        rebuild. Epoch bumps (re-admission contract), and epoch ⇒
+        revision so no (epoch, revision) pair recurs."""
+        if tid in self._order:
+            i = self._order.index(tid)
+            states = jax.tree.map(lambda b, s: b.at[i].set(s),
+                                  self._batch.states, m.held_state)
+            self._batch = self._batch._replace(
+                states=states,
+                active=self._batch.active.at[i].set(True))
+        else:
+            order2 = self._order + [tid]
+            batch2, prev2, compacted = self._rebuilt(
+                order2, extra={tid: (m.held_state, m.world, m.key)})
+            self._order = order2
+            self._batch = batch2
+            self._prev_order = prev2
+            if compacted:
+                self.n_compactions += 1
+        m.state = "active"
+        m.held_state = None
+        m.epoch += 1
+        m.revision += 1
+        self._tile_stores.pop(tid, None)
+        self._journal_append("readmit", m)
+        events.append(("tenancy_readmit",
+                       dict(tenant=tid, tick=tick, epoch=m.epoch)))
+
+    def _inject_poison_locked(self) -> None:
+        """Chaos seam (`tenant_poison`): NaN every poisoned ACTIVE
+        tenant's est-pose lane input before the tick. Quarantined
+        lanes are skipped — their frozen state must stay byte-stable
+        under the freeze select."""
+        for tid in sorted(self._poisoned):
+            m = self._missions.get(tid)
+            if m is None or m.state != "active" \
+                    or tid not in self._order:
+                continue
+            i = self._order.index(tid)
+            states = self._batch.states
+            states = states._replace(
+                est_poses=states.est_poses.at[i].set(jnp.nan))
+            self._batch = self._batch._replace(states=states)
+
+    def set_tenant_poison(self, tid: str, active: bool) -> None:
+        """Arm/clear NaN poisoning of one tenant's lane inputs (the
+        `tenant_poison` FaultPlan kind's refcount boundary — the plane
+        only sees on/off)."""
+        with self._lock:
+            if active:
+                self._poisoned.add(tid)
+            else:
+                self._poisoned.discard(tid)
+
+    def state_jump_tenant(self, tid: str, value_m: float) -> None:
+        """Teleport one tenant's estimated poses by `value_m` metres
+        (the `tenant_state_jump` FaultPlan kind): a survivable-state
+        fault the MATCH-FLOOR sentinel catches (the jump corrupts the
+        input, so the within-step pose delta stays small — scan
+        matching against the tenant's own map is what degrades)."""
+        with self._lock:
+            m = self._missions.get(tid)
+            if m is None or m.state != "active" \
+                    or tid not in self._order:
+                return
+            i = self._order.index(tid)
+            states = self._batch.states
+            states = states._replace(
+                est_poses=states.est_poses.at[i, :, :2].add(
+                    jnp.float32(value_m)))
+            self._batch = self._batch._replace(states=states)
+
+    # -- durable control plane -----------------------------------------------
+
+    def _journal_append(self, kind: str, m: "_Mission",
+                        **extra) -> None:
+        """Append one lifecycle record (caller holds `_lock`; the
+        journal is a leaf whose ordering must match registry mutation
+        order). Compaction folds in every `journal_compact_every`
+        appends. No-op when the journal is unarmed."""
+        if self._journal is None:
+            return
+        fields = dict(seed=m.seed, epoch=m.epoch, revision=m.revision,
+                      steps=m.steps, **extra)
+        if m.world is not None:
+            fields["world_shape"] = [int(s) for s in m.world.shape]
+            fields["world_dtype"] = str(m.world.dtype)
+        self._journal.append(kind, m.tid, **fields)
+        every = max(1, self.cfg.tenancy.journal_compact_every)
+        if self._journal.n_appends % every == 0:
+            self._journal.compact()
+
+    def _live_ckpt_path(self, tid: str) -> str:
+        """The containment checkpoint slot: distinct from evict's
+        `tenant_{tid}.ckpt` (plain FleetState) because this one holds
+        the `{fleet, key, world}` payload `restore()` needs — mixing
+        formats in one generation chain would turn a fallback load
+        into a template mismatch."""
+        return os.path.join(self.checkpoint_dir,
+                            f"tenant_{tid}.live.ckpt")
+
+    def _checkpoint_tenant(self, tid: str, state, world, key) -> str:
+        from jax_mapping.io.checkpoint import save_checkpoint
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        path = self._live_ckpt_path(tid)
+        save_checkpoint(
+            path, {"fleet": state, "key": key, "world": world},
+            config_json=self.cfg.to_json(),
+            retain_generations=(
+                self.cfg.resilience.checkpoint_retain_generations))
+        return path
+
+    def checkpoint_all(self) -> List[str]:
+        """Checkpoint every live tenant's current state (active: its
+        lane; suspect/suspended/quarantined: the held state) through
+        the generation-retention machinery, then journal a
+        per-tenant watermark record — the durability heartbeat
+        `restore()` replays. Returns the paths written."""
+        if self.checkpoint_dir is None:
+            return []
+        with self._lock:
+            todo = []
+            for tid, m in self._missions.items():
+                if m.state == "evicted" or m.world is None:
+                    continue
+                if m.held_state is not None:
+                    state = m.held_state
+                elif m.state == "active":
+                    state = self._lane_state_locked(tid)
+                else:
+                    continue
+                todo.append((tid, m, state, m.world, m.key))
+        paths = [self._checkpoint_tenant(tid, state, world, key)
+                 for tid, m, state, world, key in todo]
+        with self._lock:
+            for tid, m, *_ in todo:
+                self._journal_append("checkpoint", m, state=m.state)
+        return paths
+
+    def restore(self) -> dict:
+        """Replay snapshot+journal and re-admit the recorded tenant
+        set from its containment checkpoints: active tenants re-join
+        the batch through the StagedWarmup admission path; suspended
+        and quarantined tenants restore held-state-only (a restored
+        quarantine resumes its probe schedule on the new plane's
+        clock). Every restored tenant's epoch AND revision advance
+        past their journaled watermarks — the PR 8 epoch protocol, so
+        `/tiles?tenant=` clients resync instead of seeing revision
+        regressions. A tenant whose checkpoint generations are ALL
+        unreadable is reported `lost`; the rest still restore (the
+        corruption doctrine: degrade, never crash).
+
+        Returns ``{"restored": [...], "lost": [...], "meta": {...}}``.
+        """
+        if self._journal is None:
+            return {"restored": [], "lost": [],
+                    "meta": {"journal": False}}
+        from jax_mapping.io.checkpoint import (
+            load_checkpoint_with_fallback)
+        # Deep-copy the rows: re-admission below APPENDS journal
+        # records whose fold mutates the live registry's row dicts —
+        # reading the watermarks through aliased rows would clobber
+        # the journaled epoch/revision with the fresh mission's zeros.
+        registry = {tid: dict(row)
+                    for tid, row in self._journal.registry().items()}
+        restored, lost = [], []
+        for tid, row in registry.items():
+            if row.get("state") in ("evicted", "new", None):
+                continue
+            shape = row.get("world_shape")
+            dtype = row.get("world_dtype", "float32")
+            if shape is None:
+                lost.append(tid)
+                continue
+            template = {
+                "fleet": FM.init_fleet_state(
+                    self.cfg, jax.random.PRNGKey(0)),
+                "key": jax.random.PRNGKey(0),
+                "world": jnp.zeros(tuple(shape), dtype),
+            }
+            try:
+                payload, _, _ = load_checkpoint_with_fallback(
+                    self._live_ckpt_path(tid), template)
+            except Exception:                    # noqa: BLE001
+                # FileNotFoundError, CheckpointCorrupt, or a template
+                # mismatch down the generation chain — all mean the
+                # same thing here: this tenant's state is gone.
+                lost.append(tid)
+                continue
+            fleet = payload["fleet"]
+            world = payload["world"]
+            key = jnp.asarray(payload["key"])
+            seed = int(row.get("seed", 0))
+            if row["state"] == "active":
+                self._admit(tid, world, seed, fleet, None)
+                with self._lock:
+                    m = self._missions[tid]
+                    m.epoch = int(row.get("epoch", -1)) + 1
+                    m.revision = int(row.get("revision", 0)) + 1
+                    m.steps = int(row.get("steps", 0))
+                    m.key = key
+                    self._journal_append("restore", m,
+                                         state="active")
+            else:
+                with self._lock:
+                    m = _Mission(tid, seed, jnp.asarray(world), key)
+                    m.epoch = int(row.get("epoch", -1)) + 1
+                    m.revision = int(row.get("revision", 0)) + 1
+                    m.steps = int(row.get("steps", 0))
+                    m.state = row["state"]
+                    m.held_state = fleet
+                    self._missions[tid] = m
+                    if row["state"] == "quarantined":
+                        self._lanehealth.mark_quarantined(
+                            tid, self.n_ticks)
+                    self._journal_append("restore", m,
+                                         state=row["state"])
+            restored.append(tid)
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("tenancy_restore",
+                               restored=len(restored), lost=len(lost))
+        return {"restored": restored, "lost": lost,
+                "meta": {"journal": True}}
+
+    def tenant_lifecycle(self, tid: str) -> str:
+        """The tenant's lifecycle state string (`active` / `suspended`
+        / `quarantined` / `evicted` / `unknown`) — the /tiles status
+        stamp's source."""
+        with self._lock:
+            m = self._missions.get(tid)
+            return "unknown" if m is None else m.state
+
     # -- state access --------------------------------------------------------
 
     def live_batch(self) -> Optional[MB.TenantBatch]:
@@ -378,13 +832,14 @@ class TenantControlPlane:
 
     def tenant_state(self, tid: str) -> FM.FleetState:
         """The tenant's current FleetState — its live lane when
-        active, the held state when suspended."""
+        active and healthy, the held last-good state when suspect /
+        suspended / quarantined (a sick lane's garbage never serves)."""
         with self._lock:
             m = self._missions[tid]
-            if m.state == "active":
-                return self._lane_state_locked(tid)
             if m.held_state is not None:
                 return m.held_state
+            if m.state == "active":
+                return self._lane_state_locked(tid)
             raise ValueError(f"tenant {tid!r} is {m.state}; no state held")
 
     def tenant_grid(self, tid: str):
@@ -411,7 +866,8 @@ class TenantControlPlane:
                 # the public /tiles?tenant= surface, and caching a
                 # store per unknown/evicted id would let a client loop
                 # over bogus ids and grow the dict without bound.
-                self._require(tid, ("active", "suspended"))
+                self._require(tid, ("active", "suspended",
+                                    "quarantined"))
         if store is not None:
             return store
         from jax_mapping.ops import grid as G
@@ -429,10 +885,15 @@ class TenantControlPlane:
                         f"tenant {tid!r} is {m.state}; nothing to serve")
                 # Revision BEFORE content (the serving-snapshot
                 # ordering): both reads sit in one lock section here,
-                # but the order still documents the contract.
+                # but the order still documents the contract. A held
+                # state (suspect / suspended / quarantined) serves in
+                # preference to the live lane: the revision is frozen
+                # on exactly that content, so a frozen (epoch,
+                # revision) pair can never alias two different bodies.
                 rev = m.revision
-                grid = (self._lane_state_locked(tid).grid
-                        if m.state == "active" else m.held_state.grid)
+                grid = (m.held_state.grid
+                        if m.held_state is not None
+                        else self._lane_state_locked(tid).grid)
             gray = np.asarray(G.to_gray(self.cfg.grid, grid))
             return rev, gray, None
 
@@ -486,7 +947,15 @@ class TenantControlPlane:
                 s, w, k = extra[tid]
             else:
                 m = self._missions[tid]
-                s, w, k = self._old_lane(tid), m.world, m.key
+                if m.state == "quarantined" \
+                        and m.held_state is not None:
+                    # A quarantined lane's live state may be poisoned
+                    # garbage; the held last-good state is what
+                    # carries across a co-tenant churn rebuild (the
+                    # lane re-freezes below either way).
+                    s, w, k = m.held_state, m.world, m.key
+                else:
+                    s, w, k = self._old_lane(tid), m.world, m.key
             states.append(s)
             worlds.append(w)
             keys.append(k)
@@ -495,6 +964,13 @@ class TenantControlPlane:
                                  exact=self.cfg.tenancy.bit_exact_buckets)
         batch = MB.make_tenant_batch(states, worlds, keys,
                                      capacity=cap)
+        for i, tid in enumerate(order):
+            m = self._missions.get(tid)
+            if m is not None and m.state == "quarantined":
+                # make_tenant_batch marks every real lane active;
+                # quarantined lanes must come back FROZEN.
+                batch = batch._replace(
+                    active=batch.active.at[i].set(False))
         return batch, list(order), cap < old_cap
 
     def _old_lane(self, tid: str) -> FM.FleetState:
@@ -543,7 +1019,13 @@ class TenantControlPlane:
     def status(self) -> dict:
         """The /status `tenancy` object (one consistent section)."""
         with self._lock:
-            n_active = len(self._order)
+            # Quarantined tenants keep their (frozen) lane, so they
+            # occupy a slot without being active — occupancy counts
+            # slots, n_active counts live missions.
+            occupied = len(self._order)
+            n_active = sum(
+                1 for t in self._order
+                if self._missions[t].state == "active")
             cap = (0 if self._batch is None
                    else int(self._batch.active.shape[0]))
             tenants = {
@@ -555,22 +1037,40 @@ class TenantControlPlane:
                 n_admitted=self.n_admitted, n_evicted=self.n_evicted,
                 n_suspended=self.n_suspended, n_resumed=self.n_resumed,
                 n_prewarms=self.n_prewarms, n_ticks=self.n_ticks,
-                n_compactions=self.n_compactions)
+                n_compactions=self.n_compactions,
+                n_quarantined=self.n_quarantined)
             warmed = sorted(self._warmed_buckets)
+            health = self._lanehealth.snapshot()
+            admission = {
+                "in_flight": self._admissions_in_flight,
+                "queue_max": self.cfg.tenancy.admission_queue_max,
+                "n_rejected": self.n_admissions_rejected,
+            }
+            journal = None
+            if self._journal is not None:
+                journal = {"seq": self._journal.seq,
+                           "n_appends": self._journal.n_appends,
+                           "n_compactions": self._journal.n_compactions}
         n_susp = sum(1 for t in tenants.values()
                      if t["state"] == "suspended")
         n_evic = sum(1 for t in tenants.values()
                      if t["state"] == "evicted")
+        n_quar = sum(1 for t in tenants.values()
+                     if t["state"] == "quarantined")
         return {
             "n_active": n_active,
             "n_suspended": n_susp,
             "n_evicted": n_evic,
+            "n_quarantined_now": n_quar,
             "bucket_capacity": cap,
-            "bucket_occupancy": (n_active / cap) if cap else 0.0,
-            "pad_waste_frac": ((cap - n_active) / cap) if cap else 0.0,
+            "bucket_occupancy": (occupied / cap) if cap else 0.0,
+            "pad_waste_frac": ((cap - occupied) / cap) if cap else 0.0,
             "warmed_buckets": warmed,
             "warmup": self.warmup.snapshot(),
             "tenants": tenants,
+            "health": health,
+            "admission": admission,
+            "journal": journal,
             **counters,
         }
 
@@ -595,4 +1095,11 @@ class TenantControlPlane:
                    (("", f"{s['pad_waste_frac']:.4f}"),)),
             Family("jax_mapping_tenant_ticks_total", "counter",
                    (("", str(s["n_ticks"])),)),
+            Family("jax_mapping_tenant_quarantined", "gauge",
+                   (("", str(s["n_quarantined_now"])),)),
+            Family("jax_mapping_tenant_quarantines_total", "counter",
+                   (("", str(s["n_quarantined"])),)),
+            Family("jax_mapping_tenant_admission_rejected_total",
+                   "counter",
+                   (("", str(s["admission"]["n_rejected"])),)),
         )

@@ -163,20 +163,99 @@ def make_tenant_batch(states: Sequence[FM.FleetState],
         active=jnp.asarray([i < n for i in range(b)]))
 
 
+#: Lane-health word bits (uint32), computed on device inside the
+#: megabatch dispatch when `TenancyConfig.lane_health` is armed and
+#: re-derived host-side (`lane_health_host`) for closure-pending lanes
+#: and re-admission probes — the same predicate either way.
+HEALTH_NONFINITE = 1    # NaN/Inf in the lane's pose / grid-delta leaves
+HEALTH_POSE_JUMP = 2    # within-step est translation > pose_jump_max_m
+HEALTH_MATCH_FLOOR = 4  # accepted key match response < match_floor
+
+
+def _health_word(cfg: SlamConfig, batch: TenantBatch,
+                 states2: FM.FleetState, diag: FM.FleetDiag) -> Array:
+    """The (B,) uint32 per-tenant health word, traced INSIDE the
+    megabatch jit (cfg is static: knob-off traces a constant zeros
+    output — identical lane numerics, zero extra dispatches either
+    way). Reads the PRE-freeze `states2` so a flagged pad/inactive
+    lane cannot occur (inactive lanes mask to 0 at the end)."""
+    t = cfg.tenancy
+    if not (t.enabled and t.lane_health):
+        return jnp.zeros(batch.active.shape, jnp.uint32)
+    # bit 0: NaN/Inf anywhere in the pose or grid-delta leaves. The
+    # grid DELTA (not the grid) is what the lane produced this tick;
+    # subtracting the held input also catches a lane whose input was
+    # already poisoned (NaN - NaN = NaN).
+    pose_ok = jnp.isfinite(states2.est_poses).reshape(
+        states2.est_poses.shape[0], -1).all(axis=1)
+    gd = states2.grid - batch.states.grid
+    grid_ok = jnp.isfinite(gd).reshape(gd.shape[0], -1).all(axis=1)
+    word = jnp.where(pose_ok & grid_ok, jnp.uint32(0),
+                     jnp.uint32(HEALTH_NONFINITE))
+    # bit 1: pose-jump magnitude — the max over robots of the
+    # within-step estimated translation. NaN poses compare False here
+    # (bit 0 already owns that failure mode).
+    dxy = (states2.est_poses - batch.states.est_poses)[..., :2]
+    jump = jnp.sqrt((dxy * dxy).sum(axis=-1)).max(axis=-1)
+    word = word | jnp.where(jump > t.pose_jump_max_m,
+                            jnp.uint32(HEALTH_POSE_JUMP), jnp.uint32(0))
+    # bit 2: match-score floor, charged only where a key-step match
+    # actually ran (sub-gate steps carry no match information).
+    if t.match_floor > 0.0:
+        low = (diag.match_response < t.match_floor) & diag.is_key
+        word = word | jnp.where(
+            low.reshape(low.shape[0], -1).any(axis=1),
+            jnp.uint32(HEALTH_MATCH_FLOOR), jnp.uint32(0))
+    return jnp.where(batch.active, word, jnp.uint32(0))
+
+
+def lane_health_host(cfg: SlamConfig, old_state: FM.FleetState,
+                     new_state: FM.FleetState,
+                     diag=None) -> int:
+    """Host-side twin of the device health word, over ONE lane (no
+    tenant axis): used for closure-pending lanes (whose megabatch
+    health described the discarded no-closure evolution) and for the
+    re-admission probe's solo-tick verdict. Same predicate, numpy."""
+    import numpy as np
+
+    t = cfg.tenancy
+    word = 0
+    new_p = np.asarray(new_state.est_poses)
+    old_p = np.asarray(old_state.est_poses)
+    gd = np.asarray(new_state.grid) - np.asarray(old_state.grid)
+    if not (np.isfinite(new_p).all() and np.isfinite(gd).all()):
+        word |= HEALTH_NONFINITE
+    dxy = (new_p - old_p)[..., :2]
+    jump = np.sqrt((dxy * dxy).sum(axis=-1)).max()
+    if jump > t.pose_jump_max_m:
+        word |= HEALTH_POSE_JUMP
+    if t.match_floor > 0.0 and diag is not None:
+        low = (np.asarray(diag.match_response) < t.match_floor) \
+            & np.asarray(diag.is_key)
+        if low.any():
+            word |= HEALTH_MATCH_FLOOR
+    return word
+
+
 @functools.partial(jax.jit, static_argnums=(0, 2))
 def megabatch_step(cfg: SlamConfig, batch: TenantBatch,
                    world_res_m: float
-                   ) -> tuple[TenantBatch, FM.FleetDiag, Array]:
+                   ) -> tuple[TenantBatch, FM.FleetDiag, Array, Array]:
     """One megabatched NO-CLOSURE tick + per-tenant closure-pending
-    flags: every active tenant advances exactly as its solo
-    `fleet_step` would on a tick whose closure cond stays false
-    (bit-for-bit), inactive slots are frozen, and the whole batch
-    costs ONE dispatch chain. Returns ``(batch', diag, pending)``
-    where ``pending[i]`` means tenant i had a loop-closure candidate
-    this tick — its lane in ``batch'`` is the (wrong) no-closure
-    evolution and MUST be resolved by the caller; `megabatch_tick` is
-    the host-driven form that does so through the solo `fleet_step`
-    executable itself.
+    flags + per-tenant health word: every active tenant advances
+    exactly as its solo `fleet_step` would on a tick whose closure
+    cond stays false (bit-for-bit), inactive slots are frozen, and the
+    whole batch costs ONE dispatch chain. Returns ``(batch', diag,
+    pending, health)`` where ``pending[i]`` means tenant i had a
+    loop-closure candidate this tick — its lane in ``batch'`` is the
+    (wrong) no-closure evolution and MUST be resolved by the caller;
+    `megabatch_tick` is the host-driven form that does so through the
+    solo `fleet_step` executable itself. ``health`` is the (B,) uint32
+    lane-health word (HEALTH_* bits), computed in the SAME dispatch
+    when `TenancyConfig.lane_health` is armed and constant zeros
+    otherwise — arming it changes no lane numerics (the word is a pure
+    reader of the tick's outputs) and adds no dispatch (cfg is static,
+    so the reduction fuses into this very executable).
 
     Why closures resolve on the host: XLA:CPU gives no cross-
     executable bit-stability — the closure body's Gauss-Newton
@@ -224,6 +303,10 @@ def megabatch_step(cfg: SlamConfig, batch: TenantBatch,
                 batch.states, pre, pre.grid, pre.graphs, pre.est,
                 closed)
 
+    # Health word BEFORE the freeze: it reads what each lane PRODUCED
+    # this tick (inactive lanes mask to zero inside _health_word).
+    health = _health_word(cfg, batch, states2, diag)
+
     # Freeze pad/suspended lanes: active lanes pass through untouched
     # (a True select is the identity), inactive lanes keep their
     # previous state bit-for-bit — the exact-no-op pad contract.
@@ -233,12 +316,12 @@ def megabatch_step(cfg: SlamConfig, batch: TenantBatch,
         return jnp.where(act, new, old)
 
     states2 = jax.tree.map(freeze, states2, batch.states)
-    return batch._replace(states=states2), diag, pending
+    return batch._replace(states=states2), diag, pending, health
 
 
 def megabatch_tick(cfg: SlamConfig, batch: TenantBatch,
                    world_res_m: float
-                   ) -> tuple[TenantBatch, FM.FleetDiag]:
+                   ) -> tuple[TenantBatch, FM.FleetDiag, "np.ndarray"]:
     """ONE host-driven megabatch tick, closure ticks included: the
     megabatch dispatch advances every tenant down the no-closure path
     and reports closure-pending lanes; each pending tenant's tick is
@@ -246,22 +329,33 @@ def megabatch_tick(cfg: SlamConfig, batch: TenantBatch,
     `fleet_step` — the identical executable the solo oracle runs, so
     closure ticks are bit-exact by construction — and written back
     into the lane (state AND diag row). The pending fetch doubles as
-    the tick's device barrier."""
+    the tick's device barrier; the health word rides the SAME barrier
+    (the only host sync the tick pays). Returns ``(batch, diag,
+    health)`` with ``health`` a host (B,) uint32 array — all zeros
+    unless `TenancyConfig.lane_health` is armed. A closure-resolved
+    lane's word is re-derived host-side from the solo outputs (its
+    device word described the discarded no-closure evolution)."""
     import numpy as np
 
-    new_batch, diag, pending = megabatch_step(cfg, batch, world_res_m)
+    new_batch, diag, pending, health = megabatch_step(
+        cfg, batch, world_res_m)
     pending_np = np.asarray(pending)
+    health_np = np.asarray(health).copy()
+    lane_armed = cfg.tenancy.enabled and cfg.tenancy.lane_health
     if pending_np.any():
         states = new_batch.states
         for i in np.nonzero(pending_np)[0]:
             i = int(i)
-            s1, d1 = FM.fleet_step(cfg, lane_state(batch, i),
-                                   world_res_m, batch.worlds[i])
+            before = lane_state(batch, i)
+            s1, d1 = FM.fleet_step(cfg, before, world_res_m,
+                                   batch.worlds[i])
             states = jax.tree.map(lambda b, s: b.at[i].set(s),
                                   states, s1)
             diag = jax.tree.map(lambda b, s: b.at[i].set(s), diag, d1)
+            if lane_armed:
+                health_np[i] = lane_health_host(cfg, before, s1, d1)
         new_batch = new_batch._replace(states=states)
-    return new_batch, diag
+    return new_batch, diag, health_np
 
 
 def lane_state(batch: TenantBatch, i: int) -> FM.FleetState:

@@ -489,6 +489,102 @@ def test_b3_silent_when_writes_guarded_or_state_never_shared():
     assert findings == []
 
 
+def test_b3_locked_helper_convention_is_interprocedural():
+    """A private helper whose EVERY same-class call site holds the lock
+    (lexically, or one hop up through another such helper) runs
+    lock-held at runtime: its writes are guarded, with no `with` in its
+    own body. This is the `_locked` suffix contract the tenancy control
+    plane relies on (its zero-suppression tier forbids baselining)."""
+    findings = run_checker(lock_discipline.UnguardedWriteChecker(), """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.value = None
+                self.n_folds = 0
+
+            def step(self):
+                with self._lock:
+                    self._fold_locked()
+
+            def _fold_locked(self):
+                self.value = 1          # guarded via step()'s lock
+                self._install_locked()
+
+            def _install_locked(self):
+                self.n_folds += 1       # guarded two hops up
+        """, path="jax_mapping/bridge/snippet.py")
+    assert findings == []
+
+
+def test_b3_helper_with_any_unlocked_entry_still_flags():
+    """One unlocked call site — or escaping as a callback value —
+    disqualifies a helper: the write CAN race the guarded readers."""
+    findings = run_checker(lock_discipline.UnguardedWriteChecker(), """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.value = None
+                self.count = 0
+
+            def read(self):
+                with self._lock:
+                    return self.value, self.count
+
+            def step(self):
+                with self._lock:
+                    self._fold()
+
+            def fast_path(self):
+                self._fold()            # unlocked entry
+
+            def arm(self, timer):
+                with self._lock:
+                    timer.cb = self._escapes   # callback: unlocked entry
+
+            def _fold(self):
+                self.value = 1
+
+            def _escapes(self):
+                self.count += 1
+        """, path="jax_mapping/bridge/snippet.py")
+    assert sorted((f.symbol, f.checker) for f in findings) == [
+        ("Plane._escapes", "B3-unguarded-write"),
+        ("Plane._fold", "B3-unguarded-write"),
+    ]
+
+
+def test_b3_public_and_uncalled_methods_never_qualify():
+    findings = run_checker(lock_discipline.UnguardedWriteChecker(), """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.value = None
+                self.other = None
+
+            def read(self):
+                with self._lock:
+                    return self.value, self.other
+
+            def step(self):
+                with self._lock:
+                    self.apply()        # public: outside callers exist
+
+            def apply(self):
+                self.value = 1
+
+            def _never_called(self):
+                self.other = 2
+        """, path="jax_mapping/bridge/snippet.py")
+    assert sorted(f.symbol for f in findings) == [
+        "Plane._never_called", "Plane.apply"]
+
+
 # ------------------------------------------------------- baseline plumbing
 
 def test_baseline_suppresses_and_reports_unused(tmp_path):

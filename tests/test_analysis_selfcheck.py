@@ -395,7 +395,11 @@ def test_no_suppressions_in_tenancy_modules():
     """ISSUE 14 CI guard, extending the zero-suppression tier: the
     mission-multi-tenancy subsystem (`jax_mapping/tenancy/`) carries
     ZERO baseline suppressions — the control plane that multiplexes
-    many missions onto one accelerator may not baseline its hazards."""
+    many missions onto one accelerator may not baseline its hazards.
+    The prefix deliberately covers the ISSUE 17 containment modules
+    too (`tenancy/lanehealth.py`, `tenancy/journal.py`): the code that
+    decides quarantine and replays the durable registry is exactly the
+    code that runs while a tenant is already sick."""
     base = Baseline.load(default_baseline_path())
     banned = [s for s in base.suppressions
               if s["path"].startswith("jax_mapping/tenancy/")]

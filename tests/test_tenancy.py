@@ -119,7 +119,7 @@ def test_megabatch_bit_identity_and_exact_noop_pads(mcfg, world_np):
               for k in range(3)]
     b = MB.make_tenant_batch(states, [world_np] * 3, [key] * 3)
     for _ in range(12):
-        b, diag = MB.megabatch_tick(mcfg, b, res)
+        b, diag, _ = MB.megabatch_tick(mcfg, b, res)
     assert diag.is_key.shape[0] == 3
     for i in range(3):
         _assert_states_bitequal(
@@ -132,7 +132,7 @@ def test_megabatch_bit_identity_and_exact_noop_pads(mcfg, world_np):
                               capacity=3)
     pad_before = MB.lane_state(b2, 2)
     for _ in range(8):
-        b2, _ = MB.megabatch_tick(mcfg, b2, res)
+        b2, _, _ = MB.megabatch_tick(mcfg, b2, res)
     for i in range(2):
         _assert_states_bitequal(
             MB.lane_state(b2, i), _solo_run(mcfg, world, i, 8),
@@ -225,10 +225,10 @@ def test_closure_pending_resolves_via_solo_executable(mcfg, world_np):
     poised = _closure_poised_state(mcfg)
     b = MB.make_tenant_batch([normal, poised], [world_np] * 2,
                              [key] * 2)
-    _, _, pending = MB.megabatch_step(mcfg, b, res)
+    _, _, pending, _ = MB.megabatch_step(mcfg, b, res)
     assert np.asarray(pending).tolist() == [False, True], (
         "the poised lane did not raise its closure-pending flag")
-    b2, diag = MB.megabatch_tick(mcfg, b, res)
+    b2, diag, _ = MB.megabatch_tick(mcfg, b, res)
     want_s, want_d = FM.fleet_step(mcfg, poised, res, world)
     _assert_states_bitequal(MB.lane_state(b2, 1), want_s,
                             "pending lane != solo fleet_step")
@@ -498,7 +498,7 @@ def test_cotenant_independence_beyond_exact_ladder(mcfg, world_np):
         b = MB.make_tenant_batch(states, [world_np] * 4, [key] * 4,
                                  capacity=4)
         for _ in range(8):
-            b, _ = MB.megabatch_tick(mcfg, b, res)
+            b, _, _ = MB.megabatch_tick(mcfg, b, res)
         return MB.lane_state(b, 0)
 
     _assert_states_bitequal(run([1, 2, 3]), run([7, 8, 9]),
@@ -627,7 +627,7 @@ b = MB.make_tenant_batch(states, [world_np] * 2, [key] * 2)
 closed = 0
 n_steps = 150
 for _ in range(n_steps):
-    b, diag = MB.megabatch_tick(cfg, b, res)
+    b, diag, _ = MB.megabatch_tick(cfg, b, res)
     closed += int(np.asarray(diag.loop_closed).sum())
 assert closed > 0, "closure branch never fired"
 for i in range(2):

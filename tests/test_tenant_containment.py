@@ -1,0 +1,965 @@
+"""Tenant blast-radius containment (ISSUE 17): lane-health sentinels,
+the durable control plane, and seeded tenant-level chaos.
+
+Three contracts under test:
+
+* **Containment** — a sick tenant (NaN poison, pose teleport) walks the
+  healthy -> suspect -> QUARANTINED hysteresis ladder on device-computed
+  health words (ZERO extra dispatches — the word rides the megabatch),
+  its lane freezes in place via the pad-style ``active=False`` select,
+  and every co-tenant stays BIT-IDENTICAL to a no-fault twin (state and
+  served tile bytes). Serving keeps the frozen last-good revision with a
+  ``state=quarantined`` stamp; bounded seeded probes re-admit with an
+  epoch bump.
+* **Durability** — the lifecycle journal (CRC-per-record, torn tail
+  truncated, compaction snapshots) lets a crashed plane `restore()` the
+  SAME tenant set with epochs advanced; all-corrupt checkpoints degrade
+  to a `lost` report, never a crash.
+* **Chaos determinism** — the tenant FaultPlan kinds compose refcounted,
+  reject same-resource overlap in `random_plan`, and two same-seed runs
+  produce identical quarantine/restore sequences (the slow drill).
+
+Wall-clock discipline: every ARMED (lane_health=True) in-process test
+shares ONE module-scoped config and stays on buckets {1, 2}, so the
+armed megabatch variants compile at most twice per test process; the
+12-tenant acceptance drill is `slow` and runs in a clean subprocess.
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jax_mapping.config import TenancyConfig, micro_config
+from jax_mapping.models import fleet as FM
+from jax_mapping.sim import world as W
+from jax_mapping.tenancy import megabatch as MB
+from jax_mapping.tenancy.controlplane import (AdmissionRejected,
+                                              TenantControlPlane)
+from jax_mapping.tenancy.journal import (ControlJournal, read_journal,
+                                         read_registry)
+from jax_mapping.tenancy.lanehealth import (HEALTHY, QUARANTINED,
+                                            SUSPECT, LaneHealthLadder)
+
+#: The ONE armed tenancy shape for this module (buckets {1,2} only):
+#: persist=2 and probe cadence 3 give the canonical timeline — poison
+#: at tick 4 -> suspect(4) -> quarantined(5) -> probe+readmit(8).
+_ARMED = TenancyConfig(
+    enabled=True, prewarm_on_admit=False, lane_health=True,
+    quarantine_persist_ticks=2, readmit_probe_ticks=3,
+    max_readmit_probes=2, journal=True)
+
+
+@pytest.fixture(scope="module")
+def acfg():
+    return dataclasses.replace(micro_config(), tenancy=_ARMED)
+
+
+@pytest.fixture(scope="module")
+def world_np(acfg):
+    return W.empty_arena(acfg.grid.size_cells, acfg.grid.resolution_m)
+
+
+def _solo_run(cfg, world, seed, n_steps, state=None):
+    s = (FM.init_fleet_state(cfg, jax.random.PRNGKey(seed))
+         if state is None else state)
+    for _ in range(n_steps):
+        s, _ = FM.fleet_step(cfg, s, cfg.grid.resolution_m, world)
+    return s
+
+
+def _assert_states_bitequal(a, b, what: str) -> None:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=what)
+
+
+def _tile_digest(cp, tid: str) -> str:
+    """SHA-256 over the tenant's full served tile manifest — the
+    'served bytes' half of the co-tenant bit-identity contract."""
+    store = cp.tile_store(tid)
+    store.refresh()
+    _, entries, _ = store.tiles_since(-1)
+    return hashlib.sha256(
+        json.dumps(entries, sort_keys=True).encode()).hexdigest()
+
+
+# ----------------------------------------------------------- knob-off
+
+def test_containment_knobs_default_off():
+    """The pre-PR reproduction contract starts at the config layer:
+    every ISSUE 17 knob defaults OFF."""
+    t = TenancyConfig()
+    assert t.lane_health is False
+    assert t.journal is False
+    assert t.admission_queue_max == 0
+    # And micro_config carries the defaults through.
+    assert micro_config().tenancy.lane_health is False
+
+
+def test_knob_off_bit_exact_and_armed_observational(acfg, world_np):
+    """Property: arming the sentinel changes NOTHING but the health
+    output — the armed and unarmed megabatch evolve bit-identical
+    batches from identical inputs (the sentinel is a read-only fold of
+    values the step already computes), and the unarmed trace returns a
+    constant-zeros word (knob-off = pre-PR behavior bit-exactly)."""
+    off_cfg = dataclasses.replace(
+        acfg, tenancy=dataclasses.replace(_ARMED, lane_health=False))
+    res = acfg.grid.resolution_m
+    key = jax.random.PRNGKey(0)
+    states = [FM.init_fleet_state(acfg, jax.random.PRNGKey(k))
+              for k in range(2)]
+    b_off = MB.make_tenant_batch(states, [world_np] * 2, [key] * 2)
+    b_arm = b_off
+    for _ in range(6):
+        b_off, _, h_off = MB.megabatch_tick(off_cfg, b_off, res)
+        b_arm, _, h_arm = MB.megabatch_tick(acfg, b_arm, res)
+        assert np.asarray(h_off).tolist() == [0, 0], (
+            "unarmed health word must be constant zeros")
+        assert np.asarray(h_arm).tolist() == [0, 0], (
+            "clean run flagged by the armed sentinel")
+    for i in range(2):
+        _assert_states_bitequal(
+            MB.lane_state(b_arm, i), MB.lane_state(b_off, i),
+            f"arming the sentinel perturbed lane {i}")
+
+
+# ------------------------------------------------------------- ladder
+
+def test_lane_health_ladder_units():
+    """Hysteresis, probe scheduling, the probe budget, and the
+    restore-path re-assertion — pure host logic."""
+    cfg = dataclasses.replace(_ARMED, quarantine_persist_ticks=3,
+                              readmit_probe_ticks=4,
+                              max_readmit_probes=2)
+    lad = LaneHealthLadder(cfg)
+    assert lad.state("t") == HEALTHY
+    # One flagged tick -> suspect; a clean tick returns to healthy.
+    assert lad.observe("t", MB.HEALTH_NONFINITE, 1) is None
+    assert lad.state("t") == SUSPECT
+    assert lad.observe("t", 0, 2) is None
+    assert lad.state("t") == HEALTHY
+    # persist_ticks CONSECUTIVE flags declare quarantine exactly once.
+    assert lad.observe("t", 1, 3) is None
+    assert lad.observe("t", 1, 4) is None
+    assert lad.observe("t", 1, 5) == QUARANTINED
+    assert lad.state("t") == QUARANTINED
+    assert lad.n_quarantines == 1
+    # No flag-based exit from quarantine; further words are ignored.
+    assert lad.observe("t", 0, 6) is None
+    assert lad.state("t") == QUARANTINED
+    # Probe cadence: every 4 ticks after the declaration (tick 5).
+    assert not lad.probe_due("t", 6)
+    assert lad.probe_due("t", 9)
+    assert not lad.note_probe("t", False, 9)       # burns budget
+    assert lad.probe_due("t", 13)
+    assert not lad.note_probe("t", False, 13)
+    assert not lad.probe_due("t", 17), "probe budget must exhaust"
+    # mark_quarantined (restore path) resets the budget and schedule.
+    lad2 = LaneHealthLadder(cfg)
+    lad2.mark_quarantined("r", 10)
+    assert lad2.state("r") == QUARANTINED
+    assert lad2.probe_due("r", 14)
+    assert lad2.note_probe("r", True, 14)          # readmit
+    assert lad2.state("r") == HEALTHY
+    assert lad2.n_readmits == 1
+    # forget: eviction wipes the ladder entry.
+    lad2.mark_quarantined("r", 20)
+    lad2.forget("r")
+    assert lad2.state("r") == HEALTHY
+    assert lad2.quarantined() == []
+    snap = lad.snapshot()
+    assert snap["n_quarantines"] == 1
+    assert snap["lanes"]["t"]["state"] == QUARANTINED
+
+
+def test_lane_health_host_word_bits(acfg):
+    """The host twin flags exactly the three sentinel conditions."""
+    cfg = dataclasses.replace(
+        acfg, tenancy=dataclasses.replace(_ARMED, match_floor=0.1))
+    s0 = FM.init_fleet_state(cfg, jax.random.PRNGKey(0))
+    assert MB.lane_health_host(cfg, s0, s0) == 0
+    # NaN pose -> NONFINITE (grid delta of identical grids stays 0).
+    bad = s0._replace(est_poses=s0.est_poses.at[0, 0].set(jnp.nan))
+    assert MB.lane_health_host(cfg, s0, bad) & MB.HEALTH_NONFINITE
+    # A finite teleport past the traced threshold -> POSE_JUMP only.
+    far = s0._replace(est_poses=s0.est_poses.at[:, :2].add(
+        cfg.tenancy.pose_jump_max_m * 3.0))
+    word = MB.lane_health_host(cfg, s0, far)
+    assert word & MB.HEALTH_POSE_JUMP
+    assert not word & MB.HEALTH_NONFINITE
+    # Match floor: charged only where a key-step match ran.
+    R = cfg.fleet.n_robots
+    diag = type("D", (), {})()
+    diag.match_response = np.full((R,), 0.01, np.float32)
+    diag.is_key = np.ones((R,), bool)
+    assert MB.lane_health_host(cfg, s0, s0, diag) \
+        & MB.HEALTH_MATCH_FLOOR
+    diag.is_key = np.zeros((R,), bool)
+    assert MB.lane_health_host(cfg, s0, s0, diag) == 0
+
+
+# ------------------------------------------- quarantine lifecycle
+
+def test_quarantine_probe_readmit_cycle(acfg, world_np, tmp_path):
+    """THE containment tentpole, in-process at bucket 2: a poisoned
+    tenant walks suspect -> quarantined on the canonical timeline, its
+    revision freezes on the held last-good content, the co-tenant
+    stays bit-identical to a no-fault twin (state AND served tile
+    bytes), a seeded probe re-admits with an epoch bump — and the
+    whole cycle compiles ZERO new megabatch variants post-warmup (the
+    live recompile guard: quarantine freezes in place, no restack)."""
+    from jax_mapping.obs.recorder import flight_recorder
+
+    world = jnp.asarray(world_np)
+    cp = TenantControlPlane(acfg, checkpoint_dir=str(tmp_path / "a"))
+    twin = TenantControlPlane(acfg,
+                              checkpoint_dir=str(tmp_path / "b"))
+    for plane in (cp, twin):
+        plane.admit("sick", world_np, seed=0)
+        plane.admit("ok", world_np, seed=1)
+    cp.step(3)
+    twin.step(3)
+    variants_warm = int(MB.megabatch_step._cache_size())
+    mark = flight_recorder.mark()
+
+    cp.set_tenant_poison("sick", True)
+    cp.step(2)                       # tick 4: suspect, tick 5: declare
+    twin.step(2)
+    assert cp.tenant_lifecycle("sick") == "quarantined"
+    assert cp.status()["n_quarantined_now"] == 1
+    # Flagged ticks never published: the frozen revision is the
+    # last-good tick-3 content, and serving holds exactly that state.
+    assert cp.revision("sick") == 3
+    assert cp.revision("ok") == 5
+    _assert_states_bitequal(cp.tenant_state("sick"),
+                            _solo_run(acfg, world, 0, 3),
+                            "held last-good != pre-fault content")
+
+    # Probe at tick 8 (cadence 3 after the tick-5 declaration): the
+    # held state is finite and survives a solo tick -> readmit.
+    cp.set_tenant_poison("sick", False)
+    cp.step(3)
+    twin.step(3)
+    assert cp.tenant_lifecycle("sick") == "active"
+    assert cp.epoch("sick") == 1, "re-admission must bump the epoch"
+    cp.step(1)
+    twin.step(1)
+    # Readmitted lane resumed from the held tick-3 state: one tick
+    # after re-admission equals the 4-tick solo run.
+    _assert_states_bitequal(cp.tenant_state("sick"),
+                            _solo_run(acfg, world, 0, 4),
+                            "readmitted lane != held-state solo run")
+
+    # Co-tenant blast radius: bit-identical to the no-fault twin in
+    # state AND served tile bytes, through poison, quarantine, the
+    # probe's solo dispatch and the in-place readmit.
+    _assert_states_bitequal(cp.tenant_state("ok"),
+                            twin.tenant_state("ok"),
+                            "co-tenant state diverged from twin")
+    assert _tile_digest(cp, "ok") == _tile_digest(twin, "ok"), (
+        "co-tenant served tiles diverged from the no-fault twin")
+
+    # Zero extra dispatches is by construction (the word rides the
+    # megabatch); zero extra COMPILES is the gate here. The absolute
+    # per-process ceiling is NOT asserted on the shared pytest cache
+    # (sibling modules mint their own bucket variants first) — the
+    # canonical-scenario ratchet in test_analysis_selfcheck owns it.
+    assert int(MB.megabatch_step._cache_size()) == variants_warm, (
+        "quarantine/probe/readmit minted a megabatch variant "
+        "post-warmup")
+
+    kinds = [e["kind"] for e in flight_recorder.events_since(mark)]
+    assert "tenancy_quarantine" in kinds
+    assert "tenancy_readmit_probe" in kinds
+    assert "tenancy_readmit" in kinds
+    # The ladder's transition log is the determinism surface.
+    assert [(t, s0_, s1_) for t, tid, s0_, s1_
+            in cp._lanehealth.transitions] == [
+        (4, HEALTHY, SUSPECT), (5, SUSPECT, QUARANTINED),
+        (8, QUARANTINED, HEALTHY)]
+
+
+def test_state_jump_is_survivable_state_fault(acfg, world_np):
+    """`tenant_state_jump` corrupts INPUT state (the within-step delta
+    stays small, so the POSE_JUMP sentinel is the wrong detector by
+    design) — the host twin confirms the teleported state itself is
+    finite and un-flagged, i.e. the fault is survivable and only the
+    match-floor sentinel (armed per deployment) would catch the
+    degradation."""
+    cp = TenantControlPlane(acfg)
+    cp.admit("t", world_np, seed=0)
+    cp.step(1)
+    before = cp.tenant_state("t")
+    cp.state_jump_tenant("t", 1.5)
+    after = cp.tenant_state("t")
+    d = np.asarray(after.est_poses - before.est_poses)[..., :2]
+    np.testing.assert_allclose(d, 1.5, rtol=1e-6)
+    assert np.isfinite(np.asarray(after.est_poses)).all()
+    cp.evict("t", checkpoint=False)
+
+
+# ------------------------------------------------------------ journal
+
+def test_journal_roundtrip_compaction_and_reopen(tmp_path):
+    d = str(tmp_path)
+    j = ControlJournal(d)
+    j.append("admit", "a", seed=3, epoch=0, revision=1, steps=0,
+             world_shape=[64, 64], world_dtype="float32")
+    j.append("admit", "b", seed=4, epoch=0, revision=1, steps=0)
+    j.append("suspend", "b", epoch=0, revision=5, steps=4)
+    j.append("quarantine", "a", epoch=0, revision=7, steps=9, word=1)
+    reg = j.registry()
+    assert reg["a"]["state"] == "quarantined"
+    assert reg["a"]["world_shape"] == [64, 64]
+    assert reg["b"]["state"] == "suspended"
+    with pytest.raises(ValueError, match="unknown journal record"):
+        j.append("frobnicate", "a")
+    # Compaction truncates the journal; the snapshot carries the fold.
+    j.compact()
+    assert os.path.getsize(j.journal_path) == 0
+    reg2, seq, meta = read_registry(d)
+    assert reg2 == reg and seq == j.seq
+    assert meta["snapshot"] and meta["n_replayed"] == 0
+    # Post-compaction appends replay on top of the snapshot.
+    j.append("evict", "b", epoch=0, revision=5, steps=4)
+    reg3, _, meta3 = read_registry(d)
+    assert reg3["b"]["state"] == "evicted"
+    assert meta3["n_replayed"] == 1
+    # Reopening restores seq monotonicity — the ordering extends.
+    j2 = ControlJournal(d)
+    assert j2.seq == j.seq
+    assert j2.registry()["a"]["state"] == "quarantined"
+    assert j2.append("resume", "b") == j.seq + 1
+
+
+def test_journal_torn_tail_truncates(tmp_path):
+    """Torn mid-record (the power-loss case): short header, short
+    payload, and CRC rot all end the walk at the last intact record
+    and truncate the file — corrupt degrades, never crashes."""
+    d = str(tmp_path)
+    j = ControlJournal(d)
+    j.append("admit", "a", seed=0)
+    j.append("admit", "b", seed=1)
+    good_size = os.path.getsize(j.journal_path)
+    # Append a torn record: a length prefix promising more bytes than
+    # exist (a crash mid-append).
+    with open(j.journal_path, "ab") as f:
+        f.write(b"\xff\x00\x00\x00partial")
+    recs, truncated = read_journal(j.journal_path)
+    assert [r["tid"] for r in recs] == ["a", "b"]
+    assert truncated > 0
+    assert os.path.getsize(j.journal_path) == good_size, (
+        "torn bytes must truncate away, never resurrect")
+    # CRC rot inside the LAST record: that record (only) is dropped.
+    with open(j.journal_path, "rb+") as f:
+        f.seek(good_size - 5)
+        f.write(b"\x00")
+    recs2, _ = read_journal(j.journal_path)
+    assert [r["tid"] for r in recs2] == ["a"]
+    # A fresh plane-side open replays only the intact prefix.
+    reg, _, meta = read_registry(d)
+    assert set(reg) == {"a"}
+    assert meta["torn_bytes_truncated"] == 0    # already truncated
+
+
+def test_snapshot_newer_than_journal_tail(tmp_path):
+    """A journal tail OLDER than the snapshot (compaction raced a
+    crash that resurrected pre-compaction records) replays to nothing:
+    records at or below the snapshot seq are skipped."""
+    d = str(tmp_path)
+    j = ControlJournal(d)
+    j.append("admit", "a", seed=0)
+    j.append("suspend", "a")
+    j.compact()                                  # snapshot seq = 2
+    # Hand-write a stale record (seq 1) into the truncated journal —
+    # same bytes an interrupted compaction could leave behind.
+    stale = ControlJournal(str(tmp_path / "scratch"))
+    stale.append("evict", "a")                   # seq 1 in its file
+    with open(stale.journal_path, "rb") as f:
+        raw = f.read()
+    with open(j.journal_path, "ab") as f:
+        f.write(raw)
+    reg, seq, meta = read_registry(d)
+    assert reg["a"]["state"] == "suspended", (
+        "a stale (seq <= snapshot) record replayed over the snapshot")
+    assert seq == 2 and meta["n_replayed"] == 0
+
+
+# ------------------------------------------------------------ restore
+
+def test_restore_crash_roundtrip(acfg, world_np, tmp_path):
+    """Plane crash -> rebuild -> restore: the SAME tenant set comes
+    back (active tenants re-admitted through the warmup path, a
+    quarantined tenant held-state-only with its probe schedule live),
+    every epoch advances past its journaled watermark, and the
+    restored plane steps and re-admits normally."""
+    ckdir = str(tmp_path)
+    world = jnp.asarray(world_np)
+    cp = TenantControlPlane(acfg, checkpoint_dir=ckdir)
+    cp.admit("a", world_np, seed=0)
+    cp.admit("q", world_np, seed=1)
+    cp.step(3)
+    cp.set_tenant_poison("q", True)
+    cp.step(2)                                   # q quarantined @5
+    assert cp.tenant_lifecycle("q") == "quarantined"
+    cp.checkpoint_all()
+    a_state = cp.tenant_state("a")
+    q_held = cp.tenant_state("q")
+    a_epoch, q_epoch = cp.epoch("a"), cp.epoch("q")
+    a_rev = cp.revision("a")
+
+    cp2 = TenantControlPlane(acfg, checkpoint_dir=ckdir)
+    report = cp2.restore()
+    assert sorted(report["restored"]) == ["a", "q"]
+    assert report["lost"] == []
+    assert cp2.tenant_lifecycle("a") == "active"
+    assert cp2.tenant_lifecycle("q") == "quarantined"
+    # Epoch protocol: advanced past the journaled watermark, and
+    # epoch ⇒ revision so no (epoch, revision) ETag pair recurs.
+    assert cp2.epoch("a") == a_epoch + 1
+    assert cp2.epoch("q") == q_epoch + 1
+    assert cp2.revision("a") == a_rev + 1
+    _assert_states_bitequal(cp2.tenant_state("a"), a_state,
+                            "restored active state != checkpointed")
+    _assert_states_bitequal(cp2.tenant_state("q"), q_held,
+                            "restored held state != checkpointed")
+    # The restored quarantine probes on the new plane's clock and
+    # re-joins through the laneless (resume-style) readmit path.
+    cp2.step(3)
+    assert cp2.tenant_lifecycle("q") == "active"
+    assert cp2.epoch("q") == q_epoch + 2
+    # A fault-free tick after readmission advances both tenants.
+    cp2.step(1)
+    assert cp2.revision("a") > a_rev + 1
+
+
+def test_restore_all_corrupt_checkpoints_reports_lost(acfg, world_np,
+                                                      tmp_path):
+    """A tenant whose checkpoint generations are ALL unreadable is
+    reported `lost`; the other tenants still restore (degrade, never
+    crash)."""
+    ckdir = str(tmp_path)
+    cp = TenantControlPlane(acfg, checkpoint_dir=ckdir)
+    cp.admit("keep", world_np, seed=0)
+    cp.admit("gone", world_np, seed=1)
+    cp.step(2)
+    cp.checkpoint_all()
+    for name in os.listdir(ckdir):
+        # Every generation: the live slot AND the .prev fallback.
+        if name.startswith("tenant_gone.live."):
+            p = os.path.join(ckdir, name)
+            with open(p, "rb+") as f:
+                f.truncate(max(1, os.path.getsize(p) // 3))
+    cp2 = TenantControlPlane(acfg, checkpoint_dir=ckdir)
+    report = cp2.restore()
+    assert report["restored"] == ["keep"]
+    assert report["lost"] == ["gone"]
+    assert cp2.tenant_lifecycle("keep") == "active"
+    cp2.step(1)                                  # still serviceable
+
+
+def test_restore_with_torn_journal_tail(acfg, world_np, tmp_path):
+    """A torn journal tail at plane-construction time truncates and
+    restores the intact prefix — never fatal."""
+    ckdir = str(tmp_path)
+    cp = TenantControlPlane(acfg, checkpoint_dir=ckdir)
+    cp.admit("a", world_np, seed=0)
+    cp.step(1)
+    cp.checkpoint_all()
+    jpath = os.path.join(ckdir, "controlplane", "control.journal")
+    with open(jpath, "ab") as f:
+        f.write(b"\x40\x00\x00\x00torn-mid-record")
+    cp2 = TenantControlPlane(acfg, checkpoint_dir=ckdir)
+    report = cp2.restore()
+    assert report["restored"] == ["a"] and report["lost"] == []
+
+
+# --------------------------------------------------------- admission
+
+def test_admission_backpressure_rejects(acfg, world_np, tmp_path):
+    """Bounded admission: with `admission_queue_max=1`, a second
+    admission entering while one is in flight raises AdmissionRejected
+    (never queues), bumps the counter, flight-records the rejection,
+    and the /status admission block reports it. The in-flight window
+    is held open deterministically by gating `_admit`."""
+    from jax_mapping.obs.recorder import flight_recorder
+
+    cfg = dataclasses.replace(
+        acfg, tenancy=dataclasses.replace(_ARMED, journal=False,
+                                          admission_queue_max=1))
+    cp = TenantControlPlane(cfg)
+    inner = cp._admit
+    entered = threading.Event()
+    release = threading.Event()
+
+    def gated(tid, world, seed, state, dynamics):
+        entered.set()
+        assert release.wait(30)
+        return inner(tid, world, seed, state, dynamics)
+
+    cp._admit = gated
+    mark = flight_recorder.mark()
+    t = threading.Thread(target=cp.admit,
+                         args=("slow", world_np), kwargs={"seed": 0})
+    t.start()
+    try:
+        assert entered.wait(30)
+        with pytest.raises(AdmissionRejected, match="in flight"):
+            cp.admit("burst", world_np, seed=1)
+    finally:
+        release.set()
+        t.join(timeout=60)
+    assert not t.is_alive()
+    cp._admit = inner
+    st = cp.status()
+    assert st["admission"] == {"in_flight": 0, "queue_max": 1,
+                               "n_rejected": 1}
+    events = [e for e in flight_recorder.events_since(mark)
+              if e["kind"] == "tenancy_admission_rejected"]
+    assert len(events) == 1 and events[0]["tenant"] == "burst"
+    fams = {f.name for f in cp.metric_families()}
+    assert "jax_mapping_tenant_admission_rejected_total" in fams
+    # The admitted tenant is intact; the rejected one left no trace.
+    assert cp.tenant_lifecycle("slow") == "active"
+    assert cp.tenant_lifecycle("burst") == "unknown"
+
+
+def test_admission_backpressure_concurrent_consistency(acfg, world_np):
+    """Concurrent admits against a bounded queue: every thread either
+    lands a fully-consistent tenant or gets a clean AdmissionRejected
+    — the registry never holds a half-admitted mission and the
+    accounting (admitted + rejected) balances."""
+    cfg = dataclasses.replace(
+        acfg, tenancy=dataclasses.replace(_ARMED, journal=False,
+                                          admission_queue_max=1))
+    cp = TenantControlPlane(cfg)
+    outcomes = []
+    gate = threading.Barrier(4)
+
+    def admit_one(i):
+        try:
+            gate.wait(30)
+            cp.admit(f"c{i}", world_np, seed=i)
+            outcomes.append(("ok", i))
+        except AdmissionRejected:
+            outcomes.append(("rejected", i))
+        except Exception as e:                   # noqa: BLE001
+            outcomes.append(("error", repr(e)))
+
+    threads = [threading.Thread(target=admit_one, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads)
+    assert not [o for o in outcomes if o[0] == "error"], outcomes
+    ok = [i for k, i in outcomes if k == "ok"]
+    st = cp.status()
+    assert len(ok) >= 1, "the bounded queue starved every admission"
+    assert st["n_admitted"] == len(ok)
+    assert st["admission"]["n_rejected"] == 4 - len(ok)
+    assert st["admission"]["in_flight"] == 0
+    for i in ok:
+        assert cp.tenant_lifecycle(f"c{i}") == "active"
+        cp.tenant_state(f"c{i}")                 # fully materialized
+
+
+# ---------------------------------------------------- serving client
+
+def test_client_tenant_gone_and_quarantine_stamp(acfg, world_np):
+    """DeltaMapClient on a tenant route: steady polls work, a
+    quarantined tenant serves its frozen revision with the
+    `state=quarantined` stamp (and a `-quarantined` ETag, so a
+    healthy-tagged client re-fetches once), and an evicted tenant's
+    404 raises typed TenantGone — mission churn, not breakage."""
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.serving.client import DeltaMapClient, TenantGone
+
+    st = launch_sim_stack(acfg, world_np, n_robots=1, http_port=0,
+                          realtime=False, seed=0)
+    try:
+        plane = st.tenancy
+        plane.admit("m0", world_np, seed=0)
+        plane.step(3)
+        base = f"http://127.0.0.1:{st.api.port}"
+        client = DeltaMapClient(base, route="/tiles?tenant=m0")
+        body = client.poll()
+        assert client.revision == 3 and client.state is None
+        assert body["tiles"]
+
+        plane.set_tenant_poison("m0", True)
+        plane.step(2)                            # suspect -> quarantined
+        assert plane.tenant_lifecycle("m0") == "quarantined"
+        body = client.poll()
+        assert client.state == "quarantined"
+        assert body["revision"] == 3, "frozen revision moved"
+        assert "-quarantined" in client._etag
+        # Current client + unchanged frozen revision -> 304 now.
+        body = client.poll()
+        assert body.get("not_modified") is True
+
+        plane.evict("m0", checkpoint=False)
+        with pytest.raises(TenantGone) as ei:
+            client.poll()
+        assert ei.value.route == "/tiles?tenant=m0"
+        assert ei.value.detail
+        # Unknown tenant ids get the same typed signal.
+        ghost = DeltaMapClient(base, route="/tiles?tenant=ghost")
+        with pytest.raises(TenantGone):
+            ghost.poll()
+    finally:
+        st.shutdown()
+
+
+# ----------------------------------------------------------- threads
+
+def test_racewatch_quarantine_vs_status(acfg, world_np):
+    """Eraser lockset gate over the containment path: /status and
+    /metrics polling from worker threads races the stepping thread
+    through poison, quarantine, probes and readmission — zero race
+    reports, and the lane-health ladder's candidate lockset converges
+    on the declared `_lock`."""
+    from jax_mapping.analysis.protection import groups_by_class
+    from jax_mapping.analysis.racewatch import RaceWatch
+
+    cp = TenantControlPlane(acfg)
+    cp.admit("sick", world_np, seed=0)
+    cp.admit("ok", world_np, seed=1)
+    cp.step(3)                                   # warm in-line
+    watch = RaceWatch()
+    errors = []
+    try:
+        watch.watch_object(cp,
+                           groups_by_class()["TenantControlPlane"][0],
+                           name="containment")
+        stop = threading.Event()
+
+        def poller():
+            while not stop.is_set():
+                try:
+                    st = cp.status()
+                    assert "health" in st
+                    cp.metric_families()
+                    cp.tenant_lifecycle("sick")
+                except Exception as e:           # noqa: BLE001
+                    errors.append(f"status: {e}")
+                stop.wait(0.002)
+
+        threads = [threading.Thread(target=poller) for _ in range(2)]
+        for t in threads:
+            t.start()
+        cp.set_tenant_poison("sick", True)
+        cp.step(2)                               # quarantine
+        cp.set_tenant_poison("sick", False)
+        cp.step(4)                               # probe + readmit
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        watch.unwatch_all()
+    assert not errors, errors
+    assert watch.reports() == []
+    assert cp.tenant_lifecycle("sick") == "active"
+    states = watch.field_states()
+    lh = [s for name, s in states.items() if "_lanehealth" in name]
+    assert lh, "racewatch never saw the lane-health field"
+    for s in lh:
+        assert s.candidate is None or any(
+            "_lock" in c for c in s.candidate), (
+            f"{s.name} lockset did not converge on _lock: "
+            f"{s.candidate}")
+
+
+# --------------------------------------------------------- faultplan
+
+def test_faultplan_tenant_kind_validation_and_sampling():
+    from jax_mapping.resilience.faultplan import (TENANT_KINDS,
+                                                  FaultEvent,
+                                                  random_plan)
+
+    assert TENANT_KINDS == {"tenant_poison", "tenant_state_jump",
+                            "controlplane_crash"}
+    with pytest.raises(ValueError, match="needs name"):
+        FaultEvent(step=1, kind="tenant_poison")
+    with pytest.raises(ValueError, match="needs name"):
+        FaultEvent(step=1, kind="tenant_state_jump", value=1.0)
+    with pytest.raises(ValueError, match="value > 0"):
+        FaultEvent(step=1, kind="tenant_state_jump", name="t")
+    FaultEvent(step=1, kind="controlplane_crash")        # name-free
+
+    tenants = [f"m{i}" for i in range(4)]
+    p1 = random_plan(200, n_faults=12, seed=7, tenant_ids=tenants,
+                     allow_controlplane_crash=True)
+    p2 = random_plan(200, n_faults=12, seed=7, tenant_ids=tenants,
+                     allow_controlplane_crash=True)
+    assert p1.events == p2.events, "same-seed plans must be identical"
+    tenant_events = [e for e in p1.events
+                     if e.kind in ("tenant_poison",
+                                   "tenant_state_jump")]
+    assert tenant_events, "the tenant kinds never sampled"
+    assert all(e.name in tenants for e in tenant_events)
+    # Overlap rejection: windows on one tenant never intersect.
+    from jax_mapping.resilience.faultplan import _fault_resource
+    windows = {}
+    for e in p1.events:
+        res = _fault_resource(e.kind, e.robot, e.name)
+        for s, en in windows.get(res, []):
+            assert not (e.step <= en and s <= e.step + e.duration), (
+                f"overlapping windows on {res}")
+        windows.setdefault(res, []).append(
+            (e.step, e.step + e.duration))
+    # One plane = one resource: at most ONE crash per plan.
+    assert sum(e.kind == "controlplane_crash"
+               for e in p1.events) <= 1
+    # Without tenant_ids the sampler reproduces the pre-PR pool.
+    p3 = random_plan(200, n_faults=6, seed=3)
+    assert all(e.kind not in TENANT_KINDS for e in p3.events)
+
+
+def test_faultplan_tenant_poison_refcount_composes():
+    """Two overlapping hand-written poison windows on one tenant: the
+    first window's clear must NOT un-poison while the second still
+    holds (the partition refcount doctrine); a crash swapping the
+    plane mid-window clears against the RESTORED plane."""
+    from jax_mapping.resilience.faultplan import FaultEvent, FaultPlan
+
+    class _Plane:
+        def __init__(self):
+            self.calls = []
+
+        def set_tenant_poison(self, tid, active):
+            self.calls.append((tid, active))
+
+    class _Stack:
+        def __init__(self):
+            self.tenancy = _Plane()
+        bus = None
+        brain = None
+
+    stack = _Stack()
+    plan = FaultPlan([
+        FaultEvent(step=1, kind="tenant_poison", name="t", duration=4),
+        FaultEvent(step=3, kind="tenant_poison", name="t", duration=4),
+    ])
+    for step in range(0, 9):
+        plan.apply(stack, step)
+    # Holds at 1 and 3; window-1 clear at 5 is refcount-held (no
+    # un-poison); window-2 clear at 7 releases.
+    assert stack.tenancy.calls == [("t", True), ("t", True),
+                                   ("t", False)]
+    assert plan.done()
+    # Plane swapped mid-window (controlplane_crash): the clear re-reads
+    # stack.tenancy and lands on the NEW plane.
+    stack2 = _Stack()
+    plan2 = FaultPlan([
+        FaultEvent(step=1, kind="tenant_poison", name="t", duration=3)])
+    plan2.apply(stack2, 1)
+    old_plane = stack2.tenancy
+    stack2.tenancy = _Plane()
+    plan2.apply(stack2, 4)
+    assert old_plane.calls == [("t", True)]
+    assert stack2.tenancy.calls == [("t", False)]
+
+
+def test_controlplane_crash_overlapping_cache_wipe(acfg, world_np,
+                                                   tmp_path):
+    """The restore edge the satellites pin: a `controlplane_crash`
+    fires INSIDE a `cache_wipe` window — restore re-admits through a
+    wiped compile cache (plain recompile, never blocked), the full
+    tenant set comes back with epochs advanced, and the wipe window
+    clears cleanly afterwards. Runs through the real Stack wiring
+    (`Stack.crash_controlplane`) and the real FaultPlan kinds."""
+    from jax_mapping.bridge.launch import launch_sim_stack
+    from jax_mapping.io.compile_cache import CompileCacheManager
+    from jax_mapping.resilience.faultplan import FaultEvent, FaultPlan
+
+    st = launch_sim_stack(acfg, world_np, n_robots=1, http_port=None,
+                          realtime=False, seed=0,
+                          checkpoint_dir=str(tmp_path))
+    try:
+        st.compile_cache = CompileCacheManager(
+            acfg.cold_start, str(tmp_path / "cc"))
+        plane0 = st.tenancy
+        plane0.admit("m0", world_np, seed=0)
+        plane0.step(2)
+        epoch0 = plane0.epoch("m0")
+        plan = FaultPlan([
+            FaultEvent(step=1, kind="cache_wipe", duration=4),
+            FaultEvent(step=2, kind="controlplane_crash"),
+        ])
+        for step in range(0, 7):
+            plan.apply(st, step)
+        assert plan.done()
+        assert st.tenancy is not plane0, "the plane did not crash"
+        assert st.api is None or st.api.tenancy is st.tenancy
+        assert st.tenancy.tenant_lifecycle("m0") == "active"
+        assert st.tenancy.epoch("m0") > epoch0
+        st.tenancy.step(1)                       # restored plane serves
+        logs = [d for _, d in plan.log]
+        assert "cache_wipe" in logs
+        assert any(d.startswith("controlplane_crash restored=1 lost=0")
+                   for d in logs), logs
+        assert st.compile_cache._wipe_refs == 0, (
+            "the wipe window did not clear after the crash")
+    finally:
+        st.shutdown()
+
+
+# ------------------------------------------------- acceptance drill
+
+def _clean_cpu_env() -> dict:
+    """CPU-pinned subprocess env WITHOUT the harness's virtual-mesh
+    flag (the EXACT_BUCKETS gotcha — see tests/test_tenancy.py)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    return env
+
+
+@pytest.mark.slow
+def test_tenant_blast_radius_drill(tmp_path):
+    """THE ISSUE 17 acceptance drill, from a clean subprocess: a
+    12-tenant soak under seeded chaos where (1) the poisoned tenant
+    quarantines within the hysteresis budget, (2) all 11 co-tenants
+    stay BIT-IDENTICAL to a no-fault twin (state AND served tile
+    digests), (3) a control-plane crash restores the full tenant set
+    with epochs advanced, (4) the per-tenant SLO ingest-stall burn
+    fires ONLY under the poisoned tenant's label, and (5) two
+    same-seed runs produce identical quarantine/restore/alert
+    sequences."""
+    script = r"""
+import dataclasses, hashlib, json, os, sys
+import numpy as np
+import jax, jax.numpy as jnp
+from jax_mapping.config import SloObjective, TenancyConfig, micro_config
+from jax_mapping.models import fleet as FM
+from jax_mapping.obs.pipeline import PipelineLedger
+from jax_mapping.obs.slo import SloEngine
+from jax_mapping.sim import world as W
+from jax_mapping.tenancy import megabatch as MB
+from jax_mapping.tenancy.controlplane import TenantControlPlane
+
+ROOT = sys.argv[1]
+N = 12
+SICK = "t03"
+PERSIST = 2
+cfg = dataclasses.replace(micro_config(), tenancy=TenancyConfig(
+    enabled=True, prewarm_on_admit=False, lane_health=True,
+    quarantine_persist_ticks=PERSIST, readmit_probe_ticks=4,
+    max_readmit_probes=2, journal=True))
+world_np = W.empty_arena(cfg.grid.size_cells, cfg.grid.resolution_m)
+OBJ = SloObjective(name="tenant_fresh", metric="scan_to_served_p99_ms",
+                   max_silent_ticks=2, fast_window_ticks=4,
+                   slow_window_ticks=8, fast_burn=0.5, slow_burn=0.25)
+
+def run(tag, fault):
+    ck = os.path.join(ROOT, tag)
+    ledger = PipelineLedger()
+    cp = TenantControlPlane(cfg, checkpoint_dir=ck, pipeline=ledger)
+    for i in range(N):
+        cp.admit(f"t{i:02d}", world_np, seed=i)
+    slos = {t: SloEngine([OBJ], pipeline=ledger, tenant=t)
+            for t in (SICK, "t00")}
+    seq = []
+    def tick(n):
+        for _ in range(n):
+            cp.step(1)
+            for t, eng in slos.items():
+                eng.evaluate(cp.n_ticks)
+                for a in eng.alerts()[len([s for s in seq
+                                           if s[0] == "slo"
+                                           and s[3] == t]):]:
+                    seq.append(("slo", a[0], a[1] + ":" + a[2], t))
+    tick(3)
+    if fault:
+        cp.set_tenant_poison(SICK, True)
+    tick(PERSIST + 1)
+    if fault:
+        assert cp.tenant_lifecycle(SICK) == "quarantined", (
+            "poisoned tenant not quarantined within the budget")
+        seq.append(("quarantine", cp.n_ticks, SICK, ""))
+    # Soak 2 more ticks: enough for the SLO burn windows to fire, but
+    # INSIDE the quarantine window (the cadence-4 probe at tick 9
+    # would re-admit the now-clean lane before the crash).
+    tick(2)
+    # Crash + restore mid-soak (the durable-registry acceptance).
+    if fault:
+        cp.checkpoint_all()
+        epochs_before = {f"t{i:02d}": cp.epoch(f"t{i:02d}")
+                         for i in range(N)}
+        cp2 = TenantControlPlane(cfg, checkpoint_dir=ck,
+                                 pipeline=ledger)
+        report = cp2.restore()
+        assert sorted(report["restored"]) == sorted(
+            f"t{i:02d}" for i in range(N)), report
+        assert report["lost"] == []
+        for t, e0 in epochs_before.items():
+            assert cp2.epoch(t) == e0 + 1, (t, e0, cp2.epoch(t))
+        assert cp2.tenant_lifecycle(SICK) == "quarantined"
+        seq.append(("restore", cp2.n_ticks,
+                    ",".join(sorted(report["restored"])), ""))
+        cp2.step(1)
+    digests = {}
+    for i in range(N):
+        t = f"t{i:02d}"
+        if t == SICK and fault:
+            continue
+        store = cp.tile_store(t)
+        store.refresh()
+        _, entries, _ = store.tiles_since(-1)
+        h = hashlib.sha256(
+            json.dumps(entries, sort_keys=True).encode()).hexdigest()
+        sh = hashlib.sha256(b"".join(
+            np.asarray(x).tobytes() for x in
+            jax.tree_util.tree_leaves(cp.tenant_state(t)))).hexdigest()
+        digests[t] = (sh, h)
+    trans = list(cp._lanehealth.transitions)
+    return digests, seq, trans, {t: s.firing()
+                                 for t, s in slos.items()}
+
+d_fault, seq1, trans1, firing1 = run("fault_a", True)
+d_twin, _, _, _ = run("twin", False)
+mismatch = [t for t in d_twin
+            if t in d_fault and d_fault[t] != d_twin[t]]
+assert not mismatch, f"co-tenants diverged from the twin: {mismatch}"
+assert len([t for t in d_fault if t != SICK]) == N - 1
+# SLO: the poisoned tenant's label fired; the healthy one's did not.
+assert any(k == "slo" and t == SICK and "firing" in v
+           for k, _, v, t in seq1), seq1
+assert not any(k == "slo" and t == "t00" and "firing" in v
+               for k, _, v, t in seq1), seq1
+# Determinism: a second same-seed faulted run replays identically.
+d2, seq2, trans2, _ = run("fault_b", True)
+assert seq2 == seq1, "same-seed chaos sequences diverged"
+assert trans2 == trans1
+assert d2 == d_fault
+print(json.dumps({"ok": True, "n_events": len(seq1),
+                  "transitions": trans1[:4]}))
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", script, str(tmp_path)],
+        capture_output=True, text=True, timeout=900,
+        env=_clean_cpu_env())
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr[-4000:]}"
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert doc["ok"] is True
