@@ -135,6 +135,29 @@ def measure_scenario(analysis_cfg=None) -> Dict[str, int]:
             b = MBT.make_tenant_batch([mstate] * nt, [mworld] * nt,
                                       [mkey] * nt)
             MBT.megabatch_step(mcfg, b, mcfg.grid.resolution_m)
+        # Sliding-window world jits (ISSUE 18): one fuse at global
+        # coordinates, one shift with a content-bearing leaving band
+        # (extract + roll), one shift back (host-hit rehydrate =
+        # scatter) — the full shift/evict/rehydrate dispatch set, each
+        # pinned to ONE variant (shift amounts are traced, tile size
+        # is the single static). Geometry mirrors the world tests:
+        # 12-tile logical lattice, 4-tile window, so a ±2-tile shift
+        # stays on-lattice.
+        import dataclasses as _dc
+        from jax_mapping.world.store import WorldStore
+        wcfg = cfg.replace(
+            grid=_dc.replace(cfg.grid, size_cells=768),
+            world=_dc.replace(cfg.world, windowed=True,
+                              window_tiles=4, margin_tiles=1))
+        wstore = WorldStore(wcfg)
+        win = G.empty_grid(wstore.cfg.grid)
+        win = wstore.fuse_scan_global(
+            win, jnp.full((cfg.scan.padded_beams,), 1.0, jnp.float32),
+            jnp.zeros((3,), jnp.float32))
+        win = wstore.shift(win, 2, 2)
+        win = wstore.shift(win, -2, -2)
+        win, _ = wstore.poll_prefetch(win)
+        jax.block_until_ready(win)
     finally:
         st.shutdown()
     return {k: v for k, v in snapshot_cache_sizes().items() if v > 0}

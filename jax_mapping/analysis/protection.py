@@ -260,6 +260,51 @@ REPO_PROTECTION: List[LockGroup] = [
     group("WarmPool", "_lock",
           ["_entries", "n_served", "n_fallthrough", "n_dropped"],
           lockfree_ok=["_bindings", "installed"]),
+    # Sliding-window world store (world/store.py): the host LRU, the
+    # away-set (the serving evicted-marker mask), the in-flight
+    # prefetch table and the admission generation stamp mutate
+    # together under `_lock` — the mapper tick thread evicts and
+    # rehydrates while HTTP workers compose serving mosaics and read
+    # /status, exactly the evict-vs-serve pair the world racewatch
+    # gate hammers (tests/test_world.py). `origin_tile` and
+    # `decay_epoch` are tick-thread single-writer (shift()/
+    # note_decay_pass() run only on the mapper tick, which also owns
+    # the device grid; foreign readers take the point-in-time value by
+    # the /status convention); `eviction_epoch` bumps under the lock
+    # but is read bare as the serving ETag suffix; the schedule log is
+    # appended from both in- and out-of-lock sites by design (the
+    # shift note stamps on the single-writer tick thread). `spill` and
+    # `governor` are set-once wiring references.
+    group("WorldStore", "_lock",
+          ["_host", "_away", "_pending", "_gen"],
+          lockfree_ok=["origin_tile", "decay_epoch", "eviction_epoch",
+                       "schedule", "n_schedule_events", "n_shifts",
+                       "n_evictions", "n_rehydrated_host",
+                       "n_rehydrated_disk", "n_lost",
+                       "n_corrupt_spills", "spill", "governor"]),
+    # Memory-pressure governor (world/governor.py): its own `_lock`
+    # guards only the named-hold table (FaultPlan threads arm/clear
+    # squeezes while the tick thread reads the worst-of). The rung and
+    # the shed counters are serialized by the STORE's `_lock` instead
+    # (observe()/_shed() run only inside WorldStore lock sections) and
+    # read bare by /status — out of this lock's racewatch scope, same
+    # as the DispatchProfiler's module-lock escape.
+    group("MemoryGovernor", "_lock",
+          ["_pressure"],
+          lockfree_ok=["rung", "n_spills", "n_drops", "n_coarsened",
+                       "n_refused", "n_rung_changes", "cfg"]),
+    # Disk spill tier (world/spill.py): the offset index is the
+    # guarded state — eviction appends from the tick thread while
+    # prefetch threads seek-read and chaos rewrites frames. `_f` is
+    # opened once at (single-threaded) construction and thereafter a
+    # read-only reference whose file OPERATIONS serialize under
+    # `_lock`; the read/corrupt counters follow the /status
+    # convention (n_reads deliberately increments outside the lock —
+    # a monotonic gauge, not snapshot state).
+    group("SpillStore", "_lock",
+          ["_index"],
+          lockfree_ok=["_f", "n_appends", "n_reads",
+                       "n_corrupt_reads", "n_truncated_bytes"]),
 ]
 
 

@@ -490,6 +490,13 @@ class MapApiServer:
                     # Live odometry-scale re-measurement of the
                     # hand-calibrated SPEED_COEFF (report.pdf §III.D).
                     body["odom_calibration"] = calib
+                if hasattr(self.mapper, "world_status"):
+                    ws = self.mapper.world_status()
+                    if ws is not None:
+                        # Bounded-memory world (world/store.py): window
+                        # origin/offset, eviction + rehydration
+                        # counters, governor rung, spill-tier health.
+                        body["world"] = ws
             if self.voxel_mapper is not None:
                 body["n_images_fused"] = self.voxel_mapper.n_images_fused
                 body["n_depth_keyframes"] = \
@@ -614,27 +621,49 @@ class MapApiServer:
         name = os.path.basename(q.get("name", ["slam_state"])[0]) or \
             "slam_state"
         fp = os.path.join(self.checkpoint_dir, name + ".npz")
-        if name.endswith((".voxel", ".voxelkf", ".prior")):
+        if name.endswith((".voxel", ".voxelkf", ".prior", ".world")):
             # Reserved: checkpoint "x"'s sidecars live at "x.voxel.npz" /
-            # "x.voxelkf.npz" / "x.prior.npz"; a checkpoint NAMED with
-            # any of those suffixes would collide with them.
+            # "x.voxelkf.npz" / "x.prior.npz" / "x.world.npz"; a
+            # checkpoint NAMED with any of those suffixes would collide
+            # with them.
             return 400, "application/json", json.dumps(
                 {"error": "checkpoint names ending in '.voxel', "
-                          "'.voxelkf' or '.prior' are reserved for "
-                          "sidecars"}).encode()
+                          "'.voxelkf', '.prior' or '.world' are "
+                          "reserved for sidecars"}).encode()
+        # The LOGICAL config is what checkpoints record: in windowed
+        # mode `mapper.cfg` is the window-sized derivation, and two
+        # stacks with different logical extents share a window shape —
+        # only the full config pins the world geometry the window
+        # origin is anchored to. full_cfg == cfg when not windowed.
+        cfg_json = getattr(self.mapper, "full_cfg",
+                           self.mapper.cfg).to_json()
         if route == "/save":
             os.makedirs(self.checkpoint_dir, exist_ok=True)
             states = self.mapper.snapshot_states()
-            save_checkpoint(fp, states,
-                            config_json=self.mapper.cfg.to_json())
+            save_checkpoint(fp, states, config_json=cfg_json)
             body = {"status": "saved", "path": fp, "robots": len(states)}
+            from jax_mapping.io.checkpoint import (clear_world_sidecar,
+                                                   save_world_sidecar)
+            world = getattr(self.mapper, "world", None)
+            if world is not None:
+                try:
+                    body["world_path"] = save_world_sidecar(
+                        fp, world.checkpoint_payload(),
+                        config_json=cfg_json)
+                except ValueError as e:
+                    body["world_error"] = str(e)
+            else:
+                # A stale window manifest from an earlier windowed save
+                # under this name would re-anchor a later windowed
+                # resume at a dead origin.
+                clear_world_sidecar(fp)
             prior = self.mapper.map_prior()
             from jax_mapping.io.checkpoint import (clear_prior_sidecar,
                                                    save_prior_sidecar)
             if prior is not None:
                 try:
                     body["prior_path"] = save_prior_sidecar(
-                        fp, prior, config_json=self.mapper.cfg.to_json())
+                        fp, prior, config_json=cfg_json)
                 except ValueError as e:
                     # Same contract as the voxel sidecar: the main
                     # checkpoint IS saved; report the sidecar problem.
@@ -651,13 +680,13 @@ class MapApiServer:
                 try:
                     body["voxel_path"] = save_voxel_sidecar(
                         fp, self.voxel_mapper.snapshot_grid(),
-                        config_json=self.mapper.cfg.to_json())
+                        config_json=cfg_json)
                     # Keyframe ring alongside, so post-/load closures can
                     # still repair the 3D map (the 2D scan ring's
                     # persistence, in 3D).
                     body["keyframe_path"] = save_keyframe_sidecar(
                         fp, self.voxel_mapper.snapshot_keyframes(),
-                        config_json=self.mapper.cfg.to_json())
+                        config_json=cfg_json)
                 except ValueError as e:
                     body["voxel_error"] = str(e)
             return 200, "application/json", json.dumps(body).encode()
@@ -667,13 +696,27 @@ class MapApiServer:
         from jax_mapping.models import slam as _S
         template = [_S.init_state(self.mapper.cfg)
                     for _ in self.mapper.states]
-        states, cfg_json = load_checkpoint(fp, template)
+        states, saved_cfg_json = load_checkpoint(fp, template)
         from jax_mapping.config import configs_equivalent
-        if cfg_json is not None and \
-                not configs_equivalent(cfg_json, self.mapper.cfg.to_json()):
+        if saved_cfg_json is not None and \
+                not configs_equivalent(saved_cfg_json, cfg_json):
             return 409, "application/json", json.dumps(
                 {"error": "checkpoint config differs from the running "
                           "config; refusing to load"}).encode()
+        # World-window sidecar (bounded-memory world): validate BEFORE
+        # any restore mutates live state, same contract as the voxel
+        # sidecar. A windowed checkpoint loaded into a non-windowed
+        # stack already 409'd above (the state shapes differ).
+        world = getattr(self.mapper, "world", None)
+        world_payload = None
+        if world is not None:
+            from jax_mapping.io.checkpoint import load_world_sidecar
+            try:
+                world_payload = load_world_sidecar(
+                    fp, running_config_json=cfg_json)
+            except ValueError as e:
+                return 409, "application/json", json.dumps(
+                    {"error": f"world sidecar: {e}"}).encode()
         # Validate + read the 3D sidecar BEFORE any restore mutates live
         # state: a bad sidecar must 409 with everything untouched, not
         # leave the server half-restored.
@@ -686,9 +729,9 @@ class MapApiServer:
             try:
                 vgrid = load_voxel_sidecar(
                     fp, self.voxel_mapper.snapshot_grid(),
-                    running_config_json=self.mapper.cfg.to_json())
+                    running_config_json=cfg_json)
                 vkf = load_keyframe_sidecar(
-                    fp, running_config_json=self.mapper.cfg.to_json())
+                    fp, running_config_json=cfg_json)
                 if vkf is not None:
                     self.voxel_mapper.validate_keyframes(vkf)
             except ValueError as e:
@@ -698,7 +741,7 @@ class MapApiServer:
         try:
             prior = load_prior_sidecar(
                 fp, self._G_empty(),
-                running_config_json=self.mapper.cfg.to_json())
+                running_config_json=cfg_json)
         except ValueError as e:
             return 409, "application/json", json.dumps(
                 {"error": f"prior sidecar: {e}"}).encode()
@@ -706,8 +749,19 @@ class MapApiServer:
         # robots holding still, so checkpoint poses are still valid.
         # map_prior=None CLEARS a live prior — the checkpoint is the
         # source of truth now.
+        if world is not None and world_payload is not None:
+            # Re-anchor BEFORE the state install: the checkpointed
+            # window grids are content AT the checkpointed origin, and
+            # the install's revision bump + full dirty mark then serve
+            # the re-anchored mosaic in one step.
+            world.restore_payload(world_payload)
         self.mapper.restore_states(states, map_prior=prior)
         body = {"status": "loaded", "path": fp, "robots": len(states)}
+        if world is not None and world_payload is not None:
+            from jax_mapping.io.checkpoint import world_sidecar_path
+            body["world_path"] = world_sidecar_path(fp)
+            body["world_origin_tile"] = [int(v) for v in
+                                         world_payload["origin_tile"]]
         if prior is not None:
             from jax_mapping.io.checkpoint import prior_sidecar_path
             body["prior_path"] = prior_sidecar_path(fp)
@@ -931,6 +985,12 @@ class MapApiServer:
                        == "quarantined")
         suffix = ('-warming' if warming else '') + \
             ('-quarantined' if quarantined else '')
+        # Bounded-memory world: the eviction epoch rides the ETag so a
+        # validator can never 304 across an eviction-state flip whose
+        # content change is exactly "these tiles became markers".
+        wepoch = getattr(store, "evicted_epoch", 0)
+        if wepoch:
+            suffix += f"-w{wepoch}"
         etag = f'W/"{source}-e{epoch}-r{rev}{suffix}"'
         # First-client-delivery waypoint + Server-Timing revision age:
         # a 304 confirms freshness exactly as a body does (the client
@@ -1411,6 +1471,55 @@ class MapApiServer:
             ]
             return fams
         reg.add_source(serving_families)
+
+        def world_families():
+            # Bounded-memory world (world/store.py): the governor rung
+            # + pressure, tier occupancies, eviction/rehydration and
+            # integrity counters — the memory-chaos observables.
+            if self.mapper is None \
+                    or not hasattr(self.mapper, "world_status"):
+                return None
+            ws = self.mapper.world_status()
+            if ws is None:
+                return None
+            gov = ws.get("governor", {})
+            fams = [
+                Family("jax_mapping_world_shifts_total", "counter",
+                       (("", str(ws["shifts"])),)),
+                Family("jax_mapping_world_evictions_total", "counter",
+                       (("", str(ws["evictions"])),)),
+                Family("jax_mapping_world_rehydrated_host_total",
+                       "counter", (("", str(ws["rehydrated_host"])),)),
+                Family("jax_mapping_world_rehydrated_disk_total",
+                       "counter", (("", str(ws["rehydrated_disk"])),)),
+                Family("jax_mapping_world_tiles_lost_total", "counter",
+                       (("", str(ws["lost_tiles"])),)),
+                Family("jax_mapping_world_corrupt_spills_total",
+                       "counter", (("", str(ws["corrupt_spills"])),)),
+                Family("jax_mapping_world_host_tiles", "gauge",
+                       (("", str(ws["host_tiles"])),)),
+                Family("jax_mapping_world_away_tiles", "gauge",
+                       (("", str(ws["away_tiles"])),)),
+                Family("jax_mapping_world_device_window_bytes", "gauge",
+                       (("", str(ws["device_window_bytes"])),)),
+                Family("jax_mapping_world_governor_rung", "gauge",
+                       (("", str(gov.get("rung", 0))),)),
+                Family("jax_mapping_world_governor_pressure", "gauge",
+                       (("", str(gov.get("pressure", 0.0))),)),
+                Family("jax_mapping_world_governor_refused_total",
+                       "counter", (("", str(gov.get("refused", 0))),)),
+            ]
+            spill = ws.get("spill")
+            if spill is not None:
+                fams += [
+                    Family("jax_mapping_world_spill_tiles", "gauge",
+                           (("", str(spill["tiles"])),)),
+                    Family("jax_mapping_world_spill_corrupt_reads_total",
+                           "counter",
+                           (("", str(spill["corrupt_reads"])),)),
+                ]
+            return fams
+        reg.add_source(world_families)
 
         def degraded_samples():
             with self._stats_lock:

@@ -78,6 +78,11 @@ class Stack:
     #: from ("" = auto-checkpointing disabled; pass checkpoint_dir to
     #: launch_sim_stack to enable).
     auto_checkpoint_path: str = ""
+    #: Bounded-memory world spill directory ("" = host-LRU only): where
+    #: evicted tiles overflow to disk when cfg.world.windowed; a
+    #: restarted MapperNode reopens the SAME spill file so tiles
+    #: evicted before the crash rehydrate after it.
+    world_spill_dir: str = ""
     #: Warm-restart storage tier (io/compile_cache.CompileCacheManager)
     #: when ColdStartConfig.enabled — persistent XLA cache, AOT
     #: snapshots, the cache_wipe fault boundary. None = cold restarts.
@@ -152,14 +157,33 @@ class Stack:
         generation to the .prev slot — the corruption fallback). A
         journal-armed tenancy plane checkpoints its live tenants on the
         same cadence — the durability heartbeat `restore()` replays."""
-        from jax_mapping.io.checkpoint import save_checkpoint
+        from jax_mapping.io.checkpoint import (
+            clear_world_sidecar, previous_checkpoint_path,
+            save_checkpoint, save_world_sidecar, world_sidecar_path)
         os.makedirs(os.path.dirname(self.auto_checkpoint_path),
                     exist_ok=True)
+        world = getattr(self.mapper, "world", None)
+        if world is not None:
+            # Rotate the window manifest in LOCKSTEP with
+            # save_checkpoint's current -> .prev rotation: a corrupt
+            # primary falls back to the .prev STATES, which must
+            # re-anchor from the manifest saved with them — a newer
+            # origin under older tiles is silent map corruption.
+            wp = world_sidecar_path(self.auto_checkpoint_path)
+            if os.path.exists(wp):
+                os.replace(wp, world_sidecar_path(
+                    previous_checkpoint_path(self.auto_checkpoint_path)))
         save_checkpoint(
             self.auto_checkpoint_path, self.mapper.snapshot_states(),
             config_json=self.cfg.to_json(),
             retain_generations=self.cfg.resilience
             .checkpoint_retain_generations)
+        if world is not None:
+            save_world_sidecar(self.auto_checkpoint_path,
+                               world.checkpoint_payload(),
+                               config_json=self.cfg.to_json())
+        else:
+            clear_world_sidecar(self.auto_checkpoint_path)
         if self.tenancy is not None:
             self.tenancy.checkpoint_all()
 
@@ -231,14 +255,24 @@ class Stack:
         old.destroy()
         wu.begin_restore()
         states = None
+        used_path = None
         if self.auto_checkpoint_path:
             from jax_mapping.io.checkpoint import (
                 CheckpointCorrupt, load_checkpoint_with_fallback)
             from jax_mapping.models import slam as _S
-            template = [_S.init_state(self.cfg) for _ in range(n)]
+            mcfg = self.cfg
+            if mcfg.world.windowed:
+                # Windowed checkpoints carry WINDOW-shaped states (the
+                # mapper's device config) under the full logical
+                # config_json — the template must match the arrays, not
+                # the logical extent (io/checkpoint shape checks).
+                from jax_mapping.world.store import window_slam_config
+                mcfg = window_slam_config(mcfg)
+            template = [_S.init_state(mcfg) for _ in range(n)]
             try:
-                states, _cfg_json, _used = load_checkpoint_with_fallback(
-                    self.auto_checkpoint_path, template)
+                states, _cfg_json, used_path = \
+                    load_checkpoint_with_fallback(
+                        self.auto_checkpoint_path, template)
             except (FileNotFoundError, CheckpointCorrupt):
                 states = None                # no intact generation: blank
         # Pre-warm BEFORE the new node enters service: entry points
@@ -262,7 +296,8 @@ class Stack:
                 traceback.print_exc()
         new = MapperNode(self.cfg, self.bus, tf=self.tf, n_robots=n,
                          health=self.health, recovery=self.recovery,
-                         pipeline=self.pipeline, slo=self.slo)
+                         pipeline=self.pipeline, slo=self.slo,
+                         spill_dir=self.world_spill_dir or None)
         # Serving restart epoch: the resumed node legitimately re-serves
         # an OLDER map_revision (checkpoints lag the live map); the
         # bumped epoch tells delta clients to drop their cache and
@@ -274,6 +309,27 @@ class Stack:
                                resumed_from_checkpoint=states is not None)
         anchors = self.brain.poses.copy()
         if states is not None:
+            if new.world is not None:
+                # Re-anchor the window BEFORE the states install: the
+                # checkpointed grid is window content AT the manifest's
+                # origin, and the brain's world-frame anchor poses must
+                # convert to the robocentric window frame (world =
+                # window + offset). A missing/corrupt/drifted manifest
+                # degrades to the boot origin — flight-recorded, never
+                # a crashed restart (the checkpoint states still load;
+                # only spilled-tile provenance is lost).
+                from jax_mapping.io.checkpoint import load_world_sidecar
+                try:
+                    payload = load_world_sidecar(
+                        used_path, running_config_json=self.cfg.to_json())
+                except Exception as e:       # noqa: BLE001
+                    payload = None
+                    flight_recorder.record(
+                        "world_sidecar_degraded", node="jax_mapper",
+                        error=f"{type(e).__name__}: {e}")
+                if payload is not None:
+                    new.world.restore_payload(payload)
+                anchors[:, :2] -= new.world.offset_xy()[None, :]
             new.restore_states(states, anchor_poses=anchors)
         else:
             for i, st in enumerate(new.states):
@@ -438,8 +494,14 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
     # boot poses in the map frame up front keeps multi-robot maps aligned
     # (the fleet model's convention, models/fleet.py init_fleet_state).
     brain.poses = sim.truth_poses().copy()
+    # Bounded-memory world spill tier: evicted tiles overflow to disk
+    # under the checkpoint dir (surviving mapper restarts); a disk-free
+    # stack keeps the host LRU only and sheds beyond it.
+    world_spill_dir = (os.path.join(checkpoint_dir, "world_spill")
+                       if checkpoint_dir and cfg.world.windowed else "")
     mapper = MapperNode(cfg, bus, tf=tf, n_robots=n_robots, health=health,
-                        recovery=recovery, pipeline=pipeline, slo=slo)
+                        recovery=recovery, pipeline=pipeline, slo=slo,
+                        spill_dir=world_spill_dir or None)
     for i, st in enumerate(mapper.states):
         mapper.states[i] = st._replace(pose=jnp.asarray(brain.poses[i]))
 
@@ -511,7 +573,8 @@ def launch_sim_stack(cfg: SlamConfig, world: np.ndarray,
                   voxel_mapper=voxel_mapper, planner=planner,
                   health=health, supervisor=supervisor, recovery=recovery,
                   tracer=tracer, devprof=devprof, pipeline=pipeline,
-                  slo=slo, compile_cache=compile_cache, warmup=warmup)
+                  slo=slo, compile_cache=compile_cache, warmup=warmup,
+                  world_spill_dir=world_spill_dir)
     if cfg.tenancy.enabled:
         # Mission multi-tenancy (tenancy/): the control plane that
         # admits/evicts megabatched model-level missions alongside

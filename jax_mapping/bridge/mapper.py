@@ -54,7 +54,8 @@ class MapperNode(Node):
     def __init__(self, cfg: SlamConfig, bus: Bus,
                  tf: Optional[TfTree] = None, n_robots: int = 1,
                  tick_period_s: Optional[float] = None, health=None,
-                 recovery=None, pipeline=None, slo=None):
+                 recovery=None, pipeline=None, slo=None,
+                 spill_dir: Optional[str] = None):
         super().__init__("jax_mapper", bus, tf)
         import jax.numpy as jnp
 
@@ -62,6 +63,23 @@ class MapperNode(Node):
         from jax_mapping.ops import frontier as F
         from jax_mapping.ops import grid as G
 
+        #: Bounded-memory world (world/store.py) or None. When
+        #: `cfg.world.windowed`, the node's DEVICE config shrinks to
+        #: the derived window-sized SlamConfig — `slam_step` is
+        #: config-static, so the matcher, pyramids, graph, loop
+        #: closure and frontier all run at window scale unchanged —
+        #: while `self.full_cfg` keeps the logical-extent original
+        #: (serving tile lattice, /map metadata). Poses live in the
+        #: robocentric WINDOW frame; `_maybe_shift_window` translates
+        #: them on each tile-aligned shift and every publish boundary
+        #: adds `world.offset_xy()` back (world = window + offset).
+        #: None = bit-exact pre-windowed behavior (every use gates).
+        self.full_cfg = cfg
+        self.world = None
+        if cfg.world.windowed:
+            from jax_mapping.world.store import WorldStore
+            self.world = WorldStore(cfg, spill_dir=spill_dir)
+            cfg = self.world.cfg
         self.cfg = cfg
         self.n_robots = n_robots
         self._S, self._F, self._G, self._jnp = S, F, G, jnp
@@ -208,12 +226,17 @@ class MapperNode(Node):
         #: off.
         self._tile_rev: Optional[np.ndarray] = None
         if self._serving_enabled:
-            if cfg.grid.size_cells % cfg.serving.tile_cells:
+            # The tile lattice is LOGICAL-extent: in windowed mode the
+            # dirty/revision maps cover the whole addressable world
+            # (markers translate window-local coordinates through the
+            # store's origin), so serving and the pyramid caches see
+            # one consistent lattice however the window moves.
+            if self.full_cfg.grid.size_cells % cfg.serving.tile_cells:
                 raise ValueError(
                     f"ServingConfig.tile_cells={cfg.serving.tile_cells} "
                     f"does not divide grid.size_cells="
-                    f"{cfg.grid.size_cells}")
-            nt = cfg.grid.size_cells // cfg.serving.tile_cells
+                    f"{self.full_cfg.grid.size_cells}")
+            nt = self.full_cfg.grid.size_cells // cfg.serving.tile_cells
             self._dirty_tiles = np.zeros((nt, nt), bool)
             self._tile_rev = np.zeros((nt, nt), np.int64)
         #: Last key-scan match work accounting per robot (SlamDiag
@@ -269,8 +292,14 @@ class MapperNode(Node):
     # -- callbacks ----------------------------------------------------------
 
     def _initialpose_cb(self, msg) -> None:
-        self.reset_robot_pose(0, [float(msg.x), float(msg.y),
-                                  float(msg.theta)])
+        pose = [float(msg.x), float(msg.y), float(msg.theta)]
+        if self.world is not None:
+            # Asserted poses arrive in WORLD coordinates; the chain
+            # lives in the robocentric window frame.
+            off = self.world.offset_xy()
+            pose[0] -= float(off[0])
+            pose[1] -= float(off[1])
+        self.reset_robot_pose(0, pose)
         M.counters.inc("mapper.initialpose_resets")
 
     def reset_robot_pose(self, i: int, pose) -> None:
@@ -321,10 +350,14 @@ class MapperNode(Node):
         row = (xy[1] - g.origin_m[1]) / g.resolution_m
         t = self.cfg.serving.tile_cells
         nt = self._dirty_tiles.shape[0]
-        r0 = min(nt - 1, max(0, int((row - half) // t)))
-        r1 = min(nt - 1, max(0, int((row + half) // t)))
-        c0 = min(nt - 1, max(0, int((col - half) // t)))
-        c1 = min(nt - 1, max(0, int((col + half) // t)))
+        # Window-local coordinates map to the logical lattice through
+        # the store's origin (identity zero when not windowed).
+        off_r, off_c = (0, 0) if self.world is None \
+            else self.world.origin_tile
+        r0 = min(nt - 1, max(0, int((row - half) // t) + off_r))
+        r1 = min(nt - 1, max(0, int((row + half) // t) + off_r))
+        c0 = min(nt - 1, max(0, int((col - half) // t) + off_c))
+        c1 = min(nt - 1, max(0, int((col + half) // t) + off_c))
         with self._dirty_lock:
             self._dirty_tiles[r0:r1 + 1, c0:c1 + 1] = True
             self._tile_rev[r0:r1 + 1, c0:c1 + 1] = self.map_revision
@@ -341,6 +374,12 @@ class MapperNode(Node):
         if self._dirty_tiles is None:
             return
         tr0, tr1, tc0, tc1 = box
+        if self.world is not None:
+            # The box is window-tile coordinates (device-computed on
+            # the window grid); translate to the logical lattice.
+            off_r, off_c = self.world.origin_tile
+            tr0, tr1 = tr0 + off_r, tr1 + off_r
+            tc0, tc1 = tc0 + off_c, tc1 + off_c
         with self._dirty_lock:
             self._dirty_tiles[tr0:tr1 + 1, tc0:tc1 + 1] = True
             self._tile_rev[tr0:tr1 + 1, tc0:tc1 + 1] = self.map_revision
@@ -391,10 +430,15 @@ class MapperNode(Node):
             return None
         t = self.cfg.serving.tile_cells
         nt = self._tile_rev.shape[0]
-        r0 = min(nt - 1, max(0, row0 // t))
-        r1 = min(nt - 1, max(0, (row0 + span_cells - 1) // t))
-        c0 = min(nt - 1, max(0, col0 // t))
-        c1 = min(nt - 1, max(0, (col0 + span_cells - 1) // t))
+        # Callers pass window-local cell coordinates (the pyramids are
+        # built over the device grid); translate through the window
+        # origin onto the logical lattice (identity when not windowed).
+        off_r, off_c = (0, 0) if self.world is None \
+            else self.world.origin_tile
+        r0 = min(nt - 1, max(0, row0 // t + off_r))
+        r1 = min(nt - 1, max(0, (row0 + span_cells - 1) // t + off_r))
+        c0 = min(nt - 1, max(0, col0 // t + off_c))
+        c1 = min(nt - 1, max(0, (col0 + span_cells - 1) // t + off_c))
         with self._dirty_lock:
             return int(self._tile_rev[r0:r1 + 1, c0:c1 + 1].max())
 
@@ -418,6 +462,25 @@ class MapperNode(Node):
                     hint = self._dirty_tiles.copy()
                     self._dirty_tiles[:] = False
         return rev, grid, hint
+
+    def world_status(self):
+        """Bounded-memory world introspection for /status.world and the
+        jax_mapping_world_* metrics; None when not windowed (the knob-off
+        doctrine: no new status surface unless the store exists)."""
+        if self.world is None:
+            return None
+        body = self.world.status()
+        off = self.world.offset_xy()
+        body["offset_m"] = [float(off[0]), float(off[1])]
+        return body
+
+    def destroy(self) -> None:
+        super().destroy()
+        if self.world is not None:
+            # Release the spill file handle: a staged restart reopens
+            # the SAME spill file from the replacement node, and two
+            # live writers would interleave (= corrupt) frames.
+            self.world.close()
 
     def add_revision_listener(self, fn) -> None:
         """Register fn(revision): called from the tick thread after the
@@ -553,6 +616,12 @@ class MapperNode(Node):
         robots keep localizing, now against the imported walls.
         """
         jnp = self._jnp
+        if self.world is not None:
+            raise ValueError(
+                "map priors are not supported in windowed mode "
+                "(world.windowed): a logical-extent prior exceeds the "
+                "device window — import it unwindowed or grow the "
+                "window to the prior's extent")
         g = self.cfg.grid
         prior = jnp.asarray(prior_logodds, dtype="float32")
         if prior.shape != (g.size_cells, g.size_cells):
@@ -677,6 +746,7 @@ class MapperNode(Node):
 
     def _tick_body(self) -> None:
         jnp = self._jnp
+        self._maybe_shift_window()
         with self._state_lock:
             work: List[List] = [[] for _ in range(self.n_robots)]
             for i in range(self.n_robots):
@@ -770,6 +840,68 @@ class MapperNode(Node):
              "rejected_stale": self.n_scans_rejected_stale,
              "loops_closed": self.n_loops_closed})
 
+    def _maybe_shift_window(self) -> None:
+        """Windowed-mode per-tick world maintenance (no-op otherwise):
+        join last tick's disk prefetches into the window (the
+        deterministic one-tick unknown-degrade), then recentre the
+        window when a robot strays into the margin band.
+
+        A shift is a whole-frame translation: the device grid rolls
+        (one jitted dispatch, evicting/rehydrating through the store),
+        and every pose-like leaf — state.pose, last_key_pose, the
+        graph's pose rows, the install correction basis — translates
+        by the shift delta. Graph EDGES are relative poses and scan
+        rings are ranges-only, so the translation is the entire
+        fix-up; generation bumps resync out-of-band consumers (voxel
+        anchoring), and the revision bump + full dirty mark make
+        serving, the frontier pipeline and the pyramid caches see the
+        shift as an ordinary whole-map mutation. Runs on the tick
+        thread BETWEEN steps — no in-flight step can race the swap
+        (the `_apply_decay` discipline)."""
+        if self.world is None:
+            return
+        jnp = self._jnp
+        with self._state_lock:
+            grid, n_rehydrated = self.world.poll_prefetch(
+                self.shared_grid)
+            if n_rehydrated:
+                self.shared_grid = grid
+                for j in range(self.n_robots):
+                    self.states[j] = self.states[j]._replace(grid=grid)
+                if self._serving_enabled:
+                    self.map_revision += 1
+                    self._mark_dirty_all()
+            poses = [np.asarray(st.pose) for st in self.states]
+            dr, dc = self.world.desired_shift(poses)
+            if (dr, dc) == (0, 0):
+                return
+            with M.stages.stage("mapper.window_shift"):
+                new_grid = self.world.shift(self.shared_grid, dr, dc)
+            delta = self.world.shift_delta_m(dr, dc)
+            shift3_np = np.array([delta[0], delta[1], 0.0], np.float32)
+            shift3 = jnp.asarray(shift3_np)
+            self.shared_grid = new_grid
+            for j in range(self.n_robots):
+                st = self.states[j]
+                graph = st.graph._replace(
+                    poses=st.graph.poses - shift3[None, :])
+                self.states[j] = st._replace(
+                    grid=new_grid,
+                    pose=st.pose - shift3,
+                    last_key_pose=st.last_key_pose - shift3,
+                    graph=graph)
+                self._state_gen[j] += 1
+                if self._correction[j] is not None:
+                    est, odo = self._correction[j]
+                    self._correction[j] = (est - shift3_np, odo)
+            if self._serving_enabled:
+                self.map_revision += 1
+                self._mark_dirty_all()
+        M.counters.inc("mapper.window_shifts")
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record("window_shift", dr=dr, dc=dc,
+                               origin=list(self.world.origin_tile))
+
     def _apply_decay(self) -> None:
         """One map-healing pass (DecayConfig): shrink every cell's
         log-odds toward unknown and clamp to the evidence cap, in one
@@ -798,6 +930,11 @@ class MapperNode(Node):
             # every decay cadence.
             self._pipeline.installed(rev, tick=self._tick_no,
                                      ingest=False)
+        if self.world is not None:
+            # Spilled tiles catch up lazily at rehydrate time (one
+            # sequential clip(x*f) per missed pass — bit-exact with
+            # the device's per-pass arithmetic).
+            self.world.note_decay_pass()
         self.n_decay_passes += 1
         M.counters.inc("mapper.decay_passes")
         from jax_mapping.obs.recorder import flight_recorder
@@ -1323,6 +1460,11 @@ class MapperNode(Node):
                             od: Odometry) -> None:
         """map->odom correction TF: est ⊖ odom (slam_toolbox's role)."""
         est = np.asarray(self.states[i].pose)
+        if self.world is not None:
+            # TF consumers live in the fixed world frame; the estimator
+            # runs robocentric — translate at the publish boundary.
+            est = est + np.array([*self.world.offset_xy(), 0.0],
+                                 np.float32)
         o = od.pose
         ns = robot_ns(i, self.n_robots)
         c, s = np.cos(est[2] - o.theta), np.sin(est[2] - o.theta)
@@ -1354,6 +1496,11 @@ class MapperNode(Node):
                 return None
             st = self.states[i]
             gen = self._state_gen[i]
+        if self.world is not None:
+            # The voxel mapper fuses in world frame; est and graph-node
+            # poses are robocentric (odom is odom-frame: untouched).
+            shift3 = np.array([*self.world.offset_xy(), 0.0], np.float32)
+            corr = (np.asarray(corr[0], np.float32) + shift3, corr[1])
         n = int(st.graph.n_poses)
         if n == 0:
             # A correction without a graph: localization mode tracks the
@@ -1364,8 +1511,10 @@ class MapperNode(Node):
             # with no closures possible, nothing would re-fuse them.
             return (gen, corr[0], corr[1], -1, corr[0],
                     int(st.n_keyscans))
-        return (gen, corr[0], corr[1], n - 1,
-                np.asarray(st.graph.poses[n - 1], np.float32),
+        node_pose = np.asarray(st.graph.poses[n - 1], np.float32)
+        if self.world is not None:
+            node_pose = node_pose + shift3
+        return (gen, corr[0], corr[1], n - 1, node_pose,
                 int(st.n_keyscans))
 
     def graph_snapshot(self, i: int):
@@ -1375,8 +1524,14 @@ class MapperNode(Node):
             st = self.states[i]
             gen = self._state_gen[i]
         cap = self.cfg.loop.max_poses
-        return (gen, np.asarray(st.graph.poses[:cap], np.float32),
-                np.asarray(st.graph.pose_valid[:cap]),
+        poses = np.asarray(st.graph.poses[:cap], np.float32)
+        if self.world is not None:
+            # World frame, same as depth_anchor: graph poses translate
+            # with every window shift, the offset undoes it — node
+            # poses stay shift-invariant for keyframe re-anchoring.
+            poses = poses + np.array([*self.world.offset_xy(), 0.0],
+                                     np.float32)
+        return (gen, poses, np.asarray(st.graph.pose_valid[:cap]),
                 int(st.graph.n_poses), int(st.n_keyscans))
 
     def merged_grid(self):
@@ -1390,8 +1545,14 @@ class MapperNode(Node):
     def publish_map(self) -> None:
         g = self.cfg.grid
         lo = np.asarray(self.merged_grid())
+        origin = g.origin_m
+        if self.world is not None:
+            # The published grid is the WINDOW; its origin rides the
+            # window so /map consumers see it at the right world pose.
+            off = self.world.offset_xy()
+            origin = (float(origin[0] + off[0]), float(origin[1] + off[1]))
         msg = occupancy_from_logodds(lo, g.occ_threshold, g.free_threshold,
-                                     g.resolution_m, g.origin_m)
+                                     g.resolution_m, origin)
         self._last_map_stamp = msg.header.stamp
         self.map_pub.publish(msg)
         self.map_updates_pub.publish(msg)
@@ -1566,7 +1727,19 @@ class MapperNode(Node):
             if self._tile_rev is not None:
                 with self._dirty_lock:
                     tile_rev = self._tile_rev.copy()
+        if tile_rev is not None and self.world is not None:
+            # The pipeline runs at window scale: slice its view of the
+            # logical revision lattice to the resident window.
+            r0, c0 = self.world.origin_tile
+            wt = self.world.window_tiles
+            tile_rev = np.ascontiguousarray(
+                tile_rev[r0:r0 + wt, c0:c0 + wt])
         lo, extra_key = self._frontier_basis(lo, rev)
+        if self.world is not None:
+            # A shift changes what window-local coordinates MEAN — the
+            # origin in the key invalidates every cached tile across
+            # one (the frontier pipeline's extra_key contract).
+            extra_key = ("worigin", self.world.origin_tile, extra_key)
         pipeline = self._frontier_incremental()
         if pipeline is not None:
             with M.stages.stage("mapper.frontier_publish"):
@@ -1587,6 +1760,16 @@ class MapperNode(Node):
             assignment = np.asarray(fr.assignment)
             stamp_rev = rev if self._serving_enabled else -1
             M.counters.inc("mapper.frontier_recomputes")
+        if self.world is not None and len(targets):
+            # Publish boundary: the pipeline computed in WINDOW frame;
+            # targets cross into world frame here, before the post-
+            # passes (blacklist entries are world-frame) and the wire.
+            # Copy first — pub.targets may alias the pipeline's cache.
+            off = self.world.offset_xy()
+            targets = np.asarray(targets, np.float32) + off[None, :]
+        if self.world is not None:
+            poses = poses.copy()
+            poses[:, :2] += self.world.offset_xy()[None, :]
         # Post-passes run FRESH even on a skipped recompute (health and
         # blacklists move on their own clocks); they copy-on-write, so
         # the pipeline's cached assignment is never mutated.

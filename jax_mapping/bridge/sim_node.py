@@ -179,7 +179,8 @@ class SimNode(Node):
                 self.n_world_updates += 1
         targets = self._jnp.asarray(self.driver.targets().astype(np.float32))
         self.sim_state, measured = self._thymio.step_fleet(
-            cfg.robot, self.sim_state, targets, 1.0 / self.rate_hz)
+            cfg.robot, self.sim_state, targets, 1.0 / self.rate_hz,
+            cfg.robot.speed_noise_frac)
         prox = self._lidar.ir_proximity(self.world, self.world_res_m,
                                         self.sim_state.poses)
         prox7 = np.zeros((self.driver.n_robots, 7), np.int32)

@@ -135,6 +135,13 @@ class RobotConfig:
     # Pi variant odometry reads motor *targets* not measured speeds
     # (pi/src/.../main.py:188-191); the sim models this as first-order lag.
     motor_lag_tau_s: float = 0.15
+    # Multiplicative wheel-speed measurement noise the SIM feeds the
+    # odometry path (report.pdf §V.B: 13% calibration CV motivates a
+    # nonzero default). 0.0 = measured speeds equal actual speeds, so
+    # the odometry estimate tracks sim ground truth to wire-quantization
+    # precision — scripted-trajectory soaks rely on that to keep a
+    # goal-regulated robot physically on its lane without scan matching.
+    speed_noise_frac: float = 0.05
 
     @property
     def speed_coeff_cm_per_unit_s(self) -> float:
@@ -909,6 +916,62 @@ class ServingConfig:
 
 
 @_frozen
+class WorldConfig:
+    """Bounded-memory robocentric world store (world/ subsystem).
+
+    The pre-PR design allocates ONE fixed-extent grid (bench: 4096^2
+    @ 0.05 m) and every subsystem assumes it; a robot that walks off
+    the edge or a multi-day lifelong mission is out of scope. These
+    knobs parameterize the sliding-window world store
+    (`world/store.py`): a fixed-budget device-resident window of
+    serving tiles that shifts with the robot via a zero-copy roll
+    (one jitted dispatch), an LRU of evicted tiles spilled to host
+    RAM and then to disk with per-tile CRC + generation stamps, and a
+    memory-pressure governor with a watermark-driven load-shed ladder
+    (shrink retention -> coarsen spilled tiles -> refuse admission).
+
+    `windowed=False` is EXACT pre-PR behavior: no store, no new jits,
+    the mapper runs the full fixed grid (the knob-off doctrine,
+    property-tested across grids, frontier targets and served tile
+    hashes). `grid.size_cells` becomes the LOGICAL extent — the
+    addressable world — while device bytes scale with the window
+    only."""
+
+    windowed: bool = False
+    #: Window edge length, in serving tiles: the device-resident grid
+    #: is (window_tiles * serving.tile_cells)^2 cells regardless of
+    #: the logical extent. Must leave the derived window grid
+    #: divisible by every shape contract the fixed grid honors
+    #: (patch, frontier downsample, tile_cells).
+    window_tiles: int = 8
+    #: Recentring hysteresis, in tiles: the window shifts only when
+    #: the robot strays within `margin_tiles` of the window edge, and
+    #: recentres so the robot sits in the middle band again. 0 shifts
+    #: every tile crossing (churn); large margins shift early.
+    margin_tiles: int = 1
+    #: Host LRU capacity, in evicted tiles, before the governor's
+    #: eviction cadence spills the coldest to disk (or drops them
+    #: when no spill dir is configured).
+    host_tile_budget: int = 256
+    #: Governor watermarks, as fractions of `host_tile_budget`:
+    #: above `high` the ladder arms (rung 1: faster spill cadence +
+    #: shrunk retention); above `critical` it escalates (rung 2:
+    #: coarsen spilled-tile retention by `retention_coarsen`; rung 3
+    #: under synthetic/pressure squeeze: refuse admission — evicted
+    #: tiles degrade to unknown on re-entry).
+    host_high_watermark: float = 0.75
+    host_critical_watermark: float = 0.92
+    #: Disk spill directory; "" = no disk tier (host LRU overflow is
+    #: dropped at rung 0 too). Launch derives
+    #: `<checkpoint_dir>/world_spill` when a checkpoint dir exists.
+    spill_dir: str = ""
+    #: Rung-2 retention coarsening: spilled tiles are downsampled by
+    #: this factor (max-pool on |logodds|) and re-upsampled on
+    #: rehydrate — lossy, bounded, never a crash.
+    retention_coarsen: int = 2
+
+
+@_frozen
 class TenancyConfig:
     """Mission multi-tenancy (tenancy/ subsystem).
 
@@ -1064,6 +1127,7 @@ class SlamConfig:
     resilience: ResilienceConfig = ResilienceConfig()
     recovery: RecoveryConfig = RecoveryConfig()
     serving: ServingConfig = ServingConfig()
+    world: WorldConfig = WorldConfig()
     decay: DecayConfig = DecayConfig()
     obs: ObsConfig = ObsConfig()
     cold_start: ColdStartConfig = ColdStartConfig()
@@ -1118,6 +1182,7 @@ class SlamConfig:
             resilience=ResilienceConfig(**raw.get("resilience", {})),
             recovery=RecoveryConfig(**raw.get("recovery", {})),
             serving=ServingConfig(**raw.get("serving", {})),
+            world=WorldConfig(**raw.get("world", {})),
             decay=DecayConfig(**raw.get("decay", {})),
             obs=ObsConfig(**obs_raw),
             cold_start=ColdStartConfig(**raw.get("cold_start", {})),

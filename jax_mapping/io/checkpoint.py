@@ -559,6 +559,111 @@ def _is_voxel_sidecar(vp: str) -> bool:
         return False
 
 
+def world_sidecar_path(path: str) -> str:
+    """Sidecar for the bounded-memory world's window state next to a
+    2D checkpoint (world/store.py): window origin, epochs, away-set,
+    and — when the store has no disk spill tier — the host-LRU tiles
+    embedded. With a spill tier the tiles flush to the spill FILE at
+    save time and this sidecar is just the re-anchor manifest; restore
+    re-anchors immediately and rehydrates lazily on re-entry."""
+    root, ext = os.path.splitext(path)
+    return root + ".world" + (ext or ".npz")
+
+
+#: Sidecar arrays a world payload always carries; host_meta/host_tiles
+#: ride along only when the store has no disk tier.
+_WORLD_KEYS = ("origin_tile", "epochs", "away")
+
+
+def save_world_sidecar(path: str, payload: dict,
+                       config_json: Optional[str] = None) -> str:
+    """Write a WorldStore.checkpoint_payload() as `path`'s .world
+    sidecar; returns the sidecar path. Same refuse-to-clobber guard as
+    the other sidecars, same per-array CRC discipline as the main
+    checkpoint (a rotted sidecar must refuse loudly, not re-anchor the
+    window at garbage coordinates)."""
+    missing = [k for k in _WORLD_KEYS if k not in payload]
+    if missing:
+        raise ValueError(f"world payload missing keys {missing}")
+    wp = world_sidecar_path(path)
+    if os.path.exists(wp) and not _is_world_sidecar(wp):
+        raise ValueError(
+            f"{wp} exists and is not a world sidecar (a checkpoint named "
+            f"with the reserved '.world' suffix?); refusing to overwrite")
+    arrays = {k: np.asarray(v) for k, v in payload.items()}
+    meta = {"config": config_json, "version": 1, "kind": "world_window",
+            "keys": sorted(arrays),
+            "crc32": {k: _leaf_crc(v) for k, v in arrays.items()}}
+    arrays[_META_KEY] = np.frombuffer(json.dumps(meta).encode(), np.uint8)
+    tmp = wp + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    os.replace(tmp, wp)
+    return wp
+
+
+def load_world_sidecar(path: str,
+                       running_config_json: Optional[str] = None):
+    """Load `path`'s world-window sidecar, or None when no sidecar
+    exists (pre-windowed checkpoints and windowed=False stacks: the
+    window simply starts at its anchor, exactly the boot behavior).
+    Raises ValueError on a wrong-kind file, CRC failure, or config
+    drift — one validation path for launch restore and HTTP /load."""
+    wp = world_sidecar_path(path)
+    if not os.path.exists(wp):
+        return None
+    try:
+        with np.load(wp) as z:
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+            if meta.get("kind") != "world_window":
+                raise ValueError(
+                    f"{wp} is not a world sidecar; refusing to load")
+            out = {k: z[k] for k in meta["keys"]}
+    except (OSError, KeyError, json.JSONDecodeError, zipfile.BadZipFile,
+            zlib.error) as e:
+        raise CheckpointCorrupt(
+            f"world sidecar {wp} is unreadable "
+            f"({type(e).__name__}: {e})") from e
+    crcs = meta.get("crc32", {})
+    bad = [k for k, v in out.items()
+           if k in crcs and _leaf_crc(v) != crcs[k]]
+    if bad:
+        raise CheckpointCorrupt(
+            f"world sidecar {wp} failed CRC32 verification on "
+            f"arrays {bad} — corrupted on disk")
+    missing = [k for k in _WORLD_KEYS if k not in out]
+    if missing:
+        raise ValueError(f"world sidecar {wp} missing arrays {missing}")
+    if running_config_json is not None and \
+            meta.get("config") is not None:
+        from jax_mapping.config import configs_equivalent
+        if not configs_equivalent(meta["config"], running_config_json):
+            raise ValueError(
+                "world sidecar config differs from the running config")
+    return out
+
+
+def clear_world_sidecar(path: str) -> bool:
+    """Remove checkpoint `path`'s .world sidecar if one exists —
+    sentinel-checked like clear_prior_sidecar (a save from a
+    non-windowed stack must clear a stale window manifest so a later
+    windowed resume can't re-anchor at a dead origin)."""
+    wp = world_sidecar_path(path)
+    if os.path.exists(wp) and _is_world_sidecar(wp):
+        os.unlink(wp)
+        return True
+    return False
+
+
+def _is_world_sidecar(wp: str) -> bool:
+    try:
+        with np.load(wp) as z:
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        return meta.get("kind") == "world_window"
+    except Exception:
+        return False
+
+
 def checkpoint_bytes(state: Any, config_json: Optional[str] = None) -> bytes:
     """In-memory variant (for shipping state over a wire/HTTP)."""
     buf = io.BytesIO()

@@ -127,6 +127,27 @@ tenancy plane.
                         snapshot+journal, and every tenant comes back
                         with its epoch advanced (clients resync via
                         the epoch protocol).
+
+MEMORY kinds (ISSUE 18, bounded-memory world): the fault targets the
+windowed world store's retention tiers — the contract is that memory
+starvation DEGRADES (shed harder, coarsen, refuse admission; tiles
+re-read as unknown) and storage rot is DETECTED (CRC), never a crash
+or silent wrong-map. Injected at the store's own chaos seams
+(`WorldStore.hold_pressure` / `corrupt_spill`). No-op (noted) on
+stacks without a windowed world.
+
+    memory_pressure     synthetic host-budget squeeze: the effective
+                        LRU budget shrinks by `value` (0.55 = the
+                        governor plans against 45% of the configured
+                        tiles) for the window; overlapping windows
+                        compose WORST-OF through the governor's named
+                        holds — the first to clear must not relax a
+                        squeeze another window still holds.
+    spill_corrupt       flip a CRC-detectable bit in up to `value`
+                        spilled tiles (frame checksum re-stamped =
+                        silent at-rest rot); one-shot and permanent —
+                        the next rehydrate of a hit tile must degrade
+                        it to unknown with a flight event, never raise.
 """
 
 from __future__ import annotations
@@ -151,11 +172,15 @@ TENANT_KINDS = frozenset({
     "tenant_poison", "tenant_state_jump", "controlplane_crash",
 })
 
+#: Bounded-memory world kinds (WorldStore chaos-seam boundary; the
+#: pressure governor + spill CRC integrity are their targets).
+MEMORY_KINDS = frozenset({"memory_pressure", "spill_corrupt"})
+
 KINDS = frozenset({
     "lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
     "kill_node", "kill_robot", "rejoin_robot", "corrupt_checkpoint",
     "cache_wipe",
-}) | SENSOR_KINDS | WORLD_KINDS | TENANT_KINDS
+}) | SENSOR_KINDS | WORLD_KINDS | TENANT_KINDS | MEMORY_KINDS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -211,6 +236,16 @@ class FaultEvent:
             raise ValueError(
                 "tenant_state_jump needs value > 0: the teleport "
                 "distance in metres (0.0 jumps nowhere)")
+        if self.kind == "memory_pressure" \
+                and not 0.0 < self.value <= 1.0:
+            raise ValueError(
+                "memory_pressure needs 0 < value <= 1: the budget "
+                "squeeze fraction (0.0 squeezes nothing, and a chaos "
+                "test would silently 'pass' without it)")
+        if self.kind == "spill_corrupt" and self.value < 1.0:
+            raise ValueError(
+                "spill_corrupt needs value >= 1: the number of spilled "
+                "tiles to rot (0 corrupts nothing)")
 
 
 class FaultPlan:
@@ -483,6 +518,42 @@ class FaultPlan:
                 self._note(step, "controlplane_crash restored="
                                  f"{len(report.get('restored', []))} "
                                  f"lost={len(report.get('lost', []))}")
+        elif ev.kind in MEMORY_KINDS:
+            store = getattr(stack, "world", None) or \
+                getattr(getattr(stack, "mapper", None), "world", None)
+            if store is None:
+                self._note(step, f"{ev.kind} skipped (no windowed "
+                                 "world store on this stack)")
+            elif ev.kind == "memory_pressure":
+                # One named hold per EVENT (step disambiguates two
+                # same-kind windows): overlapping holds compose
+                # worst-of inside the governor, and each window's
+                # clear releases only its own name.
+                hold = f"chaos@{ev.step}"
+                store.hold_pressure(hold, ev.value)
+                self._note(step, f"memory_pressure={ev.value}")
+                if ev.duration:
+                    def _relax(name=hold):
+                        # Re-read the store at clear time: a kill_node
+                        # inside the window replaced the mapper (and
+                        # its store), and the governor holds died with
+                        # it — releasing against the dead store is the
+                        # harmless branch.
+                        s = getattr(stack, "world", None) or \
+                            getattr(getattr(stack, "mapper", None),
+                                    "world", None)
+                        if s is not None:
+                            s.release_pressure(name)
+                    self._clears.append((step + ev.duration, _relax,
+                                         "memory_pressure"))
+            else:
+                hit = store.corrupt_spill(max(1, int(ev.value)))
+                if hit:
+                    self._note(step, f"spill_corrupt {len(hit)} "
+                                     f"tile(s): {sorted(hit)}")
+                else:
+                    self._note(step, "spill_corrupt skipped (no "
+                                     "spilled tiles to rot)")
         elif ev.kind == "corrupt_checkpoint":
             path = ev.name or getattr(stack, "auto_checkpoint_path", "")
             if path and os.path.exists(path):
@@ -546,6 +617,14 @@ def _fault_resource(kind: str, robot: int, name: str = "") -> tuple:
         return ("tenant", name)          # name field = tenant id
     if kind == "controlplane_crash":
         return ("controlplane",)         # one plane per stack
+    if kind == "memory_pressure":
+        return ("memory",)               # one host LRU per stack
+    if kind in ("spill_corrupt", "corrupt_checkpoint"):
+        # One durable-storage resource: rotting the spill file AND
+        # truncating a checkpoint in one window would make the
+        # degradation unattributable (both heal through re-anchor /
+        # rehydrate paths that share the postmortem).
+        return ("checkpoint",)
     return ("bus", kind)                 # bus_drop / bus_reorder
 
 
@@ -567,6 +646,12 @@ def _sample_value(rng: random.Random, kind: str) -> float:
         # Well past any honest per-tick translation, well inside the
         # arena: the jump must corrupt, not escape the map.
         return round(rng.uniform(0.5, 2.0), 3)
+    if kind == "memory_pressure":
+        # Deep enough that the governor must climb a rung, shy of the
+        # budget floor (1.0 would plan against a single tile).
+        return round(rng.uniform(0.4, 0.9), 3)
+    if kind == "spill_corrupt":
+        return float(rng.randrange(1, 4))
     return 0.0
 
 
@@ -575,7 +660,8 @@ def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
                 n_crowds: int = 0,
                 allow_cache_wipe: bool = False,
                 tenant_ids=(),
-                allow_controlplane_crash: bool = False) -> FaultPlan:
+                allow_controlplane_crash: bool = False,
+                allow_world_faults: bool = False) -> FaultPlan:
     """Generate a reproducible schedule: `seed` fully determines the
     fault mix, placement, and durations (fuzz-style soak variety with
     CI-replayable failures). Samples the adversarial sensor kinds
@@ -594,10 +680,13 @@ def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
     (stacks with a cold-start compile cache; the one cache = one
     resource), `tenant_ids` (ids live on the stack's tenancy plane)
     admits `tenant_poison` / `tenant_state_jump` windows (one tenant =
-    one resource), and `allow_controlplane_crash` admits ONE
-    `controlplane_crash` per plan (the one plane = one resource).
-    Default arguments reproduce the pre-scenario sampler
-    bit-for-bit."""
+    one resource), `allow_controlplane_crash` admits ONE
+    `controlplane_crash` per plan (the one plane = one resource), and
+    `allow_world_faults` admits `memory_pressure` windows (the one
+    host LRU = one resource) and one-shot `spill_corrupt` rots (the
+    one durable-storage resource, shared with checkpoint truncation)
+    for stacks running a windowed world store. Default arguments
+    reproduce the pre-scenario sampler bit-for-bit."""
     rng = random.Random(seed)
     kinds = ["lidar_dead", "driver_offline", "bus_drop", "bus_reorder",
              "wheel_slip", "lidar_miscal", "ghost_returns", "scan_jam"]
@@ -613,6 +702,8 @@ def random_plan(mission_steps: int, n_faults: int = 3, seed: int = 0,
         kinds += ["tenant_poison", "tenant_state_jump"]
     if allow_controlplane_crash:
         kinds.append("controlplane_crash")
+    if allow_world_faults:
+        kinds += ["memory_pressure", "spill_corrupt"]
     events: List[FaultEvent] = []
     occupied: List[tuple] = []           # (resource, start, end)
     shortfall = 0

@@ -19,7 +19,7 @@ from __future__ import annotations
 import dataclasses
 import glob
 import os
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -85,9 +85,30 @@ class MissionReport:
     #: report identical alert schedules (the chaos-determinism
     #: contract extended to alerting).
     slo_alerts: List[tuple] = dataclasses.field(default_factory=list)
+    #: Traveled-distance axis (ISSUE 18, bounded-memory soaks): total
+    #: ground-truth path length over the fleet and per robot,
+    #: chunk-sampled every `sample_every` steps — the x-axis the
+    #: constant-device-bytes gate plots against (a lifelong corridor
+    #: mission must show memory FLAT while distance grows).
+    distance_traveled_m: float = 0.0
+    distance_per_robot_m: List[float] = dataclasses.field(
+        default_factory=list)
+    #: One sample per chunk: {step, distance_m} plus — when the stack
+    #: runs a windowed world — the store's live footprint
+    #: (device_window_bytes, host_tiles, spill_tiles, away_tiles,
+    #: origin_tile). Deterministic fields only: two same-seed missions
+    #: report identical series, eviction/spill schedules included.
+    world_series: List[dict] = dataclasses.field(default_factory=list)
 
     def known_cells(self, thresh: float = 0.5) -> int:
         return int((np.abs(self.grid) > thresh).sum())
+
+    def peak_device_window_bytes(self) -> int:
+        """Max device-resident window bytes across the series (0 when
+        the mission was not windowed) — the constant-memory gate's
+        subject: flat vs `distance_traveled_m` or the window leaks."""
+        return max((s.get("device_window_bytes", 0)
+                    for s in self.world_series), default=0)
 
 
 def _mission_dumps(recorder, ev_mark: int):
@@ -108,7 +129,11 @@ def _mission_dumps(recorder, ev_mark: int):
 def run_lifelong_mission(cfg: SlamConfig, world: np.ndarray, doors,
                          events: Sequence[FaultEvent], steps: int,
                          seed: int, checkpoint_dir: Optional[str],
-                         n_robots: int = 2) -> MissionReport:
+                         n_robots: int = 2,
+                         sample_every: int = 10,
+                         goal_script: Optional[
+                             Sequence[Tuple[int, float, float]]] = None
+                         ) -> MissionReport:
     """Drive one deterministic lifelong mission end-to-end and report.
 
     Boots the scenario stack (world dynamics armed, supervisor +
@@ -116,7 +141,26 @@ def run_lifelong_mission(cfg: SlamConfig, world: np.ndarray, doors,
     schedule as ONE FaultPlan (world kinds and process chaos are the
     same mechanism), runs `steps`, and collects the assertion surface.
     Determinism anchor: same (cfg, world, doors, events, seed, steps)
-    → bit-identical report.grid and plan_log."""
+    → bit-identical report.grid and plan_log.
+
+    The run is CHUNKED every `sample_every` steps to accumulate the
+    traveled-distance axis and (windowed stacks) the world-footprint
+    series — chunked `run_steps` is step-for-step identical to one
+    call (the fault plan and supervisor tick on the step index), so
+    the sampling changes no mission bit.
+
+    `goal_script` is an optional sequence of `(step, x, y)` entries
+    (map metres) published on `/goal_pose` — the operator goal
+    ingress, addressing robot 0 — at the first chunk boundary at or
+    after `step` (exact when `step` is a multiple of `sample_every`).
+    A scripted patrol pins the TRAJECTORY to the step clock: manual
+    goals override frontier assignment in the brain, so the path no
+    longer depends on frontier-auction tie-breaks, which on symmetric
+    courses sit within float noise of each other and are therefore
+    the one mission input same-seed determinism cannot pin across
+    processes (XLA CPU codegen may vary per process; within one
+    process the contract holds regardless)."""
+    from jax_mapping.bridge.messages import Pose2D
     from jax_mapping.obs.recorder import flight_recorder
     from jax_mapping.scenarios import launch_scenario_stack
     # Event mark, not a dump count: `postmortem_dump` events stamp at
@@ -131,7 +175,37 @@ def run_lifelong_mission(cfg: SlamConfig, world: np.ndarray, doors,
         st.brain.reconnect_period_s = 0.0
         plan = FaultPlan(list(events), seed=seed)
         st.attach_fault_plan(plan)
-        st.run_steps(steps)
+        dist = np.zeros(n_robots)
+        prev_xy = st.sim.truth_poses()[:, :2].copy()
+        series: List[dict] = []
+        script = sorted(goal_script or [], key=lambda e: int(e[0]))
+        goal_pub = (st.bus.publisher("/goal_pose") if script else None)
+        si = 0
+        done = 0
+        chunk = max(1, int(sample_every))
+        while done < steps:
+            while si < len(script) and int(script[si][0]) <= done:
+                _, gx, gy = script[si]
+                goal_pub.publish(Pose2D(x=float(gx), y=float(gy)))
+                si += 1
+            k = min(chunk, steps - done)
+            st.run_steps(k)
+            done += k
+            cur_xy = st.sim.truth_poses()[:, :2].copy()
+            dist += np.linalg.norm(cur_xy - prev_xy, axis=1)
+            prev_xy = cur_xy
+            entry = {"step": done, "distance_m": float(dist.sum())}
+            ws = st.mapper.world_status() \
+                if hasattr(st.mapper, "world_status") else None
+            if ws is not None:
+                entry.update(
+                    device_window_bytes=int(ws["device_window_bytes"]),
+                    host_tiles=int(ws["host_tiles"]),
+                    away_tiles=int(ws["away_tiles"]),
+                    spill_tiles=(int(ws["spill"]["tiles"])
+                                 if ws.get("spill") else 0),
+                    origin_tile=[int(v) for v in ws["origin_tile"]])
+            series.append(entry)
         # Revision BEFORE content (the C1 ordering doctrine): a stamp
         # read after the grid could pair new content with an older
         # revision's successor and misreport the mission's final state.
@@ -162,6 +236,9 @@ def run_lifelong_mission(cfg: SlamConfig, world: np.ndarray, doors,
                          e.get("state"))
                         for e in flight_recorder.events_since(ev_mark)
                         if e["kind"] == "slo_alert"],
+            distance_traveled_m=float(dist.sum()),
+            distance_per_robot_m=[float(d) for d in dist],
+            world_series=series,
         )
     finally:
         st.shutdown()

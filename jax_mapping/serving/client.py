@@ -83,6 +83,7 @@ class DeltaMapClient:
         self.n_polls = 0
         self.n_not_modified = 0
         self.n_tiles_applied = 0
+        self.n_tiles_pruned = 0       # evicted-marker prunes (windowed)
         self.n_epoch_resyncs = 0
         self.bytes_received = 0
         self.snapshot_bytes = 0       # first (full) poll's body size
@@ -210,9 +211,18 @@ class DeltaMapClient:
             if lvl not in self.mosaics:
                 self.mosaics[lvl] = np.full(
                     (sizes[lvl], sizes[lvl]), 127, np.uint8)
+            ty, tx = int(tile["ty"]), int(tile["tx"])
+            if tile.get("evicted"):
+                # Typed tile-evicted marker (the bounded-memory world):
+                # the window no longer backs this tile — prune it to
+                # unknown instead of treating the byteless entry as a
+                # protocol violation. Re-entry re-serves real bytes.
+                self.mosaics[lvl][ty * t:(ty + 1) * t,
+                                  tx * t:(tx + 1) * t] = 127
+                self.n_tiles_pruned += 1
+                continue
             arr = png_codec.decode_gray(
                 base64.b64decode(tile["png"]))
-            ty, tx = int(tile["ty"]), int(tile["tx"])
             self.mosaics[lvl][ty * t:(ty + 1) * t,
                               tx * t:(tx + 1) * t] = arr
             self.n_tiles_applied += 1

@@ -91,6 +91,16 @@ class TileStore:
         self.n_tiles_clean_skipped = 0
         self.n_hint_missed = 0
         self._level_sizes: Optional[List[int]] = None
+        #: Bounded-memory serving (world/store.py): level-0 tiles the
+        #: window evicted carry a typed `evicted` marker in the delta
+        #: stream instead of PNG bytes — (ty, tx) -> revision stamped
+        #: at eviction. `evicted_epoch` counts eviction-state
+        #: transitions (the /tiles ETag `-w` suffix source, so a cache
+        #: validator can never 304 across an eviction flip).
+        self._evicted_stamps: Dict[Tuple[int, int], int] = {}
+        self._evicted_mask: Optional[np.ndarray] = None
+        self.evicted_epoch = 0
+        self.n_tiles_evicted_served = 0
 
     # -- geometry ------------------------------------------------------------
 
@@ -126,9 +136,17 @@ class TileStore:
             # cost a /tiles poller pays when the map moved. The cheap
             # already-fresh peek above is deliberately outside it.
             with M.stages.stage("serving.snapshot"):
-                rev, image, hint = self._snapshot_fn()
+                snap = self._snapshot_fn()
+                # Windowed providers return a 4th element: the (T, T)
+                # bool mask of level-0 tiles currently evicted from the
+                # live window (serving degrades them to typed markers).
+                if len(snap) == 4:
+                    rev, image, hint, evicted = snap
+                else:
+                    rev, image, hint = snap
+                    evicted = None
                 rev = int(rev)
-                self._install(rev, image, hint)
+                self._install(rev, image, hint, evicted)
         if self._on_install is not None:
             # After BOTH locks release: the commit is visible, the
             # waypoint stamp is honest, and no foreign code ran under
@@ -139,7 +157,8 @@ class TileStore:
                 pass                              # telemetry only
         return rev
 
-    def _install(self, rev: int, image, hint: Optional[np.ndarray]) -> None:
+    def _install(self, rev: int, image, hint: Optional[np.ndarray],
+                 evicted: Optional[np.ndarray] = None) -> None:
         """Hash, diff, and re-encode under `_refresh_lock`; commit
         atomically under `_lock`. Caller holds `_refresh_lock`."""
         from jax_mapping.ops import grid as G
@@ -172,6 +191,12 @@ class TileStore:
                 changed = np.any(h != self._hashes[lvl], axis=-1)
             if lvl == 0 and hint is not None and not first:
                 hint_missed += int(np.count_nonzero(changed & ~hint))
+            if lvl == 0 and evicted is not None:
+                # Evicted level-0 tiles serve a typed marker, never
+                # bytes: skip the encode here; the commit below stamps
+                # them. (The mosaic paints them unknown, so the hash
+                # still tracks content — re-entry re-encodes normally.)
+                changed = changed & ~evicted
             n_clean += int(changed.size - np.count_nonzero(changed))
             if not changed.any():
                 continue
@@ -183,6 +208,22 @@ class TileStore:
 
         with self._lock:
             self._tiles.update(encoded)
+            if evicted is not None:
+                prev = self._evicted_mask
+                for ty, tx in np.argwhere(evicted):
+                    key = (int(ty), int(tx))
+                    if prev is None or not prev[key]:
+                        # Newly evicted: drop the cached bytes so a full
+                        # resync can never serve a tile the window no
+                        # longer backs, stamp the marker at THIS rev.
+                        self._tiles.pop((0,) + key, None)
+                        self._evicted_stamps[key] = rev
+                        self.evicted_epoch += 1
+                if prev is not None:
+                    for ty, tx in np.argwhere(prev & ~evicted):
+                        self._evicted_stamps.pop((int(ty), int(tx)), None)
+                        self.evicted_epoch += 1
+                self._evicted_mask = evicted.copy()
             self._hashes = hashes
             self._level_sizes = sizes
             self.revision = rev
@@ -209,6 +250,14 @@ class TileStore:
                 for (lvl, ty, tx), (tile_rev, data)
                 in sorted(self._tiles.items())
                 if tile_rev > since and (level is None or lvl == level)]
+            evicted_entries = [
+                {"level": 0, "ty": ty, "tx": tx, "revision": tile_rev,
+                 "evicted": True}
+                for (ty, tx), tile_rev in sorted(self._evicted_stamps.items())
+                if tile_rev > since and (level is None or level == 0)]
+            self.n_tiles_evicted_served += len(evicted_entries)
+            entries.extend(evicted_entries)
+            n_evicted = len(self._evicted_stamps)
         meta = dict(self.meta)
         meta.update({
             "map": self.name,
@@ -216,6 +265,8 @@ class TileStore:
             "levels": [{"level": i, "size_cells": s}
                        for i, s in enumerate(sizes)],
         })
+        if n_evicted or self._evicted_mask is not None:
+            meta["evicted_tiles"] = n_evicted
         return rev, entries, meta
 
     def stats(self) -> dict:
@@ -227,6 +278,8 @@ class TileStore:
                 "n_tiles_clean_skipped": self.n_tiles_clean_skipped,
                 "n_hint_missed": self.n_hint_missed,
                 "n_tiles_cached": len(self._tiles),
+                "n_tiles_evicted": len(self._evicted_stamps),
+                "evicted_epoch": self.evicted_epoch,
             }
 
 
@@ -258,12 +311,32 @@ class MapServing:
         self.map_store: Optional[TileStore] = None
         self.voxel_store: Optional[TileStore] = None
         if mapper is not None:
-            g = mapper.cfg.grid
+            # LOGICAL geometry for the manifest: in windowed mode the
+            # served surface is the full addressable lattice (window
+            # content in place, evicted tiles as typed markers), so
+            # clients keep one fixed world-anchored mosaic however the
+            # window moves. full_cfg == cfg when not windowed.
+            g = getattr(mapper, "full_cfg", mapper.cfg).grid
+            world = getattr(mapper, "world", None)
 
             def _map_snapshot():
                 from jax_mapping.ops import grid as G
                 rev, grid, hint = mapper.serving_snapshot()
-                return rev, G.to_gray(g, grid), hint
+                if world is None:
+                    return rev, G.to_gray(g, grid), hint
+                # Compose outside the mapper's state lock, then verify
+                # no shift landed mid-compose: every shift/rehydrate
+                # bumps the revision, so rev-stability proves the grid
+                # and the window origin/away-set belong together.
+                for _ in range(4):
+                    mosaic, evicted = world.compose_serving(
+                        np.asarray(G.to_gray(g, grid)))
+                    if mapper.serving_revision() == rev:
+                        break
+                    rev, grid, hint2 = mapper.serving_snapshot()
+                    if hint2 is not None:
+                        hint = hint2 if hint is None else (hint | hint2)
+                return rev, mosaic, hint, evicted
 
             self.map_store = TileStore(
                 cfg, "grid", mapper.serving_revision, _map_snapshot,

@@ -105,6 +105,50 @@ def rooms_with_doors(size_cells: int, resolution_m: float,
     return w, doors
 
 
+def corridor_course(size_cells: int, resolution_m: float,
+                    corridor_w_m: float = 1.2, n_rooms: int = 4,
+                    seed: int = 2) -> tuple:
+    """Long east-west corridor through an otherwise solid slab, with
+    `n_rooms` side rooms hanging off it behind door gaps the generator
+    REPORTS (dict form, see `arena_with_door`) — the lifelong
+    bounded-memory soak's world. Unlike the compact arenas above,
+    exploring this world forces TRAVEL: the corridor spans the full
+    extent, so traveled distance — and with it the sliding window's
+    shift/eviction pressure — grows with mission length instead of
+    saturating near the spawn. Robots spawn mid-corridor (the centre
+    cell is always carved).
+
+    Returns (world, doors)."""
+    rng = np.random.default_rng(seed)
+    w = np.ones((size_cells, size_cells), bool)
+    res = resolution_m
+    half = max(2, int(corridor_w_m / res) // 2)
+    c = size_cells // 2
+    w[c - half:c + half, 2:size_cells - 2] = False
+    door = max(3, int(0.5 / res))
+    thick = 2
+    doors = []
+    for k in range(n_rooms):
+        cx = int((k + 1) * size_cells / (n_rooms + 1))
+        room = max(door + 4,
+                   int(rng.integers(int(1.2 / res), int(2.0 / res))))
+        if k % 2 == 0:                       # rooms alternate sides
+            wall_r0 = c + half
+            r0, r1 = wall_r0 + thick, min(size_cells - 2,
+                                          wall_r0 + thick + room)
+        else:
+            wall_r0 = c - half - thick
+            r1, r0 = wall_r0, max(2, wall_r0 - room)
+        c0 = max(2, cx - room // 2)
+        c1 = min(size_cells - 2, cx + room // 2)
+        w[r0:r1, c0:c1] = False              # the room
+        g0 = min(max(c0 + 1, cx - door // 2), c1 - door - 1)
+        w[wall_r0:wall_r0 + thick, g0:g0 + door] = False  # the doorway
+        doors.append({"name": f"room{k}", "r0": wall_r0,
+                      "r1": wall_r0 + thick, "c0": g0, "c1": g0 + door})
+    return w, doors
+
+
 def stamp_disc(world: np.ndarray, row: float, col: float,
                radius_cells: float) -> np.ndarray:
     """Stamp a filled occupied disc (a crowd blob) into `world` IN

@@ -122,6 +122,13 @@ class TenantControlPlane:
     def __init__(self, cfg: SlamConfig, world_res_m: Optional[float] = None,
                  checkpoint_dir: Optional[str] = None,
                  compile_cache=None, devprof=None, pipeline=None):
+        # Bounded-memory tenancy (ISSUE 18): under `cfg.world.windowed`
+        # every mission lane runs at the WINDOW-sized grid — the plane
+        # transforms its config ONCE here so init/stack/tick/serve/
+        # checkpoint/restore all agree on lane shapes (a mixed-extent
+        # plane would shape-reject its own checkpoints). Identity when
+        # not windowed — bit-exact pre-PR.
+        cfg = MB.windowed_mission_config(cfg)
         self.cfg = cfg
         #: Pipeline latency ledger (obs/pipeline.py) or None: tenant
         #: revision bumps and tile-store commits stamp under the

@@ -80,6 +80,27 @@ Array = jax.Array
 EXACT_BUCKETS = (1, 2, 3, 6, 12)
 
 
+def windowed_mission_config(cfg: SlamConfig) -> SlamConfig:
+    """The per-tenant BOUNDED-MEMORY mission config: when
+    `cfg.world.windowed`, every lane's device grid is the robocentric
+    window (`window_tiles * serving.tile_cells` square, the same
+    derivation the bridge mapper runs — ONE definition in
+    world/store.window_slam_config), not the full logical extent. N
+    tenants then cost N x window² device cells instead of N x
+    logical² — the tenant axis is exactly where full-extent lane
+    grids explode first (a 64-tenant megabatch at the production 4096²
+    logical grid is 4 TB of lane grids; at an 8-tile window it is
+    ~17 GB). Tenant lanes anchor their window at mission start and do
+    NOT shift (megabatched missions are short-horizon; the shifting
+    robocentric store is the bridge mapper's tier) — the window IS the
+    mission's world extent. Identity when not windowed: bit-exact
+    pre-PR lane shapes, the knob-off doctrine."""
+    if not cfg.world.windowed:
+        return cfg
+    from jax_mapping.world.store import window_slam_config
+    return window_slam_config(cfg)
+
+
 def bucket_capacity(n: int, cap: Optional[int] = None,
                     exact: bool = True) -> int:
     """Smallest allowed tenant capacity >= n. `exact=True` (the

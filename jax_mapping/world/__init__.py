@@ -1,0 +1,14 @@
+"""Bounded-memory robocentric world store (`WorldConfig.windowed`).
+
+`store.WorldStore` — fixed-budget device window over the logical tile
+lattice (shift = one jitted roll, eviction → host LRU → CRC-stamped
+disk spill, transparent rehydration); `governor.MemoryGovernor` — the
+watermark load-shed ladder; `spill.SpillStore` — the append-only
+CRC-framed disk tier. `windowed=False` constructs nothing: bit-exact
+pre-PR behavior (the knob-off doctrine)."""
+
+from jax_mapping.world.governor import MemoryGovernor  # noqa: F401
+from jax_mapping.world.spill import SpillStore  # noqa: F401
+from jax_mapping.world.store import (  # noqa: F401
+    WorldStore, window_slam_config,
+)

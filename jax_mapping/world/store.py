@@ -1,0 +1,797 @@
+"""Robocentric sliding-window world store (the bounded-memory
+contract; ROG-Map's window idiom rebuilt on the tile lattice).
+
+ONE store unifies the tile bookkeepings that grew up separately —
+serving tiles, frontier dirty-tile scatter, decay invalidation, the
+fused engine's touched-tile box, checkpoint state — behind a logical
+tile lattice: `grid.size_cells` becomes the LOGICAL world extent
+(set it as large as the mission needs; it allocates nothing), while
+the device holds a fixed `window_tiles^2` window of it that shifts
+with the robot. Device bytes are constant and independent of distance
+traveled — the memory-safety contract the lifelong soak gates on.
+
+Frames. The mapper runs ALL of its machinery (matcher, pyramids,
+graph, loop closure, frontier, serving geometry) on a derived
+window-sized `SlamConfig` (`window_slam_config`) — `slam_step` is
+fully config-static, so no device code changes. Poses live in the
+robocentric WINDOW frame; `offset_xy()` maps window → world
+(`world = window + offset`), starts at exactly zero (the initial
+window is centred on the logical origin) and advances by whole tiles.
+On a shift the mapper translates its pose-like leaves by the shift
+delta — graph edges are relative and scan rings are ranges-only, so
+a uniform translation is the entire frame fix-up.
+
+Shift = one jitted dispatch (`shift_window`: roll + re-zero of the
+entering band, both shift amounts traced so ONE executable serves
+every shift vector). Leaving tiles are extracted on device
+(`_extract_tile`), landed in a host LRU, and spilled to disk with
+per-tile CRC + generation stamps (`world/spill.py`); re-entering a
+region rehydrates transparently — host hit → device scatter this
+tick; disk hit → prefetch thread joined at the NEXT tick (a
+deterministic one-tick unknown-degrade regardless of IO timing);
+corrupt spill → the tile degrades to unknown with a flight event,
+never an exception. The `MemoryGovernor` owns the host budget and
+its load-shed ladder.
+
+Every transition appends to a bounded `schedule` log — two same-seed
+missions must produce bit-identical schedules (the FaultPlan
+determinism doctrine extended to memory traffic).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from jax_mapping.config import SlamConfig
+from jax_mapping.utils import global_metrics as M
+
+Tile = Tuple[int, int]
+
+#: Schedule-log bound: big enough that a soak's full eviction history
+#: fits (the determinism gate compares complete logs); the counter
+#: keeps counting past it.
+_SCHEDULE_CAP = 65536
+
+
+# ---------------------------------------------------------------------------
+# Jitted window primitives (compile_budget-pinned: max 1 variant each)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _jits():
+    """Lazy jit construction (package import must not import jax)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def shift_window(grid, dr_cells, dc_cells):
+        """Window shifted by (dr, dc) CELLS in logical space: content
+        rolls by the negated shift, the entering band re-zeros to
+        unknown. Both shifts traced → one executable for every shift
+        vector (the zero-copy roll contract)."""
+        wr, wc = grid.shape
+        rolled = jnp.roll(grid, (-dr_cells, -dc_cells), axis=(0, 1))
+        rows = jnp.arange(wr)
+        cols = jnp.arange(wc)
+        keep_r = (rows >= jnp.maximum(0, -dr_cells)) \
+            & (rows < wr - jnp.maximum(0, dr_cells))
+        keep_c = (cols >= jnp.maximum(0, -dc_cells)) \
+            & (cols < wc - jnp.maximum(0, dc_cells))
+        keep = keep_r[:, None] & keep_c[None, :]
+        return jnp.where(keep, rolled, jnp.zeros((), grid.dtype))
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def extract_tile(grid, r0, c0, t):
+        return jax.lax.dynamic_slice(grid, (r0, c0), (t, t))
+
+    @jax.jit
+    def scatter_tile(grid, tile, r0, c0):
+        return jax.lax.dynamic_update_slice(grid, tile, (r0, c0))
+
+    # Publish the closure-built jits as module attributes so the
+    # compile-budget snapshot (analysis/compilebudget.py walks module
+    # vars for `_cache_size`) can pin their variant counts.
+    globals().update(_shift_window=shift_window,
+                     _extract_tile=extract_tile,
+                     _scatter_tile=scatter_tile)
+    return shift_window, extract_tile, scatter_tile
+
+
+@functools.lru_cache(maxsize=None)
+def _fuse_jit():
+    """Global-coordinate fusion into the window (the store-level
+    direct-drive API the bit-identity gate runs on): the inverse
+    sensor model evaluates at GLOBAL cell coordinates — float-for-
+    float the oracle big-grid computation — and the clip-add applies
+    at the window-local offset, so a windowed run's live content is
+    bit-identical to an oracle run's same region."""
+    import jax
+    import jax.numpy as jnp
+    from jax_mapping.ops import grid as G
+
+    @functools.partial(jax.jit, static_argnums=(0, 1))
+    def fuse_patch_global(grid_cfg, scan_cfg, window, ranges, pose,
+                          origin_global, origin_local):
+        delta = G.classify_patch(grid_cfg, scan_cfg, ranges, pose,
+                                 origin_global)
+        p = grid_cfg.patch_cells
+        cur = jax.lax.dynamic_slice(
+            window, (origin_local[0], origin_local[1]), (p, p))
+        new = jnp.clip(cur + delta, grid_cfg.logodds_min,
+                       grid_cfg.logodds_max)
+        return jax.lax.dynamic_update_slice(
+            window, new, (origin_local[0], origin_local[1]))
+
+    globals()["_fuse_patch_global"] = fuse_patch_global  # budget snapshot
+    return fuse_patch_global
+
+
+# ---------------------------------------------------------------------------
+# Config derivation
+# ---------------------------------------------------------------------------
+
+def window_slam_config(cfg: SlamConfig) -> SlamConfig:
+    """The window-sized `SlamConfig` the mapper's device machinery
+    runs on when `world.windowed`: same resolution, patch, alignment,
+    sensor and matcher parameters — only `grid.size_cells` shrinks to
+    the window. `slam_step` is config-static, so this ONE derivation
+    is the entire device-side integration."""
+    w = cfg.world
+    t = cfg.serving.tile_cells
+    g = cfg.grid
+    if g.size_cells % t:
+        raise ValueError(
+            f"grid.size_cells={g.size_cells} not divisible by "
+            f"serving.tile_cells={t}")
+    wc = w.window_tiles * t
+    nt = g.size_cells // t
+    if w.window_tiles > nt:
+        raise ValueError(
+            f"window_tiles={w.window_tiles} exceeds the logical "
+            f"lattice ({nt} tiles)")
+    if (nt - w.window_tiles) % 2:
+        raise ValueError(
+            f"logical minus window tiles ({nt} - {w.window_tiles}) "
+            "must be even so the initial window centres on the "
+            "logical origin (the zero-offset start contract)")
+    if g.patch_cells > wc:
+        raise ValueError(
+            f"patch_cells={g.patch_cells} exceeds the window "
+            f"({wc} cells); grow window_tiles or shrink the patch")
+    if 2 * w.margin_tiles >= w.window_tiles:
+        raise ValueError(
+            f"margin_tiles={w.margin_tiles} leaves no interior in a "
+            f"{w.window_tiles}-tile window")
+    return cfg.replace(grid=dataclasses.replace(g, size_cells=wc))
+
+
+class WorldStore:
+    """Fixed-budget device window + host LRU + disk spill of the
+    logical tile lattice.
+
+    Locking: `_lock` guards the host-side maps (LRU, away-set,
+    pending prefetch) — the mapper tick thread evicts/rehydrates
+    while the serving thread composes mosaics and `/status` reads
+    counters (the evict-vs-serve pair the racewatch gate drives).
+    The caller (mapper) owns the device grid and serializes shifts
+    under its own state lock."""
+
+    def __init__(self, cfg: SlamConfig, spill_dir: Optional[str] = None):
+        from jax_mapping.world.governor import MemoryGovernor
+        from jax_mapping.world.spill import SpillStore
+
+        self.full_cfg = cfg
+        self.cfg = window_slam_config(cfg)
+        w = cfg.world
+        self.tile_cells = cfg.serving.tile_cells
+        self.window_tiles = w.window_tiles
+        self.window_cells = self.window_tiles * self.tile_cells
+        self.logical_tiles = cfg.grid.size_cells // self.tile_cells
+        self.margin_tiles = w.margin_tiles
+        #: Initial (and re-anchorable) window origin on the logical
+        #: tile lattice; the centred start makes offset_xy() == 0.
+        self._anchor = (self.logical_tiles - self.window_tiles) // 2
+        self.origin_tile: Tuple[int, int] = (self._anchor, self._anchor)
+
+        self._lock = threading.Lock()
+        #: (r, c) -> (gen, decay_epoch, float32 (t, t) array, coarse)
+        self._host: "OrderedDict[Tile, tuple]" = OrderedDict()
+        #: Every logical tile currently NOT resident that once was —
+        #: host, disk, in-flight prefetch or lost: the serving
+        #: evicted-marker mask.
+        self._away: set = set()
+        #: Disk prefetches in flight: tile -> (thread, result holder).
+        self._pending: Dict[Tile, tuple] = {}
+
+        dir_ = spill_dir if spill_dir is not None else w.spill_dir
+        self.spill: Optional[SpillStore] = \
+            SpillStore(dir_) if dir_ else None
+        self.governor = MemoryGovernor(w)
+
+        self.decay_epoch = 0
+        self._gen = 0
+        self.n_shifts = 0
+        self.n_evictions = 0
+        self.n_rehydrated_host = 0
+        self.n_rehydrated_disk = 0
+        self.n_lost = 0
+        self.n_corrupt_spills = 0
+        self.eviction_epoch = 0       # bumps per away-set change (ETag)
+        #: Bounded memory-traffic log; the same-seed determinism gate
+        #: compares two runs' complete logs.
+        self.schedule: List[tuple] = []
+        self.n_schedule_events = 0
+
+    # -- frame math --------------------------------------------------------
+
+    def offset_xy(self) -> np.ndarray:
+        """(2,) float32 window→world translation: world = window +
+        offset. Exactly zero at the centred start; advances by whole
+        tiles, computed from the integer tile delta so the same shift
+        sequence always yields the same float."""
+        res = np.float32(self.full_cfg.grid.resolution_m)
+        dc = np.int32((self.origin_tile[1] - self._anchor)
+                      * self.tile_cells)
+        dr = np.int32((self.origin_tile[0] - self._anchor)
+                      * self.tile_cells)
+        return np.array([np.float32(dc) * res, np.float32(dr) * res],
+                        np.float32)
+
+    def shift_delta_m(self, dr: int, dc: int) -> np.ndarray:
+        """World-metre translation a (dr, dc)-tile shift adds to the
+        offset (the amount the mapper subtracts from its pose-like
+        leaves)."""
+        res = np.float32(self.full_cfg.grid.resolution_m)
+        return np.array(
+            [np.float32(dc * self.tile_cells) * res,
+             np.float32(dr * self.tile_cells) * res], np.float32)
+
+    def desired_shift(self, poses_window: Sequence[np.ndarray]
+                      ) -> Tuple[int, int]:
+        """(dr, dc) tile shift that recentres the fleet, or (0, 0).
+
+        Shifts only when some robot strays into the `margin_tiles`
+        edge band (hysteresis — no churn while roaming the interior);
+        recentres on the fleet centroid, clamped to the logical
+        lattice. Assumes a clustered fleet (the lifelong regime);
+        robots outside the shifted window clip at the edge like any
+        out-of-grid pose."""
+        t = self.tile_cells
+        res = self.full_cfg.grid.resolution_m
+        half = self.window_cells * res / 2.0
+        wt = self.window_tiles
+        m = self.margin_tiles
+        tiles = []
+        for p in poses_window:
+            col = (float(p[0]) + half) / res
+            row = (float(p[1]) + half) / res
+            tiles.append((int(row // t), int(col // t)))
+        trigger = any(
+            tr < m or tr >= wt - m or tc < m or tc >= wt - m
+            for tr, tc in tiles)
+        if not trigger:
+            return (0, 0)
+        cr = sum(tr for tr, _ in tiles) / len(tiles)
+        cc = sum(tc for _, tc in tiles) / len(tiles)
+        dr = int(round(cr - (wt - 1) / 2.0))
+        dc = int(round(cc - (wt - 1) / 2.0))
+        lim = self.logical_tiles - wt
+        r0, c0 = self.origin_tile
+        dr = max(0, min(lim, r0 + dr)) - r0
+        dc = max(0, min(lim, c0 + dc)) - c0
+        return (dr, dc)
+
+    # -- shift + eviction + rehydration -------------------------------------
+
+    def shift(self, grid, dr: int, dc: int):
+        """Shift the window by (dr, dc) tiles: evict the leaving band
+        through the governor ladder, roll + re-zero in one jitted
+        dispatch, rehydrate entering tiles (host → scatter now; disk
+        → prefetch joined next tick). Returns the new device grid."""
+        if (dr, dc) == (0, 0):
+            return grid
+        shift_window, extract_tile, scatter_tile = _jits()
+        t = self.tile_cells
+        wt = self.window_tiles
+        r0, c0 = self.origin_tile
+        leaving, entering = self._bands(dr, dc)
+
+        # Extract leaving tiles from the OLD grid, then admit them
+        # host-side (governor ladder decides spill/coarsen/refuse).
+        for (wr, wc_) in leaving:
+            tile = (r0 + wr, c0 + wc_)
+            arr = np.asarray(extract_tile(
+                grid, np.int32(wr * t), np.int32(wc_ * t), t))
+            self._admit(tile, arr)
+
+        grid = shift_window(grid, np.int32(dr * t), np.int32(dc * t))
+        with self._lock:
+            # Tick-thread single-writer, but the install is guarded so
+            # no write site needs a baselined B3 exception; foreign
+            # readers (serving compose, /status) still take the
+            # point-in-time value bare by convention.
+            self.origin_tile = (r0 + dr, c0 + dc)
+        self.n_shifts += 1
+        M.counters.inc("world.shifts")
+        self._note("shift", dr, dc, self.origin_tile[0],
+                   self.origin_tile[1])
+
+        # Rehydrate what the entering band re-covers.
+        nr0, nc0 = self.origin_tile
+        for (wr, wc_) in entering:
+            tile = (nr0 + wr, nc0 + wc_)
+            grid = self._rehydrate(grid, tile, (wr, wc_), scatter_tile)
+        return grid
+
+    def _bands(self, dr: int, dc: int):
+        """Window-tile coordinates of the (leaving, entering) bands of
+        a (dr, dc)-tile shift. Leaving is in PRE-shift window coords,
+        entering in POST-shift ones; a tile leaves (enters) when its
+        row OR column does."""
+        wt = self.window_tiles
+
+        def band_leave(d, wt):
+            if d > 0:
+                return set(range(min(d, wt)))
+            if d < 0:
+                return set(range(max(0, wt + d), wt))
+            return set()
+
+        def band_enter(d, wt):
+            if d > 0:
+                return set(range(max(0, wt - d), wt))
+            if d < 0:
+                return set(range(min(-d, wt)))
+            return set()
+
+        rows_l, cols_l = band_leave(dr, wt), band_leave(dc, wt)
+        rows_e, cols_e = band_enter(dr, wt), band_enter(dc, wt)
+        leaving = [(r, c) for r in range(wt) for c in range(wt)
+                   if r in rows_l or c in cols_l]
+        entering = [(r, c) for r in range(wt) for c in range(wt)
+                    if r in rows_e or c in cols_e]
+        return leaving, entering
+
+    def _admit(self, tile: Tile, arr: np.ndarray) -> None:
+        """One evicted tile enters the host tier through the governor
+        ladder. All-unknown tiles are not retained (nothing to lose —
+        re-entry re-zeros anyway), but still leave the away-set mark
+        if the tile ever held content."""
+        with self._lock:
+            self.n_evictions += 1
+            M.counters.inc("world.evictions")
+            if not arr.any():
+                # Never-observed tile: re-entry re-creates it exactly.
+                self._host.pop(tile, None)
+                if self.spill is not None:
+                    self.spill.discard(tile)
+                self._away.discard(tile)
+                return
+            self._away.add(tile)
+            self.eviction_epoch += 1
+            rung = self.governor.observe(len(self._host) + 1)
+            if rung >= 3 and self.spill is None:
+                # Rung 3 with no deeper tier to shed into: refuse
+                # admission — the newest content is dropped and any
+                # stale spilled generation goes with it (a lost tile
+                # must re-enter as unknown, not as old walls). With a
+                # disk tier the admission lands and the shed below
+                # spills the coldest tiles instead.
+                self._host.pop(tile, None)
+                if self.spill is not None:
+                    self.spill.discard(tile)
+                self.governor.n_refused += 1
+                self.n_lost += 1
+                M.counters.inc("world.tiles_lost")
+                self._note("lost", tile[0], tile[1], "refused")
+                self._flight("world_admission_refused", tile=list(tile))
+                return
+            self._gen += 1
+            self._host[tile] = (self._gen, self.decay_epoch, arr, 1)
+            self._host.move_to_end(tile)
+            self._note("evict", tile[0], tile[1], self._gen)
+            self._shed(rung)
+
+    def _shed(self, rung: int) -> None:
+        """Spill (or drop) the coldest host tiles down to the rung's
+        target occupancy. Caller holds `_lock`."""
+        target = (self.governor.effective_budget() if rung == 0
+                  else self.governor.target_resident())
+        coarsen = (self.full_cfg.world.retention_coarsen
+                   if rung >= 2 else 1)
+        while len(self._host) > target:
+            tile, (gen, epoch, arr, coarse) = \
+                self._host.popitem(last=False)
+            if self.spill is None:
+                self.governor.n_drops += 1
+                self.n_lost += 1
+                self._away.add(tile)
+                M.counters.inc("world.tiles_lost")
+                self._note("lost", tile[0], tile[1], "no_spill_tier")
+                continue
+            k = max(coarse, coarsen)
+            out = arr
+            if k > coarse:
+                out = _coarsen(arr, k // coarse)
+                self.governor.n_coarsened += 1
+                M.counters.inc("world.tiles_coarsened")
+            self.spill.put(tile, gen, out, epoch, coarse=k)
+            self.governor.n_spills += 1
+            M.counters.inc("world.tiles_spilled")
+            self._note("spill", tile[0], tile[1], gen, k)
+
+    def _rehydrate(self, grid, tile: Tile, slot: Tuple[int, int],
+                   scatter_tile):
+        """One entering tile: host hit scatters NOW; disk hit starts a
+        prefetch joined at the next tick (one-tick unknown-degrade);
+        miss stays unknown."""
+        t = self.tile_cells
+        with self._lock:
+            entry = self._host.pop(tile, None)
+            if entry is not None:
+                gen, epoch, arr, coarse = entry
+                self._away.discard(tile)
+                self.eviction_epoch += 1
+                if self.spill is not None:
+                    self.spill.discard(tile)   # resident beats stale
+                arr = self._catch_up(arr, epoch, coarse)
+                self.n_rehydrated_host += 1
+                M.counters.inc("world.rehydrated_host")
+                self._note("rehydrate", tile[0], tile[1], "host")
+                return scatter_tile(
+                    grid, self._to_device(arr),
+                    np.int32(slot[0] * t), np.int32(slot[1] * t))
+            if self.spill is not None and tile in self.spill:
+                holder: list = []
+                th = threading.Thread(
+                    target=self._prefetch_read, args=(tile, holder),
+                    name=f"world-prefetch-{tile[0]}-{tile[1]}",
+                    daemon=True)
+                self._pending[tile] = (th, holder)
+                th.start()
+                self._note("prefetch", tile[0], tile[1])
+                M.counters.inc("world.prefetches")
+            elif tile in self._away:
+                # Nothing to restore (the tile was lost — refused or
+                # dropped): it is resident again, AS UNKNOWN, so the
+                # evicted marker clears (the away-set invariant is
+                # "once-seen and NOT resident"); the loss stays visible
+                # through n_lost and the schedule log.
+                self._away.discard(tile)
+                self.eviction_epoch += 1
+                self._note("reenter_unknown", tile[0], tile[1])
+        return grid
+
+    def _prefetch_read(self, tile: Tile, holder: list) -> None:
+        """Prefetch-thread body: ONLY the disk read + CRC check runs
+        off-thread; the device scatter happens at the next
+        `poll_prefetch` on the tick thread, so the rehydrate schedule
+        is deterministic regardless of IO timing."""
+        holder.append(self.spill.get(tile))
+
+    def poll_prefetch(self, grid):
+        """Join finished (blocking on still-running — determinism over
+        latency) prefetches and scatter them into the window; corrupt
+        spills degrade to unknown with a flight event. Returns
+        (grid, n_applied)."""
+        with self._lock:
+            pending = sorted(self._pending.items())
+            self._pending.clear()
+        if not pending:
+            return grid, 0
+        _, _, scatter_tile = _jits()
+        t = self.tile_cells
+        n = 0
+        for tile, (th, holder) in pending:
+            th.join()
+            rec = holder[0] if holder else None
+            slot = self._window_slot(tile)
+            with self._lock:
+                if rec is None:
+                    self.n_corrupt_spills += 1
+                    self.n_lost += 1
+                    if self.spill is not None:
+                        self.spill.discard(tile)
+                    if slot is not None:
+                        # Resident again (as unknown): the evicted
+                        # marker clears, same as the lost-tile re-entry.
+                        self._away.discard(tile)
+                        self.eviction_epoch += 1
+                    M.counters.inc("world.corrupt_spills")
+                    self._note("corrupt", tile[0], tile[1])
+                    self._flight("world_spill_corrupt",
+                                 tile=list(tile))
+                    continue
+                if slot is None:
+                    # The window moved on while the read was in
+                    # flight: keep the tile warm in the host tier.
+                    self._gen += 1
+                    self._host[tile] = (self._gen, rec.decay_epoch,
+                                        rec.data, rec.coarse)
+                    self.spill.discard(tile)
+                    self._note("rehydrate", tile[0], tile[1],
+                               "disk_to_host")
+                    continue
+                self._away.discard(tile)
+                self.eviction_epoch += 1
+                self.spill.discard(tile)
+                arr = rec.data
+                if rec.coarse > 1:
+                    arr = _upsample(arr, rec.coarse, self.tile_cells)
+                arr = self._catch_up(arr, rec.decay_epoch, 1)
+                self.n_rehydrated_disk += 1
+                M.counters.inc("world.rehydrated_disk")
+                self._note("rehydrate", tile[0], tile[1], "disk")
+            grid = scatter_tile(
+                grid, self._to_device(arr),
+                np.int32(slot[0] * t), np.int32(slot[1] * t))
+            n += 1
+        return grid, n
+
+    def _window_slot(self, tile: Tile) -> Optional[Tuple[int, int]]:
+        r = tile[0] - self.origin_tile[0]
+        c = tile[1] - self.origin_tile[1]
+        if 0 <= r < self.window_tiles and 0 <= c < self.window_tiles:
+            return (r, c)
+        return None
+
+    @staticmethod
+    def _to_device(arr: np.ndarray):
+        import jax.numpy as jnp
+        return jnp.asarray(arr, dtype=jnp.float32)
+
+    # -- decay exactness -----------------------------------------------------
+
+    def note_decay_pass(self) -> None:
+        """The mapper decayed the RESIDENT window (ops/grid.decay_grid,
+        one jitted dispatch); spilled tiles catch up lazily at
+        rehydrate time."""
+        with self._lock:
+            self.decay_epoch += 1
+
+    def _catch_up(self, arr: np.ndarray, tile_epoch: int,
+                  coarse: int) -> np.ndarray:
+        """Apply the decay passes a tile missed while evicted — one
+        SEQUENTIAL clip(x*f) per missed pass in float32, matching the
+        device's per-pass arithmetic bit-for-bit (f^k compounded once
+        rounds differently)."""
+        k = self.decay_epoch - tile_epoch
+        if k <= 0:
+            return arr
+        d = self.full_cfg.decay
+        f = np.float32(d.factor)
+        c = np.float32(d.evidence_cap)
+        out = arr.astype(np.float32, copy=True)
+        for _ in range(k):
+            out = np.clip(out * f, -c, c)
+        return out
+
+    # -- chaos seams (resilience/faultplan.py) -------------------------------
+
+    def corrupt_spill(self, n_tiles: int) -> List[Tile]:
+        """`spill_corrupt` FaultPlan kind: flip a CRC-detectable bit in
+        up to `n_tiles` spilled tiles, deterministically. No disk tier
+        = nothing to corrupt (skip-noted by the plan)."""
+        if self.spill is None:
+            return []
+        hit = self.spill.corrupt_tiles(int(n_tiles))
+        for tile in hit:
+            self._note("chaos_corrupt", tile[0], tile[1])
+        return hit
+
+    def hold_pressure(self, name: str, squeeze: float) -> None:
+        """`memory_pressure` FaultPlan kind: synthetic budget squeeze;
+        overlapping holds compose worst-of in the governor. Sheds
+        immediately so the squeeze is visible the tick it lands."""
+        self.governor.hold_pressure(name, squeeze)
+        with self._lock:
+            rung = self.governor.observe(len(self._host))
+            self._note("pressure", name, round(float(squeeze), 4))
+            self._shed(max(rung, 1))
+
+    def release_pressure(self, name: str) -> None:
+        self.governor.release_pressure(name)
+        with self._lock:
+            self.governor.observe(len(self._host))
+            self._note("pressure_clear", name)
+
+    # -- serving composition -------------------------------------------------
+
+    def compose_serving(self, window_gray: np.ndarray):
+        """(logical gray mosaic, (nt, nt) evicted mask) for the tile
+        store: the resident window pastes at its origin, everything
+        else reads unknown-127, and tiles currently away (host, disk,
+        in-flight, lost) are flagged so `TileStore` emits typed
+        evicted markers instead of re-encoding stale pixels."""
+        L = self.logical_tiles * self.tile_cells
+        t = self.tile_cells
+        mosaic = np.full((L, L), 127, np.uint8)
+        r0, c0 = self.origin_tile
+        mosaic[r0 * t:r0 * t + self.window_cells,
+               c0 * t:c0 * t + self.window_cells] = window_gray
+        mask = np.zeros((self.logical_tiles, self.logical_tiles), bool)
+        with self._lock:
+            for (r, c) in self._away:
+                mask[r, c] = True
+        return mosaic, mask
+
+    # -- checkpoint (io/checkpoint.py world sidecar) -------------------------
+
+    def checkpoint_payload(self) -> Dict[str, np.ndarray]:
+        """Flat-array payload for the checkpoint's world sidecar:
+        window origin + epochs + away-set, plus the host tier — tiles
+        flush to the spill file when a disk tier exists (the manifest
+        then IS the spill index, lazily rehydrated on restore), and
+        embed in the sidecar otherwise."""
+        with self._lock:
+            if self.spill is not None:
+                # Flush host -> disk so restore needs only the file.
+                while self._host:
+                    tile, (gen, epoch, arr, coarse) = \
+                        self._host.popitem(last=False)
+                    self.spill.put(tile, gen, arr, epoch,
+                                   coarse=coarse)
+                self.spill.compact()
+            payload = {
+                "origin_tile": np.asarray(self.origin_tile, np.int64),
+                "epochs": np.asarray(
+                    [self.decay_epoch, self._gen,
+                     self.eviction_epoch], np.int64),
+                "away": np.asarray(sorted(self._away),
+                                   np.int64).reshape(-1, 2),
+            }
+            if self.spill is None and self._host:
+                meta, tiles = [], []
+                for tile, (gen, epoch, arr, coarse) in \
+                        self._host.items():
+                    if coarse != 1:
+                        arr = _upsample(arr, coarse, self.tile_cells)
+                    meta.append([tile[0], tile[1], gen, epoch])
+                    tiles.append(arr)
+                payload["host_meta"] = np.asarray(meta, np.int64)
+                payload["host_tiles"] = np.stack(tiles)
+        return payload
+
+    def restore_payload(self, payload: Dict[str, np.ndarray]) -> None:
+        """Re-anchor at the checkpointed origin; away tiles rehydrate
+        lazily on re-entry (disk tier) or from the embedded host
+        tier."""
+        with self._lock:
+            origin = payload["origin_tile"]
+            self.origin_tile = (int(origin[0]), int(origin[1]))
+            epochs = payload["epochs"]
+            self.decay_epoch = int(epochs[0])
+            self._gen = int(epochs[1])
+            self.eviction_epoch = int(epochs[2])
+            self._away = {(int(r), int(c))
+                          for r, c in np.asarray(payload["away"])}
+            self._host.clear()
+            self._pending.clear()
+            if "host_meta" in payload:
+                meta = np.asarray(payload["host_meta"])
+                tiles = np.asarray(payload["host_tiles"], np.float32)
+                for row, arr in zip(meta, tiles):
+                    self._host[(int(row[0]), int(row[1]))] = (
+                        int(row[2]), int(row[3]), arr, 1)
+
+    # -- observability -------------------------------------------------------
+
+    def _note(self, kind: str, *args) -> None:
+        self.n_schedule_events += 1
+        if len(self.schedule) < _SCHEDULE_CAP:
+            self.schedule.append((kind,) + args)
+
+    @staticmethod
+    def _flight(event: str, **kw) -> None:
+        from jax_mapping.obs.recorder import flight_recorder
+        flight_recorder.record(event, **kw)
+
+    def host_tiles(self) -> int:
+        with self._lock:
+            return len(self._host)
+
+    def status(self) -> dict:
+        """/status.world section (lock-held host reads, lock-free
+        counters — the /status convention)."""
+        with self._lock:
+            host = len(self._host)
+            away = len(self._away)
+            pending = len(self._pending)
+            host_bytes = sum(e[2].nbytes for e in self._host.values())
+        s = {
+            "windowed": True,
+            "origin_tile": list(self.origin_tile),
+            "window_tiles": self.window_tiles,
+            "logical_tiles": self.logical_tiles,
+            "device_window_bytes": self.window_cells ** 2 * 4,
+            "host_tiles": host,
+            "host_bytes": host_bytes,
+            "away_tiles": away,
+            "pending_prefetch": pending,
+            "shifts": self.n_shifts,
+            "evictions": self.n_evictions,
+            "rehydrated_host": self.n_rehydrated_host,
+            "rehydrated_disk": self.n_rehydrated_disk,
+            "lost_tiles": self.n_lost,
+            "corrupt_spills": self.n_corrupt_spills,
+            "eviction_epoch": self.eviction_epoch,
+            "decay_epoch": self.decay_epoch,
+            "schedule_events": self.n_schedule_events,
+            "governor": self.governor.status(),
+        }
+        if self.spill is not None:
+            s["spill"] = self.spill.status()
+        return s
+
+    # -- store-level direct-drive fusion (the oracle gate's API) -------------
+
+    def fuse_scan_global(self, window_grid, ranges, pose_world):
+        """Fuse one scan into the window with the inverse sensor model
+        evaluated at GLOBAL coordinates — float-identical to the
+        oracle big-grid fusion (`ops/grid.classify_patch` at the same
+        logical origin), applied at the window-local offset. The
+        bit-identity gate drives the store through this; the bridge's
+        windowed mapper runs the window-frame `slam_step` instead
+        (matcher float drift makes bridge-level bit-identity
+        unattainable — the soak gates ≥90% agreement there)."""
+        import jax.numpy as jnp
+        from jax_mapping.ops import grid as G
+        g = self.full_cfg.grid
+        fuse = _fuse_jit()
+        pose = jnp.asarray(pose_world, jnp.float32)
+        origin_global = G.patch_origin(g, pose[:2])
+        og = np.asarray(origin_global)
+        r0, c0 = self.origin_tile
+        local = og - np.array([r0 * self.tile_cells,
+                               c0 * self.tile_cells])
+        wc = self.window_cells
+        p = g.patch_cells
+        if not (0 <= local[0] <= wc - p and 0 <= local[1] <= wc - p):
+            raise ValueError(
+                f"patch at logical {og.tolist()} does not fit the "
+                f"window at origin {self.origin_tile} — shift first")
+        return fuse(g, self.full_cfg.scan, window_grid,
+                    jnp.asarray(ranges), pose,
+                    jnp.asarray(og, jnp.int32),
+                    jnp.asarray(local, jnp.int32))
+
+    def close(self) -> None:
+        # Drain in-flight prefetch reads BEFORE closing the spill file:
+        # a daemon reader racing the close would die on a closed-file
+        # error instead of returning its (now moot) tile.
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for th, _holder in pending:
+            th.join()
+        if self.spill is not None:
+            self.spill.close()
+
+
+# ---------------------------------------------------------------------------
+# Rung-2 retention coarsening (host-side, lossy, bounded)
+# ---------------------------------------------------------------------------
+
+def _coarsen(arr: np.ndarray, k: int) -> np.ndarray:
+    """Downsample by max-|logodds| per k x k block: walls survive
+    coarsening (the pyramid's occupied-priority idea applied to
+    evidence)."""
+    t = arr.shape[0]
+    b = arr.reshape(t // k, k, t // k, k).transpose(0, 2, 1, 3) \
+        .reshape(t // k, t // k, k * k)
+    idx = np.abs(b).argmax(axis=2)
+    return np.take_along_axis(b, idx[..., None], axis=2)[..., 0] \
+        .astype(np.float32)
+
+
+def _upsample(arr: np.ndarray, k: int, t: int) -> np.ndarray:
+    """Nearest-neighbour re-expansion of a coarsened tile back to the
+    (t, t) lattice."""
+    out = np.repeat(np.repeat(arr, k, axis=0), k, axis=1)
+    return np.ascontiguousarray(out[:t, :t], dtype=np.float32)

@@ -407,6 +407,20 @@ def test_no_suppressions_in_tenancy_modules():
         f"suppressions are not allowed in tenancy/: {banned}")
 
 
+def test_no_suppressions_in_world_modules():
+    """ISSUE 18 CI guard, extending the zero-suppression tier: the
+    bounded-memory world subsystem (`jax_mapping/world/`) carries ZERO
+    baseline suppressions — the store that evicts, spills and
+    rehydrates the live map while serving threads read it may not
+    baseline its hazards (the evict-vs-serve pair is exactly where a
+    torn read scatters stale walls into a fresh window)."""
+    base = Baseline.load(default_baseline_path())
+    banned = [s for s in base.suppressions
+              if s["path"].startswith("jax_mapping/world/")]
+    assert not banned, (
+        f"suppressions are not allowed in world/: {banned}")
+
+
 def test_no_suppressions_in_coldstart_modules():
     """ISSUE 12 CI guard, extending the zero-suppression tier: the
     warm-restart tier (`io/compile_cache.py`, the staged warm-up

@@ -314,3 +314,78 @@ def test_keyframe_sidecar_guards(tmp_path):
     save_keyframe_sidecar(base, bad)
     with pytest.raises(ValueError, match="disagree on length"):
         load_keyframe_sidecar(base)
+
+
+def test_world_sidecar_roundtrip_and_guards(tiny_cfg, tmp_path):
+    """ISSUE 18 satellite: the .world sidecar (window re-anchor
+    manifest) follows the full sidecar doctrine — exact roundtrip,
+    refuse-to-clobber, wrong-kind refusal, CRC-loud corruption,
+    config-drift refusal, None on absence, sentinel-checked clear."""
+    import dataclasses
+    import os
+
+    from jax_mapping.io.checkpoint import (CheckpointCorrupt,
+                                           clear_world_sidecar,
+                                           load_world_sidecar,
+                                           save_checkpoint,
+                                           save_world_sidecar,
+                                           world_sidecar_path)
+
+    base = str(tmp_path / "ck.npz")
+    payload = {
+        "origin_tile": np.asarray([2, 5], np.int64),
+        "epochs": np.asarray([3, 17, 4], np.int64),
+        "away": np.asarray([[0, 1], [7, 9]], np.int64),
+    }
+
+    # No sidecar yet -> None (pre-windowed checkpoints load fine).
+    assert load_world_sidecar(base) is None
+
+    # A REAL checkpoint parked at the sidecar's path must not be
+    # silently overwritten…
+    save_checkpoint(world_sidecar_path(base), {"grid": np.ones(3)})
+    with pytest.raises(ValueError, match="refusing to overwrite"):
+        save_world_sidecar(base, payload)
+    # …and must not load as one either.
+    with pytest.raises(ValueError, match="not a world sidecar"):
+        load_world_sidecar(base)
+    # clear() is sentinel-checked: it refuses to delete the impostor.
+    assert clear_world_sidecar(base) is False
+    assert os.path.exists(world_sidecar_path(base))
+    os.remove(world_sidecar_path(base))
+
+    # Incomplete payloads refuse at SAVE time.
+    with pytest.raises(ValueError, match="missing keys"):
+        save_world_sidecar(base, {"origin_tile": payload["origin_tile"]})
+
+    wp = save_world_sidecar(base, payload,
+                            config_json=tiny_cfg.to_json())
+    got = load_world_sidecar(base,
+                             running_config_json=tiny_cfg.to_json())
+    for k in payload:
+        np.testing.assert_array_equal(got[k], payload[k])
+
+    # Config drift (a different lattice) refuses with ValueError.
+    drifted = tiny_cfg.replace(grid=dataclasses.replace(
+        tiny_cfg.grid, size_cells=tiny_cfg.grid.size_cells * 2))
+    with pytest.raises(ValueError, match="differs from the running"):
+        load_world_sidecar(base, running_config_json=drifted.to_json())
+
+    # Truncation -> CheckpointCorrupt, never a silent re-anchor.
+    raw = open(wp, "rb").read()
+    with open(wp, "wb") as f:
+        f.write(raw[:len(raw) // 2])
+    with pytest.raises(CheckpointCorrupt):
+        load_world_sidecar(base)
+
+    # The damaged file fails the sentinel, so even the saver refuses
+    # to touch it (it COULD be a user checkpoint) — explicit removal
+    # is the operator's escape hatch.
+    with pytest.raises(ValueError, match="refusing to overwrite"):
+        save_world_sidecar(base, payload)
+    os.remove(wp)
+
+    # A fresh save wins, and clear() removes the genuine article.
+    save_world_sidecar(base, payload)
+    assert clear_world_sidecar(base) is True
+    assert load_world_sidecar(base) is None

@@ -462,3 +462,153 @@ def test_chaos_soak_corrupt_checkpoint_falls_back(tmp_path):
     # The resumed mapper kept fusing (map alive after the fallback).
     assert st.mapper.n_scans_fused > 0
     assert _known_cells(grid) > 500
+
+
+# ------------------------------------------- world-fault kinds (ISSUE 18)
+
+def test_world_fault_event_validation():
+    with pytest.raises(ValueError, match="memory_pressure needs"):
+        FaultEvent(step=0, kind="memory_pressure", value=0.0)
+    with pytest.raises(ValueError, match="memory_pressure needs"):
+        FaultEvent(step=0, kind="memory_pressure", value=1.5)
+    with pytest.raises(ValueError, match="spill_corrupt needs"):
+        FaultEvent(step=0, kind="spill_corrupt", value=0.0)
+    # The valid shapes construct.
+    FaultEvent(step=0, kind="memory_pressure", value=0.6, duration=10)
+    FaultEvent(step=0, kind="spill_corrupt", value=2.0)
+
+
+class _StubWorldStore:
+    """Records the governor seam calls FaultPlan makes."""
+
+    def __init__(self, spilled=2):
+        self.holds = []                      # live hold names
+        self.trace = []                      # (op, arg) sequence
+        self._spilled = spilled
+
+    def hold_pressure(self, name, squeeze):
+        self.holds.append(name)
+        self.trace.append(("hold", name, float(squeeze)))
+
+    def release_pressure(self, name):
+        self.holds.remove(name)
+        self.trace.append(("release", name))
+
+    def corrupt_spill(self, n):
+        k = min(int(n), self._spilled)
+        self._spilled -= k
+        hit = [(0, i) for i in range(k)]
+        self.trace.append(("corrupt", k))
+        return hit
+
+
+def test_memory_pressure_windows_compose_per_event_holds():
+    """Two overlapping memory_pressure windows hold under DISTINCT
+    per-event names (worst-of composes inside the governor), and each
+    window's clear releases only its own hold — the bus_drop/partition
+    refcount doctrine applied to the memory resource."""
+    store = _StubWorldStore()
+    stack = type("S", (), {"world": store, "bus": None})()
+    plan = FaultPlan([
+        FaultEvent(step=0, kind="memory_pressure", value=0.7,
+                   duration=10),
+        FaultEvent(step=5, kind="memory_pressure", value=0.4,
+                   duration=10),
+    ], seed=0)
+    plan.apply(stack, 0)
+    assert store.holds == ["chaos@0"]
+    plan.apply(stack, 5)
+    assert store.holds == ["chaos@0", "chaos@5"]   # both live
+    plan.apply(stack, 10)                    # first window clears
+    assert store.holds == ["chaos@5"]        # second survives
+    plan.apply(stack, 15)
+    assert store.holds == []
+    assert plan.done()
+    assert ("hold", "chaos@0", 0.7) in store.trace
+    assert ("hold", "chaos@5", 0.4) in store.trace
+
+
+def test_world_faults_skip_note_on_storeless_stack():
+    """Degrade, never die: both kinds no-op with a log note against a
+    stack with no windowed world store (windowed=False missions run
+    the same chaos scripts)."""
+    stack = type("S", (), {"world": None, "mapper": None,
+                        "bus": None})()
+    plan = FaultPlan([
+        FaultEvent(step=0, kind="memory_pressure", value=0.5,
+                   duration=5),
+        FaultEvent(step=1, kind="spill_corrupt", value=1.0),
+    ], seed=0)
+    plan.apply(stack, 0)
+    plan.apply(stack, 1)
+    plan.apply(stack, 6)
+    assert plan.done()
+    assert sum(1 for _, d in plan.log if "skipped" in d) == 2
+
+    # The mapper.world fallback path reaches the store too.
+    store = _StubWorldStore(spilled=3)
+    mapper = type("M", (), {"world": store})()
+    stack2 = type("S", (), {"mapper": mapper, "bus": None})()
+    plan2 = FaultPlan([
+        FaultEvent(step=0, kind="spill_corrupt", value=2.0),
+    ], seed=0)
+    plan2.apply(stack2, 0)
+    assert ("corrupt", 2) in store.trace
+    assert any("spill_corrupt 2 tile(s)" in d for _, d in plan2.log)
+
+    # An empty spill notes the skip instead of inventing a hit list.
+    store3 = _StubWorldStore(spilled=0)
+    stack3 = type("S", (), {"world": store3, "bus": None})()
+    plan3 = FaultPlan([
+        FaultEvent(step=0, kind="spill_corrupt", value=1.0),
+    ], seed=0)
+    plan3.apply(stack3, 0)
+    assert any("no spilled tiles" in d for _, d in plan3.log)
+
+
+def test_random_plan_world_faults_magnitudes_and_shared_resource():
+    """`allow_world_faults=True` admits both memory kinds with
+    kind-appropriate magnitudes, and `spill_corrupt` shares the
+    durable-storage resource with `corrupt_checkpoint` so generated
+    plans never overlap the two."""
+    from jax_mapping.resilience.faultplan import (MEMORY_KINDS,
+                                                  _fault_resource)
+    # One resource, by declaration: generated plans can therefore
+    # never stack a spill rot inside a checkpoint-truncation window.
+    assert _fault_resource("spill_corrupt", 0) \
+        == _fault_resource("corrupt_checkpoint", 0) == ("checkpoint",)
+    assert _fault_resource("memory_pressure", 0) == ("memory",)
+
+    seen = set()
+    for seed in range(30):
+        plan = random_plan(200, n_faults=8, seed=seed, n_robots=2,
+                           allow_world_faults=True)
+        occupied = []
+        for ev in plan.events:
+            if ev.kind == "memory_pressure":
+                assert 0.4 <= ev.value <= 0.9
+                assert ev.duration > 0
+            elif ev.kind == "spill_corrupt":
+                assert ev.value in (1.0, 2.0, 3.0)
+            if ev.kind in MEMORY_KINDS or ev.kind == "corrupt_checkpoint":
+                res = _fault_resource(ev.kind, ev.robot, ev.name)
+                window = (res, ev.step, ev.step + ev.duration)
+                for r, s, e in occupied:
+                    assert not (r == res and s <= window[2]
+                                and window[1] <= e), \
+                        f"seed {seed}: overlapping {res} windows"
+                occupied.append(window)
+            seen.add(ev.kind)
+    assert "memory_pressure" in seen and "spill_corrupt" in seen
+
+
+def test_random_plan_defaults_reproduce_pre_world_sampler():
+    """Default arguments are bit-compatible with the pre-world-fault
+    sampler: same seed, same events, no memory kinds."""
+    from jax_mapping.resilience.faultplan import MEMORY_KINDS
+    for seed in (0, 3, 7):
+        a = random_plan(150, n_faults=6, seed=seed, n_robots=2)
+        b = random_plan(150, n_faults=6, seed=seed, n_robots=2,
+                        allow_world_faults=False)
+        assert a.events == b.events
+        assert not any(ev.kind in MEMORY_KINDS for ev in a.events)

@@ -576,3 +576,69 @@ def test_racewatch_clean_on_live_stack_with_serving(stack):
     assert grid.state == "shared-modified"
     assert grid.candidate == \
         frozenset({"MapperNode._state_lock@mapper"})
+
+
+def test_tile_store_typed_evicted_markers_and_client_prune():
+    """ISSUE 18 satellite: the tile protocol's typed `evicted` markers
+    — a windowed provider's 4th snapshot element turns level-0 tiles
+    into byteless markers (cached bytes dropped, so no resync can
+    serve a tile the window no longer backs), the client prunes them
+    to unknown instead of raising, and re-entry re-encodes normally."""
+    cfg = ServingConfig(tile_cells=64, pyramid_levels=1)
+    img = np.full((256, 256), 127, np.uint8)
+    img[:64, :64] = 0                        # content in tile (0, 0)
+    state = {"rev": 0, "img": img, "ev": np.zeros((4, 4), bool)}
+    store = TileStore(cfg, "grid", lambda: state["rev"],
+                      lambda: (state["rev"], state["img"], None,
+                               state["ev"]))
+    store.refresh()
+    rev0, entries0, meta0 = store.tiles_since(-1)
+    assert not any(e.get("evicted") for e in entries0)
+    assert meta0.get("evicted_tiles", 0) == 0
+    client = DeltaMapClient("http://unused")
+    client.apply({"revision": rev0, "since": -1, "tiles": entries0,
+                  "tile_cells": 64, "levels": meta0["levels"]})
+    assert (client.image()[:64, :64] == 0).all()
+
+    # The window drops (0, 0): the provider paints it unknown and
+    # flags it in the mask.
+    img2 = img.copy()
+    img2[:64, :64] = 127
+    ev2 = np.zeros((4, 4), bool)
+    ev2[0, 0] = True
+    state.update(rev=5, img=img2, ev=ev2)
+    store.refresh()
+    rev1, entries1, meta1 = store.tiles_since(rev0)
+    markers = [e for e in entries1 if e.get("evicted")]
+    assert markers == [{"level": 0, "ty": 0, "tx": 0, "revision": 5,
+                        "evicted": True}]
+    assert meta1["evicted_tiles"] == 1
+    assert store.evicted_epoch == 1
+    assert store.stats()["n_tiles_evicted"] == 1
+    # The cached bytes are GONE: a since=-1 resync serves the marker,
+    # never stale content for the evicted slot.
+    _, full, _ = store.tiles_since(-1)
+    slot = [e for e in full
+            if e["level"] == 0 and (e["ty"], e["tx"]) == (0, 0)]
+    assert all(e.get("evicted") for e in slot) and slot
+
+    before = client.n_tiles_pruned
+    client.apply({"revision": rev1, "since": rev0, "tiles": entries1,
+                  "tile_cells": 64, "levels": meta1["levels"]})
+    assert client.n_tiles_pruned == before + 1
+    assert (client.image()[:64, :64] == 127).all()
+
+    # Re-entry: content returns, the marker clears, bytes flow again.
+    img3 = img2.copy()
+    img3[:64, :64] = 0
+    state.update(rev=9, img=img3, ev=np.zeros((4, 4), bool))
+    store.refresh()
+    assert store.evicted_epoch == 2          # the flip BACK also bumps
+    assert store.stats()["n_tiles_evicted"] == 0
+    rev2, entries2, meta2 = store.tiles_since(rev1)
+    assert not any(e.get("evicted") for e in entries2)
+    assert any((e["ty"], e["tx"]) == (0, 0) and "png" in e
+               for e in entries2 if e["level"] == 0)
+    client.apply({"revision": rev2, "since": rev1, "tiles": entries2,
+                  "tile_cells": 64, "levels": meta2["levels"]})
+    assert (client.image()[:64, :64] == 0).all()

@@ -646,3 +646,51 @@ print("OK", closed)
         timeout=900, env=_clean_cpu_env())
     assert r.returncode == 0, f"{r.stdout}\n{r.stderr[-3000:]}"
     assert r.stdout.strip().startswith("OK")
+
+
+# ----------------------------------------------- bounded-memory tenancy
+
+def test_windowed_mission_config_window_sized_lanes(mcfg):
+    """ISSUE 18 satellite: under `world.windowed` every tenant lane
+    runs at the WINDOW-sized grid (the single window_slam_config
+    derivation), identity object when not windowed, and the control
+    plane applies the transform once at construction."""
+    from jax_mapping.config import WorldConfig
+    from jax_mapping.tenancy.controlplane import TenantControlPlane
+    from jax_mapping.world.store import window_slam_config
+
+    # Knob off: the SAME object, not an equal copy — bit-exact pre-PR.
+    assert MB.windowed_mission_config(mcfg) is mcfg
+
+    wcfg_in = dataclasses.replace(
+        mcfg,
+        serving=dataclasses.replace(mcfg.serving, tile_cells=8),
+        world=WorldConfig(windowed=True, window_tiles=4,
+                          margin_tiles=1))
+    out = MB.windowed_mission_config(wcfg_in)
+    # ONE derivation: bit-equal to the store's own.
+    assert out == window_slam_config(wcfg_in)
+    assert out.grid.size_cells == 4 * 8            # the window
+    # Everything that shapes kernels EXCEPT the lattice is untouched.
+    assert out.grid.patch_cells == wcfg_in.grid.patch_cells
+    assert out.scan == wcfg_in.scan
+    assert out.matcher == wcfg_in.matcher
+    assert out.loop == wcfg_in.loop
+
+    # Lane state actually lands on the window shape (N tenants cost
+    # N x window^2 device cells, not N x logical^2).
+    s = FM.init_fleet_state(out, jax.random.PRNGKey(0))
+    assert s.grid.shape == (32, 32)
+
+    # The control plane transforms ONCE at construction, so lane
+    # init / checkpoints / serving all agree on shapes.
+    plane = TenantControlPlane(wcfg_in)
+    assert plane.cfg.grid.size_cells == 32
+
+    # The derivation refuses ill-posed windows rather than mis-shaping
+    # lanes: a window smaller than the fuse patch cannot host a scan.
+    bad = dataclasses.replace(
+        wcfg_in, world=WorldConfig(windowed=True, window_tiles=2,
+                                   margin_tiles=0))
+    with pytest.raises(ValueError, match="exceeds the window"):
+        MB.windowed_mission_config(bad)

@@ -1,0 +1,925 @@
+"""Bounded-memory world store tests (ISSUE 18 tentpole).
+
+The load-bearing assertions:
+
+* ORACLE BIT-IDENTITY — a windowed mission's live window content is
+  bit-identical (float-for-float) to an oracle big-grid run of the
+  same scans, through shifts, host/disk eviction, re-entry and decay
+  catch-up (the store-level direct-drive gate).
+* DEGRADE, NEVER DIE — a corrupt spill degrades its tile to unknown
+  with a flight event; refused admissions re-enter as unknown; no
+  world-store path ever raises into the mapper tick.
+* DETERMINISM — two same-seed drives produce bit-identical
+  eviction/spill/rehydrate schedules (the FaultPlan doctrine extended
+  to memory traffic).
+* EVICT-VS-SERVE RACE GATE — the tick thread shifting/evicting under
+  RaceWatch against serving composition and /status reads converges
+  with zero reports on the declared locks.
+* KNOB-OFF — `WorldConfig.windowed=False` builds no store and is
+  bit-exact regardless of the window knobs.
+"""
+
+import dataclasses
+import functools
+import threading
+
+import numpy as np
+import pytest
+
+from jax_mapping.config import WorldConfig, tiny_config
+from jax_mapping.world.store import WorldStore, window_slam_config
+
+
+# ------------------------------------------------------------------ helpers
+
+def _wcfg(base=None, **world_kw):
+    """Windowed config on the verified tiny geometry: 768-cell logical
+    lattice (12 serving tiles), a 4-tile (256-cell — the tiny device
+    shape, so jits reuse the suite's compile cache) window, 1-tile
+    margin band (recentre triggers at |x| > 3.2 m)."""
+    cfg = base if base is not None else tiny_config()
+    kw = dict(windowed=True, window_tiles=4, margin_tiles=1,
+              host_tile_budget=64)
+    kw.update(world_kw)
+    return cfg.replace(
+        grid=dataclasses.replace(cfg.grid, size_cells=768),
+        world=WorldConfig(**kw))
+
+
+def _ranges(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(0.5, 2.5,
+                       cfg.scan.padded_beams).astype(np.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _oracle_jit():
+    """The oracle big-grid fusion: the exact clip-add formula the
+    store's `fuse_patch_global` applies, evaluated on the full logical
+    grid — what a windowed run's live region must match bit-for-bit."""
+    import jax
+    import jax.numpy as jnp
+    from jax_mapping.ops import grid as G
+
+    @functools.partial(jax.jit, static_argnums=(0, 1))
+    def fuse(grid_cfg, scan_cfg, big, ranges, pose, origin):
+        delta = G.classify_patch(grid_cfg, scan_cfg, ranges, pose,
+                                 origin)
+        p = grid_cfg.patch_cells
+        cur = jax.lax.dynamic_slice(big, (origin[0], origin[1]), (p, p))
+        new = jnp.clip(cur + delta, grid_cfg.logodds_min,
+                       grid_cfg.logodds_max)
+        return jax.lax.dynamic_update_slice(big, new,
+                                            (origin[0], origin[1]))
+
+    return fuse
+
+
+def _oracle_fuse(cfg, big, ranges, pose_world):
+    import jax.numpy as jnp
+    from jax_mapping.ops import grid as G
+    pose = jnp.asarray(pose_world, jnp.float32)
+    origin = G.patch_origin(cfg.grid, pose[:2])
+    return _oracle_jit()(cfg.grid, cfg.scan, big, jnp.asarray(ranges),
+                         pose,
+                         jnp.asarray(np.asarray(origin), jnp.int32))
+
+
+def _window_region(store, big):
+    """The oracle's cells under the store's current window."""
+    t = store.tile_cells
+    r0, c0 = store.origin_tile
+    w = store.window_cells
+    return np.asarray(big)[r0 * t:r0 * t + w, c0 * t:c0 * t + w]
+
+
+def _drive(cfg, xs, spill_dir=None, decay_at=(), pressure_at=(),
+           check_each=True):
+    """Walk one robot along y=0 fusing a scan per pose, shifting the
+    window exactly as the mapper does (poll, recentre, fuse), with the
+    oracle big grid running alongside. Returns (store, window, big)."""
+    from jax_mapping.ops import grid as G
+    store = WorldStore(cfg, spill_dir=spill_dir)
+    win = G.empty_grid(store.cfg.grid)
+    big = G.empty_grid(cfg.grid)
+    ranges = _ranges(cfg)
+    for i, x in enumerate(xs):
+        pose_w = np.array([x, 0.0, 0.0], np.float32)
+        win, _ = store.poll_prefetch(win)
+        off = store.offset_xy()
+        dr, dc = store.desired_shift(
+            [pose_w - np.array([off[0], off[1], 0.0], np.float32)])
+        if (dr, dc) != (0, 0):
+            win = store.shift(win, dr, dc)
+            # Join disk rehydrations NOW (determinism over latency) so
+            # the next fuse never writes into a tile a pending scatter
+            # would overwrite — the mapper pays the one-tick degrade
+            # instead; that path has its own test below.
+            win, _ = store.poll_prefetch(win)
+        win = store.fuse_scan_global(win, ranges, pose_w)
+        big = _oracle_fuse(cfg, big, ranges, pose_w)
+        if i in decay_at:
+            d = cfg.decay
+            win = G.decay_grid(win, d.factor, d.evidence_cap)
+            store.note_decay_pass()
+            big = G.decay_grid(big, d.factor, d.evidence_cap)
+        if i in pressure_at:
+            store.hold_pressure(f"drive@{i}", 0.5)
+        if check_each:
+            np.testing.assert_array_equal(
+                np.asarray(win), _window_region(store, big),
+                err_msg=f"window diverged from oracle at step {i}")
+    return store, win, big
+
+
+#: The east-and-back corridor walk: two eastward recentres (evicting
+#: the content the robot mapped near the origin), then the return leg
+#: rehydrates it.  Margin trigger is |window x| > 3.2 m.
+_WALK = [0.0, 1.6, 3.3, 6.6, 9.9, 6.6, 3.3, 0.0]
+
+
+# --------------------------------------------------- config derivation
+
+def test_window_slam_config_geometry_validation(tiny_cfg):
+    cfg = _wcfg(tiny_cfg)
+    out = window_slam_config(cfg)
+    # ONLY grid.size_cells shrinks, to the window edge.
+    assert out.grid.size_cells == 4 * 64
+    assert out.grid.patch_cells == cfg.grid.patch_cells
+    assert out.scan == cfg.scan
+    assert out.serving == cfg.serving
+
+    bad = cfg.replace(grid=dataclasses.replace(cfg.grid,
+                                               size_cells=800))
+    with pytest.raises(ValueError, match="not divisible"):
+        window_slam_config(bad)
+    with pytest.raises(ValueError, match="exceeds the logical"):
+        window_slam_config(_wcfg(tiny_cfg, window_tiles=16))
+    with pytest.raises(ValueError, match="must be even"):
+        window_slam_config(_wcfg(tiny_cfg, window_tiles=3))
+    with pytest.raises(ValueError, match="no interior"):
+        window_slam_config(_wcfg(tiny_cfg, margin_tiles=2))
+
+
+def test_offset_starts_at_exact_zero_and_advances_by_tiles(tiny_cfg):
+    store = WorldStore(_wcfg(tiny_cfg))
+    assert store.origin_tile == (4, 4)
+    np.testing.assert_array_equal(store.offset_xy(),
+                                  np.zeros(2, np.float32))
+    from jax_mapping.ops import grid as G
+    win = G.empty_grid(store.cfg.grid)
+    win = store.shift(win, 1, 2)
+    assert store.origin_tile == (5, 6)
+    # offset = (dc, dr) tiles * 64 cells * 0.05 m; x is columns.
+    np.testing.assert_allclose(store.offset_xy(), [6.4, 3.2])
+    np.testing.assert_array_equal(store.shift_delta_m(1, 2),
+                                  store.offset_xy())
+    assert store.n_shifts == 1
+
+
+# ------------------------------------------- oracle bit-identity gates
+
+def test_host_eviction_roundtrip_bit_identical_to_oracle(tiny_cfg):
+    """East-and-back with a roomy host budget: every fuse along the
+    way — through two evicting shifts, a mid-mission decay pass and
+    the host rehydration on the return leg — leaves the live window
+    bit-identical to the oracle big grid (decay catch-up included:
+    evicted tiles missed the device pass and replay it lazily)."""
+    cfg = _wcfg(tiny_cfg)
+    store, win, big = _drive(cfg, _WALK, decay_at=(4,))
+    assert store.n_shifts >= 3
+    assert store.n_evictions > 0
+    assert store.n_rehydrated_host > 0
+    assert store.n_lost == 0 and store.n_corrupt_spills == 0
+    assert store.decay_epoch == 1
+    # Return to the anchor: the offset is EXACTLY zero again.
+    np.testing.assert_array_equal(store.offset_xy(),
+                                  np.zeros(2, np.float32))
+
+
+def test_disk_spill_roundtrip_bit_identical_to_oracle(tiny_cfg,
+                                                      tmp_path):
+    """A one-tile host budget pushes evicted content to disk
+    (retention_coarsen=1 keeps the spill lossless at every rung); the
+    return leg rehydrates through the prefetch path and still matches
+    the oracle float-for-float."""
+    cfg = _wcfg(tiny_cfg, host_tile_budget=1, retention_coarsen=1)
+    store, win, big = _drive(cfg, _WALK, spill_dir=str(tmp_path))
+    assert store.n_rehydrated_disk > 0
+    assert store.governor.n_spills > 0
+    assert store.n_corrupt_spills == 0
+    assert store.spill.n_corrupt_reads == 0
+
+
+def test_disk_rehydration_is_one_tick_unknown_degrade(tiny_cfg,
+                                                      tmp_path):
+    """Disk hits do NOT scatter at shift time: the tile reads unknown
+    until the next poll joins the prefetch (deterministic one-tick
+    degrade regardless of IO timing)."""
+    from jax_mapping.ops import grid as G
+    cfg = _wcfg(tiny_cfg, host_tile_budget=1, retention_coarsen=1)
+    store = WorldStore(cfg, spill_dir=str(tmp_path))
+    win = G.empty_grid(store.cfg.grid)
+    big = G.empty_grid(cfg.grid)
+    ranges = _ranges(cfg)
+    pose = np.zeros(3, np.float32)
+    win = store.fuse_scan_global(win, ranges, pose)
+    big = _oracle_fuse(cfg, big, ranges, pose)
+    win = store.shift(win, 0, 4)           # whole window leaves
+    assert store.host_tiles() == 1         # budget: newest stays warm
+    spilled = store.spill.tiles()
+    assert len(spilled) == 3
+    win = store.shift(win, 0, -4)          # ...and comes back
+    st = store.status()
+    assert st["pending_prefetch"] > 0
+    # Host tile scattered NOW; disk tiles still unknown this tick.
+    t = store.tile_cells
+    w = np.asarray(win)
+    oracle = _window_region(store, big)
+    for (r, c) in spilled:
+        sr, sc = r - store.origin_tile[0], c - store.origin_tile[1]
+        assert not w[sr * t:(sr + 1) * t, sc * t:(sc + 1) * t].any()
+    win, n = store.poll_prefetch(win)
+    assert n == len(spilled)
+    np.testing.assert_array_equal(np.asarray(win), oracle)
+    assert store.status()["pending_prefetch"] == 0
+    assert store.n_rehydrated_disk == n
+
+
+# ----------------------------------------------- integrity + degrade
+
+def test_corrupt_spill_degrades_to_unknown_with_flight_event(
+        tiny_cfg, tmp_path):
+    """The `spill_corrupt` contract: a rotted spilled tile re-enters
+    as unknown with a `world_spill_corrupt` flight event — counters
+    move, the away marker clears, and nothing raises."""
+    from jax_mapping.obs.recorder import flight_recorder
+    from jax_mapping.ops import grid as G
+    cfg = _wcfg(tiny_cfg, host_tile_budget=1, retention_coarsen=1)
+    store = WorldStore(cfg, spill_dir=str(tmp_path))
+    win = G.empty_grid(store.cfg.grid)
+    big = G.empty_grid(cfg.grid)
+    ranges = _ranges(cfg)
+    pose = np.zeros(3, np.float32)
+    win = store.fuse_scan_global(win, ranges, pose)
+    big = _oracle_fuse(cfg, big, ranges, pose)
+    win = store.shift(win, 0, 4)
+    hit = store.corrupt_spill(1)
+    assert len(hit) == 1
+    mark = flight_recorder.mark()
+    win = store.shift(win, 0, -4)
+    win, n_ok = store.poll_prefetch(win)   # never raises
+    assert store.n_corrupt_spills == 1
+    assert store.n_lost >= 1
+    evs = [e for e in flight_recorder.events_since(mark)
+           if e["kind"] == "world_spill_corrupt"]
+    assert len(evs) == 1 and tuple(evs[0]["tile"]) == hit[0]
+    # The rotted tile is resident-as-unknown: away marker cleared,
+    # content zero; every OTHER tile matches the oracle.
+    st = store.status()
+    assert st["away_tiles"] == 0
+    t = store.tile_cells
+    w = np.asarray(win)
+    oracle = _window_region(store, big).copy()
+    r, c = hit[0]
+    sr, sc = r - store.origin_tile[0], c - store.origin_tile[1]
+    assert not w[sr * t:(sr + 1) * t, sc * t:(sc + 1) * t].any()
+    oracle[sr * t:(sr + 1) * t, sc * t:(sc + 1) * t] = 0.0
+    np.testing.assert_array_equal(w, oracle)
+
+
+def test_spillstore_torn_tail_truncates_newest_gen_wins(tmp_path):
+    from jax_mapping.world.spill import SpillStore
+    s = SpillStore(str(tmp_path))
+    a1 = np.full((8, 8), 1.0, np.float32)
+    a2 = np.full((8, 8), 2.0, np.float32)
+    b = np.full((8, 8), 3.0, np.float32)
+    s.put((1, 2), 1, a1, 0)
+    s.put((1, 2), 2, a2, 0)                # newest generation wins
+    s.put((3, 4), 1, b, 0)
+    np.testing.assert_array_equal(s.get((1, 2)).data, a2)
+    assert s.get((9, 9)) is None           # miss, not an exception
+    size_before = s.nbytes()
+    s.close()
+
+    # A torn append (length prefix promising more bytes than exist)
+    # must truncate to the last good record on reopen, never fail.
+    with open(s.path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00partial")
+    s2 = SpillStore(str(tmp_path))
+    assert s2.n_truncated_bytes > 0
+    np.testing.assert_array_equal(s2.get((1, 2)).data, a2)
+    np.testing.assert_array_equal(s2.get((3, 4)).data, b)
+
+    # Compaction drops the superseded (1,2) gen-1 record.
+    s2.compact()
+    assert s2.nbytes() < size_before
+    np.testing.assert_array_equal(s2.get((1, 2)).data, a2)
+
+    # corrupt_tiles flips INSIDE the tile bytes and re-stamps the
+    # frame CRC: only the inner CRC catches it, at read time.
+    assert s2.corrupt_tiles(1) == [(1, 2)]
+    assert s2.get((1, 2)) is None
+    assert s2.n_corrupt_reads == 1
+    np.testing.assert_array_equal(s2.get((3, 4)).data, b)
+    s2.close()
+
+
+# -------------------------------------------------- governor ladder
+
+def test_governor_watermark_ladder_and_worst_of_holds():
+    from jax_mapping.world.governor import MemoryGovernor
+    gov = MemoryGovernor(WorldConfig(host_tile_budget=100))
+    assert gov.observe(50) == 0
+    assert gov.observe(80) == 1            # >= 0.75 high watermark
+    assert gov.observe(93) == 2            # >= 0.92 critical
+    assert gov.observe(100) == 3           # at budget: refuse
+    assert gov.observe(10) == 0
+    assert gov.n_rung_changes == 4
+
+    gov.hold_pressure("a", 0.5)
+    assert gov.effective_budget() == 50
+    gov.hold_pressure("b", 0.75)           # worst-of composes
+    assert gov.effective_budget() == 25
+    assert gov.pressure() == 0.75
+    gov.release_pressure("b")
+    assert gov.effective_budget() == 50    # a's window still holds
+    gov.release_pressure("a")
+    assert gov.effective_budget() == 100
+    st = gov.status()
+    assert st["rung_name"] == "normal" and st["pressure_holds"] == 0
+    assert st["effective_budget_tiles"] == 100
+
+
+def test_refused_admission_reenters_as_unknown(tiny_cfg):
+    """Rung 3 with no disk tier: eviction drops the tile (flight
+    event, counters), and re-entry clears the away marker — the tile
+    is resident again AS UNKNOWN, never as stale walls."""
+    from jax_mapping.obs.recorder import flight_recorder
+    from jax_mapping.ops import grid as G
+    cfg = _wcfg(tiny_cfg, host_tile_budget=1)
+    store = WorldStore(cfg)
+    win = G.empty_grid(store.cfg.grid)
+    win = store.fuse_scan_global(win, _ranges(cfg),
+                                 np.zeros(3, np.float32))
+    mark = flight_recorder.mark()
+    win = store.shift(win, 0, 4)
+    assert store.governor.n_refused > 0
+    assert store.n_lost == store.governor.n_refused
+    assert store.host_tiles() == 0
+    evs = [e for e in flight_recorder.events_since(mark)
+           if e["kind"] == "world_admission_refused"]
+    assert len(evs) == store.governor.n_refused
+    st = store.status()
+    assert st["away_tiles"] > 0
+    epoch = store.eviction_epoch
+
+    win = store.shift(win, 0, -4)
+    assert store.status()["away_tiles"] == 0   # reenter_unknown
+    assert store.eviction_epoch > epoch
+    assert not np.asarray(win).any()
+    assert any(ev[0] == "reenter_unknown" for ev in store.schedule)
+
+
+def test_pressure_hold_sheds_immediately_drop_without_spill(tiny_cfg):
+    from jax_mapping.ops import grid as G
+    cfg = _wcfg(tiny_cfg, host_tile_budget=4)
+    store = WorldStore(cfg)
+    win = G.empty_grid(store.cfg.grid)
+    win = store.fuse_scan_global(win, _ranges(cfg),
+                                 np.zeros(3, np.float32))
+    win = store.shift(win, 0, 4)
+    n_host = store.host_tiles()
+    assert n_host >= 2                     # content survived eviction
+    lost_before = store.n_lost
+    store.hold_pressure("chaos@1", 0.7)    # effective budget -> 1
+    assert store.host_tiles() == 1
+    assert store.governor.n_drops == n_host - 1
+    assert store.n_lost - lost_before == n_host - 1
+    store.release_pressure("chaos@1")
+    assert store.governor.effective_budget() == 4
+    assert any(ev[0] == "pressure" for ev in store.schedule)
+    assert any(ev[0] == "pressure_clear" for ev in store.schedule)
+
+
+def test_rung2_coarsens_spilled_retention(tiny_cfg, tmp_path):
+    """Above the critical watermark the spill coarsens by
+    `retention_coarsen` (lossy, bounded); rehydrate upsamples back to
+    the tile lattice — content survives approximately, shape exactly."""
+    from jax_mapping.ops import grid as G
+    cfg = _wcfg(tiny_cfg, host_tile_budget=1)   # default coarsen=2
+    store = WorldStore(cfg, spill_dir=str(tmp_path))
+    win = G.empty_grid(store.cfg.grid)
+    win = store.fuse_scan_global(win, _ranges(cfg),
+                                 np.zeros(3, np.float32))
+    win = store.shift(win, 0, 4)
+    assert store.governor.n_coarsened > 0
+    win = store.shift(win, 0, -4)
+    win, n = store.poll_prefetch(win)
+    assert n > 0 and store.n_rehydrated_disk == n
+    assert np.asarray(win).any()           # coarse content came back
+    assert np.asarray(win).shape == (256, 256)
+
+
+# --------------------------------------------------- determinism gate
+
+def test_same_seed_drives_produce_bit_identical_schedules(tiny_cfg,
+                                                          tmp_path):
+    cfg = _wcfg(tiny_cfg, host_tile_budget=1, retention_coarsen=1)
+    a, win_a, _ = _drive(cfg, _WALK, spill_dir=str(tmp_path / "a"),
+                         decay_at=(4,), pressure_at=(3,),
+                         check_each=False)
+    b, win_b, _ = _drive(cfg, _WALK, spill_dir=str(tmp_path / "b"),
+                         decay_at=(4,), pressure_at=(3,),
+                         check_each=False)
+    assert a.schedule == b.schedule
+    assert a.n_schedule_events == b.n_schedule_events
+    assert a.origin_tile == b.origin_tile
+    assert a.status()["evictions"] == b.status()["evictions"]
+    np.testing.assert_array_equal(np.asarray(win_a), np.asarray(win_b))
+    # The schedule saw every transition class this drive exercises.
+    kinds = {ev[0] for ev in a.schedule}
+    assert {"shift", "evict", "spill", "prefetch", "rehydrate",
+            "pressure"} <= kinds
+
+
+# ----------------------------------------------- serving composition
+
+def test_compose_serving_masks_away_tiles(tiny_cfg):
+    from jax_mapping.ops import grid as G
+    cfg = _wcfg(tiny_cfg, host_tile_budget=64)
+    store = WorldStore(cfg)
+    win = G.empty_grid(store.cfg.grid)
+    win = store.fuse_scan_global(win, _ranges(cfg),
+                                 np.zeros(3, np.float32))
+    win = store.shift(win, 0, 4)
+    gray = np.full((store.window_cells, store.window_cells), 200,
+                   np.uint8)
+    mosaic, mask = store.compose_serving(gray)
+    assert mosaic.shape == (768, 768) and mask.shape == (12, 12)
+    r0, c0 = store.origin_tile
+    t = store.tile_cells
+    w = store.window_cells
+    assert (mosaic[r0 * t:r0 * t + w, c0 * t:c0 * t + w] == 200).all()
+    outside = mosaic.copy()
+    outside[r0 * t:r0 * t + w, c0 * t:c0 * t + w] = 127
+    assert (outside == 127).all()
+    away = {tuple(t_) for t_ in np.argwhere(mask)}
+    assert away and away == store._away
+
+
+# ------------------------------------------------ checkpoint payloads
+
+def test_checkpoint_payload_roundtrip_embedded_host(tiny_cfg):
+    from jax_mapping.ops import grid as G
+    cfg = _wcfg(tiny_cfg)
+    store, win, big = _drive(cfg, [0.0, 1.6, 3.3], check_each=False)
+    payload = store.checkpoint_payload()
+    assert "host_meta" in payload and "host_tiles" in payload
+
+    fresh = WorldStore(cfg)
+    fresh.restore_payload(payload)
+    assert fresh.origin_tile == store.origin_tile
+    assert fresh._away == store._away
+    assert fresh.decay_epoch == store.decay_epoch
+    assert fresh.eviction_epoch == store.eviction_epoch
+    # Walking back onto the evicted region restores the content the
+    # payload carried, bit-exact vs the oracle.
+    win2 = G.empty_grid(fresh.cfg.grid)
+    win2 = fresh.shift(win2, 0, 4 - fresh.origin_tile[1])
+    evicted_cols = np.asarray(win2)[:, :2 * 64]
+    np.testing.assert_array_equal(
+        evicted_cols, _window_region(fresh, big)[:, :2 * 64])
+    assert fresh.n_rehydrated_host > 0
+
+
+def test_checkpoint_payload_spill_backed_flushes_host(tiny_cfg,
+                                                      tmp_path):
+    from jax_mapping.ops import grid as G
+    cfg = _wcfg(tiny_cfg, host_tile_budget=1, retention_coarsen=1)
+    store, win, big = _drive(cfg, [0.0, 1.6, 3.3],
+                             spill_dir=str(tmp_path),
+                             check_each=False)
+    payload = store.checkpoint_payload()
+    # With a disk tier the host flushes: the spill file IS the
+    # manifest, the sidecar carries only the re-anchor arrays.
+    assert "host_meta" not in payload
+    assert store.host_tiles() == 0
+    store.close()
+
+    fresh = WorldStore(cfg, spill_dir=str(tmp_path))
+    fresh.restore_payload(payload)
+    assert fresh.origin_tile == store.origin_tile
+    win2 = G.empty_grid(fresh.cfg.grid)
+    win2 = fresh.shift(win2, 0, 4 - fresh.origin_tile[1])
+    win2, n = fresh.poll_prefetch(win2)
+    assert n > 0
+    evicted_cols = np.asarray(win2)[:, :2 * 64]
+    np.testing.assert_array_equal(
+        evicted_cols, _window_region(fresh, big)[:, :2 * 64])
+    fresh.close()
+
+
+# ----------------------------------------------- racewatch gate (CI)
+
+def test_racewatch_gate_evict_vs_serve(tiny_cfg):
+    """ISSUE 18 CI satellite: one tick-thread shifting/evicting/
+    rehydrating (+ pressure holds) against serving composition,
+    /status reads and checkpoint snapshots from concurrent threads —
+    RaceWatch must converge every declared field on the declared lock
+    with ZERO reports."""
+    from jax_mapping.analysis.protection import groups_by_class
+    from jax_mapping.analysis.racewatch import RaceWatch
+    from jax_mapping.ops import grid as G
+
+    cfg = _wcfg(tiny_cfg, host_tile_budget=64)
+    store = WorldStore(cfg)
+    win = G.empty_grid(store.cfg.grid)
+    win = store.fuse_scan_global(win, _ranges(cfg),
+                                 np.zeros(3, np.float32))
+    errs = []
+    watch = RaceWatch()
+    try:
+        watch.watch_object(store, groups_by_class()["WorldStore"][0],
+                           name="world")
+        watch.watch_object(store.governor,
+                           groups_by_class()["MemoryGovernor"][0],
+                           name="gov")
+
+        def tick(g=win):
+            try:
+                for _ in range(25):
+                    g = store.shift(g, 0, 2)
+                    store.note_decay_pass()
+                    store.hold_pressure("gate", 0.3)
+                    g = store.shift(g, 0, -2)
+                    g, _ = store.poll_prefetch(g)
+                    store.release_pressure("gate")
+            except Exception as e:            # noqa: BLE001
+                errs.append(e)
+
+        def serve():
+            gray = np.full((store.window_cells, store.window_cells),
+                           127, np.uint8)
+            try:
+                for _ in range(120):
+                    store.compose_serving(gray)
+                    store.status()
+                    store.host_tiles()
+                    store.checkpoint_payload()
+            except Exception as e:            # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=tick)] + \
+            [threading.Thread(target=serve) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        watch.unwatch_all()
+    assert errs == []
+    assert watch.reports() == [], \
+        "\n".join(r.message for r in watch.reports())
+    # `_gen` is the cross-thread written field (evictions stamp it on
+    # the tick thread; checkpoint snapshots read it from the serve
+    # threads) — its candidate lockset must converge on the store lock.
+    gen = watch.field_states()["WorldStore._gen@world"]
+    assert gen.state == "shared-modified"
+    assert "WorldStore._lock@world" in gen.candidate
+
+
+# ------------------------------------------------ mapper integration
+
+def _scan(stamp, cfg, ranges=None):
+    from jax_mapping.bridge.messages import Header, LaserScan
+    n = cfg.scan.n_beams
+    r = np.zeros(n, np.float32) if ranges is None else ranges
+    return LaserScan(header=Header(stamp=stamp, frame_id="base_laser"),
+                     angle_increment=cfg.scan.angle_increment_rad,
+                     ranges=r)
+
+
+def _odom(stamp, x, y, theta):
+    from jax_mapping.bridge.messages import (Header, Odometry, Pose2D,
+                                             Twist)
+    return Odometry(header=Header(stamp=stamp, frame_id="odom"),
+                    pose=Pose2D(x, y, theta),
+                    twist=Twist(linear_x=0.0, angular_z=0.0))
+
+
+def test_windowed_mapper_shift_translates_pose_leaves(tiny_cfg):
+    """Bridge integration: the mapper runs window-frame machinery, and
+    a margin-band crossing shifts the window + translates every
+    pose-like leaf so `window pose + offset == world pose` holds
+    through the shift (zero-range scans = pure odometric propagation,
+    so the odometry IS the world-frame truth)."""
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.mapper import MapperNode
+    from jax_mapping.obs.recorder import flight_recorder
+
+    cfg = _wcfg(tiny_cfg)
+    bus = Bus()
+    mapper = MapperNode(cfg, bus, n_robots=1)
+    try:
+        assert mapper.world is not None
+        assert mapper.cfg.grid.size_cells == 256     # window config
+        assert mapper.full_cfg.grid.size_cells == 768
+        scan_pub = bus.publisher("scan")
+        odom_pub = bus.publisher("odom")
+        mark = flight_recorder.mark()
+        t = 0.0
+        for x in [0.0, 0.8, 1.6, 2.4, 3.2, 4.0, 4.8]:
+            t += 0.5
+            odom_pub.publish(_odom(t, x, 0.0, 0.0))
+            scan_pub.publish(_scan(t, cfg))
+            mapper.tick()
+        assert mapper.world.n_shifts >= 1
+        ws = mapper.world_status()
+        assert ws["windowed"] and ws["origin_tile"] != [4, 4]
+        off = mapper.world.offset_xy()
+        assert float(off[0]) > 0.0 and float(off[1]) == 0.0
+        pose = np.asarray(mapper.states[0].pose)
+        assert pose[0] + off[0] == pytest.approx(4.8, abs=1e-3)
+        assert abs(pose[0]) < 6.4            # pose stays in-window
+        evs = [e for e in flight_recorder.events_since(mark)
+               if e["kind"] == "window_shift"]
+        assert evs and evs[0]["dr"] == 0 and evs[0]["dc"] > 0
+        assert ws["offset_m"] == [float(off[0]), float(off[1])]
+    finally:
+        mapper.destroy()
+
+
+def test_windowed_off_builds_no_store_and_is_knob_inert(tiny_cfg):
+    """The knob-off doctrine: `windowed=False` builds no store, and
+    the OTHER world knobs are bit-inert — two mappers with different
+    window parameters produce identical grids for identical input."""
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.mapper import MapperNode
+
+    grids = []
+    for knobs in (WorldConfig(),
+                  WorldConfig(windowed=False, window_tiles=6,
+                              margin_tiles=2, host_tile_budget=7)):
+        cfg = tiny_cfg.replace(world=knobs)
+        bus = Bus()
+        mapper = MapperNode(cfg, bus, n_robots=1)
+        assert mapper.world is None
+        assert mapper.world_status() is None
+        assert mapper.cfg.grid.size_cells == tiny_cfg.grid.size_cells
+        scan_pub = bus.publisher("scan")
+        odom_pub = bus.publisher("odom")
+        ranges = _ranges(cfg)[:cfg.scan.n_beams]
+        for i, x in enumerate([0.0, 0.3, 0.6]):
+            st = 0.5 * (i + 1)
+            odom_pub.publish(_odom(st, x, 0.0, 0.0))
+            scan_pub.publish(_scan(st, cfg, ranges=ranges))
+            mapper.tick()
+        grids.append(np.asarray(mapper.shared_grid))
+        mapper.destroy()
+    np.testing.assert_array_equal(grids[0], grids[1])
+
+
+def test_windowed_serving_and_http_surface(tiny_cfg):
+    """End-to-end on a real windowed mapper: `/tiles` serves typed
+    evicted markers the DeltaMapClient prunes on, the ETag grows a
+    `-w{epoch}` suffix across an eviction flip, `/status` carries the
+    world section, and `/metrics` exports the jax_mapping_world_*
+    families."""
+    from jax_mapping.bridge.bus import Bus
+    from jax_mapping.bridge.http_api import MapApiServer
+    from jax_mapping.bridge.mapper import MapperNode
+    from jax_mapping.serving.client import DeltaMapClient
+    import json
+
+    cfg = _wcfg(tiny_cfg)
+    bus = Bus()
+    mapper = MapperNode(cfg, bus, n_robots=1)
+    api = MapApiServer(bus, mapper=mapper, port=0)
+    try:
+        store = api.serving.map_store
+        scan_pub = bus.publisher("scan")
+        odom_pub = bus.publisher("odom")
+        ranges = _ranges(cfg)[:cfg.scan.n_beams]
+
+        # Map some content around the origin, serve the snapshot.
+        for i, x in enumerate([0.0, 0.2]):
+            st = 0.5 * (i + 1)
+            odom_pub.publish(_odom(st, x, 0.0, 0.0))
+            scan_pub.publish(_scan(st, cfg, ranges=ranges))
+            mapper.tick()
+        store.refresh()
+        rev0, entries0, meta0 = store.tiles_since(-1)
+        assert meta0["size_cells"] == 768    # LOGICAL manifest
+        assert not any(e.get("evicted") for e in entries0)
+        client = DeltaMapClient("http://unused")
+        client.apply({"revision": rev0, "since": -1, "tiles": entries0,
+                      "tile_cells": 64, "levels": meta0["levels"]})
+        assert client.image().shape == (768, 768)
+        known0 = int((client.image() != 127).sum())
+        assert known0 > 0
+        res = api.handle("/tiles?since=-1")
+        assert res[0] == 200
+        etag0 = res[3]["ETag"]
+        assert "-w" not in etag0             # nothing evicted yet
+
+        # Walk east past the margin: the shift evicts mapped tiles.
+        t = 1.0
+        for x in [1.6, 2.4, 3.2, 4.0, 4.8]:
+            t += 0.5
+            odom_pub.publish(_odom(t, x, 0.0, 0.0))
+            scan_pub.publish(_scan(t, cfg))
+            mapper.tick()
+        assert mapper.world.n_shifts >= 1
+        assert mapper.world.status()["away_tiles"] > 0
+        store.refresh()
+        rev1, entries1, meta1 = store.tiles_since(rev0)
+        markers = [e for e in entries1 if e.get("evicted")]
+        assert markers and meta1["evicted_tiles"] > 0
+        assert all("png" not in e for e in markers)
+        before = client.n_tiles_pruned
+        client.apply({"revision": rev1, "since": rev0,
+                      "tiles": entries1, "tile_cells": 64,
+                      "levels": meta1["levels"]})
+        assert client.n_tiles_pruned == before + len(markers)
+        for e in markers:
+            ty, tx = e["ty"], e["tx"]
+            region = client.image()[ty * 64:(ty + 1) * 64,
+                                    tx * 64:(tx + 1) * 64]
+            assert (region == 127).all()
+        assert store.stats()["n_tiles_evicted"] > 0
+        assert store.stats()["evicted_epoch"] > 0
+        res1 = api.handle("/tiles?since=-1")
+        etag1 = res1[3]["ETag"]
+        assert f"-w{store.evicted_epoch}" in etag1
+        assert etag1 != etag0
+
+        # /status.world + /metrics world families.
+        body = json.loads(api.handle("/status")[2])
+        assert body["world"]["windowed"] is True
+        assert body["world"]["shifts"] >= 1
+        text = api.handle("/metrics")[2].decode()
+        for fam in ("jax_mapping_world_shifts_total",
+                    "jax_mapping_world_evictions_total",
+                    "jax_mapping_world_device_window_bytes",
+                    "jax_mapping_world_governor_rung",
+                    "jax_mapping_world_away_tiles"):
+            assert f"# TYPE {fam} " in text
+    finally:
+        api.shutdown()
+        mapper.destroy()
+
+
+# ------------------------------------------------- the lifelong gate
+
+@pytest.mark.slow
+def test_bounded_memory_corridor_soak(tmp_path):
+    """ISSUE 18 acceptance: a robot walks a corridor far beyond the
+    window — peak device grid bytes stay constant while traveled
+    distance grows, the window recentres in BOTH directions (out and
+    back: eviction, disk spill, re-entry), the memory chaos kinds
+    fire mid-mission (`spill_corrupt` rotting REAL spilled tiles),
+    occupancy sign-agreement vs sim ground truth holds in the final
+    live window, and two same-seed missions are bit-identical
+    INCLUDING the eviction/spill series.
+
+    Oracle note: bit-identity of the live window vs a big-grid oracle
+    is asserted at the STORE level by the fast tests above (a
+    windowed=False twin MISSION is not a trajectory oracle — the
+    planner sees a different map extent and drives a different path).
+
+    The trajectory is a SCRIPTED goal patrol (out +x, back past the
+    spawn to −x, out +x again), not free frontier exploration: on
+    this symmetric corridor the frontier auction's two directions
+    score within float noise of each other, so the pick — frozen
+    per process by XLA CPU codegen — is the one mission input
+    same-seed determinism cannot pin ACROSS processes. Manual goals
+    override frontier assignment in the brain, pinning the path to
+    the step clock while still exercising the full sim/SLAM/window
+    path. Chaos is timed to the patrol: pressure squeezes the host
+    tier while the return leg's shifts evict the outbound columns,
+    the rot fires while the spill holds those tiles, and the third
+    leg drives BACK INTO them — the rehydrate hits the bad CRC,
+    degrades to unknown with a `world_spill_corrupt` flight event,
+    and the mission keeps driving."""
+    from jax_mapping.obs.recorder import flight_recorder
+    from jax_mapping.resilience.faultplan import FaultEvent
+    from jax_mapping.scenarios.lifelong import run_lifelong_mission
+    from jax_mapping.sim import world as W
+
+    base = tiny_config()
+    cfg = base.replace(
+        grid=dataclasses.replace(base.grid, size_cells=768),
+        # 32-cell serving tiles: same 256-cell window (8 tiles — the
+        # suite's compile cache reuses the jits) but a 3-tile margin
+        # band, so recentring triggers after only 1.6 m of travel.
+        serving=dataclasses.replace(base.serving, tile_cells=32),
+        # Odometry-driven tracking: the corridor's aperture problem
+        # makes scan matching slide along the axis, so gate it off.
+        matcher=dataclasses.replace(base.matcher, min_travel_m=1e9),
+        # A 10x-calibration robot (0.3 m/s cruise): sim AND odometry
+        # share the coefficient, so SLAM stays consistent — the stock
+        # 3 cm/s Thymio would need thousands of steps to leave the
+        # window. The lidar shield scales with the speed.
+        robot=dataclasses.replace(base.robot,
+                                  speed_coeff_m_per_unit_s=0.003027,
+                                  speed_noise_frac=0.0,
+                                  lidar_warn_dist_m=0.5,
+                                  lidar_stop_dist_m=0.8),
+        # Estimator-watchdog guardrails off: with the matcher gated
+        # (no relocalization evidence) a single diverge verdict would
+        # quarantine the robot into a permanent coast. The guardrails
+        # have their own suite (test_recovery.py); this gate is about
+        # the memory tier under a DRIVING robot.
+        recovery=dataclasses.replace(base.recovery, enabled=False),
+        world=WorldConfig(windowed=True, window_tiles=8,
+                          margin_tiles=3, host_tile_budget=6,
+                          retention_coarsen=1))
+    # 3.2 m corridor: narrower widths keep the fast robot inside its
+    # own lidar warn band, where the swerve reflex fights the goal
+    # seek and the patrol crawls.
+    world, doors = W.corridor_course(768, cfg.grid.resolution_m,
+                                     corridor_w_m=3.2)
+    steps = 800
+    # Out-and-back-and-out patrol: +x to ~+4.0 m (turn at step 130),
+    # back west across the spawn (turn at 520), then +x again to
+    # ~+7 m. The return leg shifts the window back, evicting the
+    # columns the robot mapped outbound — and leg 3 drives back INTO
+    # those very columns. Goals sit at ±15 m (in-corridor, in-map) so
+    # they are never "reached": the patrol never falls back to
+    # frontier exploration. The +0.9 bias on goal 2 points the return
+    # bearing away from the south wall.
+    goal_script = [(0, 15.0, 0.0), (130, -15.0, 0.9),
+                   (520, 15.0, 0.0)]
+    # Pressure squeezes the host tier across leg 2's shift-back
+    # (~step 265): leg 1's content columns evict past the squeezed
+    # budget into the spill. TWO rots (x=1.6 sits on the recentre
+    # trigger, so leg 2 may re-cross it and rehydrate early — which
+    # empties the spill): one inside the pressure window right after
+    # the shift-back, one during the second back-swing; each fires
+    # while the spill holds real tiles in at least one of the two
+    # wiggle patterns leg 2 exhibits, and every rotted tile is
+    # re-read by a later eastbound re-entry.
+    events = [
+        FaultEvent(step=240, kind="memory_pressure", value=0.7,
+                   duration=150),
+        FaultEvent(step=330, kind="spill_corrupt", value=2.0),
+        FaultEvent(step=500, kind="spill_corrupt", value=2.0),
+    ]
+
+    mark = flight_recorder.mark()
+    rep = run_lifelong_mission(cfg, world, doors, events, steps,
+                               seed=0, n_robots=1,
+                               checkpoint_dir=str(tmp_path / "a"),
+                               goal_script=goal_script)
+    degrades = [e for e in flight_recorder.events_since(mark)
+                if e["kind"] == "world_spill_corrupt"]
+    # Constant-memory gate: the device window never grows, whatever
+    # the traveled distance did (~17 m on a 12.8 m window).
+    window_bytes = (8 * 32) ** 2 * 4
+    assert rep.peak_device_window_bytes() == window_bytes
+    assert all(s["device_window_bytes"] == window_bytes
+               for s in rep.world_series)
+    assert rep.distance_traveled_m > 8.0
+    dists = [s["distance_m"] for s in rep.world_series]
+    assert dists == sorted(dists) and dists[-1] > dists[0]
+    # The window machinery actually ran: recentres (plural origins),
+    # eviction to host/disk on the way.
+    origins = {tuple(s["origin_tile"]) for s in rep.world_series}
+    assert len(origins) >= 2
+    assert max(s["away_tiles"] for s in rep.world_series) > 0
+    assert max(s["spill_tiles"] for s in rep.world_series) > 0
+    # Chaos fired for real: the rot note names actual tiles (not the
+    # "no spilled tiles" skip), and the pressure window cleared.
+    assert any("memory_pressure" in d for _, d in rep.plan_log)
+    assert any("clear: memory_pressure" in d for _, d in rep.plan_log)
+    assert any("spill_corrupt" in d and "tile(s)" in d
+               for _, d in rep.plan_log), rep.plan_log
+    # …and the rotted tiles were READ BACK: re-entry hit the bad
+    # inner CRC, degraded to unknown with a flight event, and the
+    # mission drove on (degrade-never-die at mission scale).
+    assert degrades, "corrupt spill records were never re-read"
+    assert rep.grid.shape == (256, 256)     # the WINDOW, not 768²
+
+    # Map quality through eviction/re-entry/chaos: occupancy sign vs
+    # sim ground truth in the final window slice. (Odometry drift
+    # compresses the estimated frame along the corridor, so this is a
+    # structural gate — the walls sit at fixed y — not exact-pose.)
+    t = cfg.serving.tile_cells
+    r0, c0 = rep.world_series[-1]["origin_tile"]
+    truth = world[r0 * t:r0 * t + 256, c0 * t:c0 * t + 256]
+    known = np.abs(rep.grid) > 0.5
+    assert int(known.sum()) > 3000
+    agree = float(((rep.grid > 0.5) == (truth > 0.5))[known].mean())
+    assert agree >= 0.85, f"sign agreement {agree:.3f}"
+
+    # Same-seed chaos determinism, memory traffic included: the
+    # world_series carries origin/host/spill/away per chunk — the
+    # eviction/spill schedule the gate demands bit-identical.
+    rep2 = run_lifelong_mission(cfg, world, doors, events, steps,
+                                seed=0, n_robots=1,
+                                checkpoint_dir=str(tmp_path / "c"),
+                                goal_script=goal_script)
+    assert rep2.plan_log == rep.plan_log
+    assert rep2.world_series == rep.world_series
+    np.testing.assert_array_equal(rep2.grid, rep.grid)
